@@ -1,0 +1,31 @@
+"""mxnet_tpu — a TPU-native framework with the capabilities of MXNet 1.x.
+
+Built on JAX/XLA/Pallas: XLA async dispatch plays the ThreadedEngine, XLA
+buffer assignment plays PlanMemory, jit plays CachedOp/GraphExecutor, and
+sharding collectives over ICI play KVStore/NCCL. Blueprint: SURVEY.md.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# MXNet supports float64/int64 tensors as first-class dtypes; JAX gates them
+# behind x64. Enable it — all framework defaults remain explicit float32.
+_jax.config.update("jax_enable_x64", True)
+
+from . import base
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus
+from . import ndarray
+from . import ndarray as nd
+from .ndarray.ndarray import NDArray
+from . import autograd
+from . import random
+from . import test_utils
+
+__all__ = [
+    "nd", "ndarray", "autograd", "random", "context", "Context", "cpu",
+    "gpu", "tpu", "NDArray", "MXNetError", "test_utils",
+]

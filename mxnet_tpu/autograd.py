@@ -1,0 +1,369 @@
+"""Tape-based autograd over eager ops.
+
+Reference semantics replicated: ``record()/pause()`` scopes, ``train_mode/
+predict_mode``, ``attach_grad`` leaves, ``backward()`` populating ``.grad``
+honoring ``grad_req`` in {'write','add','null'} (ref: python/mxnet/autograd.py,
+src/imperative/imperative.cc — Imperative::Backward).
+
+TPU-native design: instead of building an nnvm gradient graph, each recorded
+op captures its ``jax.vjp`` closure at invoke time (forward runs once, XLA
+keeps the residuals); ``backward()`` walks the tape in reverse topological
+order calling the stored vjp closures. Hybridized blocks appear on the tape
+as a single CachedOp node whose vjp is the vjp of the whole jitted program —
+the analog of CachedOp::Backward.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "Function",
+    "set_recording",
+    "set_training",
+]
+
+
+class _AGState(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.recording = False
+        self.training = False
+
+
+_state = _AGState()
+
+
+def is_recording():
+    return _state.recording
+
+
+def is_training():
+    return _state.training
+
+
+def set_recording(is_record):
+    prev = _state.recording
+    _state.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode_):
+    prev = _state.training
+    _state.training = bool(train_mode_)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode_):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode_
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = (_state.recording, _state.training)
+        if self._enter_is_record is not None:
+            _state.recording = self._enter_is_record
+        if self._enter_train_mode is not None:
+            _state.training = self._enter_train_mode
+        return self
+
+    def __exit__(self, *args):
+        _state.recording, _state.training = self._prev
+
+
+def record(train_mode=True):
+    """Scope: ops executed inside are recorded for backward."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# --------------------------------------------------------------------------
+# Tape nodes
+# --------------------------------------------------------------------------
+class AGNode:
+    """One recorded op: vjp closure + parent links.
+
+    parents[i] is (AGNode, out_index) for tracked inputs, else None.
+    out_avals: (shape, dtype) per output, for synthesizing zero cotangents.
+    """
+
+    __slots__ = ("vjp_fn", "parents", "out_avals", "name", "_ct", "_seen_out")
+
+    def __init__(self, vjp_fn, parents, out_avals, name=""):
+        self.vjp_fn = vjp_fn
+        self.parents = parents
+        self.out_avals = out_avals
+        self.name = name
+        self._ct = None  # per-output cotangent accumulation during backward
+        self._seen_out = None
+
+    def init_ct(self):
+        self._ct = [None] * len(self.out_avals)
+
+    def add_ct(self, idx, val):
+        if self._ct[idx] is None:
+            self._ct[idx] = val
+        else:
+            self._ct[idx] = self._ct[idx] + val
+
+    def full_ct(self):
+        out = []
+        for i, c in enumerate(self._ct):
+            if c is None:
+                shape, dtype = self.out_avals[i]
+                out.append(jnp.zeros(shape, dtype))
+            else:
+                out.append(c)
+        return tuple(out)
+
+
+class AGLeaf(AGNode):
+    """A variable created by attach_grad/mark_variables."""
+
+    __slots__ = ("array_ref", "grad_req")
+
+    def __init__(self, array_ref, grad_req):
+        super().__init__(None, [], [(array_ref.shape, array_ref.dtype)], name="leaf")
+        self.array_ref = array_ref
+        self.grad_req = grad_req
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Associate gradient buffers with variables
+    (ref: python/mxnet/autograd.py — mark_variables)."""
+    if not isinstance(variables, (list, tuple)):
+        variables = [variables]
+        gradients = [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, gradbuf, req in zip(variables, gradients, grad_reqs):
+        var._grad = gradbuf
+        var._ag_node = (AGLeaf(var, req), 0)
+
+
+def _toposort(root_nodes):
+    order = []
+    visited = set()
+    stack = [(n, False) for n in root_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for p in node.parents:
+            if p is not None and id(p[0]) not in visited:
+                stack.append((p[0], False))
+    return order  # parents-before-children; reverse for backward
+
+
+def _run_backward(heads, head_grads, retain_graph=False, collect=None):
+    """Core reverse pass. If ``collect`` is a list of leaf NDArray refs,
+    returns their cotangents instead of writing ``.grad``."""
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray) or not isinstance(
+        head_grads, (list, tuple)
+    ):
+        head_grads = [head_grads]
+    if len(head_grads) != len(heads):
+        raise ValueError(
+            "head_grads length %d does not match heads length %d"
+            % (len(head_grads), len(heads))
+        )
+
+    roots = []
+    seeds = []
+    for h, hg in zip(heads, head_grads):
+        entry = getattr(h, "_ag_node", None)
+        if entry is None:
+            raise ValueError(
+                "cannot differentiate a head that was not computed inside "
+                "autograd.record() (or lacks attach_grad)"
+            )
+        node, idx = entry
+        roots.append(node)
+        g = jnp.ones(h.shape, h.dtype) if hg is None else (
+            hg.data if isinstance(hg, NDArray) else jnp.asarray(hg)
+        )
+        seeds.append((node, idx, g))
+
+    order = _toposort(roots)
+    for n in order:
+        n.init_ct()
+    for node, idx, g in seeds:
+        node.add_ct(idx, g)
+
+    leaf_cts = {}
+    for node in reversed(order):
+        if isinstance(node, AGLeaf):
+            ct = node._ct[0]
+            if ct is not None:
+                key = id(node.array_ref)
+                if key in leaf_cts:
+                    leaf_cts[key] = (node, leaf_cts[key][1] + ct)
+                else:
+                    leaf_cts[key] = (node, ct)
+            continue
+        if node.vjp_fn is None:
+            continue
+        in_cts = node.vjp_fn(node.full_ct())
+        for parent, ct in zip(node.parents, in_cts):
+            if parent is None:
+                continue
+            # integer/float0 cotangents carry no gradient
+            if hasattr(ct, "dtype") and ct.dtype == jax.dtypes.float0:
+                continue
+            parent[0].add_ct(parent[1], ct)
+        if not retain_graph:
+            node.vjp_fn = None
+        node._ct = None
+
+    if collect is not None:
+        out = []
+        for arr in collect:
+            key = id(arr)
+            if key in leaf_cts:
+                out.append(leaf_cts[key][1])
+            else:
+                out.append(None)
+        return out
+
+    for _, (node, ct) in leaf_cts.items():
+        arr = node.array_ref
+        if node.grad_req == "null":
+            continue
+        if arr._grad is None:
+            continue
+        if node.grad_req == "add":
+            arr._grad._set_data(arr._grad.data + ct.astype(arr._grad.dtype))
+        else:
+            arr._grad._set_data(ct.astype(arr._grad.dtype))
+    return None
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. attached variables
+    (ref: python/mxnet/autograd.py — backward)."""
+    del train_mode  # forward already ran; mode was captured then
+    _run_backward(heads, head_grads, retain_graph=retain_graph)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Return gradients of heads w.r.t. variables without touching ``.grad``
+    (ref: python/mxnet/autograd.py — grad). ``create_graph`` (higher-order)
+    is not supported yet — matches the reference's own '[partial]' support."""
+    del train_mode
+    from .ndarray.ndarray import NDArray
+
+    if create_graph:
+        raise NotImplementedError("create_graph=True not supported yet")
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        single = True
+    else:
+        single = False
+    for v in variables:
+        if getattr(v, "_ag_node", None) is None or not isinstance(v._ag_node[0], AGLeaf):
+            raise ValueError(
+                "variables passed to grad() must have attach_grad() called "
+                "before the recorded computation"
+            )
+    cts = _run_backward(
+        heads, head_grads, retain_graph=bool(retain_graph), collect=variables
+    )
+    outs = []
+    for v, ct in zip(variables, cts):
+        if ct is None:
+            outs.append(NDArray(jnp.zeros(v.shape, v.dtype)))
+        else:
+            outs.append(NDArray(ct.astype(v.dtype)))
+    return outs[0] if single else outs
+
+
+class Function:
+    """Custom differentiable function
+    (ref: python/mxnet/autograd.py — Function).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` using NDArray math. The forward runs
+    with recording paused; backward is invoked during the tape's reverse pass.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, _wrap_outputs
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+
+        if is_recording() and any(getattr(x, "_ag_node", None) for x in inputs):
+            parents = [getattr(x, "_ag_node", None) for x in inputs]
+            out_avals = [(o.shape, o.dtype) for o in outs]
+            fn_self = self
+
+            def vjp_fn(cts):
+                from .ndarray.ndarray import NDArray as ND
+
+                ct_nd = [ND(c) for c in cts]
+                with pause():
+                    in_grads = fn_self.backward(*ct_nd)
+                if not isinstance(in_grads, (list, tuple)):
+                    in_grads = [in_grads]
+                return tuple(
+                    g.data if g is not None else None for g in in_grads
+                )
+
+            node = AGNode(vjp_fn, parents, out_avals, name=type(self).__name__)
+            for i, o in enumerate(outs):
+                o._ag_node = (node, i)
+        return outs[0] if single else outs

@@ -1,0 +1,102 @@
+"""Foundation types shared across the package.
+
+TPU-native re-imagining of the reference's ctypes base layer
+(ref: python/mxnet/base.py — _LIB/check_call/MXNetError). There is no C API
+boundary here: JAX/XLA is the backend, so this module only carries the error
+type, dtype tables, and small helpers.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "MXNetError",
+    "numeric_types",
+    "integer_types",
+    "string_types",
+    "DTYPE_NAME_TO_NP",
+    "NP_TO_DTYPE_NAME",
+    "get_dtype",
+    "dtype_name",
+]
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (ref: python/mxnet/base.py — MXNetError)."""
+
+
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+string_types = (str,)
+
+# MXNet dtype flag order (ref: include/mxnet/base.h / mshadow type flags):
+# 0: float32, 1: float64, 2: float16, 3: uint8, 4: int32, 5: int8, 6: int64,
+# bool and bfloat16 were later additions. We keep the name table and add
+# bfloat16 as a first-class citizen since it is the TPU-preferred dtype.
+try:  # ml_dtypes ships with jax
+    import ml_dtypes as _ml
+
+    _bfloat16 = np.dtype(_ml.bfloat16)
+except Exception:  # pragma: no cover
+    _bfloat16 = np.dtype("float32")
+
+DTYPE_NAME_TO_NP = {
+    "float32": np.dtype(np.float32),
+    "float64": np.dtype(np.float64),
+    "float16": np.dtype(np.float16),
+    "bfloat16": _bfloat16,
+    "uint8": np.dtype(np.uint8),
+    "int32": np.dtype(np.int32),
+    "int8": np.dtype(np.int8),
+    "int64": np.dtype(np.int64),
+    "bool": np.dtype(np.bool_),
+    "int16": np.dtype(np.int16),
+    "uint16": np.dtype(np.uint16),
+    "uint32": np.dtype(np.uint32),
+    "uint64": np.dtype(np.uint64),
+}
+
+NP_TO_DTYPE_NAME = {}
+for _k, _v in DTYPE_NAME_TO_NP.items():
+    # first name wins: if bfloat16 falls back to float32 (no ml_dtypes),
+    # float32 must keep its own name
+    NP_TO_DTYPE_NAME.setdefault(_v, _k)
+
+# MXNet integer type flags, kept for .params/.ndarray binary format parity
+# (ref: src/ndarray/ndarray.cc — NDArray::Save uses mshadow type flags).
+DTYPE_NAME_TO_FLAG = {
+    "float32": 0,
+    "float64": 1,
+    "float16": 2,
+    "uint8": 3,
+    "int32": 4,
+    "int8": 5,
+    "int64": 6,
+    "bool": 7,
+    "int16": 8,
+    "uint16": 9,
+    "uint32": 10,
+    "uint64": 11,
+    "bfloat16": 12,
+}
+DTYPE_FLAG_TO_NAME = {v: k for k, v in DTYPE_NAME_TO_FLAG.items()}
+
+
+def get_dtype(dtype):
+    """Normalize a user-provided dtype (name, np.dtype, or type) to np.dtype."""
+    if dtype is None:
+        return DTYPE_NAME_TO_NP["float32"]
+    if isinstance(dtype, str):
+        if dtype not in DTYPE_NAME_TO_NP:
+            raise MXNetError("unknown dtype %r" % (dtype,))
+        return DTYPE_NAME_TO_NP[dtype]
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """np.dtype → canonical name string."""
+    d = np.dtype(dtype)
+    name = NP_TO_DTYPE_NAME.get(d)
+    if name is None:
+        return d.name
+    return name

@@ -1,0 +1,54 @@
+"""``mx.nd`` — the imperative NDArray namespace.
+
+The reference code-generates this namespace from C-API op metadata at import
+(ref: python/mxnet/ndarray/register.py — _init_op_module). Here the same
+thing happens against the native op registry: every registered op becomes a
+module-level function taking NDArrays.
+"""
+from __future__ import annotations
+
+import functools as _functools
+import sys as _sys
+
+from .ndarray import (
+    NDArray, array, empty, zeros, ones, full, arange, linspace, eye,
+    concatenate, waitall, save, load, zeros_like, ones_like, moveaxis,
+)
+from ..ops import registry as _registry
+from ..ops.registry import apply_op as _apply_op
+
+
+def _make_op_func(op):
+    def fn(*args, **kwargs):
+        return _apply_op(op, *args, **kwargs)
+
+    fn.__name__ = op.name
+    fn.__qualname__ = op.name
+    fn.__doc__ = (op.fn.__doc__ or "") + "\n(registered op: %s)" % op.name
+    return fn
+
+
+_mod = _sys.modules[__name__]
+for _name in _registry.list_ops():
+    _op = _registry.get_op(_name)
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_op_func(_op))
+for _alias, _target in list(_registry._ALIASES.items()):
+    if not hasattr(_mod, _alias):
+        setattr(_mod, _alias, getattr(_mod, _target))
+
+from . import random  # noqa: E402  (needs op funcs above)
+from ..ops.matrix import infer_reshape  # noqa: E402,F401
+
+# creation-op names the reference exposes under nd.*
+maximum = getattr(_mod, "broadcast_maximum")
+minimum = getattr(_mod, "broadcast_minimum")
+add = getattr(_mod, "broadcast_add")
+subtract = getattr(_mod, "broadcast_sub")
+multiply = getattr(_mod, "broadcast_mul")
+divide = getattr(_mod, "broadcast_div")
+power = getattr(_mod, "broadcast_power")
+equal = getattr(_mod, "broadcast_equal")
+not_equal = getattr(_mod, "broadcast_not_equal")
+greater = getattr(_mod, "broadcast_greater")
+lesser = getattr(_mod, "broadcast_lesser")

@@ -1,0 +1,83 @@
+"""``mx.nd.random`` — sampling namespace
+(ref: python/mxnet/ndarray/random.py). Scalar params route to _random_*,
+NDArray params to the _sample_* broadcasting variants, like the reference.
+"""
+from __future__ import annotations
+
+from .ndarray import NDArray
+from ..ops.registry import apply_op
+
+__all__ = ["uniform", "normal", "randn", "randint", "gamma", "exponential",
+           "poisson", "negative_binomial", "multinomial", "shuffle",
+           "bernoulli"]
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None):
+    if isinstance(low, NDArray) or isinstance(high, NDArray):
+        return apply_op("_sample_uniform", low, high, shape=_shape(shape),
+                        dtype=dtype, out=out)
+    return apply_op("_random_uniform", low=low, high=high, shape=_shape(shape),
+                    dtype=dtype, out=out)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None):
+    if isinstance(loc, NDArray) or isinstance(scale, NDArray):
+        return apply_op("_sample_normal", loc, scale, shape=_shape(shape),
+                        dtype=dtype, out=out)
+    return apply_op("_random_normal", loc=loc, scale=scale, shape=_shape(shape),
+                    dtype=dtype, out=out)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None):
+    return normal(loc=loc, scale=scale, shape=shape, dtype=dtype, ctx=ctx)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
+    return apply_op("_random_randint", low=low, high=high, shape=_shape(shape),
+                    dtype=dtype, out=out)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None, out=None):
+    if isinstance(alpha, NDArray) or isinstance(beta, NDArray):
+        return apply_op("_sample_gamma", alpha, beta, shape=_shape(shape),
+                        dtype=dtype, out=out)
+    return apply_op("_random_gamma", alpha=alpha, beta=beta,
+                    shape=_shape(shape), dtype=dtype, out=out)
+
+
+def exponential(scale=1.0, shape=None, dtype=None, ctx=None, out=None):
+    return apply_op("_random_exponential", lam=1.0 / scale,
+                    shape=_shape(shape), dtype=dtype, out=out)
+
+
+def poisson(lam=1.0, shape=None, dtype=None, ctx=None, out=None):
+    return apply_op("_random_poisson", lam=lam, shape=_shape(shape),
+                    dtype=dtype, out=out)
+
+
+def negative_binomial(k=1, p=0.5, shape=None, dtype=None, ctx=None, out=None):
+    return apply_op("_random_negative_binomial", k=k, p=p,
+                    shape=_shape(shape), dtype=dtype, out=out)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", out=None):
+    return apply_op("_sample_multinomial", data,
+                    shape=_shape(shape) if shape is not None else (),
+                    get_prob=get_prob, dtype=dtype, out=out)
+
+
+def shuffle(data, out=None):
+    return apply_op("_shuffle", data, out=out)
+
+
+def bernoulli(prob=0.5, shape=None, dtype="float32", ctx=None, out=None):
+    return apply_op("bernoulli", prob=prob, shape=_shape(shape), dtype=dtype,
+                    out=out)

@@ -1,0 +1,14 @@
+"""Operator library. Importing this package registers all ops
+(analog of the reference's static NNVM_REGISTER_OP registration)."""
+from . import registry
+from . import elemwise
+from . import reduce
+from . import matrix
+from . import indexing
+from . import nn
+from . import random_ops
+from . import rnn
+
+from .registry import apply_op, get_op, list_ops, register, Op
+
+__all__ = ["apply_op", "get_op", "list_ops", "register", "Op"]

@@ -1,0 +1,294 @@
+"""Elementwise binary/unary/scalar ops.
+
+Covers the reference tensor-op families (ref: src/operator/tensor/
+elemwise_binary_broadcast_op*.cc, elemwise_unary_op*.cc,
+elemwise_binary_scalar_op*.cc, src/operator/mshadow_op.h scalar functor zoo).
+Names and semantics follow the reference: comparisons/logicals return the
+input dtype (1.0/0.0), not bool; broadcast_* ops broadcast, elemwise_* require
+equal shapes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+_jsp = jax.scipy.special
+
+
+# --------------------------------------------------------------------------
+# broadcast binary
+# --------------------------------------------------------------------------
+_BINARY = {
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "arctan2": jnp.arctan2,
+}
+_BINARY_ALIASES = {
+    "broadcast_add": ("broadcast_plus",),
+    "broadcast_sub": ("broadcast_minus",),
+}
+
+for _name, _jfn in _BINARY.items():
+
+    def _mk(jfn):
+        def fn(a, b):
+            return jfn(a, b)
+
+        return fn
+
+    register(_name, aliases=_BINARY_ALIASES.get(_name, ()))(_mk(_jfn))
+
+_COMPARE = {
+    "broadcast_equal": jnp.equal,
+    "broadcast_not_equal": jnp.not_equal,
+    "broadcast_greater": jnp.greater,
+    "broadcast_greater_equal": jnp.greater_equal,
+    "broadcast_lesser": jnp.less,
+    "broadcast_lesser_equal": jnp.less_equal,
+    "broadcast_logical_and": jnp.logical_and,
+    "broadcast_logical_or": jnp.logical_or,
+    "broadcast_logical_xor": jnp.logical_xor,
+}
+
+for _name, _jfn in _COMPARE.items():
+
+    def _mkc(jfn):
+        def fn(a, b):
+            return jfn(a, b).astype(a.dtype)
+
+        return fn
+
+    register(_name, differentiable=False)(_mkc(_jfn))
+
+
+# elemwise_* (shape-equal) variants share impls with broadcast on XLA
+@register("elemwise_add", aliases=("_plus", "_add"))
+def elemwise_add(a, b):
+    return jnp.add(a, b)
+
+
+@register("elemwise_sub", aliases=("_minus", "_sub"))
+def elemwise_sub(a, b):
+    return jnp.subtract(a, b)
+
+
+@register("elemwise_mul", aliases=("_mul",))
+def elemwise_mul(a, b):
+    return jnp.multiply(a, b)
+
+
+@register("elemwise_div", aliases=("_div",))
+def elemwise_div(a, b):
+    return jnp.divide(a, b)
+
+
+@register("_power")
+def _power(a, b):
+    return jnp.power(a, b)
+
+
+@register("_maximum")
+def _maximum(a, b):
+    return jnp.maximum(a, b)
+
+
+@register("_minimum")
+def _minimum(a, b):
+    return jnp.minimum(a, b)
+
+
+@register("_mod")
+def _mod(a, b):
+    return jnp.mod(a, b)
+
+
+# --------------------------------------------------------------------------
+# scalar binary
+# --------------------------------------------------------------------------
+def _sc(v, a):
+    return jnp.asarray(v, dtype=a.dtype)
+
+
+@register("_plus_scalar")
+def _plus_scalar(a, scalar=0.0):
+    return a + _sc(scalar, a)
+
+
+@register("_minus_scalar")
+def _minus_scalar(a, scalar=0.0):
+    return a - _sc(scalar, a)
+
+
+@register("_rminus_scalar")
+def _rminus_scalar(a, scalar=0.0):
+    return _sc(scalar, a) - a
+
+
+@register("_mul_scalar")
+def _mul_scalar(a, scalar=1.0):
+    return a * _sc(scalar, a)
+
+
+@register("_div_scalar")
+def _div_scalar(a, scalar=1.0):
+    return a / _sc(scalar, a)
+
+
+@register("_rdiv_scalar")
+def _rdiv_scalar(a, scalar=1.0):
+    return _sc(scalar, a) / a
+
+
+@register("_mod_scalar")
+def _mod_scalar(a, scalar=1.0):
+    return jnp.mod(a, _sc(scalar, a))
+
+
+@register("_rmod_scalar")
+def _rmod_scalar(a, scalar=1.0):
+    return jnp.mod(_sc(scalar, a), a)
+
+
+@register("_power_scalar")
+def _power_scalar(a, scalar=1.0):
+    return jnp.power(a, _sc(scalar, a))
+
+
+@register("_rpower_scalar")
+def _rpower_scalar(a, scalar=1.0):
+    return jnp.power(_sc(scalar, a), a)
+
+
+@register("_maximum_scalar")
+def _maximum_scalar(a, scalar=0.0):
+    return jnp.maximum(a, _sc(scalar, a))
+
+
+@register("_minimum_scalar")
+def _minimum_scalar(a, scalar=0.0):
+    return jnp.minimum(a, _sc(scalar, a))
+
+
+@register("_hypot_scalar")
+def _hypot_scalar(a, scalar=0.0):
+    return jnp.hypot(a, _sc(scalar, a))
+
+
+for _name, _jfn in {
+    "_equal_scalar": jnp.equal,
+    "_not_equal_scalar": jnp.not_equal,
+    "_greater_scalar": jnp.greater,
+    "_greater_equal_scalar": jnp.greater_equal,
+    "_lesser_scalar": jnp.less,
+    "_lesser_equal_scalar": jnp.less_equal,
+    "_logical_and_scalar": jnp.logical_and,
+    "_logical_or_scalar": jnp.logical_or,
+    "_logical_xor_scalar": jnp.logical_xor,
+}.items():
+
+    def _mks(jfn):
+        def fn(a, scalar=0.0):
+            return jfn(a, jnp.asarray(scalar, a.dtype)).astype(a.dtype)
+
+        return fn
+
+    register(_name, differentiable=False)(_mks(_jfn))
+
+
+# --------------------------------------------------------------------------
+# unary
+# --------------------------------------------------------------------------
+def _gamma_fn(x):
+    if hasattr(_jsp, "gamma"):
+        return _jsp.gamma(x)
+    return jnp.exp(_jsp.gammaln(x))
+
+
+_UNARY = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "rint": jnp.rint,
+    "round": jnp.round,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "hard_sigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
+    "softsign": jax.nn.soft_sign,
+    "erf": _jsp.erf,
+    "erfinv": _jsp.erfinv,
+    "gamma": _gamma_fn,
+    "gammaln": _jsp.gammaln,
+    "reciprocal": lambda x: 1.0 / x,
+    "negative": jnp.negative,
+}
+
+_UNARY_NONDIFF = {"sign", "rint", "round", "ceil", "floor", "trunc", "fix"}
+
+for _name, _jfn in _UNARY.items():
+
+    def _mku(jfn):
+        def fn(a):
+            return jfn(a)
+
+        return fn
+
+    register(_name, differentiable=_name not in _UNARY_NONDIFF)(_mku(_jfn))
+
+
+@register("logical_not", differentiable=False)
+def logical_not(a):
+    return jnp.logical_not(a).astype(a.dtype)
+
+
+@register("clip")
+def clip(a, a_min=None, a_max=None):
+    return jnp.clip(a, a_min, a_max)
+
+
+@register("gelu")
+def gelu(a, approximate=True):
+    return jax.nn.gelu(a, approximate=bool(approximate))
+
+
+@register("smooth_l1")
+def smooth_l1(a, scalar=1.0):
+    s2 = float(scalar) ** 2
+    absa = jnp.abs(a)
+    return jnp.where(absa < 1.0 / s2, 0.5 * s2 * jnp.square(a), absa - 0.5 / s2)

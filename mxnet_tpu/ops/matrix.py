@@ -1,0 +1,321 @@
+"""Shape/layout/linear-algebra ops (ref: src/operator/tensor/matrix_op.cc,
+dot.cc, concat.cc, src/operator/slice_channel.cc).
+
+Includes the reference's reshape special codes (0, -1, -2, -3, -4 — ref:
+matrix_op-inl.h ReshapeParam doc) and dot/batch_dot with transpose flags.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+__all__ = ["infer_reshape"]
+
+
+def infer_reshape(src_shape, target_shape, reverse=False):
+    """Resolve a reference-style reshape spec against src_shape.
+
+    Codes: 0 copy input dim; -1 infer one dim; -2 copy all remaining input
+    dims; -3 merge two consecutive input dims; -4 split an input dim into the
+    next two spec values (one may be -1).
+    """
+    src = list(src_shape)
+    if reverse:
+        src = src[::-1]
+        target_shape = tuple(target_shape)[::-1]
+    out = []
+    src_i = 0
+    i = 0
+    tgt = list(target_shape)
+    while i < len(tgt):
+        t = tgt[i]
+        if t == 0:
+            out.append(src[src_i])
+            src_i += 1
+        elif t == -1:
+            out.append(-1)
+            src_i += 1
+        elif t == -2:
+            out.extend(src[src_i:])
+            src_i = len(src)
+        elif t == -3:
+            out.append(src[src_i] * src[src_i + 1])
+            src_i += 2
+        elif t == -4:
+            d1, d2 = tgt[i + 1], tgt[i + 2]
+            if d1 == -1 and d2 == -1:
+                raise ValueError("-4 split cannot infer both dims")
+            if d1 == -1:
+                d1 = src[src_i] // d2
+            if d2 == -1:
+                d2 = src[src_i] // d1
+            out.extend([d1, d2])
+            src_i += 1
+            i += 2
+        else:
+            out.append(t)
+            src_i += 1
+        i += 1
+    # resolve a single -1 against total size
+    total = int(np.prod(src_shape)) if src_shape else 1
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        idx = out.index(-1)
+        out[idx] = total // max(known, 1)
+    if reverse:
+        out = out[::-1]
+    return tuple(int(d) for d in out)
+
+
+@register("reshape", aliases=("Reshape",))
+def reshape(a, shape=None, reverse=False):
+    new_shape = infer_reshape(a.shape, tuple(shape), reverse=bool(reverse))
+    return jnp.reshape(a, new_shape)
+
+
+@register("flatten", aliases=("Flatten",))
+def flatten(a):
+    return jnp.reshape(a, (a.shape[0], -1))
+
+
+@register("transpose")
+def transpose(a, axes=None):
+    if axes is not None and len(axes) == 0:
+        axes = None
+    return jnp.transpose(a, axes)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def swapaxes(a, dim1=0, dim2=0):
+    return jnp.swapaxes(a, dim1, dim2)
+
+
+@register("expand_dims")
+def expand_dims(a, axis=0):
+    return jnp.expand_dims(a, axis)
+
+
+@register("squeeze")
+def squeeze(a, axis=None):
+    return jnp.squeeze(a, axis=axis)
+
+
+@register("broadcast_to")
+def broadcast_to(a, shape=None):
+    # reference: 0 in target shape means keep source dim
+    tgt = tuple(s if t == 0 else t for s, t in zip(a.shape, shape))
+    return jnp.broadcast_to(a, tgt)
+
+
+@register("broadcast_like")
+def broadcast_like(a, b, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(a, b.shape)
+    tgt = list(a.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        tgt[la % a.ndim] = b.shape[ra % b.ndim]
+    return jnp.broadcast_to(a, tuple(tgt))
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(a, axis=(), size=()):
+    if isinstance(axis, int):
+        axis = (axis,)
+    if isinstance(size, int):
+        size = (size,)
+    tgt = list(a.shape)
+    for ax, s in zip(axis, size):
+        tgt[ax % a.ndim] = s
+    return jnp.broadcast_to(a, tuple(tgt))
+
+
+# --------------------------------------------------------------------------
+# slicing
+# --------------------------------------------------------------------------
+def _norm_begin_end(shape, begin, end, step=None):
+    ndim = len(shape)
+    begin = list(begin) + [None] * (ndim - len(begin))
+    end = list(end) + [None] * (ndim - len(end))
+    step = list(step) + [None] * (ndim - len(step)) if step is not None else [None] * ndim
+    slices = []
+    for b, e, s in zip(begin, end, step):
+        slices.append(slice(b, e, s))
+    return tuple(slices)
+
+
+@register("slice", aliases=("crop",))
+def slice_op(a, begin=(), end=(), step=None):
+    return a[_norm_begin_end(a.shape, begin, end, step)]
+
+
+@register("slice_axis")
+def slice_axis(a, axis=0, begin=0, end=None):
+    idx = [slice(None)] * a.ndim
+    idx[axis % a.ndim] = slice(begin, end)
+    return a[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(a, b, axes=()):
+    idx = [slice(None)] * a.ndim
+    if not axes:
+        axes = tuple(range(b.ndim))
+    for ax in axes:
+        idx[ax % a.ndim] = slice(0, b.shape[ax % b.ndim])
+    return a[tuple(idx)]
+
+
+@register("concat", aliases=("Concat",))
+def concat(*args, dim=1, num_args=None):
+    del num_args
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack")
+def stack(*args, axis=0, num_args=None):
+    del num_args
+    return jnp.stack(args, axis=axis)
+
+
+@register("split", aliases=("SliceChannel",))
+def split(a, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(a, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    if num_outputs == 1:
+        return parts[0]
+    return tuple(parts)
+
+
+@register("split_v2")
+def split_v2(a, indices_or_sections=1, axis=0, squeeze_axis=False):
+    if isinstance(indices_or_sections, tuple):
+        parts = jnp.split(a, list(indices_or_sections), axis=axis)
+    else:
+        parts = jnp.split(a, indices_or_sections, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register("tile")
+def tile(a, reps=()):
+    return jnp.tile(a, tuple(reps))
+
+
+@register("repeat")
+def repeat(a, repeats=1, axis=None):
+    return jnp.repeat(a, repeats, axis=axis)
+
+
+@register("flip", aliases=("reverse",))
+def flip(a, axis=()):
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(a, axis=tuple(axis))
+
+
+@register("pad", aliases=("Pad",))
+def pad(a, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(a.ndim)]
+    if mode == "constant":
+        return jnp.pad(a, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(a, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(a, pw, mode="reflect")
+    raise ValueError("unknown pad mode %r" % (mode,))
+
+
+@register("where")
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("diag")
+def diag(a, k=0):
+    if a.ndim == 1:
+        return jnp.diag(a, k=k)
+    return jnp.diagonal(a, offset=k, axis1=-2, axis2=-1)
+
+
+# --------------------------------------------------------------------------
+# dot / batch_dot — the MXU path
+# --------------------------------------------------------------------------
+@register("dot")
+def dot(a, b, transpose_a=False, transpose_b=False):
+    """N-D dot: contract last axis of a with first axis of b
+    (ref: src/operator/tensor/dot-inl.h). Lowers to dot_general → MXU."""
+    if transpose_a:
+        a = jnp.transpose(a)
+    if transpose_b:
+        b = jnp.transpose(b)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao")
+def khatri_rao(*args):
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(
+            out.shape[0] * m.shape[0], *out.shape[1:]
+        )
+    return out
+
+
+@register("L2Normalization")
+def l2_normalization(a, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        ax = tuple(range(1, a.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    elif mode == "spatial":
+        ax = tuple(range(2, a.ndim))
+    else:
+        raise ValueError("unknown mode %r" % (mode,))
+    nrm = jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=True) + eps)
+    return a / nrm
+
+
+@register("norm_like_cast", aliases=("cast", "Cast"))
+def cast(a, dtype="float32"):
+    from ..base import get_dtype
+
+    return a.astype(get_dtype(dtype))
+
+
+@register("zeros_like")
+def zeros_like(a):
+    return jnp.zeros_like(a)
+
+
+@register("ones_like")
+def ones_like(a):
+    return jnp.ones_like(a)
+
+
+@register("shape_array", differentiable=False)
+def shape_array(a):
+    return jnp.asarray(a.shape, dtype=jnp.int64)
+
+
+@register("size_array", differentiable=False)
+def size_array(a):
+    return jnp.asarray([a.size], dtype=jnp.int64)

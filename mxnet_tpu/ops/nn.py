@@ -1,0 +1,457 @@
+"""Neural-net ops — the reference's hot kernels, rebuilt on XLA
+(ref: src/operator/nn/*: convolution, fully_connected, batch_norm, pooling,
+softmax, dropout, layer_norm; cuDNN paths become lax.conv_general_dilated /
+dot_general / reduce_window, which XLA tiles onto the MXU/VPU).
+
+Layout note: the reference defaults to NCHW. All ops accept ``layout`` and
+the model zoo uses NHWC on TPU (better MXU tiling); NCHW stays the API
+default for parity.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from .. import random as _random
+
+
+@register("FullyConnected", aliases=("fully_connected",))
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    """ref: src/operator/nn/fully_connected.cc. weight is (num_hidden, in)."""
+    del num_hidden
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    out = jax.lax.dot_general(
+        x, weight,
+        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    ).astype(x.dtype)
+    if not no_bias and bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out
+
+
+def _conv_dn(layout, nd):
+    if layout in (None, "NCHW", "NCW", "NCDHW"):
+        lhs = "NC" + "DHW"[3 - nd:]
+        out = lhs
+    elif layout in ("NHWC", "NWC", "NDHWC"):
+        lhs = "N" + "DHW"[3 - nd:] + "C"
+        out = lhs
+    else:
+        raise ValueError("unsupported layout %r" % (layout,))
+    rhs = "OI" + "DHW"[3 - nd:]
+    return (lhs, rhs, out)
+
+
+@register("Convolution", aliases=("convolution",))
+def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                pad=(), num_filter=0, num_group=1, no_bias=False,
+                layout=None, workspace=None, cudnn_tune=None, cudnn_off=None):
+    """ref: src/operator/nn/convolution.cc (+cudnn path). Weight logical
+    layout is OIHW regardless of data layout, matching the reference."""
+    del num_filter, workspace, cudnn_tune, cudnn_off
+    nd = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nd
+    dilate = tuple(dilate) if dilate else (1,) * nd
+    pad = tuple(pad) if pad else (0,) * nd
+    dn = _conv_dn(layout, nd)
+    out = jax.lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+    ).astype(data.dtype)
+    if not no_bias and bias is not None:
+        c_ax = dn[2].index("C")
+        shape = [1] * out.ndim
+        shape[c_ax] = bias.shape[0]
+        out = out + bias.reshape(shape).astype(out.dtype)
+    return out
+
+
+@register("Deconvolution")
+def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                  pad=(), adj=(), num_filter=0, num_group=1, no_bias=False,
+                  layout=None, target_shape=None, workspace=None):
+    """ref: src/operator/nn/deconvolution.cc — transposed conv. weight is
+    (in_ch, out_ch/group, kH, kW) in the reference; we honor that."""
+    del num_filter, target_shape, workspace
+    nd = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nd
+    dilate = tuple(dilate) if dilate else (1,) * nd
+    pad = tuple(pad) if pad else (0,) * nd
+    adj = tuple(adj) if adj else (0,) * nd
+    dn = _conv_dn(layout, nd)
+    # transposed conv = lhs-dilated conv with flipped kernel, IO swapped
+    kern = jnp.swapaxes(weight, 0, 1)
+    kern = jnp.flip(kern, axis=tuple(range(2, 2 + nd)))
+    pads = [
+        (dilate[i] * (kernel[i] - 1) - pad[i],
+         dilate[i] * (kernel[i] - 1) - pad[i] + adj[i])
+        for i in range(nd)
+    ]
+    if num_group != 1:
+        raise NotImplementedError("grouped deconvolution not yet supported")
+    out = jax.lax.conv_general_dilated(
+        data, kern,
+        window_strides=(1,) * nd,
+        padding=pads,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+    )
+    if not no_bias and bias is not None:
+        c_ax = dn[2].index("C")
+        shape = [1] * out.ndim
+        shape[c_ax] = bias.shape[0]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register("Activation", aliases=("activation",))
+def activation_op(data, act_type="relu"):
+    if act_type == "relu":
+        return jnp.maximum(data, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "gelu_tanh":
+        return jax.nn.gelu(data, approximate=True)
+    if act_type == "silu" or act_type == "swish":
+        return jax.nn.silu(data)
+    raise ValueError("unknown act_type %r" % (act_type,))
+
+
+@register("LeakyReLU")
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, alpha * jnp.expm1(data))
+    if act_type == "prelu":
+        g = gamma
+        shape = [1] * data.ndim
+        if g.ndim == 1 and data.ndim > 1:
+            shape[1] = g.shape[0]
+            g = g.reshape(shape)
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data >= 0, data, mid * data)
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    raise ValueError("unknown act_type %r" % (act_type,))
+
+
+@register("softmax")
+def softmax(data, axis=-1, temperature=None, length=None):
+    x = data
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if length is not None:
+        # mask positions >= length along `axis` (reference masked softmax)
+        idx = jnp.arange(x.shape[axis])
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        mask = idx.reshape(shape) < length.reshape(
+            length.shape + (1,) * (x.ndim - length.ndim)
+        )
+        x = jnp.where(mask, x, -jnp.inf)
+        out = jax.nn.softmax(x, axis=axis)
+        return jnp.where(mask, out, 0.0)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(data, axis=-1, temperature=None):
+    x = data
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmin")
+def softmin(data, axis=-1):
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lbl = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, lbl[:, None], axis=-1)
+    return -jnp.sum(picked)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        multi_output, normalization):
+    out = jax.nn.softmax(data, axis=-1 if not multi_output else 1)
+    return out, (out, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, multi_output,
+                        normalization, res, ct):
+    out, label = res
+    ax = 1 if multi_output else -1
+    lbl = label.astype(jnp.int32)
+    oh = jax.nn.one_hot(lbl, out.shape[ax], dtype=out.dtype, axis=ax)
+    g = out - oh
+    if use_ignore:
+        keep = (lbl != int(ignore_label)).astype(out.dtype)
+        g = g * jnp.expand_dims(keep, ax)
+    scale = grad_scale
+    if normalization == "batch":
+        scale = scale / out.shape[0]
+    elif normalization == "valid" and use_ignore:
+        scale = scale / jnp.maximum((lbl != int(ignore_label)).sum(), 1)
+    g = g * scale
+    return (g, jnp.zeros_like(label))
+
+
+_softmax_output_core = jax.custom_vjp(
+    lambda data, label, grad_scale, ignore_label, use_ignore, multi_output,
+    normalization: _softmax_output_fwd(
+        data, label, grad_scale, ignore_label, use_ignore, multi_output,
+        normalization)[0],
+    nondiff_argnums=(2, 3, 4, 5, 6),
+)
+_softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+@register("SoftmaxOutput", aliases=("Softmax",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   use_ignore=False, multi_output=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0):
+    """Legacy fused softmax+CE-grad op (ref: src/operator/softmax_output.cc):
+    forward is softmax; backward emits (p - onehot(label)) * grad_scale
+    regardless of incoming cotangent — reproduced with jax.custom_vjp."""
+    del preserve_shape, out_grad, smooth_alpha
+    return _softmax_output_core(
+        data, label, float(grad_scale), float(ignore_label), bool(use_ignore),
+        bool(multi_output), str(normalization)
+    )
+
+
+@register("Dropout")
+def dropout(data, p=0.5, mode="training", axes=(), train_mode=False):
+    """ref: src/operator/nn/dropout.cc. ``train_mode`` is threaded by the
+    caller (gluon layer reads autograd.is_training())."""
+    if p <= 0 or (not train_mode and mode != "always"):
+        return data
+    shape = list(data.shape)
+    for ax in axes:
+        shape[ax] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(_random.new_key(), keep, tuple(shape))
+    return jnp.where(mask, data / keep, 0.0).astype(data.dtype)
+
+
+# --------------------------------------------------------------------------
+# normalization
+# --------------------------------------------------------------------------
+@register("BatchNorm", aliases=("batch_norm", "BatchNorm_v1"), num_outputs=3)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False,
+               train_mode=False):
+    """ref: src/operator/nn/batch_norm.cc. Returns (out, mean, var); in
+    training mode mean/var are the *updated running stats* the layer writes
+    back (the reference mutates aux states in-place inside the kernel)."""
+    del output_mean_var, cudnn_off
+    ax = axis % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if train_mode and not use_global_stats:
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=red)
+        var = jnp.var(x32, axis=red)
+        new_mean = momentum * moving_mean + (1 - momentum) * mean
+        new_var = momentum * moving_var + (1 - momentum) * var
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    inv = jax.lax.rsqrt(var + eps)
+    out = (data.astype(jnp.float32) - mean.reshape(shape)) * (
+        inv * g.astype(jnp.float32)
+    ).reshape(shape) + beta.astype(jnp.float32).reshape(shape)
+    return (out.astype(data.dtype),
+            jax.lax.stop_gradient(new_mean),
+            jax.lax.stop_gradient(new_var))
+
+
+@register("LayerNorm", aliases=("layer_norm",))
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
+    """ref: src/operator/nn/layer_norm.cc — normalizes along one axis."""
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.var(x32, axis=axis, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    ax = axis % data.ndim
+    shape[ax] = data.shape[ax]
+    out = (x32 - mean) * inv * gamma.astype(jnp.float32).reshape(shape) + \
+        beta.astype(jnp.float32).reshape(shape)
+    return out.astype(data.dtype)
+
+
+@register("InstanceNorm")
+def instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    shape = [1, data.shape[1]] + [1] * (data.ndim - 2)
+    return (data - mean) * jax.lax.rsqrt(var + eps) * gamma.reshape(shape) + \
+        beta.reshape(shape)
+
+
+@register("GroupNorm")
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    n, c = data.shape[0], data.shape[1]
+    rest = data.shape[2:]
+    x = data.reshape((n, num_groups, c // num_groups) + rest)
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    shape = [1, c] + [1] * (data.ndim - 2)
+    return x * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("LRN")
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """ref: src/operator/nn/lrn.cc — cross-channel local response norm."""
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2))
+    acc = sum(
+        jax.lax.dynamic_slice_in_dim(padded, i, data.shape[1], axis=1)
+        for i in range(nsize)
+    )
+    return data / jnp.power(knorm + alpha * acc / nsize, beta)
+
+
+# --------------------------------------------------------------------------
+# pooling (ref: src/operator/nn/pooling.cc) — lax.reduce_window
+# --------------------------------------------------------------------------
+@register("Pooling", aliases=("pooling", "Pooling_v1"))
+def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
+            pad=(), pooling_convention="valid", count_include_pad=True,
+            cudnn_off=False, p_value=2, layout=None):
+    del cudnn_off
+    if layout in (None, "NCHW", "NCW", "NCDHW"):
+        spatial = tuple(range(2, data.ndim))
+    else:
+        spatial = tuple(range(1, data.ndim - 1))
+    nd = len(spatial)
+    if global_pool:
+        kernel = tuple(data.shape[ax] for ax in spatial)
+        stride = (1,) * nd
+        pad = (0,) * nd
+    else:
+        kernel = tuple(kernel)
+        stride = tuple(stride) if stride else (1,) * nd
+        pad = tuple(pad) if pad else (0,) * nd
+
+    window = [1] * data.ndim
+    strides = [1] * data.ndim
+    pads = [(0, 0)] * data.ndim
+    for i, ax in enumerate(spatial):
+        window[ax] = kernel[i]
+        strides[ax] = stride[i]
+        if pooling_convention == "full":
+            # ceil-mode: add extra right padding so the last window fits
+            in_sz = data.shape[ax] + 2 * pad[i]
+            rem = (in_sz - kernel[i]) % stride[i]
+            extra = (stride[i] - rem) % stride[i] if rem else 0
+            pads[ax] = (pad[i], pad[i] + extra)
+        else:
+            pads[ax] = (pad[i], pad[i])
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
+            jnp.iinfo(data.dtype).min
+        return jax.lax.reduce_window(
+            data, jnp.asarray(init, data.dtype), jax.lax.max,
+            window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        s = jax.lax.reduce_window(
+            data, jnp.asarray(0, data.dtype), jax.lax.add,
+            window, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            denom = 1
+            for k in kernel:
+                denom *= k
+            return s / jnp.asarray(denom, data.dtype)
+        ones = jnp.ones(data.shape, data.dtype)
+        counts = jax.lax.reduce_window(
+            ones, jnp.asarray(0, data.dtype), jax.lax.add,
+            window, strides, pads)
+        return s / counts
+    if pool_type == "lp":
+        s = jax.lax.reduce_window(
+            jnp.power(jnp.abs(data), p_value), jnp.asarray(0, data.dtype),
+            jax.lax.add, window, strides, pads)
+        return jnp.power(s, 1.0 / p_value)
+    raise ValueError("unknown pool_type %r" % (pool_type,))
+
+
+@register("UpSampling")
+def upsampling(data, scale=1, sample_type="nearest", num_args=1):
+    del num_args
+    if sample_type != "nearest":
+        raise NotImplementedError("only nearest upsampling supported")
+    for ax in (2, 3):
+        data = jnp.repeat(data, scale, axis=ax)
+    return data
+
+
+@register("BilinearSampler")
+def bilinear_sampler(data, grid):
+    """ref: src/operator/bilinear_sampler.cc — grid in [-1, 1] NCHW."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1) * (w - 1) / 2
+    gy = (grid[:, 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yi, xi):
+        yi = jnp.clip(yi.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xi.astype(jnp.int32), 0, w - 1)
+        flat = data.reshape(n, c, h * w)
+        idx = (yi * w + xi).reshape(n, -1)
+        out = jnp.take_along_axis(flat, idx[:, None, :], axis=2)
+        return out.reshape(n, c, *gx.shape[1:])
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wx = wx[:, None]
+    wy = wy[:, None]
+    return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+            + v10 * (1 - wx) * wy + v11 * wx * wy)

@@ -1,0 +1,131 @@
+"""Random sampling ops (ref: src/operator/random/sample_op.cc).
+
+Backed by jax.random with keys drawn from the stateful facade in
+mxnet_tpu.random — eager calls consume the global key; traced calls fold a
+counter into the scope key (see random.key_scope).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from .. import random as _random
+from ..base import get_dtype
+
+
+def _dt(dtype):
+    return get_dtype(dtype if dtype not in (None, "None") else "float32")
+
+
+@register("_random_uniform", aliases=("uniform",), differentiable=False)
+def random_uniform(low=0.0, high=1.0, shape=(), dtype=None, ctx=None):
+    del ctx
+    return jax.random.uniform(
+        _random.new_key(), tuple(shape), _dt(dtype), minval=low, maxval=high
+    )
+
+
+@register("_random_normal", aliases=("normal",), differentiable=False)
+def random_normal(loc=0.0, scale=1.0, shape=(), dtype=None, ctx=None):
+    del ctx
+    return loc + scale * jax.random.normal(_random.new_key(), tuple(shape), _dt(dtype))
+
+
+@register("_random_gamma", differentiable=False)
+def random_gamma(alpha=1.0, beta=1.0, shape=(), dtype=None, ctx=None):
+    del ctx
+    return beta * jax.random.gamma(_random.new_key(), alpha, tuple(shape), _dt(dtype))
+
+
+@register("_random_exponential", differentiable=False)
+def random_exponential(lam=1.0, shape=(), dtype=None, ctx=None):
+    del ctx
+    return jax.random.exponential(_random.new_key(), tuple(shape), _dt(dtype)) / lam
+
+
+@register("_random_poisson", differentiable=False)
+def random_poisson(lam=1.0, shape=(), dtype=None, ctx=None):
+    del ctx
+    return jax.random.poisson(_random.new_key(), lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial", differentiable=False)
+def random_negative_binomial(k=1, p=0.5, shape=(), dtype=None, ctx=None):
+    del ctx
+    key1, key2 = jax.random.split(_random.new_key())
+    g = jax.random.gamma(key1, k, tuple(shape)) * (1 - p) / p
+    return jax.random.poisson(key2, g, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_randint", aliases=("randint",), differentiable=False)
+def random_randint(low=0, high=1, shape=(), dtype="int32", ctx=None):
+    del ctx
+    return jax.random.randint(
+        _random.new_key(), tuple(shape), int(low), int(high)
+    ).astype(_dt(dtype))
+
+
+@register("_sample_uniform", differentiable=False)
+def sample_uniform(low, high, shape=(), dtype=None):
+    u = jax.random.uniform(
+        _random.new_key(), low.shape + tuple(shape), _dt(dtype)
+    )
+    low_ = low.reshape(low.shape + (1,) * len(shape)).astype(u.dtype)
+    high_ = high.reshape(high.shape + (1,) * len(shape)).astype(u.dtype)
+    return low_ + u * (high_ - low_)
+
+
+@register("_sample_normal", differentiable=False)
+def sample_normal(mu, sigma, shape=(), dtype=None):
+    z = jax.random.normal(_random.new_key(), mu.shape + tuple(shape), _dt(dtype))
+    return mu.reshape(mu.shape + (1,) * len(shape)).astype(z.dtype) + \
+        sigma.reshape(sigma.shape + (1,) * len(shape)).astype(z.dtype) * z
+
+
+@register("_sample_gamma", differentiable=False)
+def sample_gamma(alpha, beta, shape=(), dtype=None):
+    a = alpha.reshape(alpha.shape + (1,) * len(shape))
+    g = jax.random.gamma(
+        _random.new_key(), a, alpha.shape + tuple(shape), _dt(dtype)
+    )
+    return g * beta.reshape(beta.shape + (1,) * len(shape)).astype(g.dtype)
+
+
+@register("_sample_multinomial", aliases=("sample_multinomial",),
+          differentiable=False)
+def sample_multinomial(data, shape=(), get_prob=False, dtype="int32"):
+    """Sample category indices from probability rows
+    (ref: src/operator/random/multisample_op.cc)."""
+    n = 1
+    for s in shape if isinstance(shape, tuple) else (shape,):
+        n *= int(s)
+    n = max(n, 1)
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    samp = jax.random.categorical(
+        _random.new_key(), logits[..., None, :], axis=-1,
+        shape=data.shape[:-1] + (n,)
+    )
+    out_shape = data.shape[:-1] + (tuple(shape) if isinstance(shape, tuple) else (shape,))
+    if shape == () or shape == 1:
+        out_shape = data.shape[:-1]
+    samp = samp.reshape(out_shape).astype(_dt(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jnp.log(jnp.maximum(data, 1e-37)),
+            samp.astype(jnp.int32).reshape(data.shape[:-1] + (-1,)), axis=-1
+        ).reshape(out_shape)
+        return (samp, lp)
+    return samp
+
+
+@register("_shuffle", aliases=("shuffle",), differentiable=False)
+def shuffle(data):
+    return jax.random.permutation(_random.new_key(), data, axis=0)
+
+
+@register("bernoulli", differentiable=False)
+def bernoulli(prob=0.5, shape=(), dtype="float32"):
+    return jax.random.bernoulli(
+        _random.new_key(), prob, tuple(shape)
+    ).astype(_dt(dtype))

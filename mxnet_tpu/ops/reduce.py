@@ -1,0 +1,73 @@
+"""Reduction ops (ref: src/operator/tensor/broadcast_reduce_op_value.cc).
+
+Reference semantics: ``axis`` may be int/tuple/None, ``keepdims`` bool,
+``exclude=True`` reduces over every axis *not* listed.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _norm_axis(axis, ndim, exclude):
+    if axis is None:
+        ax = None
+    else:
+        if isinstance(axis, int):
+            axis = (axis,)
+        ax = tuple(a % ndim for a in axis)
+    if exclude:
+        if ax is None:
+            ax = ()
+        ax = tuple(i for i in range(ndim) if i not in ax)
+    return ax
+
+
+def _mk_reduce(jfn):
+    def fn(a, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis, a.ndim, exclude)
+        return jfn(a, axis=ax, keepdims=bool(keepdims))
+
+    return fn
+
+
+register("sum", aliases=("sum_axis",))(_mk_reduce(jnp.sum))
+register("mean")(_mk_reduce(jnp.mean))
+register("prod")(_mk_reduce(jnp.prod))
+register("nansum")(_mk_reduce(jnp.nansum))
+register("nanprod")(_mk_reduce(jnp.nanprod))
+register("max", aliases=("max_axis",))(_mk_reduce(jnp.max))
+register("min", aliases=("min_axis",))(_mk_reduce(jnp.min))
+
+
+@register("argmax", differentiable=False)
+def argmax(a, axis=None, keepdims=False):
+    out = jnp.argmax(a, axis=axis, keepdims=bool(keepdims))
+    return out.astype(jnp.float32)  # reference returns real dtype indices
+
+
+@register("argmin", differentiable=False)
+def argmin(a, axis=None, keepdims=False):
+    out = jnp.argmin(a, axis=axis, keepdims=bool(keepdims))
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel", differentiable=False)
+def argmax_channel(a):
+    return jnp.argmax(a, axis=1).astype(jnp.float32)
+
+
+@register("norm")
+def norm(a, ord=2, axis=None, keepdims=False):
+    ax = axis if axis is None or isinstance(axis, int) else tuple(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(a), axis=ax, keepdims=bool(keepdims))
+    return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=bool(keepdims)))
+
+
+@register("logsumexp")
+def logsumexp(a, axis=None, keepdims=False):
+    import jax.scipy.special as jsp
+
+    return jsp.logsumexp(a, axis=axis, keepdims=bool(keepdims))

@@ -1,0 +1,137 @@
+"""Operator registry — one registry serves both execution modes.
+
+Mirrors the reference's nnvm op registry role (ref: src/operator/** —
+NNVM_REGISTER_OP; invariant: imperative Invoke and symbolic GraphExecutor
+dispatch the same registered ops). Here each op is a *pure JAX function*
+``fn(*jax_arrays, **static_params) -> array | tuple``:
+
+- imperative mode calls it eagerly (XLA async dispatch plays ThreadedEngine);
+- autograd records its ``jax.vjp`` closure (plays FGradient);
+- hybridize/Symbol trace through it into one XLA program (plays CachedOp /
+  GraphExecutor).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+__all__ = ["Op", "register", "get_op", "list_ops", "apply_op"]
+
+_OPS: dict[str, "Op"] = {}
+_ALIASES: dict[str, str] = {}
+
+
+class Op:
+    __slots__ = ("name", "fn", "differentiable", "num_outputs", "wrt")
+
+    def __init__(self, name, fn, differentiable=True, num_outputs=1, wrt=None):
+        self.name = name
+        self.fn = fn
+        self.differentiable = differentiable
+        self.num_outputs = num_outputs
+        # indices of array inputs that can carry gradient (None = all)
+        self.wrt = wrt
+
+    def __repr__(self):
+        return "Op(%s)" % self.name
+
+
+def register(name, aliases=(), differentiable=True, num_outputs=1, wrt=None):
+    """Decorator: register ``fn`` under a reference op name."""
+
+    def deco(fn):
+        op = Op(name, fn, differentiable=differentiable,
+                num_outputs=num_outputs, wrt=wrt)
+        _OPS[name] = op
+        for a in aliases:
+            _ALIASES[a] = name
+        fn._mxt_op = op
+        return fn
+
+    return deco
+
+
+def get_op(name) -> Op:
+    if name in _OPS:
+        return _OPS[name]
+    if name in _ALIASES:
+        return _OPS[_ALIASES[name]]
+    raise KeyError("operator %r is not registered" % (name,))
+
+
+def list_ops():
+    return sorted(_OPS)
+
+
+def _normalize_kwargs(kwargs):
+    out = {}
+    for k, v in kwargs.items():
+        if isinstance(v, list):
+            v = tuple(v)
+        out[k] = v
+    return out
+
+
+def apply_op(op, *inputs, out=None, **kwargs):
+    """Invoke a registered op on NDArrays (imperative path).
+
+    Plays Imperative::Invoke (ref: src/imperative/imperative.cc): unwrap to
+    jax.Array, run the pure fn (recording the vjp closure when autograd is
+    on), wrap outputs. Returns NDArray or tuple of NDArray.
+    """
+    from .. import autograd as ag
+    from ..ndarray.ndarray import NDArray
+
+    if isinstance(op, str):
+        op = get_op(op)
+    kwargs = _normalize_kwargs(kwargs)
+    raw = [x.data if isinstance(x, NDArray) else x for x in inputs]
+    fn = functools.partial(op.fn, **kwargs) if kwargs else op.fn
+
+    parents = None
+    if ag.is_recording() and op.differentiable:
+        parents = [
+            getattr(x, "_ag_node", None) if isinstance(x, NDArray) else None
+            for x in inputs
+        ]
+        if not any(parents):
+            parents = None
+
+    if parents is not None:
+        out_raw, vjp_fn = jax.vjp(fn, *raw)
+    else:
+        out_raw = fn(*raw)
+
+    multi = isinstance(out_raw, tuple)
+    outs_raw = list(out_raw) if multi else [out_raw]
+
+    node = None
+    if parents is not None:
+        if multi:
+            wrapped_vjp = vjp_fn
+        else:
+            def wrapped_vjp(cts, _vjp=vjp_fn):
+                return _vjp(cts[0])
+
+        node = ag.AGNode(
+            wrapped_vjp, parents, [(o.shape, o.dtype) for o in outs_raw],
+            name=op.name,
+        )
+
+    results = []
+    for i, o in enumerate(outs_raw):
+        nd = NDArray(o)
+        if node is not None:
+            nd._ag_node = (node, i)
+        results.append(nd)
+
+    if out is not None:
+        if multi:
+            raise ValueError("out= not supported for multi-output op %s" % op.name)
+        out._set_data(results[0].data)
+        # rebind history too: stale nodes would feed backward from the
+        # overwritten computation
+        out._ag_node = (node, 0) if node is not None else None
+        return out
+    return tuple(results) if multi else results[0]

@@ -1,0 +1,155 @@
+"""Fused multi-layer RNN op (ref: src/operator/rnn.cc, cudnn_rnn-inl.h).
+
+The reference runs the whole multi-layer LSTM/GRU/RNN over the sequence in
+one cuDNN call with a packed flat weight vector. TPU-native equivalent: one
+``lax.scan`` per layer inside a single traced program — XLA fuses the cell,
+keeps weights resident, and the scan compiles to a tight loop feeding the
+MXU with (B, gates*H) matmuls.
+
+Packed layout (cuDNN-compatible ordering, gate order LSTM=[i,f,g,o],
+GRU=[r,z,n]): for each layer, for each direction: W_i2h(G*H, in), then
+W_h2h(G*H, H); after ALL weights, for each layer/direction: b_i2h(G*H),
+b_h2h(G*H).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers=1,
+                   bidirectional=False):
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    total = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else h * d
+        total += d * (g * h * in_sz + g * h * h)  # weights
+        total += d * (2 * g * h)  # biases
+    return total
+
+
+def _unpack(params, mode, input_size, state_size, num_layers, bidirectional):
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    ws, bs = [], []
+    off = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else h * d
+        lw = []
+        for _ in range(d):
+            wi = params[off:off + g * h * in_sz].reshape(g * h, in_sz)
+            off += g * h * in_sz
+            wh = params[off:off + g * h * h].reshape(g * h, h)
+            off += g * h * h
+            lw.append((wi, wh))
+        ws.append(lw)
+    for layer in range(num_layers):
+        lb = []
+        for _ in range(d):
+            bi = params[off:off + g * h]
+            off += g * h
+            bh = params[off:off + g * h]
+            off += g * h
+            lb.append((bi, bh))
+        bs.append(lb)
+    return ws, bs
+
+
+def _cell_step(mode, h_size):
+    if mode == "lstm":
+        def step(carry, gates_x, wh, bh):
+            h, c = carry
+            gates = gates_x + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c2 = f * c + i * g
+            h2 = o * jnp.tanh(c2)
+            return (h2, c2), h2
+    elif mode == "gru":
+        def step(carry, gates_x, wh, bh):
+            (h,) = carry
+            gh = h @ wh.T + bh
+            xr, xz, xn = jnp.split(gates_x, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h2 = (1 - z) * n + z * h
+            return (h2,), h2
+    else:
+        act = jnp.tanh if mode == "rnn_tanh" else (lambda x: jnp.maximum(x, 0))
+
+        def step(carry, gates_x, wh, bh):
+            (h,) = carry
+            h2 = act(gates_x + h @ wh.T + bh)
+            return (h2,), h2
+    return step
+
+
+def _run_layer(x, mode, wi, wh, bi, bh, h0, c0, reverse=False):
+    """x: (T, B, in) → (T, B, H). Pre-computes the input projection for the
+    whole sequence as ONE big matmul (MXU-friendly), scanning only the
+    recurrent part."""
+    gates_x = jnp.einsum("tbi,gi->tbg", x, wi) + bi  # (T, B, G*H)
+    step = _cell_step(mode, wh.shape[1])
+    carry = (h0, c0) if mode == "lstm" else (h0,)
+
+    def body(carry, gx):
+        return step(carry, gx, wh, bh)
+
+    carry, outs = jax.lax.scan(body, carry, gates_x, reverse=reverse)
+    return carry, outs
+
+
+@register("RNN", num_outputs=3)
+def rnn_op(data, parameters, state, state_cell=None, mode="lstm",
+           state_size=0, num_layers=1, bidirectional=False, p=0.0,
+           state_outputs=False, projection_size=None, lstm_state_clip_min=None,
+           lstm_state_clip_max=None, lstm_state_clip_nan=False,
+           use_sequence_length=False, train_mode=False):
+    """Fused RNN (ref: src/operator/rnn.cc — RNNParam). data is (T, B, I);
+    state is (L*D, B, H). Returns (out, h_n[, c_n])."""
+    del projection_size, lstm_state_clip_min, lstm_state_clip_max
+    del lstm_state_clip_nan, use_sequence_length
+    d = 2 if bidirectional else 1
+    h = state_size
+    input_size = data.shape[2]
+    ws, bs = _unpack(parameters, mode, input_size, h, num_layers, bidirectional)
+
+    x = data
+    h_finals, c_finals = [], []
+    for layer in range(num_layers):
+        outs_dir = []
+        for di in range(d):
+            idx = layer * d + di
+            h0 = state[idx]
+            c0 = state_cell[idx] if mode == "lstm" else None
+            wi, wh = ws[layer][di]
+            bi, bh = bs[layer][di]
+            carry, outs = _run_layer(
+                x, mode, wi, wh, bi, bh, h0, c0, reverse=(di == 1)
+            )
+            outs_dir.append(outs)
+            h_finals.append(carry[0])
+            if mode == "lstm":
+                c_finals.append(carry[1])
+        x = outs_dir[0] if d == 1 else jnp.concatenate(outs_dir, axis=-1)
+        if p > 0 and train_mode and layer < num_layers - 1:
+            from .. import random as _random
+
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(_random.new_key(), keep, x.shape)
+            x = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+    h_n = jnp.stack(h_finals, axis=0)
+    if mode == "lstm":
+        return (x, h_n, jnp.stack(c_finals, axis=0))
+    return (x, h_n)

@@ -1,0 +1,79 @@
+"""Stateful-seed facade over JAX's functional PRNG.
+
+The reference exposes a global stateful RNG (`mx.random.seed`, per-device
+states handed to kernels via ResourceRequest::kRandom — ref: src/resource.cc,
+python/mxnet/random.py). JAX PRNG is explicit-key. Bridge: a process-global
+key that random ops split from. Inside a traced computation (hybridized
+block / jitted step) the key must be an *input*, so a context manager lets
+the tracer install a traced base key; random ops then derive per-call keys
+with a fold_in counter, keeping the trace deterministic w.r.t. the input key.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "new_key", "key_scope", "current_seed"]
+
+
+class _RandState(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.key = None
+        self.seed_ = None
+        # stack of (traced_key, counter_list) installed by tracing scopes
+        self.scopes = []
+
+
+_state = _RandState()
+_DEFAULT_SEED = 0
+
+
+def seed(seed_state, ctx="all"):
+    """Set the global seed (ref: python/mxnet/random.py — seed()).
+
+    ``ctx`` accepted for API parity; JAX keys are device-agnostic.
+    """
+    del ctx
+    _state.seed_ = int(seed_state)
+    _state.key = jax.random.key(int(seed_state))
+
+
+def current_seed():
+    return _state.seed_ if _state.seed_ is not None else _DEFAULT_SEED
+
+
+class key_scope:
+    """Install a (possibly traced) base key for random ops in this scope.
+
+    Used by CachedOp/hybridize: the jitted wrapper takes a key argument and
+    random ops inside the trace fold a call counter into it.
+    """
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        _state.scopes.append([self._key, 0])
+        return self
+
+    def __exit__(self, *args):
+        _state.scopes.pop()
+
+
+def in_key_scope() -> bool:
+    return bool(_state.scopes)
+
+
+def new_key():
+    """Produce a fresh PRNG key for one random op call."""
+    if _state.scopes:
+        scope = _state.scopes[-1]
+        k = jax.random.fold_in(scope[0], scope[1])
+        scope[1] += 1
+        return k
+    if _state.key is None:
+        _state.key = jax.random.key(_DEFAULT_SEED)
+    _state.key, sub = jax.random.split(_state.key)
+    return sub

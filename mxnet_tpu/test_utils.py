@@ -1,0 +1,212 @@
+"""Testing SDK (ref: python/mxnet/test_utils.py + tests/python/unittest/common.py).
+
+Rebuilt early per the survey's test strategy: assert_almost_equal with
+per-dtype tolerance ladder, numeric gradient checking, cpu↔accelerator
+consistency checks (replacing the reference's cpu↔gpu check_consistency),
+seeded-repro decorator (@with_seed logging MXNET_TEST_SEED), and random
+array helpers.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import random as pyrandom
+
+import numpy as np
+
+from . import random as mx_random
+from .context import cpu, current_context
+from .ndarray.ndarray import NDArray, array
+
+__all__ = [
+    "default_context",
+    "assert_almost_equal",
+    "almost_equal",
+    "same",
+    "rand_ndarray",
+    "rand_shape_nd",
+    "with_seed",
+    "check_numeric_gradient",
+    "check_consistency",
+    "default_rtols",
+]
+
+_DEFAULT_RTOL = {
+    np.dtype(np.float16): 1e-2,
+    np.dtype(np.float32): 1e-4,
+    np.dtype(np.float64): 1e-6,
+}
+_DEFAULT_ATOL = {
+    np.dtype(np.float16): 1e-2,
+    np.dtype(np.float32): 1e-5,
+    np.dtype(np.float64): 1e-7,
+}
+try:
+    import ml_dtypes as _ml
+
+    _DEFAULT_RTOL[np.dtype(_ml.bfloat16)] = 2e-2
+    _DEFAULT_ATOL[np.dtype(_ml.bfloat16)] = 2e-2
+except Exception:  # pragma: no cover
+    pass
+
+
+def default_rtols(dtype):
+    d = np.dtype(dtype)
+    return _DEFAULT_RTOL.get(d, 1e-5), _DEFAULT_ATOL.get(d, 1e-6)
+
+
+def default_context():
+    """Context tests run in; override with MXT_TEST_CTX=cpu|tpu
+    (ref: test_utils.default_context + MXNET_TEST_DEFAULT_GPU)."""
+    name = os.environ.get("MXT_TEST_CTX")
+    if name:
+        from .context import Context
+
+        return Context(name, 0)
+    return current_context()
+
+
+def _to_np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return np.asarray(a)
+
+
+def same(a, b):
+    return np.array_equal(_to_np(a), _to_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None):
+    a, b = _to_np(a), _to_np(b)
+    rt, at = default_rtols(a.dtype if a.dtype.kind == "f" else np.float32)
+    return np.allclose(a.astype(np.float64), b.astype(np.float64),
+                       rtol=rtol if rtol is not None else rt,
+                       atol=atol if atol is not None else at)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    a_np, b_np = _to_np(a), _to_np(b)
+    dt = a_np.dtype if a_np.dtype.kind == "f" else np.dtype(np.float32)
+    rt, at = default_rtols(dt)
+    rtol = rtol if rtol is not None else rt
+    atol = atol if atol is not None else at
+    np.testing.assert_allclose(
+        a_np.astype(np.float64), b_np.astype(np.float64),
+        rtol=rtol, atol=atol, equal_nan=equal_nan,
+        err_msg="%s and %s differ" % names,
+    )
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None, ctx=None):
+    dtype = dtype or np.float32
+    arr = np.random.uniform(-1.0, 1.0, size=shape).astype(dtype)
+    if stype == "default":
+        return array(arr, ctx=ctx)
+    if stype == "row_sparse":
+        from .sparse import row_sparse_array
+
+        density = 0.5 if density is None else density
+        keep = np.random.uniform(size=shape[0]) < density
+        arr[~keep] = 0
+        return row_sparse_array(array(arr), ctx=ctx)
+    raise ValueError("unsupported stype %r" % (stype,))
+
+
+def with_seed(seed=None):
+    """Seed np/python/mx RNGs per test, logging the seed so failures are
+    reproducible via MXNET_TEST_SEED (ref: tests/python/unittest/common.py)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            env = os.environ.get("MXNET_TEST_SEED")
+            this_seed = (
+                seed if seed is not None
+                else int(env) if env
+                else np.random.randint(0, 2 ** 31)
+            )
+            np_state = np.random.get_state()
+            py_state = pyrandom.getstate()
+            np.random.seed(this_seed)
+            pyrandom.seed(this_seed)
+            mx_random.seed(this_seed)
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                logging.error(
+                    "test %s failed with seed %d: set MXNET_TEST_SEED=%d "
+                    "to reproduce", fn.__name__, this_seed, this_seed,
+                )
+                raise
+            finally:
+                np.random.set_state(np_state)
+                pyrandom.setstate(py_state)
+
+        return wrapper
+
+    return deco
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-3,
+                           grad_nodes=None):
+    """Compare autograd gradients against central differences
+    (ref: test_utils.check_numeric_gradient). ``fn`` maps NDArrays to a
+    scalar-or-tensor NDArray; ``inputs`` is a list of NDArrays (float64
+    recommended for tight tolerances).
+    """
+    from . import autograd as ag
+
+    inputs = [x if isinstance(x, NDArray) else array(x) for x in inputs]
+    if grad_nodes is None:
+        grad_nodes = list(range(len(inputs)))
+    for x in inputs:
+        x.attach_grad()
+    with ag.record():
+        out = fn(*inputs)
+        out.backward(NDArray(np.ones(out.shape, out.dtype)))
+    analytic = [inputs[i].grad.asnumpy() for i in grad_nodes]
+
+    numeric = []
+    base_inputs = [x.asnumpy().astype(np.float64) for x in inputs]
+    for gi in grad_nodes:
+        g = np.zeros_like(base_inputs[gi])
+        src = base_inputs[gi]
+        for j in range(src.size):
+            orig = src.flat[j]
+            src.flat[j] = orig + eps
+            f_plus = fn(*[array(b.astype(inputs[k].dtype))
+                          for k, b in enumerate(base_inputs)]).asnumpy().sum()
+            src.flat[j] = orig - eps
+            f_minus = fn(*[array(b.astype(inputs[k].dtype))
+                           for k, b in enumerate(base_inputs)]).asnumpy().sum()
+            src.flat[j] = orig
+            g.flat[j] = (f_plus - f_minus) / (2 * eps)
+        numeric.append(g)
+
+    for i, (a, n) in enumerate(zip(analytic, numeric)):
+        np.testing.assert_allclose(
+            a.astype(np.float64), n, rtol=rtol, atol=atol,
+            err_msg="gradient mismatch for input %d" % grad_nodes[i],
+        )
+
+
+def check_consistency(fn, inputs, ctx_list=None, rtol=None, atol=None):
+    """Run ``fn`` under each context and compare outputs — the reference's
+    cpu↔gpu consistency check re-aimed at cpu↔tpu
+    (ref: test_utils.check_consistency)."""
+    if ctx_list is None:
+        ctx_list = [cpu(0), default_context()]
+    outs = []
+    for ctx in ctx_list:
+        moved = [x.as_in_context(ctx) for x in inputs]
+        out = fn(*moved)
+        outs.append(out.asnumpy())
+    for o in outs[1:]:
+        assert_almost_equal(outs[0], o, rtol=rtol, atol=atol)
+    return outs

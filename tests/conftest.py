@@ -1,0 +1,16 @@
+"""Test config: force an 8-device CPU mesh BEFORE jax initializes, so
+multi-device sharding paths are exercised without TPU hardware (the driver
+separately dry-runs the multi-chip path; see __graft_entry__.py)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# numeric tests compare against numpy float32/64; don't let XLA downcast
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,223 @@
+"""Autograd semantics (modeled on tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+
+@with_seed()
+def test_basic_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x + 2.0
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+@with_seed()
+def test_chain_and_broadcast():
+    x = nd.array(np.random.rand(3, 4).astype(np.float32))
+    w = nd.array(np.random.rand(5, 4).astype(np.float32))
+    x.attach_grad()
+    w.attach_grad()
+    with ag.record():
+        y = nd.dot(x, w, transpose_b=True)
+        z = nd.sum(y * y)
+    z.backward()
+    y_np = x.asnumpy() @ w.asnumpy().T
+    assert_almost_equal(x.grad, 2 * y_np @ w.asnumpy(), rtol=1e-4)
+    assert_almost_equal(w.grad, 2 * y_np.T @ x.asnumpy(), rtol=1e-4)
+
+
+@with_seed()
+def test_recording_scopes():
+    assert not ag.is_recording()
+    with ag.record():
+        assert ag.is_recording()
+        assert ag.is_training()
+        with ag.pause():
+            assert not ag.is_recording()
+            assert not ag.is_training()
+        with ag.predict_mode():
+            assert ag.is_recording()
+            assert not ag.is_training()
+    assert not ag.is_recording()
+    with ag.train_mode():
+        assert ag.is_training()
+        assert not ag.is_recording()
+
+
+@with_seed()
+def test_grad_req_add_and_null():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            y = 3.0 * x
+        y.backward()
+    assert_almost_equal(x.grad, np.full(2, 9.0))
+
+    z = nd.array([1.0])
+    z.attach_grad(grad_req="null")
+    with ag.record():
+        w = z * 2
+    w.backward()
+    assert_almost_equal(z.grad, np.zeros(1))
+
+
+@with_seed()
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 4
+    y.backward(nd.array([2.0, 3.0]))
+    assert_almost_equal(x.grad, np.array([8.0, 12.0]))
+
+
+@with_seed()
+def test_detach_stops_gradient():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, np.array([4.0]))  # d(4*x)/dx, y treated const
+
+
+@with_seed()
+def test_grad_function():
+    x = nd.array(np.random.rand(4).astype(np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = nd.exp(x).sum()
+    g = ag.grad(y, x)
+    assert_almost_equal(g, np.exp(x.asnumpy()))
+    # .grad untouched
+    assert_almost_equal(x.grad, np.zeros(4))
+
+
+@with_seed()
+def test_multiple_heads_backward():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        z = x * 3
+    ag.backward([y, z])
+    assert_almost_equal(x.grad, np.full(2, 5.0))
+
+
+@with_seed()
+def test_mark_variables():
+    x = nd.array([3.0])
+    gbuf = nd.zeros((1,))
+    ag.mark_variables([x], [gbuf])
+    with ag.record():
+        y = x * x
+    y.backward()
+    assert_almost_equal(gbuf, np.array([6.0]))
+
+
+@with_seed()
+def test_custom_function():
+    class Sigmoid(ag.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array(np.random.uniform(-2, 2, 5).astype(np.float32))
+    x.attach_grad()
+    f = Sigmoid()
+    with ag.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-4)
+
+
+@with_seed()
+def test_numeric_gradient_check():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    def f(a, b):
+        return nd.sum(nd.dot(a, b) ** 2)
+
+    a = nd.array(np.random.rand(3, 4).astype(np.float64))
+    b = nd.array(np.random.rand(4, 2).astype(np.float64))
+    check_numeric_gradient(f, [a, b], eps=1e-5, rtol=1e-4, atol=1e-5)
+
+
+@with_seed()
+def test_retain_graph():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    assert_almost_equal(x.grad, np.array([4.0]))
+    y.backward()
+    assert_almost_equal(x.grad, np.array([4.0]))
+
+
+@with_seed()
+def test_dropout_respects_mode():
+    x = nd.ones((100, 100))
+    with ag.record(train_mode=False):
+        y = nd.Dropout(x, p=0.5, train_mode=ag.is_training())
+    assert_almost_equal(y, x.asnumpy())
+    with ag.record():
+        z = nd.Dropout(x, p=0.5, train_mode=ag.is_training())
+    zn = z.asnumpy()
+    assert 0.3 < (zn == 0).mean() < 0.7
+
+
+@with_seed()
+def test_inplace_op_keeps_tape_node():
+    # regression: y *= 3 inside record must contribute to the gradient
+    x = nd.array([1.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        y *= 3
+    y.backward()
+    assert_almost_equal(x.grad, np.array([6.0]))
+
+
+@with_seed()
+def test_setitem_preserves_leaf():
+    # regression: slice-assign after attach_grad must not detach the leaf
+    x = nd.zeros((3,))
+    x.attach_grad()
+    x[0] = 1.0
+    with ag.record():
+        y = x * 2
+    y.backward()
+    assert_almost_equal(x.grad, np.full(3, 2.0))
+
+
+@with_seed()
+def test_list_heads_with_grads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        z = x * 3
+    ag.backward([y, z], [nd.ones((2,)), nd.ones((2,))])
+    assert_almost_equal(x.grad, np.full(2, 5.0))
+    import pytest
+
+    with pytest.raises(ValueError):
+        with ag.record():
+            y = x * 2
+            z = x * 3
+        ag.backward([y, z], nd.ones((2,)))
