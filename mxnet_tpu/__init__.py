@@ -24,8 +24,12 @@ from .ndarray.ndarray import NDArray
 from . import autograd
 from . import random
 from . import test_utils
+from . import initializer
+from . import initializer as init
+from . import gluon
 
 __all__ = [
     "nd", "ndarray", "autograd", "random", "context", "Context", "cpu",
-    "gpu", "tpu", "NDArray", "MXNetError", "test_utils",
+    "gpu", "tpu", "NDArray", "MXNetError", "test_utils", "initializer",
+    "init", "gluon",
 ]

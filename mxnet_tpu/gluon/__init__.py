@@ -1,0 +1,11 @@
+"""Gluon — the imperative/hybrid model API
+(ref: python/mxnet/gluon/__init__.py)."""
+from .parameter import Parameter, Constant, ParameterDict
+from .block import Block, HybridBlock
+from . import nn
+from . import loss
+from . import utils
+from .utils import split_and_load, split_data
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
+           "nn", "loss", "utils", "split_and_load", "split_data"]

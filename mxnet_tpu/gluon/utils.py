@@ -1,0 +1,61 @@
+"""Gluon utilities (ref: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as _nd
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Slice a batch along batch_axis into num_slice chunks
+    (ref: utils.py — split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            "batch size %d cannot be evenly split into %d slices; pad the "
+            "batch or set even_split=False" % (size, num_slice))
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(begin, end)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split a batch and load each slice onto one context
+    (ref: utils.py — split_and_load). On TPU prefer the sharded data path
+    (parallel.shard_batch) which keeps the batch as one sharded array."""
+    if not isinstance(data, NDArray):
+        data = _nd.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale NDArrays so the joint L2 norm <= max_norm
+    (ref: utils.py — clip_global_norm)."""
+    if not arrays:
+        raise ValueError("arrays must not be empty")
+    total = _nd.sum(arrays[0] * arrays[0])
+    for a in arrays[1:]:
+        total = total + _nd.sum(a * a)
+    total_norm = float(_nd.sqrt(total).asnumpy())
+    if check_isfinite and not np.isfinite(total_norm):
+        import warnings
+
+        warnings.warn("nan or inf detected in gradients' global norm")
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return total_norm

@@ -388,16 +388,17 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
         else:
             pads[ax] = (pad[i], pad[i])
 
+    # NOTE: init values must be PYTHON scalars — jax pattern-matches
+    # (max, -inf) / (add, 0) to reduce_window_max/sum primitives, which are
+    # the ones with reverse-mode autodiff rules
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
-            jnp.iinfo(data.dtype).min
+            int(jnp.iinfo(data.dtype).min)
         return jax.lax.reduce_window(
-            data, jnp.asarray(init, data.dtype), jax.lax.max,
-            window, strides, pads)
+            data, data.dtype.type(init), jax.lax.max, window, strides, pads)
     if pool_type in ("avg", "sum"):
         s = jax.lax.reduce_window(
-            data, jnp.asarray(0, data.dtype), jax.lax.add,
-            window, strides, pads)
+            data, data.dtype.type(0), jax.lax.add, window, strides, pads)
         if pool_type == "sum":
             return s
         if count_include_pad:
@@ -407,12 +408,11 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
             return s / jnp.asarray(denom, data.dtype)
         ones = jnp.ones(data.shape, data.dtype)
         counts = jax.lax.reduce_window(
-            ones, jnp.asarray(0, data.dtype), jax.lax.add,
-            window, strides, pads)
+            ones, data.dtype.type(0), jax.lax.add, window, strides, pads)
         return s / counts
     if pool_type == "lp":
         s = jax.lax.reduce_window(
-            jnp.power(jnp.abs(data), p_value), jnp.asarray(0, data.dtype),
+            jnp.power(jnp.abs(data), p_value), data.dtype.type(0),
             jax.lax.add, window, strides, pads)
         return jnp.power(s, 1.0 / p_value)
     raise ValueError("unknown pool_type %r" % (pool_type,))

@@ -1,9 +1,15 @@
-"""Test config: force an 8-device CPU mesh BEFORE jax initializes, so
-multi-device sharding paths are exercised without TPU hardware (the driver
-separately dry-runs the multi-chip path; see __graft_entry__.py)."""
+"""Test config: force an 8-device CPU mesh so multi-device sharding paths
+are exercised without TPU hardware (the driver separately dry-runs the
+multi-chip path; see __graft_entry__.py).
+
+NOTE: the axon TPU plugin (sitecustomize) force-sets jax_platforms to
+'axon,cpu' at interpreter start, overriding the JAX_PLATFORMS env var — so
+the env var alone does NOT keep tests off the TPU tunnel. The config.update
+below runs after registration and wins. Without it, every test op rides the
+single-client TPU tunnel and can wedge it.
+"""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -12,5 +18,6 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 # numeric tests compare against numpy float32/64; don't let XLA downcast
 jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,279 @@
+"""Gluon Block/HybridBlock tests (modeled on tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+
+@with_seed()
+def test_dense_explicit_shape():
+    layer = nn.Dense(4, in_units=3)
+    layer.initialize()
+    x = nd.random.uniform(shape=(2, 3))
+    out = layer(x)
+    assert out.shape == (2, 4)
+    w = layer.weight.data().asnumpy()
+    b = layer.bias.data().asnumpy()
+    assert_almost_equal(out, x.asnumpy() @ w.T + b, rtol=1e-4)
+
+
+@with_seed()
+def test_dense_deferred_init():
+    layer = nn.Dense(7)
+    layer.initialize()
+    assert layer.weight.shape == (7, 0)
+    out = layer(nd.ones((4, 5)))
+    assert layer.weight.shape == (7, 5)
+    assert out.shape == (4, 7)
+
+
+@with_seed()
+def test_sequential_and_naming():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(8))
+    net.initialize()
+    out = net(nd.ones((2, 4)))
+    assert out.shape == (2, 8)
+    names = list(net.collect_params().keys())
+    assert len(names) == 4
+    assert all(n.startswith(net.prefix) for n in names)
+
+
+@with_seed()
+def test_conv_pool_stack():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"))
+        net.add(nn.MaxPool2D(2))
+        net.add(nn.Conv2D(16, kernel_size=3))
+        net.add(nn.GlobalAvgPool2D())
+        net.add(nn.Flatten())
+        net.add(nn.Dense(10))
+    net.initialize()
+    out = net(nd.random.uniform(shape=(2, 3, 16, 16)))
+    assert out.shape == (2, 10)
+
+
+@with_seed()
+def test_hybridize_matches_eager():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="tanh"))
+        net.add(nn.Dense(5))
+    net.initialize()
+    x = nd.random.uniform(shape=(4, 8))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-5)
+    # different batch size triggers retrace, still works
+    out2 = net(nd.random.uniform(shape=(2, 8)))
+    assert out2.shape == (2, 5)
+
+
+@with_seed()
+def test_hybridize_gradients():
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(6, activation="relu", in_units=3))
+            net.add(nn.Dense(2, in_units=6))
+        return net
+
+    mx.random.seed(11)
+    np.random.seed(11)
+    net_e = build()
+    net_e.initialize()
+    mx.random.seed(11)
+    np.random.seed(11)
+    net_h = build()
+    net_h.initialize()
+    net_h.hybridize()
+
+    x = nd.random.uniform(shape=(5, 3))
+    for net in (net_e, net_h):
+        with ag.record():
+            out = net(x)
+            loss = nd.sum(out * out)
+        loss.backward()
+    for (n1, p1), (n2, p2) in zip(
+        sorted(net_e.collect_params().items()),
+        sorted(net_h.collect_params().items()),
+    ):
+        assert_almost_equal(p1.data().grad, p2.data().grad, rtol=1e-4,
+                            atol=1e-5)
+
+
+@with_seed()
+def test_batchnorm_running_stats_update():
+    bn = nn.BatchNorm(in_channels=3, momentum=0.5)
+    bn.initialize()
+    x = nd.random.normal(2.0, 3.0, shape=(8, 3, 4, 4))
+    rm0 = bn.running_mean.data().asnumpy().copy()
+    with ag.record():
+        out = bn(x)
+    out.wait_to_read()
+    rm1 = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm0, rm1)  # stats moved
+    # inference mode: no update, uses running stats
+    out_inf = bn(x)
+    rm2 = bn.running_mean.data().asnumpy()
+    assert_almost_equal(rm1, rm2)
+
+
+@with_seed()
+def test_batchnorm_aux_updates_under_hybridize():
+    bn = nn.BatchNorm(in_channels=3, momentum=0.5)
+    bn.initialize()
+    bn.hybridize()
+    x = nd.random.normal(1.0, 2.0, shape=(8, 3, 4, 4))
+    rm0 = bn.running_mean.data().asnumpy().copy()
+    with ag.record():
+        out = bn(x)
+    out.wait_to_read()
+    rm1 = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm0, rm1)  # aux writeback escaped the jit
+
+
+@with_seed()
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4))
+        net.add(nn.Dense(3, in_units=8))
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 4))
+    ref = net(x).asnumpy()
+    fname = str(tmp_path / "net.params")
+    net.save_parameters(fname)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(8, in_units=4))
+        net2.add(nn.Dense(3, in_units=8))
+    net2.load_parameters(fname)
+    assert_almost_equal(net2(x), ref)
+
+
+@with_seed()
+def test_embedding_and_dropout():
+    emb = nn.Embedding(10, 6)
+    emb.initialize()
+    idx = nd.array([1, 2, 3], dtype="int32")
+    out = emb(idx)
+    assert out.shape == (3, 6)
+    assert_almost_equal(out, emb.weight.data().asnumpy()[[1, 2, 3]])
+
+    do = nn.Dropout(0.5)
+    do.initialize()
+    x = nd.ones((50, 50))
+    assert_almost_equal(do(x), x.asnumpy())  # inference: identity
+
+
+@with_seed()
+def test_losses():
+    pred = nd.array(np.random.randn(4, 5).astype(np.float32))
+    label = nd.array([0, 2, 1, 4])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    logp = np.log(
+        np.exp(pred.asnumpy())
+        / np.exp(pred.asnumpy()).sum(-1, keepdims=True))
+    expected = -logp[np.arange(4), label.asnumpy().astype(int)]
+    assert_almost_equal(l, expected, rtol=1e-4)
+
+    p2 = nd.array([[1.0, 2.0]])
+    t2 = nd.array([[0.0, 4.0]])
+    l2 = gluon.loss.L2Loss()(p2, t2)
+    assert_almost_equal(l2, np.array([(0.5 * 1 + 0.5 * 4) / 2.0]), rtol=1e-4)
+    l1 = gluon.loss.L1Loss(weight=1.0)(p2, t2)
+    assert_almost_equal(l1, np.array([1.5]), rtol=1e-4)
+
+
+@with_seed()
+def test_custom_hybrid_block():
+    class MLP(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.fc1 = nn.Dense(16)
+                self.fc2 = nn.Dense(4)
+
+        def hybrid_forward(self, F, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = MLP()
+    net.initialize()
+    out = net(nd.ones((2, 7)))
+    assert out.shape == (2, 4)
+    net.hybridize()
+    out2 = net(nd.ones((2, 7)))
+    assert_almost_equal(out, out2.asnumpy(), rtol=1e-5)
+
+
+@with_seed()
+def test_layernorm_groupnorm():
+    ln = nn.LayerNorm()
+    ln.initialize()
+    x = nd.random.uniform(shape=(3, 7))
+    out = ln(x)
+    xn = x.asnumpy()
+    expected = (xn - xn.mean(-1, keepdims=True)) / np.sqrt(
+        xn.var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(out, expected, rtol=1e-4)
+
+    gn = nn.GroupNorm(num_groups=2, in_channels=4)
+    gn.initialize()
+    out = gn(nd.random.uniform(shape=(2, 4, 3, 3)))
+    assert out.shape == (2, 4, 3, 3)
+
+
+@with_seed()
+def test_split_and_load():
+    data = nd.arange(0, 24).reshape((8, 3))
+    ctxs = [mx.cpu(0), mx.cpu(0)]
+    parts = gluon.split_and_load(data, ctxs)
+    assert len(parts) == 2
+    assert parts[0].shape == (4, 3)
+    with pytest.raises(mx.MXNetError):
+        gluon.split_data(nd.ones((7, 2)), 2)
+
+
+@with_seed()
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(total - 1.0) < 1e-4
+    assert norm > 1.0
+
+
+@with_seed()
+def test_user_initializers_win():
+    # regression: bias_initializer/gamma_initializer must override suffix dispatch
+    d = nn.Dense(4, in_units=3, bias_initializer="ones")
+    d.initialize()
+    assert_almost_equal(d.bias.data(), np.ones(4))
+    bn = nn.BatchNorm(in_channels=3, gamma_initializer="zeros")
+    bn.initialize()
+    assert_almost_equal(bn.gamma.data(), np.zeros(3))
+
+
+@with_seed()
+def test_constant_survives_force_reinit():
+    c = gluon.Constant("c", nd.array([1.0, 2.0, 3.0]))
+    c.initialize(force_reinit=True)
+    assert_almost_equal(c.data(), np.array([1.0, 2.0, 3.0]))
+
+
+@with_seed()
+def test_set_data_shape_mismatch_raises():
+    p = gluon.Parameter("w", shape=(4, 3))
+    p.initialize()
+    with pytest.raises(mx.MXNetError):
+        p.set_data(nd.ones((5, 5)))
