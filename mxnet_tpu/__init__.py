@@ -26,10 +26,18 @@ from . import random
 from . import test_utils
 from . import initializer
 from . import initializer as init
+from . import lr_scheduler
+from . import optimizer
+from . import optimizer as opt
+from . import metric
+from . import kvstore
+from . import kvstore as kv
+from . import callback
 from . import gluon
 
 __all__ = [
     "nd", "ndarray", "autograd", "random", "context", "Context", "cpu",
     "gpu", "tpu", "NDArray", "MXNetError", "test_utils", "initializer",
-    "init", "gluon",
+    "init", "gluon", "optimizer", "opt", "metric", "kvstore", "kv",
+    "lr_scheduler", "callback",
 ]

@@ -2,10 +2,11 @@
 (ref: python/mxnet/gluon/__init__.py)."""
 from .parameter import Parameter, Constant, ParameterDict
 from .block import Block, HybridBlock
+from .trainer import Trainer
 from . import nn
 from . import loss
 from . import utils
 from .utils import split_and_load, split_data
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
-           "nn", "loss", "utils", "split_and_load", "split_data"]
+           "Trainer", "nn", "loss", "utils", "split_and_load", "split_data"]

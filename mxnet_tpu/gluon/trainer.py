@@ -1,0 +1,239 @@
+"""gluon.Trainer (ref: python/mxnet/gluon/trainer.py).
+
+Applies an Optimizer to a set of Parameters. The reference's per-GPU grad
+arrays + kvstore allreduce collapse here: each Parameter holds ONE buffer
+(possibly sharded over the mesh, in which case the backward pass already
+psum-reduced the gradient over ICI). The kvstore path is kept with the same
+`update_on_kvstore` decision logic (ref: trainer.py — _init_kvstore,
+model.py — _create_kvstore) so KVStore-driven training (including
+dist types and server-side optimizers) behaves like the reference.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import optimizer as opt
+from .. import kvstore as kvs
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % (type(params),))
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % (type(param),))
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {
+            "kvstore": kvstore, "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = []
+        self._reset_kvstore()
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params and set(optimizer_params) != {"rescale_grad"}:
+                raise ValueError(
+                    "optimizer_params must be None if optimizer is an "
+                    "instance of Optimizer instead of str")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _reset_kvstore(self):
+        if self._kvstore and self._kvstore.type.startswith("dist"):
+            raise RuntimeError(
+                "Cannot reset distributed KVStore.")
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = [p for p in self._params]
+
+    def _init_kvstore(self):
+        config = self._kvstore_params
+        kvstore_arg = config["kvstore"]
+        update_on_kvstore = config["update_on_kvstore"]
+        kvstore = None
+        if kvstore_arg:
+            if isinstance(kvstore_arg, kvs.KVStore):
+                kvstore = kvstore_arg
+            elif isinstance(kvstore_arg, str):
+                kvstore = kvs.create(kvstore_arg)
+            else:
+                raise ValueError("kvstore must be a KVStore instance or name")
+        if kvstore is not None:
+            if update_on_kvstore is None:
+                # reference default: update on kvstore when distributed
+                update_on_kvstore = kvstore.type.startswith("dist")
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+                # server-side optimizer owns the state; keep updater list
+                # for save_states compatibility
+                self._updaters = [kvstore._updater]
+        else:
+            update_on_kvstore = False
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = True
+
+    def _init_params(self):
+        """Lazily register params whose deferred init has completed."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is None:
+            self._params_to_init = []
+            return
+        remaining = []
+        for param in self._params_to_init:
+            if param._deferred_init is not None or param._data is None:
+                remaining.append(param)
+            else:
+                idx = self._param2idx[param.name]
+                self._kvstore.init(idx, param.data())
+        self._params_to_init = remaining
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr if self._optimizer.lr_scheduler is None \
+            else self._optimizer.lr_scheduler(self._optimizer.num_update)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # ------------------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce + optimizer update, scaled by 1/batch_size
+        (ref: trainer.py — step)."""
+        rescale_grad = self._scale / batch_size
+        self._check_and_rescale_grad(rescale_grad)
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def _check_and_rescale_grad(self, scale):
+        if self._update_on_kvstore and self._kv_initialized and \
+                self._optimizer.rescale_grad != scale:
+            raise UserWarning(
+                "Possible change in the `batch_size` from previous `step` "
+                "detected. Optimizer gradient normalizing factor will not "
+                "change w.r.t new batch_size when update_on_kvstore=True")
+        self._optimizer.rescale_grad = scale
+
+    def allreduce_grads(self):
+        """Only reduce gradients, no update (for grad manipulation between
+        allreduce and update; ref: trainer.py — allreduce_grads)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            raise AssertionError(
+                "allreduce_grads() when parameters are updated on kvstore "
+                "is not supported. Try setting `update_on_kvstore` to False "
+                "when creating trainer.")
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if self._update_on_kvstore:
+                # push grad; server applies the update into the weight,
+                # pull brings it back
+                self._kvstore.push(i, param.list_grad()[0])
+                self._kvstore.pull(i, param.data(), ignore_sparse=False)
+            else:
+                self._kvstore.push(i, param.list_grad()[0])
+                self._kvstore.pull(i, param.list_grad()[0])
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Only the optimizer update (call allreduce_grads first;
+        ref: trainer.py — update)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            "update() when parameters are updated on kvstore is not " \
+            "supported. Try setting `update_on_kvstore` to False when " \
+            "creating trainer."
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._update_on_kvstore:
+            return  # weights already updated server-side in _allreduce_grads
+        updater = self._updaters[0]
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if param._data is None:
+                if not ignore_stale_grad:
+                    raise MXNetError(
+                        "parameter %s has not been initialized" % param.name)
+                continue
+            updater(i, param.grad(), param.data())
+
+    # -- state persistence (ref: trainer.py — save_states/load_states) -----
+    def save_states(self, fname):
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            for updater in self._updaters:
+                updater.set_states(states)
+                updater.optimizer = self._updaters[0].optimizer
+            self._optimizer = self._updaters[0].optimizer
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        self._optimizer.param_dict = param_dict

@@ -1,0 +1,216 @@
+"""KVStore — the parameter synchronization facade
+(ref: include/mxnet/kvstore.h, src/kvstore/kvstore_local.h,
+src/kvstore/kvstore_dist.h, python/mxnet/kvstore.py).
+
+TPU-native re-design: the reference's worker/server topology (ps-lite ZMQ)
+and NCCL collectives collapse into XLA collectives compiled into the step.
+What remains as *state* is exactly what KVStoreLocal held — the merged
+buffers and the optional server-side updater. Types:
+
+- ``local`` / ``device`` / ``nccl``: single-process aggregation. Multiple
+  pushed values per key are summed (the reference reduces across GPUs; here
+  a sharded batch already arrives pre-reduced by psum, and list pushes are
+  summed with one fused XLA add-n).
+- ``dist_sync`` / ``dist_device_sync`` / ``dist_async``: multi-process via
+  ``jax.distributed`` (see parallel/). Push triggers a cross-process psum of
+  the gradient; semantics of sync mode (all workers see identical weights)
+  hold because the reduction is collective. ``dist_async`` has no pod-native
+  analog (SURVEY §5) — it is accepted and behaves synchronously, documented.
+
+``set_optimizer`` installs an Updater so ``push`` applies updates
+server-side (update_on_kvstore=True path), exactly like
+KVStoreDistServer::ApplyUpdates.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+from .ndarray import ndarray as _nd
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_str(key):
+    return str(key)
+
+
+class KVStore:
+    """Single-process key-value store (ref: kvstore_local.h — KVStoreLocal)."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store = {}           # key -> NDArray (weight if updater else merged)
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+        self._str_key_dict = {}
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        if self._type.startswith("dist"):
+            try:
+                import jax
+
+                return jax.process_index()
+            except Exception:
+                return 0
+        return 0
+
+    @property
+    def num_workers(self):
+        if self._type.startswith("dist"):
+            try:
+                import jax
+
+                return jax.process_count()
+            except Exception:
+                return 1
+        return 1
+
+    # -- core API ----------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._flatten(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                continue
+            self._store[k] = v.copy() if isinstance(v, NDArray) \
+                else _nd.array(v)
+
+    def _flatten(self, key, value):
+        if isinstance(key, (list, tuple)):
+            if len(key) != len(value):
+                raise MXNetError("key/value length mismatch")
+            return [_key_str(k) for k in key], list(value)
+        return [_key_str(key)], [value]
+
+    def _merge(self, vals):
+        """Sum a list of pushed values (ref: CommCPU/CommDevice::Reduce)."""
+        if isinstance(vals, NDArray):
+            return vals
+        if len(vals) == 1:
+            return vals[0]
+        import jax.numpy as jnp
+
+        total = vals[0].data
+        for v in vals[1:]:
+            total = total + v.data
+        return NDArray(total)
+
+    def _dist_reduce(self, merged):
+        """Cross-process gradient sum for dist types. With one process this
+        is the identity; under jax.distributed the arrays are process-local
+        and reduced via a tiny pjit psum (parallel.allreduce)."""
+        if self.num_workers <= 1:
+            return merged
+        from .parallel import allreduce_across_processes
+
+        return allreduce_across_processes(merged)
+
+    def push(self, key, value, priority=0):
+        del priority  # XLA async dispatch owns scheduling
+        keys, values = self._flatten(key, value)
+        for k, v in zip(keys, values):
+            merged = self._merge(v)
+            if self._type.startswith("dist"):
+                merged = self._dist_reduce(merged)
+            if k not in self._store:
+                self._store[k] = merged.copy()
+                continue
+            if self._updater is not None:
+                # server-side update: stored value is the weight
+                self._updater(int(k) if k.isdigit() else k, merged,
+                              self._store[k])
+            else:
+                self._store[k]._set_data(merged.data)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        del priority, ignore_sparse
+        keys, outs = self._flatten(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %s has not been initialized" % (k,))
+            src = self._store[k]
+            if isinstance(o, (list, tuple)):
+                for oo in o:
+                    oo._set_data(src.data)
+            else:
+                o._set_data(src.data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in row_ids (ref: KVStore::PullRowSparse).
+        Returns row_sparse NDArrays holding the selected rows."""
+        if row_ids is None:
+            raise MXNetError("row_ids is required for row_sparse_pull")
+        keys, outs = self._flatten(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        if len(rids) == 1 and len(outs) > 1:
+            rids = rids * len(outs)
+        from .sparse import retain_rows
+
+        for k, o, r in zip(keys, outs, rids):
+            if k not in self._store:
+                raise MXNetError("key %s has not been initialized" % (k,))
+            retain_rows(self._store[k], r, out=o)
+
+    # -- optimizer plumbing ------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Install a server-side optimizer (ref: kvstore.py —
+        set_optimizer; the reference pickles it to the servers)."""
+        # round-trip through pickle like the reference, so state must be
+        # serializable (catches the same bugs the reference would)
+        self._optimizer = pickle.loads(pickle.dumps(optimizer))
+        self._updater = opt.get_updater(self._optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        self._compression_params = dict(compression_params)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("optimizer is not set on this kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("optimizer is not set on this kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def _barrier(self):
+        if self.num_workers > 1:
+            import jax
+
+            jax.experimental.multihost_utils.sync_global_devices(
+                "kvstore_barrier")
+
+
+_KV_TYPES = ("local", "device", "nccl", "dist", "dist_sync", "dist_async",
+             "dist_device_sync", "dist_sync_device", "horovod")
+
+
+def create(name="local"):
+    """Factory (ref: kvstore.py — create / KVStore::Create)."""
+    if not isinstance(name, str) or name not in _KV_TYPES:
+        raise MXNetError("unknown KVStore type %r" % (name,))
+    if name == "horovod":
+        # horovod's allreduce role is played by the same XLA collectives
+        name = "device"
+    return KVStore(name)

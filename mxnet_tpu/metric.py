@@ -1,0 +1,549 @@
+"""Evaluation metrics (ref: python/mxnet/metric.py).
+
+Same registry + API: ``create(name)``, ``EvalMetric.update(labels, preds)``,
+``get() -> (name, value)``, ``CompositeEvalMetric``, custom fn via
+``np()``/``CustomMetric``. Computation happens on host after a sync — the
+reference does the same (metric.update calls asnumpy).
+"""
+from __future__ import annotations
+
+import math
+from collections import OrderedDict
+
+import numpy
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "F1", "MCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
+           "NegativeLogLikelihood", "PearsonCorrelation", "Loss", "Torch",
+           "Caffe", "CustomMetric", "np", "create", "register"]
+
+_REGISTRY = {}
+
+
+def register(klass, *names):
+    for n in names or (klass.__name__.lower(),):
+        _REGISTRY[n.lower()] = klass
+    return klass
+
+
+def create(metric, *args, **kwargs):
+    """Create a metric from name / callable / list (ref: metric.py — create)."""
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for child in metric:
+            composite.add(create(child, *args, **kwargs))
+        return composite
+    if isinstance(metric, str):
+        if metric.lower() not in _REGISTRY:
+            raise MXNetError("metric %r is not registered" % (metric,))
+        return _REGISTRY[metric.lower()](*args, **kwargs)
+    raise TypeError("metric must be a name, callable, EvalMetric, or list")
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def check_label_shapes(labels, preds, shape=False):
+    if not shape:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError(
+            "Shape of labels {} does not match shape of predictions {}"
+            .format(label_shape, pred_shape))
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def __str__(self):
+        return "EvalMetric: {}".format(dict(self.get_name_value()))
+
+    def get_config(self):
+        config = self._kwargs.copy()
+        config.update({
+            "metric": self.__class__.__name__,
+            "name": self.name,
+            "output_names": self.output_names,
+            "label_names": self.label_names})
+        return config
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names if name in pred]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names if name in label]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+
+@register
+class CompositeEvalMetric(EvalMetric):
+    """Manage multiple metrics as one (ref: metric.py — CompositeEvalMetric)."""
+
+    def __init__(self, metrics=None, name="composite", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        try:
+            return self.metrics[index]
+        except IndexError:
+            return ValueError("Metric index {} is out of range 0 and {}"
+                              .format(index, len(self.metrics)))
+
+    def update_dict(self, labels, preds):
+        for metric in self.metrics:
+            metric.update_dict(labels, preds)
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        try:
+            for metric in self.metrics:
+                metric.reset()
+        except AttributeError:
+            pass
+
+    def get(self):
+        names = []
+        values = []
+        for metric in self.metrics:
+            name, value = metric.get()
+            if isinstance(name, str):
+                name = [name]
+            if isinstance(value, (float, int, _np.generic)):
+                value = [value]
+            names.extend(name)
+            values.extend(value)
+        return (names, values)
+
+
+@register
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, axis=axis)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, _np.ndarray)):
+            labels = [labels]
+        if isinstance(preds, (NDArray, _np.ndarray)):
+            preds = [preds]
+        check_label_shapes(labels, preds)
+        for label, pred_label in zip(labels, preds):
+            pred_label = _as_np(pred_label)
+            label = _as_np(label)
+            if pred_label.shape != label.shape:
+                pred_label = _np.argmax(pred_label, axis=self.axis)
+            pred_label = pred_label.astype("int32").flat
+            label = label.astype("int32").flat
+            num_correct = int((_np.asarray(pred_label) ==
+                               _np.asarray(label)).sum())
+            self.sum_metric += num_correct
+            self.num_inst += len(_np.asarray(label))
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, top_k=top_k)
+        self.top_k = top_k
+        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
+        self.name += "_%d" % self.top_k
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, _np.ndarray)):
+            labels = [labels]
+        if isinstance(preds, (NDArray, _np.ndarray)):
+            preds = [preds]
+        check_label_shapes(labels, preds)
+        for label, pred_label in zip(labels, preds):
+            assert len(pred_label.shape) == 2, \
+                "Predictions should be 2 dims with first dim as batch"
+            pred_label = _np.argsort(_as_np(pred_label).astype("float32"),
+                                    axis=1)
+            label = _as_np(label).astype("int32")
+            num_samples = pred_label.shape[0]
+            num_dims = len(pred_label.shape)
+            if num_dims == 1:
+                self.sum_metric += (pred_label.flat == label.flat).sum()
+            elif num_dims == 2:
+                num_classes = pred_label.shape[1]
+                top_k = min(num_classes, self.top_k)
+                for j in range(top_k):
+                    self.sum_metric += (
+                        pred_label[:, num_classes - 1 - j].flat ==
+                        label.flat).sum()
+            self.num_inst += num_samples
+
+
+@register
+class F1(EvalMetric):
+    """Binary F1 (ref: metric.py — F1; average='macro'|'micro')."""
+
+    def __init__(self, name="f1", output_names=None, label_names=None,
+                 average="macro"):
+        self.average = average
+        self._tp = self._fp = self._fn = 0.0
+        self._sum_f1 = 0.0
+        self._count = 0
+        super().__init__(name, output_names, label_names)
+
+    def reset(self):
+        self._tp = self._fp = self._fn = 0.0
+        self._sum_f1 = 0.0
+        self._count = 0
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, _np.ndarray)):
+            labels = [labels]
+        if isinstance(preds, (NDArray, _np.ndarray)):
+            preds = [preds]
+        for label, pred in zip(labels, preds):
+            pred = _as_np(pred)
+            label = _as_np(label).astype("int32")
+            pred_label = _np.argmax(pred, axis=1) if pred.ndim > 1 else \
+                (pred > 0.5).astype("int32")
+            if not _np.all(_np.isin(label, [0, 1])):
+                raise ValueError("F1 currently only supports binary classification.")
+            tp = float(((pred_label == 1) & (label == 1)).sum())
+            fp = float(((pred_label == 1) & (label == 0)).sum())
+            fn = float(((pred_label == 0) & (label == 1)).sum())
+            if self.average == "micro":
+                self._tp += tp
+                self._fp += fp
+                self._fn += fn
+            else:
+                prec = tp / (tp + fp) if tp + fp > 0 else 0.0
+                rec = tp / (tp + fn) if tp + fn > 0 else 0.0
+                f1 = 2 * prec * rec / (prec + rec) if prec + rec > 0 else 0.0
+                self._sum_f1 += f1
+                self._count += 1
+            self.num_inst += label.size
+
+    def get(self):
+        if self.average == "micro":
+            prec = self._tp / (self._tp + self._fp) \
+                if self._tp + self._fp > 0 else 0.0
+            rec = self._tp / (self._tp + self._fn) \
+                if self._tp + self._fn > 0 else 0.0
+            f1 = 2 * prec * rec / (prec + rec) if prec + rec > 0 else 0.0
+            return (self.name, f1 if self.num_inst > 0 else float("nan"))
+        if self._count == 0:
+            return (self.name, float("nan"))
+        return (self.name, self._sum_f1 / self._count)
+
+
+@register
+class MCC(EvalMetric):
+    """Matthews correlation coefficient (ref: metric.py — MCC)."""
+
+    def __init__(self, name="mcc", output_names=None, label_names=None):
+        self._tp = self._fp = self._tn = self._fn = 0.0
+        super().__init__(name, output_names, label_names)
+
+    def reset(self):
+        self._tp = self._fp = self._tn = self._fn = 0.0
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, _np.ndarray)):
+            labels = [labels]
+        if isinstance(preds, (NDArray, _np.ndarray)):
+            preds = [preds]
+        for label, pred in zip(labels, preds):
+            pred = _as_np(pred)
+            label = _as_np(label).astype("int32")
+            pred_label = _np.argmax(pred, axis=1) if pred.ndim > 1 else \
+                (pred > 0.5).astype("int32")
+            self._tp += float(((pred_label == 1) & (label == 1)).sum())
+            self._fp += float(((pred_label == 1) & (label == 0)).sum())
+            self._tn += float(((pred_label == 0) & (label == 0)).sum())
+            self._fn += float(((pred_label == 0) & (label == 1)).sum())
+            self.num_inst += label.size
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        terms = [(self._tp + self._fp), (self._tp + self._fn),
+                 (self._tn + self._fp), (self._tn + self._fn)]
+        denom = 1.0
+        for t in terms:
+            denom *= t if t != 0 else 1.0
+        mcc = (self._tp * self._tn - self._fp * self._fn) / math.sqrt(denom)
+        return (self.name, mcc)
+
+
+@register
+class Perplexity(EvalMetric):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names,
+                         ignore_label=ignore_label, axis=axis)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, _np.ndarray)):
+            labels = [labels]
+        if isinstance(preds, (NDArray, _np.ndarray)):
+            preds = [preds]
+        assert len(labels) == len(preds)
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            label = _as_np(label).astype("int32").reshape(-1)
+            pred = _as_np(pred)
+            pred = pred.reshape(-1, pred.shape[-1])
+            probs = pred[_np.arange(label.shape[0]), label]
+            if self.ignore_label is not None:
+                ignore = (label == self.ignore_label)
+                probs = _np.where(ignore, 1.0, probs)
+                num -= int(ignore.sum())
+            loss -= float(_np.sum(_np.log(_np.maximum(1e-10, probs))))
+            num += label.shape[0]
+        self.sum_metric += loss
+        self.num_inst += num
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+
+@register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, _np.ndarray)):
+            labels = [labels]
+        if isinstance(preds, (NDArray, _np.ndarray)):
+            preds = [preds]
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_np(label)
+            pred = _as_np(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            self.sum_metric += float(_np.abs(label - pred).mean())
+            self.num_inst += 1
+
+
+@register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, _np.ndarray)):
+            labels = [labels]
+        if isinstance(preds, (NDArray, _np.ndarray)):
+            preds = [preds]
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_np(label)
+            pred = _as_np(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            self.sum_metric += float(((label - pred) ** 2.0).mean())
+            self.num_inst += 1
+
+
+@register
+class RMSE(MSE):
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.sqrt(self.sum_metric / self.num_inst))
+
+
+@register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, eps=eps)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, _np.ndarray)):
+            labels = [labels]
+        if isinstance(preds, (NDArray, _np.ndarray)):
+            preds = [preds]
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_np(label).ravel()
+            pred = _as_np(pred)
+            assert label.shape[0] == pred.shape[0]
+            prob = pred[_np.arange(label.shape[0]), _np.int64(label)]
+            self.sum_metric += float((-_np.log(prob + self.eps)).sum())
+            self.num_inst += label.shape[0]
+
+
+@register
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
+                 label_names=None):
+        super().__init__(eps=eps, name=name, output_names=output_names,
+                         label_names=label_names)
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, _np.ndarray)):
+            labels = [labels]
+        if isinstance(preds, (NDArray, _np.ndarray)):
+            preds = [preds]
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_np(label)
+            pred = _as_np(pred)
+            check_label_shapes(label, pred, shape=True)
+            self.sum_metric += float(
+                _np.corrcoef(pred.ravel(), label.ravel())[0, 1])
+            self.num_inst += 1
+
+
+@register
+class Loss(EvalMetric):
+    """Average of a loss output (ref: metric.py — Loss)."""
+
+    def __init__(self, name="loss", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, _, preds):
+        if isinstance(preds, (NDArray, _np.ndarray)):
+            preds = [preds]
+        for pred in preds:
+            pred = _as_np(pred)
+            self.sum_metric += float(pred.sum())
+            self.num_inst += pred.size
+
+
+@register
+class Torch(Loss):
+    def __init__(self, name="torch", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class Caffe(Loss):
+    def __init__(self, name="caffe", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name=None, allow_extra_outputs=False,
+                 output_names=None, label_names=None):
+        if name is None:
+            name = feval.__name__
+            if name.find("<") != -1:
+                name = "custom(%s)" % name
+        super().__init__(name, output_names, label_names, feval=feval,
+                         allow_extra_outputs=allow_extra_outputs)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, _np.ndarray)):
+            labels = [labels]
+        if isinstance(preds, (NDArray, _np.ndarray)):
+            preds = [preds]
+        if not self._allow_extra_outputs:
+            check_label_shapes(labels, preds)
+        for pred, label in zip(preds, labels):
+            label = _as_np(label)
+            pred = _as_np(pred)
+            reval = self._feval(label, pred)
+            if isinstance(reval, tuple):
+                (sum_metric, num_inst) = reval
+                self.sum_metric += sum_metric
+                self.num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.num_inst += 1
+
+
+# reference registry aliases (ref: metric.py @register(...) names)
+register(Accuracy, "acc", "accuracy")
+register(TopKAccuracy, "top_k_accuracy", "top_k_acc")
+register(CrossEntropy, "ce", "cross-entropy")
+register(NegativeLogLikelihood, "nll_loss")
+register(PearsonCorrelation, "pearsonr")
+register(CompositeEvalMetric, "composite")
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Wrap a numpy-taking function into a metric
+    (ref: metric.py — np())."""
+
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)

@@ -39,6 +39,9 @@ for _alias, _target in list(_registry._ALIASES.items()):
 
 from . import random  # noqa: E402  (needs op funcs above)
 from ..ops.matrix import infer_reshape  # noqa: E402,F401
+from ..ops.optimizer_ops import install_inplace_wrappers as _iow  # noqa: E402
+
+_iow(_mod)
 
 # creation-op names the reference exposes under nd.*
 maximum = getattr(_mod, "broadcast_maximum")

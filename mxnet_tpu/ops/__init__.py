@@ -8,6 +8,7 @@ from . import indexing
 from . import nn
 from . import random_ops
 from . import rnn
+from . import optimizer_ops
 
 from .registry import apply_op, get_op, list_ops, register, Op
 

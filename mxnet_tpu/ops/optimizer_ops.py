@@ -1,0 +1,274 @@
+"""Fused optimizer update ops.
+
+The reference runs optimizer updates as engine ops so they stay async and
+fused (ref: src/operator/optimizer_op.cc — sgd_update, sgd_mom_update,
+adam_update, rmsprop_update, ftrl_update, signsgd_update, signum_update,
+nag_mom_update, lamb_update_phase1/2, and the mp_* multi-precision
+variants). Here each is ONE jitted XLA program (all elementwise math fuses
+into a single kernel on TPU), written functionally: the op returns the
+updated buffers and the ``nd``-level wrapper writes them back in place,
+preserving the reference's mutate-in-place calling convention.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = ["install_inplace_wrappers"]
+
+
+def _prep(grad, wd, weight, rescale_grad, clip_gradient):
+    g = grad.astype(jnp.float32) if weight.dtype == jnp.float32 else grad
+    g = g * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+@register("sgd_update", differentiable=False)
+@functools.partial(jax.jit, static_argnames=("clip_gradient", "lazy_update"))
+def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+               clip_gradient=None, lazy_update=True):
+    del lazy_update  # dense path; row_sparse handled in sparse module
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    return (weight - lr * g).astype(weight.dtype)
+
+
+@register("sgd_mom_update", differentiable=False, num_outputs=2)
+@functools.partial(jax.jit, static_argnames=("clip_gradient", "lazy_update"))
+def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=None, lazy_update=True):
+    del lazy_update
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    mom_new = momentum * mom - lr * g
+    return (weight + mom_new).astype(weight.dtype), mom_new
+
+
+@register("mp_sgd_update", differentiable=False, num_outputs=2)
+@functools.partial(jax.jit, static_argnames=("clip_gradient", "lazy_update"))
+def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=None, lazy_update=True):
+    del lazy_update
+    g = _prep(grad.astype(jnp.float32), wd, weight32, rescale_grad,
+              clip_gradient)
+    w32 = weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", differentiable=False, num_outputs=3)
+@functools.partial(jax.jit, static_argnames=("clip_gradient", "lazy_update"))
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=None,
+                      lazy_update=True):
+    del lazy_update
+    g = _prep(grad.astype(jnp.float32), wd, weight32, rescale_grad,
+              clip_gradient)
+    mom_new = momentum * mom - lr * g
+    w32 = weight32 + mom_new
+    return w32.astype(weight.dtype), mom_new, w32
+
+
+@register("nag_mom_update", differentiable=False, num_outputs=2)
+@functools.partial(jax.jit, static_argnames=("clip_gradient",))
+def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=None):
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    mom_new = momentum * mom + g
+    return (weight - lr * (g + momentum * mom_new)).astype(weight.dtype), \
+        mom_new
+
+
+@register("mp_nag_mom_update", differentiable=False, num_outputs=3)
+@functools.partial(jax.jit, static_argnames=("clip_gradient",))
+def mp_nag_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=None):
+    g = _prep(grad.astype(jnp.float32), wd, weight32, rescale_grad,
+              clip_gradient)
+    mom_new = momentum * mom + g
+    w32 = weight32 - lr * (g + momentum * mom_new)
+    return w32.astype(weight.dtype), mom_new, w32
+
+
+@register("adam_update", differentiable=False, num_outputs=3)
+@functools.partial(jax.jit, static_argnames=("clip_gradient", "lazy_update"))
+def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=None,
+                lazy_update=True):
+    del lazy_update
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    mean_new = beta1 * mean + (1.0 - beta1) * g
+    var_new = beta2 * var + (1.0 - beta2) * g * g
+    w = weight - lr * mean_new / (jnp.sqrt(var_new) + epsilon)
+    return w.astype(weight.dtype), mean_new, var_new
+
+
+@register("adamw_update", differentiable=False, num_outputs=3)
+@functools.partial(jax.jit, static_argnames=("clip_gradient",))
+def adamw_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, eta=1.0, rescale_grad=1.0,
+                 clip_gradient=None):
+    """Decoupled weight decay (ref: src/operator/contrib/adamw.cc)."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mean_new = beta1 * mean + (1.0 - beta1) * g
+    var_new = beta2 * var + (1.0 - beta2) * g * g
+    w = weight - eta * (lr * mean_new / (jnp.sqrt(var_new) + epsilon)
+                        + wd * weight)
+    return w.astype(weight.dtype), mean_new, var_new
+
+
+@register("rmsprop_update", differentiable=False, num_outputs=2)
+@functools.partial(jax.jit, static_argnames=("clip_gradient", "clip_weights"))
+def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=None,
+                   clip_weights=None):
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    n_new = (1.0 - gamma1) * g * g + gamma1 * n
+    w = weight - lr * g / jnp.sqrt(n_new + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w.astype(weight.dtype), n_new
+
+
+@register("rmspropalex_update", differentiable=False, num_outputs=4)
+@functools.partial(jax.jit, static_argnames=("clip_gradient", "clip_weights"))
+def rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.9,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=None, clip_weights=None):
+    """Centered RMSProp (Graves'13 variant; ref: rmspropalex_update)."""
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    n_new = (1.0 - gamma1) * g * g + gamma1 * n
+    g_new = (1.0 - gamma1) * g + gamma1 * g_state
+    delta_new = gamma2 * delta - lr * g / jnp.sqrt(n_new - g_new * g_new
+                                                   + epsilon)
+    w = weight + delta_new
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w.astype(weight.dtype), n_new, g_new, delta_new
+
+
+@register("ftrl_update", differentiable=False, num_outputs=3)
+@functools.partial(jax.jit, static_argnames=("clip_gradient",))
+def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=None):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    n_new = n + g * g
+    z_new = z + g - (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr * weight
+    w = (jnp.sign(z_new) * lamda1 - z_new) / \
+        ((beta + jnp.sqrt(n_new)) / lr + wd) * (jnp.abs(z_new) > lamda1)
+    return w.astype(weight.dtype), z_new, n_new
+
+
+@register("signsgd_update", differentiable=False)
+@functools.partial(jax.jit, static_argnames=("clip_gradient",))
+def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=None):
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    return (weight - lr * jnp.sign(g)).astype(weight.dtype)
+
+
+@register("signum_update", differentiable=False, num_outputs=2)
+@functools.partial(jax.jit, static_argnames=("clip_gradient",))
+def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=None, wd_lh=0.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mom_new = momentum * mom - (1.0 - momentum) * (g + wd * weight)
+    w = (1.0 - lr * wd_lh) * weight + lr * jnp.sign(mom_new)
+    return w.astype(weight.dtype), mom_new
+
+
+@register("lamb_update_phase1", differentiable=False, num_outputs=3)
+@functools.partial(jax.jit, static_argnames=("clip_gradient", "bias_correction"))
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=None):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mean_new = beta1 * mean + (1.0 - beta1) * g
+    var_new = beta2 * var + (1.0 - beta2) * g * g
+    if bias_correction:
+        mean_hat = mean_new / (1.0 - beta1 ** t)
+        var_hat = var_new / (1.0 - beta2 ** t)
+    else:
+        mean_hat, var_hat = mean_new, var_new
+    update = mean_hat / (jnp.sqrt(var_hat) + epsilon) + wd * weight
+    return update, mean_new, var_new
+
+
+@register("lamb_update_phase2", differentiable=False)
+@jax.jit
+def lamb_update_phase2(weight, g_update, r1, r2, lr=0.001,
+                       lower_bound=-1.0, upper_bound=-1.0):
+    r1c = jnp.where(lower_bound >= 0, jnp.maximum(r1, lower_bound), r1)
+    r1c = jnp.where(upper_bound >= 0, jnp.minimum(r1c, upper_bound), r1c)
+    ratio = jnp.where(jnp.logical_and(r1c > 0, r2 > 0), r1c / r2, 1.0)
+    return (weight - lr * ratio * g_update).astype(weight.dtype)
+
+
+# --------------------------------------------------------------------------
+# in-place calling convention at the nd.* level:
+# nd.sgd_mom_update(w, g, mom, lr=...) updates w and mom in place, returns w
+# (the reference's out=weight idiom). Buffer order = op input order.
+# --------------------------------------------------------------------------
+_INPLACE = {
+    # op name -> number of leading NDArray args that receive updated buffers
+    "sgd_update": 1,
+    "sgd_mom_update": 2,
+    "mp_sgd_update": None,  # special: (weight, grad, weight32)
+    "mp_sgd_mom_update": None,
+    "nag_mom_update": 2,
+    "mp_nag_mom_update": None,
+    "adam_update": 3,
+    "adamw_update": 3,
+    "rmsprop_update": 2,
+    "rmspropalex_update": 4,
+    "ftrl_update": 3,
+    "signsgd_update": 1,
+    "signum_update": 2,
+}
+# for mp_* ops the grad input sits between the mutated buffers
+_MP_TARGETS = {
+    "mp_sgd_update": (0, 2),
+    "mp_sgd_mom_update": (0, 2, 3),
+    "mp_nag_mom_update": (0, 2, 3),
+}
+
+
+def install_inplace_wrappers(mod):
+    """Override the generated nd.* functions for optimizer ops with
+    mutate-in-place wrappers (called from mxnet_tpu/ndarray/__init__.py)."""
+    from .registry import apply_op
+
+    def make(name, n_targets):
+        def wrapped(*args, out=None, **kwargs):
+            res = apply_op(name, *args, **kwargs)
+            if not isinstance(res, tuple):
+                res = (res,)
+            if n_targets is None:
+                targets = [args[i] for i in _MP_TARGETS[name]]
+            else:
+                # mutated buffers are args[0] (weight), then the state
+                # buffers which follow grad: args[2:2+n-1]
+                targets = [args[0]] + list(args[2: 2 + n_targets - 1])
+            for t, r in zip(targets, res):
+                t._set_data(r.data)
+            if out is not None and out is not args[0]:
+                out._set_data(res[0].data)
+                return out
+            return args[0]
+
+        wrapped.__name__ = name
+        return wrapped
+
+    for name, n in _INPLACE.items():
+        setattr(mod, name, make(name, n))

@@ -1,0 +1,11 @@
+"""Optimizer registry and implementations
+(ref: python/mxnet/optimizer/optimizer.py)."""
+from .optimizer import (
+    Optimizer, Updater, get_updater, create, register,
+    SGD, NAG, Adam, AdamW, AdaGrad, RMSProp, AdaDelta, Ftrl, Signum,
+    SGLD, DCASGD, LAMB, FTML, Test,
+)
+
+__all__ = ["Optimizer", "Updater", "get_updater", "create", "register",
+           "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "RMSProp", "AdaDelta",
+           "Ftrl", "Signum", "SGLD", "DCASGD", "LAMB", "FTML", "Test"]

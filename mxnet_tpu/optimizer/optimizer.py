@@ -1,0 +1,647 @@
+"""Optimizers (ref: python/mxnet/optimizer/optimizer.py).
+
+Same registry + Updater architecture as the reference: `Optimizer.create`
+by lowercase name, per-index state dicts, lr/wd multipliers, multi-precision
+(fp32 master weights for fp16/bf16 params), and an `Updater` that owns the
+states and is picklable (that is what the reference ships to KVStore servers
+via set_optimizer). The update math itself runs as fused XLA ops
+(ops/optimizer_ops.py) — the analog of the reference's engine-pushed
+optimizer kernels (src/operator/optimizer_op.cc).
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as np
+
+from ..base import MXNetError, get_dtype
+from ..ndarray.ndarray import NDArray
+from ..ndarray import ndarray as _nd
+from .. import ndarray as nd
+
+__all__ = ["Optimizer", "Updater", "get_updater", "create", "register"]
+
+
+class Optimizer:
+    """Base optimizer (ref: optimizer.py — Optimizer)."""
+
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() not in Optimizer.opt_registry:
+            raise ValueError("Cannot find optimizer %s" % name)
+        return Optimizer.opt_registry[name.lower()](**kwargs)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = dict(param_idx2name)
+        self.sym_info = ()
+        del sym
+        self.param_dict = param_dict if param_dict else {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp32 master copy for low-precision weights
+        (ref: optimizer.py — create_state_multi_precision)."""
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype in (np.float16,
+                                                     get_dtype("bfloat16")):
+            weight_master_copy = weight.astype("float32")
+            return (weight_master_copy, self.create_state(
+                index, weight_master_copy))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and isinstance(state, tuple) and \
+                isinstance(state[0], NDArray) and \
+                state[0].dtype == np.float32 and weight.dtype != np.float32:
+            weight_master, inner_state = state
+            grad32 = grad.astype("float32")
+            self.update(index, weight_master, grad32, inner_state)
+            weight._set_data(weight_master.data.astype(weight.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined. Note that set_learning_rate can mutate "
+                              "the value of the learning rate of the optimizer "
+                              "only when the LRScheduler of the optimizer is "
+                              "undefined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = not (n.endswith("_weight") or n.endswith("_gamma"))
+            if is_weight and (n.endswith("_bias") or n.endswith("_beta")):
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx],
+                                  self.num_update)
+
+    def _get_lrs(self, indices):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        lrs = []
+        for index in indices:
+            mult = 1.0
+            if index in self.param_dict:
+                mult = self.param_dict[index].lr_mult
+            elif index in self.lr_mult:
+                mult = self.lr_mult[index]
+            elif index in self.idx2name:
+                mult = self.lr_mult.get(self.idx2name[index], 1.0)
+            lrs.append(lr * mult)
+        return lrs
+
+    def _get_lr(self, index):
+        return self._get_lrs([index])[0]
+
+    def _get_wds(self, indices):
+        wds = []
+        for index in indices:
+            wd = self.wd
+            if index in self.param_dict:
+                wd *= self.param_dict[index].wd_mult
+            elif index in self.wd_mult:
+                wd *= self.wd_mult[index]
+            elif index in self.idx2name:
+                wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+            wds.append(wd)
+        return wds
+
+    def _get_wd(self, index):
+        return self._get_wds([index])[0]
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        ret["lr_scheduler"] = self.lr_scheduler
+        return ret
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _common(self, index):
+    """(lr, wd) honoring multipliers + update count bump."""
+    self._update_count(index)
+    return self._get_lr(index), self._get_wd(index)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional multi-precision
+    (ref: optimizer.py — SGD; op: sgd_update/sgd_mom_update/mp_*)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype in (np.float16,
+                                                     get_dtype("bfloat16")):
+            w32 = weight.astype("float32")
+            mom = _nd.zeros(weight.shape, dtype="float32") \
+                if self.momentum != 0.0 else None
+            return (mom, w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_impl(index, weight, grad, state, multi_precision=False)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        use_mp = self.multi_precision and weight.dtype in (
+            np.float16, get_dtype("bfloat16"))
+        self._update_impl(index, weight, grad, state, multi_precision=use_mp)
+
+    def _update_impl(self, index, weight, grad, state, multi_precision):
+        lr, wd = _common(self, index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=self.clip_gradient)
+        if not multi_precision:
+            if state is not None:
+                nd.sgd_mom_update(weight, grad, state, momentum=self.momentum,
+                                  **kw)
+            else:
+                nd.sgd_update(weight, grad, lazy_update=self.lazy_update, **kw)
+        else:
+            mom, w32 = state
+            if mom is not None:
+                nd.mp_sgd_mom_update(weight, grad, mom, w32,
+                                     momentum=self.momentum, **kw)
+            else:
+                nd.mp_sgd_update(weight, grad, w32, **kw)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (ref: optimizer.py — NAG)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr, wd = _common(self, index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=self.clip_gradient)
+        if state is not None:
+            nd.nag_mom_update(weight, grad, state, momentum=self.momentum,
+                              **kw)
+        else:
+            nd.sgd_update(weight, grad, **kw)
+
+
+@register
+class Adam(Optimizer):
+    """Adam (ref: optimizer.py — Adam; op: adam_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, dtype=weight.dtype),   # mean
+                _nd.zeros(weight.shape, dtype=weight.dtype))   # var
+
+    def update(self, index, weight, grad, state):
+        lr, wd = _common(self, index)
+        t = self._index_update_count[index]
+        # bias correction folded into lr (reference does the same)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        nd.adam_update(weight, grad, mean, var, lr=lr, wd=wd,
+                       beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon, rescale_grad=self.rescale_grad,
+                       clip_gradient=self.clip_gradient,
+                       lazy_update=self.lazy_update)
+
+
+@register
+class AdamW(Optimizer):
+    """Adam with decoupled weight decay
+    (ref: src/operator/contrib/adamw.cc — contrib adamw_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, dtype=weight.dtype),
+                _nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr, wd = _common(self, index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        nd.adamw_update(weight, grad, mean, var, lr=lr, wd=wd, eta=1.0,
+                        beta1=self.beta1, beta2=self.beta2,
+                        epsilon=self.epsilon,
+                        rescale_grad=self.rescale_grad,
+                        clip_gradient=self.clip_gradient)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (ref: optimizer.py — AdaGrad; python-side update in the
+    reference too)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr, wd = _common(self, index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        grad = grad + wd * weight
+        state += grad * grad
+        weight -= lr * grad / ((state ** 0.5) + self.float_stable_eps)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, plain (Tieleman) or centered (Graves)
+    (ref: optimizer.py — RMSProp)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_nd.zeros(weight.shape, dtype=weight.dtype),  # n
+                    _nd.zeros(weight.shape, dtype=weight.dtype),  # g
+                    _nd.zeros(weight.shape, dtype=weight.dtype))  # delta
+        return _nd.zeros(weight.shape, dtype=weight.dtype)        # n
+
+    def update(self, index, weight, grad, state):
+        lr, wd = _common(self, index)
+        kw = dict(lr=lr, wd=wd, gamma1=self.gamma1, epsilon=self.epsilon,
+                  rescale_grad=self.rescale_grad,
+                  clip_gradient=self.clip_gradient,
+                  clip_weights=self.clip_weights)
+        if not self.centered:
+            nd.rmsprop_update(weight, grad, state, **kw)
+        else:
+            n, g, delta = state
+            nd.rmspropalex_update(weight, grad, n, g, delta,
+                                  gamma2=self.gamma2, **kw)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (ref: optimizer.py — AdaDelta; python-side update)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, dtype=weight.dtype),  # acc_g
+                _nd.zeros(weight.shape, dtype=weight.dtype))  # acc_delta
+
+    def update(self, index, weight, grad, state):
+        _, wd = _common(self, index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g._set_data((self.rho * acc_g + (1 - self.rho) * grad * grad).data)
+        current_delta = ((acc_delta + self.epsilon) ** 0.5) / \
+            ((acc_g + self.epsilon) ** 0.5) * grad
+        acc_delta._set_data(
+            (self.rho * acc_delta
+             + (1 - self.rho) * current_delta * current_delta).data)
+        weight -= current_delta + wd * weight
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal (ref: optimizer.py — Ftrl; op: ftrl_update)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, dtype=weight.dtype),  # z
+                _nd.zeros(weight.shape, dtype=weight.dtype))  # n
+
+    def update(self, index, weight, grad, state):
+        lr, wd = _common(self, index)
+        z, n = state
+        nd.ftrl_update(weight, grad, z, n, lr=lr, wd=wd, lamda1=self.lamda1,
+                       beta=self.beta, rescale_grad=self.rescale_grad,
+                       clip_gradient=self.clip_gradient)
+
+
+@register
+class Signum(Optimizer):
+    """Sign-momentum SGD (ref: optimizer.py — Signum)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr, wd = _common(self, index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=self.clip_gradient)
+        if state is not None:
+            nd.signum_update(weight, grad, state, momentum=self.momentum,
+                             wd_lh=self.wd_lh, **kw)
+        else:
+            nd.signsgd_update(weight, grad, **kw)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (ref: optimizer.py — SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        lr, wd = _common(self, index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        noise = nd.normal(loc=0, scale=math.sqrt(lr),
+                          shape=weight.shape, dtype=weight.dtype)
+        weight -= lr / 2 * (grad + wd * weight) - noise
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (ref: optimizer.py — DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (_nd.zeros(weight.shape, dtype=weight.dtype), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        lr, wd = _common(self, index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        mom, previous_weight = state
+        delta = -lr * (grad + wd * weight + self.lamda * grad * grad *
+                       (weight - previous_weight))
+        if mom is not None:
+            mom *= self.momentum
+            mom += delta
+            delta = mom
+        previous_weight._set_data(weight.data)
+        weight += delta
+
+
+@register
+class LAMB(Optimizer):
+    """Layerwise-adaptive large-batch optimizer
+    (ref: optimizer.py — LAMB [≥1.6]; ops lamb_update_phase1/2)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, dtype="float32"),
+                _nd.zeros(weight.shape, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        lr, wd = _common(self, index)
+        t = self._index_update_count[index]
+        mean, var = state
+        from ..ops.registry import apply_op
+
+        res = apply_op("lamb_update_phase1", weight, grad, mean, var,
+                       beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon, t=t,
+                       bias_correction=self.bias_correction, wd=wd,
+                       rescale_grad=self.rescale_grad,
+                       clip_gradient=self.clip_gradient)
+        g_update, mean_new, var_new = res
+        mean._set_data(mean_new.data)
+        var._set_data(var_new.data)
+        r1 = weight.astype("float32").norm()
+        r2 = g_update.norm()
+        w_new = apply_op("lamb_update_phase2", weight, g_update, r1, r2,
+                         lr=lr,
+                         lower_bound=self.lower_bound
+                         if self.lower_bound is not None else -1.0,
+                         upper_bound=self.upper_bound
+                         if self.upper_bound is not None else -1.0)
+        weight._set_data(w_new.data)
+
+
+@register
+class Test(Optimizer):
+    """Trivial optimizer used by the reference's own unit tests
+    (ref: optimizer.py — Test)."""
+
+    def create_state(self, index, weight):
+        return _nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+        state._set_data(weight.data)
+
+
+@register
+class FTML(Optimizer):
+    """Follow-the-moving-leader (ref: optimizer.py — FTML; op ftml_update)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, dtype=weight.dtype),  # d
+                _nd.zeros(weight.shape, dtype=weight.dtype),  # v
+                _nd.zeros(weight.shape, dtype=weight.dtype))  # z
+
+    def update(self, index, weight, grad, state):
+        lr, wd = _common(self, index)
+        t = self._index_update_count[index]
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        d, v, z = state
+        v._set_data((self.beta2 * v + (1 - self.beta2) * grad * grad).data)
+        d_t = (1 - self.beta1 ** t) / lr * \
+            ((v / (1 - self.beta2 ** t)) ** 0.5 + self.epsilon)
+        sigma_t = d_t - self.beta1 * d
+        z._set_data((self.beta1 * z + (1 - self.beta1) * grad
+                     - sigma_t * weight).data)
+        d._set_data(d_t.data)
+        weight._set_data((-z / d_t).data)
+
+
+# alias names matching the reference registry
+ccSGD = SGD
+Optimizer.opt_registry["ccsgd"] = SGD
+
+
+class Updater:
+    """Holds per-index optimizer states and applies updates
+    (ref: optimizer.py — Updater; this object is what KVStore serializes to
+    servers via set_optimizer)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = False
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            indices = [index]
+            grads = [grad]
+            weights = [weight]
+        else:
+            indices, grads, weights = index, grad, weight
+        for i, g, w in zip(indices, grads, weights):
+            if i not in self.states:
+                self.states[i] = \
+                    self.optimizer.create_state_multi_precision(i, w)
+                self.states_synced[i] = True
+            self.optimizer.update_multi_precision(i, w, g, self.states[i])
+
+    def get_states(self, dump_optimizer=False):
+        """Serialize states (+ optionally the optimizer itself) to bytes
+        (ref: optimizer.py — Updater.get_states)."""
+
+        def to_np(s):
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            if isinstance(s, (tuple, list)):
+                return tuple(to_np(x) for x in s)
+            return s
+
+        states = {i: to_np(s) for i, s in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((states, self.optimizer))
+        return pickle.dumps(states)
+
+    def set_states(self, states):
+        data = pickle.loads(states)
+        if isinstance(data, tuple) and len(data) == 2 and \
+                isinstance(data[1], Optimizer):
+            states, self.optimizer = data
+        else:
+            states = data
+
+        def to_nd(s):
+            if isinstance(s, np.ndarray):
+                return _nd.array(s, dtype=s.dtype)
+            if isinstance(s, tuple):
+                return tuple(to_nd(x) for x in s)
+            return s
+
+        self.states = {i: to_nd(s) for i, s in states.items()}
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

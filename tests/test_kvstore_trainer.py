@@ -1,0 +1,266 @@
+"""KVStore + Trainer tests (models tests/python/unittest/test_kvstore.py and
+the trainer portions of test_gluon.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import gluon
+from mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+SHAPE = (4, 4)
+
+
+# ---------------------------------------------------------------------------
+# KVStore
+# ---------------------------------------------------------------------------
+def test_kvstore_single_push_pull():
+    kv = mx.kv.create("local")
+    kv.init(3, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE))
+    kv.push(3, nd.ones(SHAPE) * 4)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE) * 4)
+
+
+def test_kvstore_aggregate_list_push():
+    kv = mx.kv.create("device")
+    kv.init("a", nd.zeros(SHAPE))
+    vals = [nd.ones(SHAPE)] * 4
+    kv.push("a", vals)
+    out = nd.zeros(SHAPE)
+    kv.pull("a", out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE) * 4)
+
+
+def test_kvstore_string_and_list_keys():
+    kv = mx.kv.create("local")
+    keys = ["b", "c", "d"]
+    kv.init(keys, [nd.ones(SHAPE)] * 3)
+    outs = [nd.zeros(SHAPE) for _ in keys]
+    kv.pull(keys, out=outs)
+    for o in outs:
+        assert_almost_equal(o.asnumpy(), np.ones(SHAPE))
+
+
+def test_kvstore_updater_on_push():
+    kv = mx.kv.create("local")
+    kv.init(0, nd.ones(SHAPE))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.push(0, nd.ones(SHAPE))  # grad = 1 → w = 1 - 0.1*1
+    out = nd.zeros(SHAPE)
+    kv.pull(0, out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE) * 0.9, rtol=1e-6)
+
+
+def test_kvstore_pull_uninited_raises():
+    kv = mx.kv.create("local")
+    with pytest.raises(mx.MXNetError):
+        kv.pull("nope", out=nd.zeros(SHAPE))
+
+
+def test_kvstore_types():
+    for t in ("local", "device", "nccl", "dist_sync", "dist_async"):
+        kv = mx.kv.create(t)
+        assert kv.type == t
+        assert kv.rank == 0
+    with pytest.raises(mx.MXNetError):
+        mx.kv.create("bogus")
+
+
+# ---------------------------------------------------------------------------
+# Trainer
+# ---------------------------------------------------------------------------
+def _tiny_net():
+    net = gluon.nn.Dense(1, in_units=2, use_bias=False, prefix="tnet_")
+    net.initialize()
+    return net
+
+
+@with_seed()
+def test_trainer_step_updates_params():
+    net = _tiny_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    w_before = net.weight.data().asnumpy().copy()
+    x = nd.array(np.ones((4, 2), dtype=np.float32))
+    with mx.autograd.record():
+        y = net(x)
+        loss = (y * y).sum()
+    loss.backward()
+    trainer.step(4)
+    w_after = net.weight.data().asnumpy()
+    assert not np.allclose(w_before, w_after)
+    # grad is rescaled by 1/batch_size
+    g = net.weight.grad().asnumpy()
+    assert_almost_equal(w_after, w_before - 0.1 * g / 4.0,
+                        rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_trainer_converges_linear_regression():
+    rng = np.random.RandomState(0)
+    true_w = np.array([[2.0, -3.4]], dtype=np.float32)
+    X = rng.normal(size=(256, 2)).astype(np.float32)
+    Y = X @ true_w.T + 1.2
+
+    net = gluon.nn.Dense(1, in_units=2, prefix="linreg_")
+    net.initialize(mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    l2 = gluon.loss.L2Loss()
+    for epoch in range(60):
+        with mx.autograd.record():
+            out = net(nd.array(X))
+            loss = l2(out, nd.array(Y)).mean()
+        loss.backward()
+        trainer.step(1)
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    assert_almost_equal(w, true_w, rtol=5e-2, atol=5e-2)
+    assert abs(float(b.reshape(())[()]) - 1.2) < 0.1
+
+
+def test_trainer_update_on_kvstore_dist_semantics():
+    net = _tiny_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore="dist_sync")
+    x = nd.array(np.ones((2, 2), dtype=np.float32))
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(2)
+    assert trainer._update_on_kvstore is True
+    assert trainer._kvstore.type == "dist_sync"
+    # allreduce_grads forbidden when updating on kvstore (reference behavior)
+    with pytest.raises(AssertionError):
+        trainer.allreduce_grads()
+
+
+@with_seed()
+def test_trainer_save_load_states(tmp_path):
+    net = _tiny_net()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    x = nd.array(np.ones((2, 2), dtype=np.float32))
+    for _ in range(3):
+        with mx.autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        trainer.step(2)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+
+    net2 = gluon.nn.Dense(1, in_units=2, use_bias=False, prefix="tnet2_")
+    net2.initialize()
+    net2.weight.set_data(net.weight.data())
+    trainer2 = gluon.Trainer(net2.collect_params(), "adam",
+                             {"learning_rate": 0.01})
+    trainer2.load_states(fname)
+    # one more identical step must produce identical weights
+    for t, n in ((trainer, net), (trainer2, net2)):
+        with mx.autograd.record():
+            loss = (n(x) ** 2).sum()
+        loss.backward()
+        t.step(2)
+    assert_almost_equal(net.weight.data().asnumpy(),
+                        net2.weight.data().asnumpy(), rtol=1e-6, atol=1e-7)
+
+
+def test_trainer_learning_rate_set_and_scheduler():
+    net = _tiny_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    assert trainer.learning_rate == 0.5
+    trainer.set_learning_rate(0.1)
+    assert trainer.learning_rate == 0.1
+
+    sched = mx.lr_scheduler.FactorScheduler(step=1, factor=0.5, base_lr=1.0)
+    trainer2 = gluon.Trainer(net.collect_params(), "sgd",
+                             {"learning_rate": 1.0, "lr_scheduler": sched})
+    with pytest.raises(UserWarning):
+        trainer2.set_learning_rate(0.1)
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+def test_metric_accuracy():
+    m = mx.metric.Accuracy()
+    preds = nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    labels = nd.array([1, 0, 0])
+    m.update([labels], [preds])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3.0) < 1e-6
+
+
+def test_metric_topk():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    preds = nd.array([[0.1, 0.2, 0.7], [0.5, 0.4, 0.1]])
+    labels = nd.array([1, 1])
+    m.update([labels], [preds])
+    _, acc = m.get()
+    assert abs(acc - 1.0) < 1e-6  # label 1 in top2 both times
+
+
+def test_metric_mse_mae_rmse():
+    labels = nd.array([1.0, 2.0, 3.0])
+    preds = nd.array([1.5, 2.0, 2.0])
+    mse = mx.metric.MSE()
+    mse.update([labels], [preds])
+    assert abs(mse.get()[1] - np.mean([0.25, 0.0, 1.0])) < 1e-6
+    mae = mx.metric.MAE()
+    mae.update([labels], [preds])
+    assert abs(mae.get()[1] - np.mean([0.5, 0.0, 1.0])) < 1e-6
+    rmse = mx.metric.RMSE()
+    rmse.update([labels], [preds])
+    assert abs(rmse.get()[1] - np.sqrt(np.mean([0.25, 0.0, 1.0]))) < 1e-6
+
+
+def test_metric_cross_entropy_and_perplexity():
+    preds = nd.array([[0.2, 0.8], [0.6, 0.4]])
+    labels = nd.array([1, 0])
+    ce = mx.metric.create("ce")
+    ce.update([labels], [preds])
+    expected = -(np.log(0.8) + np.log(0.6)) / 2
+    assert abs(ce.get()[1] - expected) < 1e-6
+    ppl = mx.metric.Perplexity(ignore_label=None)
+    ppl.update([labels], [preds])
+    assert abs(ppl.get()[1] - np.exp(expected)) < 1e-5
+
+
+def test_metric_f1():
+    m = mx.metric.F1()
+    preds = nd.array([[0.8, 0.2], [0.3, 0.7], [0.1, 0.9], [0.6, 0.4]])
+    labels = nd.array([0, 1, 1, 1])
+    m.update([labels], [preds])
+    # tp=2 fp=0 fn=1 → p=1, r=2/3, f1=0.8
+    assert abs(m.get()[1] - 0.8) < 1e-6
+
+
+def test_metric_composite_and_custom():
+    comp = mx.metric.create(["accuracy", "mse"])
+    assert isinstance(comp, mx.metric.CompositeEvalMetric)
+
+    def my_metric(label, pred):
+        return float(np.sum(label == label))
+
+    cm = mx.metric.np(my_metric)
+    labels = nd.array([1.0, 2.0])
+    cm.update([labels], [labels])
+    assert cm.get()[1] == 2.0
+
+
+def test_metric_registry_create():
+    for name in ("acc", "top_k_accuracy", "f1", "mae", "mse", "rmse",
+                 "ce", "nll_loss", "pearsonr", "loss"):
+        m = mx.metric.create(name) if name != "top_k_accuracy" else \
+            mx.metric.create(name, top_k=3)
+        assert isinstance(m, mx.metric.EvalMetric)
+    with pytest.raises(mx.MXNetError):
+        mx.metric.create("not_a_metric")

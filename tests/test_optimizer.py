@@ -1,0 +1,279 @@
+"""Optimizer tests — each update checked against a numpy reference
+implementation (models tests/python/unittest/test_optimizer.py, which
+compares fused optimizer ops against python reference updaters)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+SHAPE = (7, 13)
+
+
+def _setup(seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.uniform(-1, 1, SHAPE).astype(np.float32)
+    g = rng.uniform(-1, 1, SHAPE).astype(np.float32)
+    return w, g
+
+
+def _run_steps(opt, w0, grads):
+    weight = nd.array(w0.copy())
+    state = opt.create_state_multi_precision(0, weight)
+    for g in grads:
+        opt.update_multi_precision(0, weight, nd.array(g), state)
+    return weight.asnumpy()
+
+
+@with_seed()
+def test_sgd_matches_numpy():
+    w0, _ = _setup()
+    rng = np.random.RandomState(1)
+    grads = [rng.uniform(-1, 1, SHAPE).astype(np.float32) for _ in range(4)]
+    lr, wd, mom = 0.1, 0.01, 0.9
+
+    got = _run_steps(mx.optimizer.SGD(learning_rate=lr, wd=wd, momentum=mom),
+                     w0, grads)
+
+    w = w0.copy()
+    m = np.zeros_like(w)
+    for g in grads:
+        gg = g + wd * w
+        m = mom * m - lr * gg
+        w = w + m
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_sgd_no_momentum_and_clip():
+    w0, g = _setup()
+    lr, wd, clip = 0.05, 0.001, 0.3
+    got = _run_steps(
+        mx.optimizer.SGD(learning_rate=lr, wd=wd, clip_gradient=clip),
+        w0, [g])
+    gg = np.clip(g, -clip, clip) + wd * w0
+    assert_almost_equal(got, w0 - lr * gg, rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_nag_matches_numpy():
+    w0, _ = _setup(3)
+    rng = np.random.RandomState(4)
+    grads = [rng.uniform(-1, 1, SHAPE).astype(np.float32) for _ in range(3)]
+    lr, wd, mom = 0.1, 0.0, 0.9
+    got = _run_steps(mx.optimizer.NAG(learning_rate=lr, wd=wd, momentum=mom),
+                     w0, grads)
+    w = w0.copy()
+    m = np.zeros_like(w)
+    for g in grads:
+        m = mom * m + g
+        w = w - lr * (g + mom * m)
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_adam_matches_numpy():
+    w0, _ = _setup(5)
+    rng = np.random.RandomState(6)
+    grads = [rng.uniform(-1, 1, SHAPE).astype(np.float32) for _ in range(5)]
+    lr, b1, b2, eps, wd = 1e-2, 0.9, 0.999, 1e-8, 0.01
+    got = _run_steps(
+        mx.optimizer.Adam(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps,
+                          wd=wd), w0, grads)
+    w = w0.copy()
+    mean = np.zeros_like(w)
+    var = np.zeros_like(w)
+    for t, g in enumerate(grads, 1):
+        gg = g + wd * w
+        mean = b1 * mean + (1 - b1) * gg
+        var = b2 * var + (1 - b2) * gg * gg
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        w = w - lr_t * mean / (np.sqrt(var) + eps)
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_rmsprop_matches_numpy():
+    w0, _ = _setup(7)
+    rng = np.random.RandomState(8)
+    grads = [rng.uniform(-1, 1, SHAPE).astype(np.float32) for _ in range(3)]
+    lr, gamma1, eps = 1e-2, 0.9, 1e-8
+    got = _run_steps(
+        mx.optimizer.RMSProp(learning_rate=lr, gamma1=gamma1, epsilon=eps),
+        w0, grads)
+    w = w0.copy()
+    n = np.zeros_like(w)
+    for g in grads:
+        n = (1 - gamma1) * g * g + gamma1 * n
+        w = w - lr * g / np.sqrt(n + eps)
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_rmsprop_centered_runs():
+    w0, g = _setup(9)
+    opt = mx.optimizer.RMSProp(learning_rate=1e-2, centered=True)
+    got = _run_steps(opt, w0, [g, g])
+    assert np.all(np.isfinite(got))
+    assert not np.allclose(got, w0)
+
+
+@with_seed()
+def test_adagrad_matches_numpy():
+    w0, _ = _setup(10)
+    rng = np.random.RandomState(11)
+    grads = [rng.uniform(-1, 1, SHAPE).astype(np.float32) for _ in range(3)]
+    lr, eps = 0.1, 1e-7
+    got = _run_steps(mx.optimizer.AdaGrad(learning_rate=lr, eps=eps),
+                     w0, grads)
+    w = w0.copy()
+    h = np.zeros_like(w)
+    for g in grads:
+        h += g * g
+        w = w - lr * g / (np.sqrt(h) + eps)
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_adadelta_matches_numpy():
+    w0, _ = _setup(12)
+    rng = np.random.RandomState(13)
+    grads = [rng.uniform(-1, 1, SHAPE).astype(np.float32) for _ in range(3)]
+    rho, eps = 0.9, 1e-5
+    got = _run_steps(mx.optimizer.AdaDelta(rho=rho, epsilon=eps), w0, grads)
+    w = w0.copy()
+    acc_g = np.zeros_like(w)
+    acc_d = np.zeros_like(w)
+    for g in grads:
+        acc_g = rho * acc_g + (1 - rho) * g * g
+        d = np.sqrt(acc_d + eps) / np.sqrt(acc_g + eps) * g
+        acc_d = rho * acc_d + (1 - rho) * d * d
+        w = w - d
+    assert_almost_equal(got, w, rtol=1e-4, atol=1e-5)
+
+
+@with_seed()
+def test_ftrl_matches_numpy():
+    w0, _ = _setup(14)
+    rng = np.random.RandomState(15)
+    grads = [rng.uniform(-1, 1, SHAPE).astype(np.float32) for _ in range(3)]
+    lr, lamda1, beta, wd = 0.1, 0.01, 1.0, 0.001
+    got = _run_steps(
+        mx.optimizer.Ftrl(learning_rate=lr, lamda1=lamda1, beta=beta, wd=wd),
+        w0, grads)
+    w = w0.copy()
+    z = np.zeros_like(w)
+    n = np.zeros_like(w)
+    for g in grads:
+        n_new = n + g * g
+        z = z + g - (np.sqrt(n_new) - np.sqrt(n)) / lr * w
+        n = n_new
+        w = (np.sign(z) * lamda1 - z) / ((beta + np.sqrt(n)) / lr + wd) * \
+            (np.abs(z) > lamda1)
+    assert_almost_equal(got, w, rtol=1e-4, atol=1e-5)
+
+
+@with_seed()
+def test_signum_matches_numpy():
+    w0, _ = _setup(16)
+    rng = np.random.RandomState(17)
+    grads = [rng.uniform(-1, 1, SHAPE).astype(np.float32) for _ in range(3)]
+    lr, mom, wd_lh = 0.01, 0.9, 0.0
+    got = _run_steps(
+        mx.optimizer.Signum(learning_rate=lr, momentum=mom, wd_lh=wd_lh),
+        w0, grads)
+    w = w0.copy()
+    m = np.zeros_like(w)
+    for g in grads:
+        m = mom * m - (1 - mom) * g
+        w = (1 - lr * wd_lh) * w + lr * np.sign(m)
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_lamb_runs_and_moves_weight():
+    w0, g = _setup(18)
+    got = _run_steps(mx.optimizer.LAMB(learning_rate=1e-2), w0, [g, g, g])
+    assert np.all(np.isfinite(got))
+    assert not np.allclose(got, w0)
+
+
+def test_multi_precision_sgd_bf16():
+    w0, g = _setup(19)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           multi_precision=True)
+    weight = nd.array(w0).astype("bfloat16")
+    state = opt.create_state_multi_precision(0, weight)
+    # master copy is fp32
+    assert state[1].dtype == np.float32
+    for _ in range(3):
+        opt.update_multi_precision(0, weight, nd.array(g).astype("bfloat16"),
+                                   state)
+    # fp32 master stays close to a pure-fp32 run
+    ref = _run_steps(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9),
+                     w0, [g, g, g])
+    assert_almost_equal(state[1].asnumpy(), ref, rtol=2e-2, atol=2e-2)
+
+
+def test_create_by_name_and_registry():
+    opt = mx.optimizer.create("sgd", learning_rate=0.5)
+    assert isinstance(opt, mx.optimizer.SGD)
+    assert opt.lr == 0.5
+    assert isinstance(mx.optimizer.create("adam"), mx.optimizer.Adam)
+    with pytest.raises(ValueError):
+        mx.optimizer.create("definitely_not_an_optimizer")
+
+
+def test_lr_wd_mult():
+    opt = mx.optimizer.SGD(learning_rate=1.0, wd=0.1,
+                           param_idx2name={0: "w", 1: "b_bias"})
+    opt.set_lr_mult({"w": 0.5})
+    opt.set_wd_mult({})
+    assert opt._get_lr(0) == 0.5
+    assert opt._get_lr(1) == 1.0
+    # bias gets wd_mult 0 automatically (reference behavior)
+    assert opt._get_wd(1) == 0.0
+
+
+def test_lr_scheduler_factor():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, MultiFactorScheduler
+
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+    m = MultiFactorScheduler(step=[5, 15], factor=0.1, base_lr=1.0)
+    assert m(1) == 1.0
+    assert abs(m(6) - 0.1) < 1e-12
+    assert abs(m(16) - 0.01) < 1e-12
+
+
+def test_lr_scheduler_warmup_poly_cosine():
+    from mxnet_tpu.lr_scheduler import PolyScheduler, CosineScheduler
+
+    p = PolyScheduler(max_update=100, base_lr=1.0, pwr=2, warmup_steps=10,
+                      warmup_begin_lr=0.0)
+    assert p(5) == 0.5  # linear warmup
+    assert abs(p(100)) < 1e-6
+    c = CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.1)
+    assert abs(c(0) - 1.0) < 1e-12
+    assert abs(c(100) - 0.1) < 1e-12
+
+
+def test_updater_state_roundtrip():
+    w0, g = _setup(20)
+    opt = mx.optimizer.Adam(learning_rate=1e-2)
+    updater = mx.optimizer.get_updater(opt)
+    weight = nd.array(w0.copy())
+    updater(0, nd.array(g), weight)
+    blob = updater.get_states(dump_optimizer=True)
+
+    opt2 = mx.optimizer.Adam(learning_rate=1e-2)
+    updater2 = mx.optimizer.get_updater(opt2)
+    updater2.set_states(blob)
+    w1 = nd.array(weight.asnumpy())
+    w2 = nd.array(weight.asnumpy())
+    updater(0, nd.array(g), w1)
+    updater2(0, nd.array(g), w2)
+    assert_almost_equal(w1.asnumpy(), w2.asnumpy(), rtol=1e-6, atol=1e-7)
