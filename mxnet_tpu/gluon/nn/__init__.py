@@ -1,4 +1,5 @@
 """Gluon neural-net layers (ref: python/mxnet/gluon/nn/__init__.py)."""
+from ..block import Block, HybridBlock
 from .basic_layers import *
 from .conv_layers import *
 from .activations import *
@@ -7,4 +8,5 @@ from .basic_layers import __all__ as _basic_all
 from .conv_layers import __all__ as _conv_all
 from .activations import __all__ as _act_all
 
-__all__ = list(_basic_all) + list(_conv_all) + list(_act_all)
+__all__ = ["Block", "HybridBlock"] + list(_basic_all) + list(_conv_all) + \
+    list(_act_all)
