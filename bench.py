@@ -1,0 +1,74 @@
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Config: ResNet-50 training throughput (images/sec/chip), the SURVEY §6
+headline. Runs on whatever accelerator JAX exposes (the driver provides one
+real TPU chip); the full train step (fwd+loss+bwd+SGD) is one jitted XLA
+program in bfloat16 compute via ShardedTrainStep.
+
+vs_baseline: BASELINE.json's published table is empty (mount was empty at
+survey time), so the ratio is computed against the public MXNet-era
+V100 fp32 figure (~390 img/s, docs/faq/perf.md) as the stand-in
+denominator; see BASELINE.md.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_IMG_S = 390.0  # MXNet ResNet-50 V100 fp32 (unverified, BASELINE.md)
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import model_zoo
+    from mxnet_tpu import parallel
+
+    mx.random.seed(0)
+    net = model_zoo.get_model("resnet50_v1", classes=1000)
+    net.initialize()
+    # bf16 params/compute: MXU-native. BN stats stay f32 inside the op.
+    if os.environ.get("BENCH_DTYPE", "bfloat16") == "bfloat16":
+        net.cast("bfloat16")
+
+    x0 = nd.zeros((batch, 3, 224, 224), dtype="bfloat16")
+    net(x0)  # resolve deferred shapes eagerly
+
+    step = parallel.ShardedTrainStep(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9})
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.uniform(-1, 1, (batch, 3, 224, 224)).astype(np.float32))
+    x = x.astype(os.environ.get("BENCH_DTYPE", "bfloat16"))
+    y = nd.array(rng.randint(0, 1000, (batch,)).astype(np.float32))
+
+    for _ in range(warmup):
+        loss = step(x, y)
+    loss.wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

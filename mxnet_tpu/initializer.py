@@ -14,6 +14,17 @@ import numpy as np
 
 from .base import MXNetError
 
+
+def _rng():
+    """Draws ride the framework PRNG so mx.random.seed() reproduces inits
+    (ref: initializer.py draws via the global MXNet RNG, seeded by
+    mx.random.seed)."""
+    from . import random as _random
+    import jax
+
+    seed_arr = jax.random.key_data(_random.new_key())
+    return np.random.default_rng(np.asarray(seed_arr).astype(np.uint32))
+
 __all__ = ["Initializer", "register", "create", "Zero", "One", "Constant",
            "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu",
            "Bilinear", "LSTMBias", "Mixed", "InitDesc"]
@@ -128,7 +139,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, name, arr):
-        self._fill(arr, np.random.uniform(-self.scale, self.scale, arr.shape))
+        self._fill(arr, _rng().uniform(-self.scale, self.scale, arr.shape))
 
 
 @register
@@ -138,7 +149,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, name, arr):
-        self._fill(arr, np.random.normal(0.0, self.sigma, arr.shape))
+        self._fill(arr, _rng().normal(0.0, self.sigma, arr.shape))
 
 
 @register
@@ -152,9 +163,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(np.prod(arr.shape[1:]))
         if self.rand_type == "uniform":
-            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+            tmp = _rng().uniform(-1.0, 1.0, (nout, nin))
         else:
-            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+            tmp = _rng().normal(0.0, 1.0, (nout, nin))
         u, _, v = np.linalg.svd(tmp, full_matrices=False)
         q = u if u.shape == tmp.shape else v
         self._fill(arr, self.scale * q.reshape(arr.shape))
@@ -191,9 +202,9 @@ class Xavier(Initializer):
             raise MXNetError("invalid factor_type %r" % (self.factor_type,))
         scale = math.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            self._fill(arr, np.random.uniform(-scale, scale, shape))
+            self._fill(arr, _rng().uniform(-scale, scale, shape))
         elif self.rnd_type == "gaussian":
-            self._fill(arr, np.random.normal(0, scale, shape))
+            self._fill(arr, _rng().normal(0, scale, shape))
         else:
             raise MXNetError("invalid rnd_type %r" % (self.rnd_type,))
 

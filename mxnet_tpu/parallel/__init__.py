@@ -1,0 +1,22 @@
+"""Parallelism over device meshes — the TPU-native replacement for the
+reference's KVStore/ps-lite/NCCL stack (ref: SURVEY §2.4/§5).
+
+Design: pick a Mesh, annotate shardings, let XLA insert collectives over
+ICI/DCN (psum/all_gather/reduce_scatter compiled into the step) — instead of
+translating worker/server push/pull. The KVStore API survives as a facade
+(mxnet_tpu/kvstore.py); this package holds the real machinery:
+
+- mesh.py: mesh construction + distributed init (multi-host)
+- sharded.py: sharded training-step builder over Gluon blocks
+  (data/tensor parallel via PartitionSpec rules)
+"""
+from .mesh import (
+    make_mesh, data_parallel_mesh, init_distributed, local_device_count,
+)
+from .sharded import (
+    ShardedTrainStep, shard_params, sharding_rule, allreduce_across_processes,
+)
+
+__all__ = ["make_mesh", "data_parallel_mesh", "init_distributed",
+           "local_device_count", "ShardedTrainStep", "shard_params",
+           "sharding_rule", "allreduce_across_processes"]

@@ -1,0 +1,68 @@
+"""Device mesh construction and multi-host initialization.
+
+Replaces the reference's cluster topology layer (ref: ps-lite Postoffice
+membership + tools/launch.py tracker): on TPU the "cluster" is a slice, and
+jax.distributed.initialize + a Mesh over all devices is the whole story.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ..base import MXNetError
+
+__all__ = ["make_mesh", "data_parallel_mesh", "init_distributed",
+           "local_device_count"]
+
+
+def local_device_count():
+    return jax.local_device_count()
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Multi-host init (ref: the DMLC_PS_ROOT_URI/DMLC_ROLE rendezvous in
+    ps-lite — here a single coordinator handshake).
+
+    No-arg form reads the standard JAX env (or cloud TPU metadata)."""
+    if coordinator_address is None:
+        jax.distributed.initialize()
+    else:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+
+
+def make_mesh(shape=None, axis_names=("data", "model"), devices=None):
+    """Build a Mesh over the (global) device list.
+
+    ``shape`` of -1 entries auto-fills like reshape; default puts every
+    device on the data axis. On a pod slice the device order from
+    jax.devices() is ICI-contiguous, so adjacent mesh coordinates ride ICI
+    rather than DCN — keep the fastest-varying axis the model axis.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape is None:
+        shape = (n,) + (1,) * (len(axis_names) - 1)
+    shape = list(shape)
+    if shape.count(-1) > 1:
+        raise MXNetError("at most one mesh axis may be -1")
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        if n % known:
+            raise MXNetError(
+                "cannot infer mesh axis: %d devices not divisible by %d"
+                % (n, known))
+        shape[shape.index(-1)] = n // known
+    if int(np.prod(shape)) != n:
+        raise MXNetError(
+            "mesh shape %s does not cover %d devices" % (tuple(shape), n))
+    arr = np.array(devices).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def data_parallel_mesh(devices=None):
+    return make_mesh(axis_names=("data",), devices=devices)

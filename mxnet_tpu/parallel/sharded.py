@@ -1,0 +1,235 @@
+"""Sharded training step over a Gluon block — SPMD data/tensor parallel.
+
+This is the TPU-native core that replaces the reference's entire
+DataParallelExecutorGroup + KVStore push/pull machinery
+(ref: python/mxnet/module/executor_group.py, src/kvstore/*): the whole
+train step (forward, backward, optimizer) is ONE jitted XLA program over a
+Mesh; gradient reduction across the data axis and any tensor-parallel
+collectives are inserted by GSPMD and ride ICI.
+
+Params live as jax arrays placed with NamedSharding; PartitionSpec rules
+(regex on parameter name) give tensor parallelism, default is replicated
+(pure data parallel). Aux states (BatchNorm running stats) are carried as
+non-differentiated inputs and returned updated — the same rebind-capture
+protocol as CachedOp (gluon/block.py — _build_cached).
+"""
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from .. import autograd as ag
+from .. import random as _random
+from ..ndarray.ndarray import NDArray
+from ..gluon.block import Block, _trace_depth
+from ..gluon.parameter import param_trace_scope
+from .mesh import make_mesh
+
+__all__ = ["ShardedTrainStep", "shard_params", "sharding_rule",
+           "allreduce_across_processes"]
+
+
+def sharding_rule(*pairs):
+    """Build a rule list: (name_regex, PartitionSpec) applied first-match."""
+    return [(re.compile(pat), spec) for pat, spec in pairs]
+
+
+def _spec_for(name, rules):
+    if rules:
+        for pat, spec in rules:
+            if pat.search(name):
+                return spec
+    return P()  # replicated
+
+
+def shard_params(params, mesh, rules=None):
+    """Place Parameter buffers on the mesh per the rules (replicated unless
+    a rule names a tensor-parallel layout)."""
+    for name, p in params.items():
+        spec = _spec_for(name, rules)
+        sharded = jax.device_put(p.data().data, NamedSharding(mesh, spec))
+        p.data()._set_data(sharded)
+
+
+def _make_opt_update(optimizer, optimizer_params):
+    """Per-tensor pure update fn + state-init, from the fused optimizer ops
+    (the same kernels the eager Updater uses)."""
+    from ..ops.registry import get_op
+
+    hp = dict(optimizer_params or {})
+    lr = hp.pop("learning_rate", 0.01)
+    wd = hp.pop("wd", 0.0)
+    momentum = hp.pop("momentum", 0.0)
+    rescale = hp.pop("rescale_grad", 1.0)
+    clip = hp.pop("clip_gradient", None)
+
+    if optimizer == "sgd":
+        if momentum:
+            fn = get_op("sgd_mom_update").fn
+
+            def init(w):
+                return (jnp.zeros_like(w),)
+
+            def update(w, g, s, t):
+                w2, m2 = fn(w, g, s[0], lr=lr, momentum=momentum, wd=wd,
+                            rescale_grad=rescale, clip_gradient=clip)
+                return w2, (m2,)
+        else:
+            fn = get_op("sgd_update").fn
+
+            def init(w):
+                return ()
+
+            def update(w, g, s, t):
+                return fn(w, g, lr=lr, wd=wd, rescale_grad=rescale,
+                          clip_gradient=clip), ()
+    elif optimizer == "adam":
+        beta1 = hp.pop("beta1", 0.9)
+        beta2 = hp.pop("beta2", 0.999)
+        eps = hp.pop("epsilon", 1e-8)
+        fn = get_op("adam_update").fn
+
+        def init(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(w, g, s, t):
+            # bias correction folded into lr, as the eager Adam does
+            coef1 = 1.0 - beta1 ** t
+            coef2 = 1.0 - beta2 ** t
+            lr_t = lr * jnp.sqrt(coef2) / coef1
+            w2, m2, v2 = fn(w, g, s[0], s[1], lr=lr_t, beta1=beta1,
+                            beta2=beta2, epsilon=eps, wd=wd,
+                            rescale_grad=rescale, clip_gradient=clip)
+            return w2, (m2, v2)
+    else:
+        raise MXNetError(
+            "ShardedTrainStep supports 'sgd' and 'adam'; got %r (use the "
+            "eager Trainer for other optimizers)" % (optimizer,))
+    return init, update
+
+
+class ShardedTrainStep:
+    """One-program SPMD training step for a Gluon block.
+
+    Usage::
+
+        mesh = parallel.make_mesh((dp, tp), ("data", "model"))
+        step = ShardedTrainStep(net, loss_fn, "sgd",
+                                {"learning_rate": 0.1}, mesh=mesh,
+                                rules=sharding_rule((r"dense\\d+_weight",
+                                                     P("model", None))))
+        loss = step(x_batch, y_batch)   # params update in place
+
+    The batch is sharded along the mesh's data axis; XLA emits the grad
+    psum over that axis (data parallel) and whatever collectives the rules
+    imply (tensor parallel).
+    """
+
+    def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
+                 mesh=None, rules=None, data_axis="data"):
+        self.block = block
+        self.loss_fn = loss_fn
+        self.mesh = mesh or make_mesh(axis_names=(data_axis,))
+        self.data_axis = data_axis
+        self._all_params = OrderedDict(
+            sorted(block.collect_params().items()))
+        for name, p in self._all_params.items():
+            if p._data is None:
+                raise MXNetError(
+                    "parameter %s is not initialized (run net.initialize() "
+                    "and one eager forward for deferred shapes)" % name)
+        self._train_names = [n for n, p in self._all_params.items()
+                             if p.grad_req != "null"]
+        self._aux_names = [n for n, p in self._all_params.items()
+                           if p.grad_req == "null"]
+        shard_params(self._all_params, self.mesh, rules)
+        self._init_s, self._update = _make_opt_update(
+            optimizer, optimizer_params)
+        self._states = {
+            n: self._init_s(self._all_params[n].data().data)
+            for n in self._train_names}
+        self._t = 0
+        self._jit = self._build()
+
+    # ------------------------------------------------------------------
+    def _pure_loss(self, train_vals, aux_vals, x, y, key):
+        """Forward + loss as a pure function; aux rebinds captured."""
+        wrappers = {}
+        for n, v in zip(self._train_names, train_vals):
+            wrappers[n] = NDArray(v)
+        for n, v in zip(self._aux_names, aux_vals):
+            wrappers[n] = NDArray(v)
+        mapping = {self._all_params[n]: w for n, w in wrappers.items()}
+        _trace_depth.depth += 1
+        try:
+            with ag.pause(train_mode=True), _random.key_scope(key), \
+                    param_trace_scope(mapping):
+                out = Block.__call__(self.block, NDArray(x))
+                loss = self.loss_fn(out, NDArray(y))
+                loss = loss.mean()
+        finally:
+            _trace_depth.depth -= 1
+        new_aux = tuple(
+            jax.lax.stop_gradient(wrappers[n].data) for n in self._aux_names)
+        return loss.data, new_aux
+
+    def _build(self):
+        def step(train_vals, states, aux_vals, x, y, key, t):
+            (loss, new_aux), grads = jax.value_and_grad(
+                self._pure_loss, has_aux=True)(train_vals, aux_vals, x, y,
+                                               key)
+            new_train = []
+            new_states = []
+            for w, g, s in zip(train_vals, grads, states):
+                w2, s2 = self._update(w, g, s, t)
+                new_train.append(w2)
+                new_states.append(s2)
+            return loss, tuple(new_train), tuple(new_states), new_aux
+
+        # params/states keep their placement; donate them so XLA reuses the
+        # buffers (the static_alloc analog)
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------
+    def _shard_batch(self, arr):
+        data = arr.data if isinstance(arr, NDArray) else jnp.asarray(arr)
+        spec = P(self.data_axis, *([None] * (data.ndim - 1)))
+        return jax.device_put(data, NamedSharding(self.mesh, spec))
+
+    def __call__(self, x, y):
+        self._t += 1
+        train_vals = tuple(self._all_params[n].data().data
+                           for n in self._train_names)
+        aux_vals = tuple(self._all_params[n].data().data
+                         for n in self._aux_names)
+        states = tuple(self._states[n] for n in self._train_names)
+        key = _random.new_key()
+        loss, new_train, new_states, new_aux = self._jit(
+            train_vals, states, aux_vals, self._shard_batch(x),
+            self._shard_batch(y), key, self._t)
+        for n, v in zip(self._train_names, new_train):
+            self._all_params[n].data()._set_data(v)
+        for n, s in zip(self._train_names, new_states):
+            self._states[n] = s
+        for n, v in zip(self._aux_names, new_aux):
+            self._all_params[n].data()._set_data(v)
+        return NDArray(loss)
+
+
+def allreduce_across_processes(value):
+    """Sum an array across processes (used by the dist kvstore facade).
+    Single-process: identity."""
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    data = value.data if isinstance(value, NDArray) else value
+    summed = multihost_utils.process_allgather(data)
+    out = jnp.sum(summed, axis=0)
+    return NDArray(out) if isinstance(value, NDArray) else out
