@@ -33,11 +33,14 @@ from . import metric
 from . import kvstore
 from . import kvstore as kv
 from . import callback
+from . import recordio
+from . import io
 from . import gluon
+from . import parallel
 
 __all__ = [
     "nd", "ndarray", "autograd", "random", "context", "Context", "cpu",
     "gpu", "tpu", "NDArray", "MXNetError", "test_utils", "initializer",
     "init", "gluon", "optimizer", "opt", "metric", "kvstore", "kv",
-    "lr_scheduler", "callback",
+    "lr_scheduler", "callback", "recordio", "io", "parallel",
 ]

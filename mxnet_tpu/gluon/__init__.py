@@ -6,6 +6,7 @@ from .trainer import Trainer
 from . import nn
 from . import loss
 from . import utils
+from . import data
 from . import model_zoo
 from .utils import split_and_load, split_data
 

@@ -1,0 +1,103 @@
+"""DataLoader (ref: python/mxnet/gluon/data/dataloader.py).
+
+The reference forks worker processes that return CPUShared-storage
+NDArrays. TPU-native redesign: workers are *threads* by default —
+batchification is numpy (releases the GIL in C loops) and the expensive
+device transfer happens once on the main thread via a single device_put,
+overlapping with compute thanks to XLA async dispatch. num_workers>0 uses a
+thread pool; a multiprocessing path is intentionally not the default (the
+reference needed it for Python-speed augmentation; PIL/numpy release the
+GIL).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import queue
+import threading
+
+import numpy as np
+
+from ...ndarray.ndarray import NDArray
+from ...ndarray import ndarray as _nd
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (ref: dataloader.py — default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return _nd.array(np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    out = np.asarray(data)
+    return _nd.array(out, dtype=out.dtype)
+
+
+class DataLoader:
+    """Load a Dataset in mini-batches (ref: dataloader.py — DataLoader)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=True):
+        self._dataset = dataset
+        del pin_memory  # device placement is one device_put on TPU
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is "
+                    "specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(
+                sampler, batch_size, last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _load_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._load_batch(indices)
+            return
+
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=self._num_workers) as pool:
+            pending = []
+            it = iter(self._batch_sampler)
+            try:
+                for _ in range(max(1, self._prefetch)):
+                    pending.append(pool.submit(self._load_batch, next(it)))
+            except StopIteration:
+                it = None
+            while pending:
+                batch = pending.pop(0).result()
+                if it is not None:
+                    try:
+                        pending.append(pool.submit(self._load_batch,
+                                                   next(it)))
+                    except StopIteration:
+                        it = None
+                yield batch

@@ -1,0 +1,204 @@
+"""Vision datasets (ref: python/mxnet/gluon/data/vision/datasets.py).
+
+No network in this environment: datasets read standard-format files from a
+local ``root`` (idx-gz for MNIST/FashionMNIST, python pickles for CIFAR,
+.rec for ImageRecordDataset) and raise a clear error if absent — the
+reference's auto-download step is the only part dropped.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ....base import MXNetError
+from ....ndarray import ndarray as _nd
+from ..dataset import Dataset, RecordFileDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._root = os.path.expanduser(root)
+        if not os.path.isdir(self._root):
+            raise MXNetError(
+                "dataset root %s does not exist (no network in this build: "
+                "place the dataset files there manually)" % self._root)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from idx files (ref: datasets.py — MNIST)."""
+
+    _train_files = ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz")
+    _test_files = ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz")
+
+    def __init__(self, root="~/.mxnet/datasets/mnist", train=True,
+                 transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _read_idx(self, path):
+        opener = gzip.open if path.endswith(".gz") else open
+        if not os.path.exists(path):
+            base, ext = os.path.splitext(path)
+            alt = base if ext == ".gz" else path + ".gz"
+            if os.path.exists(alt):
+                path = alt
+            else:
+                raise MXNetError("dataset file %s not found" % path)
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            magic = struct.unpack(">I", f.read(4))[0]
+            ndim = magic & 0xFF
+            shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+            return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+    def _get_data(self):
+        img_f, lbl_f = self._train_files if self._train else self._test_files
+        images = self._read_idx(os.path.join(self._root, img_f))
+        labels = self._read_idx(os.path.join(self._root, lbl_f))
+        self._data = images.reshape(-1, 28, 28, 1)
+        self._label = labels.astype(np.int32)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 from the python-pickle batches (ref: datasets.py — CIFAR10)."""
+
+    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True,
+                 transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _load_batches(self, names):
+        data, labels = [], []
+        for name in names:
+            path = os.path.join(self._root, name)
+            if not os.path.exists(path):
+                # allow the cifar-10-batches-py subdir layout
+                alt = os.path.join(self._root, "cifar-10-batches-py", name)
+                if os.path.exists(alt):
+                    path = alt
+                else:
+                    raise MXNetError("dataset file %s not found" % path)
+            with open(path, "rb") as f:
+                batch = pickle.load(f, encoding="latin1")
+            data.append(np.asarray(batch["data"], dtype=np.uint8))
+            labels.extend(batch.get("labels", batch.get("fine_labels")))
+        data = np.concatenate(data).reshape(-1, 3, 32, 32)
+        return data.transpose(0, 2, 3, 1), np.asarray(labels, dtype=np.int32)
+
+    def _get_data(self):
+        if self._train:
+            names = ["data_batch_%d" % i for i in range(1, 6)]
+        else:
+            names = ["test_batch"]
+        self._data, self._label = self._load_batches(names)
+
+
+class CIFAR100(CIFAR10):
+    def __init__(self, root="~/.mxnet/datasets/cifar100", fine_label=True,
+                 train=True, transform=None):
+        self._fine = fine_label
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        name = "train" if self._train else "test"
+        path = os.path.join(self._root, name)
+        if not os.path.exists(path):
+            alt = os.path.join(self._root, "cifar-100-python", name)
+            if os.path.exists(alt):
+                path = alt
+            else:
+                raise MXNetError("dataset file %s not found" % path)
+        with open(path, "rb") as f:
+            batch = pickle.load(f, encoding="latin1")
+        data = np.asarray(batch["data"], dtype=np.uint8).reshape(-1, 3, 32, 32)
+        self._data = data.transpose(0, 2, 3, 1)
+        key = "fine_labels" if self._fine else "coarse_labels"
+        self._label = np.asarray(batch[key], dtype=np.int32)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images in a .rec file (ref: datasets.py — ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ....recordio import unpack_img
+
+        record = super().__getitem__(idx)
+        header, img = unpack_img(record, self._flag)
+        label = header.label
+        if isinstance(label, np.ndarray) and label.size == 1:
+            label = float(label[0])
+        img = _nd.array(img)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """root/category/image.jpg layout (ref: datasets.py — ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if os.path.splitext(filename)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from PIL import Image
+
+        path, label = self.items[idx]
+        img = Image.open(path)
+        img = img.convert("RGB" if self._flag else "L")
+        img = _nd.array(np.asarray(img))
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

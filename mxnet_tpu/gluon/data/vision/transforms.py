@@ -1,0 +1,251 @@
+"""Vision transforms (ref: python/mxnet/gluon/data/vision/transforms.py).
+
+Blocks operating on HWC uint8/float images. Host-side numpy/PIL where the
+reference used OpenCV ops; ToTensor/Normalize produce the CHW float arrays
+the models consume.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....base import MXNetError
+from ....ndarray.ndarray import NDArray
+from ....ndarray import ndarray as _nd
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomColorJitter", "RandomLighting"]
+
+
+def _to_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+class Compose(Sequential):
+    """Sequentially compose transforms (ref: transforms.py — Compose)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+    def forward(self, x):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+
+class Cast(Block):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        if isinstance(x, NDArray):
+            return x.astype(self._dtype)
+        return _nd.array(np.asarray(x), dtype=self._dtype)
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (ref: transforms.py — ToTensor)."""
+
+    def forward(self, x):
+        arr = _to_np(x).astype(np.float32) / 255.0
+        if arr.ndim == 3:
+            arr = arr.transpose(2, 0, 1)
+        elif arr.ndim == 4:
+            arr = arr.transpose(0, 3, 1, 2)
+        return _nd.array(arr)
+
+
+class Normalize(Block):
+    """(x - mean) / std on CHW float input (ref: transforms.py — Normalize)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, dtype=np.float32)
+        self._std = np.asarray(std, dtype=np.float32)
+
+    def forward(self, x):
+        arr = _to_np(x).astype(np.float32)
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return _nd.array((arr - mean) / std)
+
+
+def _pil_resize(arr, size, interpolation):
+    from PIL import Image
+
+    if isinstance(size, int):
+        size = (size, size)
+    pil = Image.fromarray(arr.astype(np.uint8))
+    return np.asarray(pil.resize(tuple(size), interpolation))
+
+
+class Resize(Block):
+    """Resize to (w, h) or short-edge (ref: transforms.py — Resize)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from PIL import Image
+
+        arr = _to_np(x)
+        interp = Image.BILINEAR if self._interpolation == 1 else \
+            Image.NEAREST
+        if isinstance(self._size, int) and self._keep:
+            h, w = arr.shape[:2]
+            if h < w:
+                size = (int(w * self._size / h), self._size)
+            else:
+                size = (self._size, int(h * self._size / w))
+        elif isinstance(self._size, int):
+            size = (self._size, self._size)
+        else:
+            size = tuple(self._size)
+        return _nd.array(_pil_resize(arr, size, interp))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        arr = _to_np(x)
+        tw, th = self._size
+        h, w = arr.shape[:2]
+        if h < th or w < tw:
+            from PIL import Image
+
+            arr = _pil_resize(arr, (max(tw, w), max(th, h)), Image.BILINEAR)
+            h, w = arr.shape[:2]
+        y = (h - th) // 2
+        x0 = (w - tw) // 2
+        return _nd.array(arr[y:y + th, x0:x0 + tw])
+
+
+class RandomResizedCrop(Block):
+    """Random area+aspect crop then resize
+    (ref: transforms.py — RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        from PIL import Image
+
+        arr = _to_np(x)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = np.random.uniform(*self._scale) * area
+            log_ratio = (np.log(self._ratio[0]), np.log(self._ratio[1]))
+            aspect = np.exp(np.random.uniform(*log_ratio))
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if cw <= w and ch <= h:
+                y = np.random.randint(0, h - ch + 1)
+                x0 = np.random.randint(0, w - cw + 1)
+                crop = arr[y:y + ch, x0:x0 + cw]
+                return _nd.array(_pil_resize(crop, self._size,
+                                             Image.BILINEAR))
+        # fallback: center crop
+        return CenterCrop(self._size)(_nd.array(arr))
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        arr = _to_np(x)
+        if np.random.rand() < 0.5:
+            arr = arr[:, ::-1]
+        return _nd.array(np.ascontiguousarray(arr))
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        arr = _to_np(x)
+        if np.random.rand() < 0.5:
+            arr = arr[::-1]
+        return _nd.array(np.ascontiguousarray(arr))
+
+
+class _RandomScale(Block):
+    def __init__(self, jitter):
+        super().__init__()
+        self._jitter = jitter
+
+    def _factor(self):
+        return 1.0 + np.random.uniform(-self._jitter, self._jitter)
+
+
+class RandomBrightness(_RandomScale):
+    def forward(self, x):
+        arr = _to_np(x).astype(np.float32)
+        return _nd.array(np.clip(arr * self._factor(), 0, 255))
+
+
+class RandomContrast(_RandomScale):
+    def forward(self, x):
+        arr = _to_np(x).astype(np.float32)
+        mean = arr.mean()
+        return _nd.array(np.clip((arr - mean) * self._factor() + mean,
+                                 0, 255))
+
+
+class RandomSaturation(_RandomScale):
+    def forward(self, x):
+        arr = _to_np(x).astype(np.float32)
+        gray = arr.mean(axis=-1, keepdims=True)
+        f = self._factor()
+        return _nd.array(np.clip(arr * f + gray * (1 - f), 0, 255))
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        del hue  # HSV hue jitter needs colorsys per-pixel; omitted (rare)
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+
+    def forward(self, x):
+        order = np.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (ref: transforms.py — RandomLighting)."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], dtype=np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], dtype=np.float32)
+
+    def __init__(self, alpha_std=0.05):
+        super().__init__()
+        self._alpha_std = alpha_std
+
+    def forward(self, x):
+        arr = _to_np(x).astype(np.float32)
+        alpha = np.random.normal(0, self._alpha_std, 3).astype(np.float32)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return _nd.array(np.clip(arr + rgb, 0, 255))

@@ -1,0 +1,8 @@
+"""Data iterators (ref: python/mxnet/io/__init__.py)."""
+from .io import (
+    DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
+    CSVIter, MNISTIter, ImageRecordIter,
+)
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]

@@ -1,0 +1,557 @@
+"""Data iterators (ref: python/mxnet/io/io.py + src/io/*).
+
+The reference's C++ iterator chain (read → decode → augment → batch →
+prefetch, src/io/iter_image_recordio_2.cc) is rebuilt as python threads over
+the RecordIO reader with a double-buffered prefetcher — host CPU work that
+overlaps with device compute (XLA dispatch is async, so the train loop's
+next-batch decode runs while the TPU executes the step). The DataIter/
+DataBatch/DataDesc API is preserved for Module binding.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from collections import namedtuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ndarray import ndarray as _nd
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """Name/shape/dtype/layout of one input (ref: io.py — DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
+                                          self.layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """One batch: data list + label list + padding info
+    (ref: io.py — DataBatch)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data] if self.data else None
+        label_shapes = [l.shape for l in self.label] if self.label else None
+        return "{}: data shapes: {} label shapes: {}".format(
+            self.__class__.__name__, data_shapes, label_shapes)
+
+
+class DataIter:
+    """Base iterator (ref: io.py — DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize data/label inputs to a list of (name, np.ndarray)
+    (ref: io.py — _init_data)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {"_%d_%s" % (i, default_name): d
+                    for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError(
+            "Input must be NDArray, numpy.ndarray, a list of them or dict "
+            "with them as values")
+    out = {}
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            out[k] = v.asnumpy()
+        else:
+            out[k] = np.asarray(v)
+    return list(sorted(out.items()))
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (ref: io.py — NDArrayIter).
+    Supports shuffle, discard/pad/roll_over last-batch handling."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False,
+                               default_name=data_name)
+        self.label = _init_data(label, allow_empty=True,
+                                default_name=label_name)
+        self.idx = np.arange(self.data[0][1].shape[0])
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.num_data = self.idx.shape[0]
+        if last_batch_handle == "discard":
+            self.num_data = (self.num_data // batch_size) * batch_size
+        assert self.num_data >= batch_size, \
+            "batch_size needs to be smaller than data size"
+        self.cursor = -batch_size
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self.idx)
+        if self.last_batch_handle == "roll_over" and \
+                self.cursor > self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % \
+                self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _take(self, arrays):
+        start = self.cursor
+        end = min(start + self.batch_size, self.num_data)
+        out = []
+        for _, arr in arrays:
+            chunk = arr[self.idx[start:end]]
+            if end - start < self.batch_size:  # pad from the beginning
+                pad = self.batch_size - (end - start)
+                chunk = np.concatenate([chunk, arr[self.idx[:pad]]], axis=0)
+            out.append(_nd.array(chunk, dtype=chunk.dtype))
+        return out
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+    def getindex(self):
+        end = min(self.cursor + self.batch_size, self.num_data)
+        return self.idx[self.cursor:end]
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to `size` batches per epoch
+    (ref: io.py — ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Thread-prefetching wrapper — the dmlc::ThreadedIter double-buffer
+    analog (ref: io.py — PrefetchingIter, src/io/iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        super().__init__()
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0].shape[0]
+        self._queues = [queue.Queue(maxsize=2) for _ in iters]
+        self._threads = []
+        self._stop = threading.Event()
+        self._start_threads()
+        self.current_batch = [None] * len(iters)
+
+    def _start_threads(self):
+        def worker(i):
+            while not self._stop.is_set():
+                try:
+                    batch = self.iters[i].next()
+                except StopIteration:
+                    self._queues[i].put(None)
+                    return
+                self._queues[i].put(batch)
+
+        self._threads = [
+            threading.Thread(target=worker, args=(i,), daemon=True)
+            for i in range(len(self.iters))]
+        for t in self._threads:
+            t.start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([
+            [DataDesc(r[x.name], x.shape, x.dtype)
+             if isinstance(r, dict) else x
+             for x in i.provide_data]
+            for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([
+            [DataDesc(r[x.name], x.shape, x.dtype)
+             if isinstance(r, dict) else x
+             for x in i.provide_label]
+            for r, i in zip(self.rename_label, self.iters)], [])
+
+    def reset(self):
+        self._stop.set()
+        for q in self._queues:
+            while not q.empty():
+                q.get_nowait()
+        for t in self._threads:
+            t.join(timeout=1.0)
+        for it in self.iters:
+            it.reset()
+        self._stop = threading.Event()
+        self._queues = [queue.Queue(maxsize=2) for _ in self.iters]
+        self._start_threads()
+
+    def iter_next(self):
+        batches = [q.get() for q in self._queues]
+        if any(b is None for b in batches):
+            return False
+        self.current_batch = batches
+        return True
+
+    def next(self):
+        if self.iter_next():
+            if len(self.current_batch) == 1:
+                return self.current_batch[0]
+            return DataBatch(
+                data=sum([b.data for b in self.current_batch], []),
+                label=sum([b.label for b in self.current_batch], []),
+                pad=self.current_batch[0].pad)
+        raise StopIteration
+
+    def getdata(self):
+        return sum([b.data for b in self.current_batch], [])
+
+    def getlabel(self):
+        return sum([b.label for b in self.current_batch], [])
+
+    def getpad(self):
+        return self.current_batch[0].pad
+
+
+class CSVIter(NDArrayIter):
+    """CSV-backed iterator (ref: src/io/iter_csv.cc — CSVIter). Loads to
+    memory (host RAM is ample relative to the reference's streaming C++
+    design; revisit if a config needs out-of-core CSV)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        super().__init__(
+            data, label, batch_size=batch_size,
+            last_batch_handle="pad" if round_batch else "discard", **kwargs)
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST idx-format reader (ref: src/io/iter_mnist.cc)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 silent=True, seed=None, **kwargs):
+        import gzip
+        import struct as _struct
+
+        def read_idx(path):
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rb") as f:
+                magic = _struct.unpack(">I", f.read(4))[0]
+                ndim = magic & 0xFF
+                shape = _struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+                return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+        images = read_idx(image).astype(np.float32) / 255.0
+        labels = read_idx(label).astype(np.float32)
+        if flat:
+            images = images.reshape(images.shape[0], -1)
+        else:
+            images = images.reshape(images.shape[0], 1,
+                                    images.shape[1], images.shape[2])
+        if seed is not None:
+            np.random.seed(seed)
+        super().__init__(images, labels, batch_size=batch_size,
+                         shuffle=shuffle, **kwargs)
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator with threaded decode + augmentation
+    (ref: src/io/iter_image_recordio_2.cc — ImageRecordIOParser2).
+
+    Supported augmentations (the hot subset of image_aug_default.cc):
+    resize, rand_crop, rand_mirror, crop to data_shape, mean/std
+    normalization. Decode threads pull record offsets from a shared cursor;
+    a bounded queue feeds batches.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, path_imgidx=None,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, resize=-1, label_width=1,
+                 preprocess_threads=4, round_batch=True, seed=0,
+                 part_index=0, num_parts=1, **kwargs):
+        super().__init__(batch_size)
+        from ..recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
+
+        self._unpack_img = unpack_img
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.resize = resize
+        self.mean = np.array([mean_r, mean_g, mean_b], dtype=np.float32)
+        self.std = np.array([std_r, std_g, std_b], dtype=np.float32)
+        self.round_batch = round_batch
+        self.preprocess_threads = max(1, preprocess_threads)
+        self._rng = np.random.RandomState(seed)
+
+        # index all record offsets once (via .idx if present, else a scan)
+        if path_imgidx:
+            rec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            self._offsets = [rec.idx[k] for k in rec.keys]
+            rec.close()
+        else:
+            rec = MXRecordIO(path_imgrec, "r")
+            self._offsets = []
+            while True:
+                pos = rec.tell()
+                if rec.read() is None:
+                    break
+                self._offsets.append(pos)
+            rec.close()
+        # distributed sharding (part_index/num_parts — dmlc InputSplit)
+        self._offsets = self._offsets[part_index::num_parts]
+        self.path_imgrec = path_imgrec
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        self._order = np.arange(len(self._offsets))
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+        self._cursor = 0
+
+    def _decode_one(self, offset, reader):
+        reader.handle.seek(offset)
+        raw = reader.read()
+        header, img = self._unpack_img(raw)
+        img = img.astype(np.float32)
+        if self.resize > 0:
+            img = _resize_short(img, self.resize)
+        c, h, w = self.data_shape
+        img = _crop(img, h, w,
+                    rand=self.rand_crop, rng=self._rng)
+        if self.rand_mirror and self._rng.rand() < 0.5:
+            img = img[:, ::-1, :]
+        img = (img - self.mean) / self.std
+        img = np.transpose(img, (2, 0, 1))  # HWC → CHW
+        label = header.label
+        if isinstance(label, np.ndarray) and self.label_width == 1:
+            label = float(label[0])
+        return img, label
+
+    def next(self):
+        from ..recordio import MXRecordIO
+
+        n = len(self._offsets)
+        if self._cursor >= n:
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        idxs = list(self._order[self._cursor:min(end, n)])
+        pad = 0
+        if end > n:
+            if not self.round_batch and len(idxs) == 0:
+                raise StopIteration
+            pad = end - n
+            idxs += list(self._order[:pad])
+        self._cursor = end
+
+        results = [None] * len(idxs)
+
+        def worker(tid):
+            reader = MXRecordIO(self.path_imgrec, "r")
+            for j in range(tid, len(idxs), self.preprocess_threads):
+                results[j] = self._decode_one(self._offsets[idxs[j]], reader)
+            reader.close()
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(self.preprocess_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        data = np.stack([r[0] for r in results])
+        label = np.asarray([r[1] for r in results], dtype=np.float32)
+        return DataBatch(data=[_nd.array(data)], label=[_nd.array(label)],
+                         pad=pad)
+
+
+def _resize_short(img, size):
+    """Resize so the short edge is `size` (PIL bilinear)."""
+    from PIL import Image
+
+    h, w = img.shape[:2]
+    if h < w:
+        new_h, new_w = size, int(w * size / h)
+    else:
+        new_h, new_w = int(h * size / w), size
+    pil = Image.fromarray(img.astype(np.uint8))
+    return np.asarray(pil.resize((new_w, new_h), Image.BILINEAR),
+                      dtype=np.float32)
+
+
+def _crop(img, th, tw, rand=False, rng=None):
+    h, w = img.shape[:2]
+    if h < th or w < tw:  # upscale if too small
+        from PIL import Image
+
+        scale = max(th / h, tw / w)
+        pil = Image.fromarray(img.astype(np.uint8))
+        img = np.asarray(
+            pil.resize((int(np.ceil(w * scale)), int(np.ceil(h * scale))),
+                       Image.BILINEAR), dtype=np.float32)
+        h, w = img.shape[:2]
+    if rand:
+        y = rng.randint(0, h - th + 1)
+        x = rng.randint(0, w - tw + 1)
+    else:
+        y = (h - th) // 2
+        x = (w - tw) // 2
+    return img[y:y + th, x:x + tw, :]

@@ -1,0 +1,228 @@
+"""RecordIO — framed binary record files
+(ref: 3rdparty/dmlc-core/include/dmlc/recordio.h,
+python/mxnet/recordio.py — MXRecordIO/MXIndexedRecordIO/IRHeader/pack/unpack).
+
+Byte format follows the dmlc spec: every record is
+``[kMagic u32][cflag:3|len:29 u32][payload][pad to 4B]`` so shards are
+recoverable by magic-scan and readable by dmlc tooling. Image records carry
+an IRHeader prefix (flag, label, id, id2) with optional multi-label tail.
+Pure python implementation (the reference's C++ reader is a host-side
+throughput concern; the TPU build overlaps decode with device compute in the
+iterator layer instead — see io/).
+"""
+from __future__ import annotations
+
+import io as _io
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_KMAGIC = 0xCED7230A
+_LEN_MASK = (1 << 29) - 1
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (ref: recordio.py — MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.handle.close()
+            self.is_open = False
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        """Support pickling across DataLoader worker fork
+        (ref: recordio.py — __getstate__ closes the handle)."""
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        del d["handle"]
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.handle = None
+        is_open = d["is_open"]
+        self.is_open = False
+        if is_open:
+            self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.handle.tell()
+
+    def write(self, buf):
+        assert self.writable
+        header = struct.pack("<II", _KMAGIC, len(buf) & _LEN_MASK)
+        self.handle.write(header)
+        self.handle.write(buf)
+        pad = (4 - (len(buf) % 4)) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        header = self.handle.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _KMAGIC:
+            raise RuntimeError(
+                "invalid RecordIO magic 0x%08x at offset %d"
+                % (magic, self.handle.tell() - 8))
+        length = lrec & _LEN_MASK
+        buf = self.handle.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.handle.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """RecordIO with a sidecar .idx for random seek
+    (ref: recordio.py — MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.flag == "r" and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+        if self.flag == "w":
+            self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+        super().close()
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d.pop("fidx", None)
+        return d
+
+    def __setstate__(self, d):
+        d = dict(d)
+        d["fidx"] = None
+        super().__setstate__(d)
+
+    def seek(self, idx):
+        assert not self.writable
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        assert self.writable
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# --------------------------------------------------------------------------
+# image record header (ref: recordio.py — IRHeader/pack/unpack)
+# --------------------------------------------------------------------------
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack an IRHeader + payload into bytes (ref: recordio.py — pack).
+    Multi-label: header.label is an array → flag stores its length."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(label=float(header.label))
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    return struct.pack(_IR_FORMAT, *header) + s
+
+
+def unpack(s):
+    """Unpack bytes into (IRHeader, payload) (ref: recordio.py — unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[: header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode an image array and pack it (ref: recordio.py — pack_img;
+    OpenCV imencode → PIL here)."""
+    from PIL import Image
+
+    arr = np.asarray(img).astype(np.uint8)
+    buf = _io.BytesIO()
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    Image.fromarray(arr).save(buf, format=fmt, quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=1):
+    """Unpack and decode an image record (ref: recordio.py — unpack_img).
+    Returns (IRHeader, HxWx3 uint8 array)."""
+    from PIL import Image
+
+    header, img_bytes = unpack(s)
+    img = Image.open(_io.BytesIO(img_bytes))
+    if iscolor:
+        img = img.convert("RGB")
+    else:
+        img = img.convert("L")
+    return header, np.asarray(img)

@@ -1,0 +1,272 @@
+"""Data pipeline tests (models tests/python/unittest/test_io.py,
+test_recordio.py, and the gluon data portions of test_gluon_data.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, recordio
+from mxnet_tpu.gluon import data as gdata
+from mxnet_tpu.io import NDArrayIter, DataBatch, DataDesc, ResizeIter, \
+    PrefetchingIter, ImageRecordIter
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ---------------------------------------------------------------------------
+# RecordIO
+# ---------------------------------------------------------------------------
+def test_recordio_roundtrip(tmp_path):
+    frec = str(tmp_path / "test.rec")
+    N = 25
+    writer = recordio.MXRecordIO(frec, "w")
+    for i in range(N):
+        writer.write(b"x" * i + b"payload%d" % i)
+    writer.close()
+
+    reader = recordio.MXRecordIO(frec, "r")
+    for i in range(N):
+        buf = reader.read()
+        assert buf == b"x" * i + b"payload%d" % i
+    assert reader.read() is None
+    reader.close()
+
+
+def test_indexed_recordio(tmp_path):
+    frec = str(tmp_path / "test.rec")
+    fidx = str(tmp_path / "test.idx")
+    writer = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    for i in range(10):
+        writer.write_idx(i, b"record_%d" % i)
+    writer.close()
+
+    reader = recordio.MXIndexedRecordIO(fidx, frec, "r")
+    assert reader.keys == list(range(10))
+    for i in (3, 7, 0, 9):
+        assert reader.read_idx(i) == b"record_%d" % i
+    reader.close()
+
+
+def test_irheader_pack_unpack():
+    header = recordio.IRHeader(0, 3.5, 42, 0)
+    s = recordio.pack(header, b"imagebytes")
+    h2, payload = recordio.unpack(s)
+    assert h2.label == 3.5
+    assert h2.id == 42
+    assert payload == b"imagebytes"
+    # multi-label path
+    header = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0]), 7, 0)
+    s = recordio.pack(header, b"xyz")
+    h3, payload = recordio.unpack(s)
+    assert h3.flag == 3
+    assert_almost_equal(h3.label, np.array([1.0, 2.0, 3.0]))
+    assert payload == b"xyz"
+
+
+def test_pack_img_unpack_img():
+    img = (np.random.uniform(0, 255, (32, 24, 3))).astype(np.uint8)
+    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                          img_fmt=".png")
+    header, img2 = recordio.unpack_img(s)
+    assert header.label == 1.0
+    assert img2.shape == (32, 24, 3)
+    assert np.array_equal(img, img2)  # png is lossless
+
+
+# ---------------------------------------------------------------------------
+# NDArrayIter
+# ---------------------------------------------------------------------------
+def test_ndarray_iter_basic():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    label = np.arange(10).astype(np.float32)
+    it = NDArrayIter(data, label, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    assert_almost_equal(batches[0].data[0].asnumpy(), data[:3])
+
+    it.reset()
+    again = list(it)
+    assert len(again) == 4
+
+
+def test_ndarray_iter_discard_and_shuffle():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    it = NDArrayIter(data, None, batch_size=3, shuffle=True,
+                     last_batch_handle="discard")
+    batches = list(it)
+    assert len(batches) == 3
+    seen = np.concatenate([b.data[0].asnumpy() for b in batches])
+    assert seen.shape == (9, 4)
+
+
+def test_ndarray_iter_provide_data():
+    data = np.zeros((8, 2, 3), dtype=np.float32)
+    it = NDArrayIter(data, np.zeros(8), batch_size=4)
+    d = it.provide_data[0]
+    assert d.name == "data"
+    assert d.shape == (4, 2, 3)
+    assert it.provide_label[0].name == "softmax_label"
+
+
+def test_resize_and_prefetch_iter():
+    data = np.arange(24).reshape(12, 2).astype(np.float32)
+    base = NDArrayIter(data, np.zeros(12), batch_size=4)
+    r = ResizeIter(base, 5)
+    assert len(list(r)) == 5
+
+    base.reset()
+    p = PrefetchingIter(NDArrayIter(data, np.zeros(12), batch_size=4))
+    batches = list(p)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 2)
+
+
+# ---------------------------------------------------------------------------
+# ImageRecordIter over a generated .rec
+# ---------------------------------------------------------------------------
+def _make_rec(tmp_path, n=12, size=(20, 18)):
+    frec = str(tmp_path / "imgs.rec")
+    fidx = str(tmp_path / "imgs.idx")
+    writer = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = rng.randint(0, 255, size + (3,)).astype(np.uint8)
+        writer.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img, img_fmt=".png"))
+    writer.close()
+    return frec, fidx
+
+
+def test_image_record_iter(tmp_path):
+    frec, fidx = _make_rec(tmp_path)
+    it = ImageRecordIter(path_imgrec=frec, path_imgidx=fidx,
+                         data_shape=(3, 16, 16), batch_size=4,
+                         shuffle=True, rand_crop=True, rand_mirror=True,
+                         preprocess_threads=2)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 16, 16)
+    assert batches[0].label[0].shape == (4,)
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert set(labels.tolist()) <= {0.0, 1.0, 2.0}
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_record_iter_sharded(tmp_path):
+    frec, fidx = _make_rec(tmp_path)
+    it0 = ImageRecordIter(path_imgrec=frec, path_imgidx=fidx,
+                          data_shape=(3, 16, 16), batch_size=2,
+                          part_index=0, num_parts=2)
+    it1 = ImageRecordIter(path_imgrec=frec, path_imgidx=fidx,
+                          data_shape=(3, 16, 16), batch_size=2,
+                          part_index=1, num_parts=2)
+    assert len(list(it0)) == 3
+    assert len(list(it1)) == 3
+
+
+# ---------------------------------------------------------------------------
+# Gluon data
+# ---------------------------------------------------------------------------
+def test_array_dataset_and_loader():
+    X = np.arange(20).reshape(10, 2).astype(np.float32)
+    y = np.arange(10).astype(np.float32)
+    ds = gdata.ArrayDataset(X, y)
+    assert len(ds) == 10
+    x0, y0 = ds[3]
+    assert_almost_equal(x0, X[3])
+
+    loader = gdata.DataLoader(ds, batch_size=4, shuffle=False,
+                              last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 2)
+    assert batches[2][0].shape == (2, 2)
+
+    loader2 = gdata.DataLoader(ds, batch_size=4, shuffle=True,
+                               last_batch="discard", num_workers=2)
+    batches2 = list(loader2)
+    assert len(batches2) == 2
+
+
+def test_dataset_transform():
+    X = np.arange(10).astype(np.float32)
+    ds = gdata.SimpleDataset(list(X)).transform(lambda x: x * 2)
+    assert ds[3] == 6.0
+    ds2 = gdata.ArrayDataset(X, X).transform_first(lambda x: x + 1)
+    a, b = ds2[0]
+    assert a == 1.0 and b == 0.0
+
+
+def test_samplers():
+    s = gdata.SequentialSampler(5)
+    assert list(s) == [0, 1, 2, 3, 4]
+    r = gdata.RandomSampler(5)
+    assert sorted(list(r)) == [0, 1, 2, 3, 4]
+    b = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "keep")
+    assert [len(x) for x in b] == [3, 3, 1]
+    assert len(b) == 3
+    b2 = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "discard")
+    assert [len(x) for x in b2] == [3, 3]
+    b3 = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "rollover")
+    assert [len(x) for x in list(b3)] == [3, 3]
+    assert [len(x) for x in list(b3)] == [3, 3]  # rolled-over 1 + 7 = 8 → 2x3
+
+
+def test_record_file_dataset(tmp_path):
+    frec, fidx = _make_rec(tmp_path, n=6)
+    ds = gdata.vision.ImageRecordDataset(frec)
+    assert len(ds) == 6
+    img, label = ds[2]
+    assert img.shape == (20, 18, 3)
+    assert label == 2.0
+
+
+def test_transforms():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    img = nd.array(np.random.randint(0, 255, (20, 16, 3)).astype(np.uint8))
+    t = T.ToTensor()(img)
+    assert t.shape == (3, 20, 16)
+    assert float(t.max().asscalar()) <= 1.0
+
+    n = T.Normalize(mean=(0.5, 0.5, 0.5), std=(2.0, 2.0, 2.0))(t)
+    assert n.shape == (3, 20, 16)
+
+    r = T.Resize((8, 10))(img)
+    assert r.shape == (10, 8, 3)
+
+    c = T.CenterCrop(8)(img)
+    assert c.shape == (8, 8, 3)
+
+    rc = T.RandomResizedCrop(8)(img)
+    assert rc.shape == (8, 8, 3)
+
+    comp = T.Compose([T.Resize(12), T.ToTensor()])
+    out = comp(img)
+    assert out.shape == (3, 12, 12)
+
+    f = T.RandomFlipLeftRight()(img)
+    assert f.shape == img.shape
+    cj = T.RandomColorJitter(0.4, 0.4, 0.4)(img)
+    assert cj.shape == img.shape
+    rl = T.RandomLighting(0.1)(img)
+    assert rl.shape == img.shape
+
+
+def test_dataloader_with_transform_pipeline():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    imgs = [np.random.randint(0, 255, (20, 16, 3)).astype(np.uint8)
+            for _ in range(8)]
+    labels = list(range(8))
+    ds = gdata.ArrayDataset(gdata.SimpleDataset(imgs),
+                            gdata.SimpleDataset(labels))
+    tds = ds.transform_first(
+        T.Compose([T.Resize(12), T.ToTensor()]))
+    loader = gdata.DataLoader(tds, batch_size=4)
+    for x, y in loader:
+        assert x.shape == (4, 3, 12, 12)
+        assert y.shape == (4,)

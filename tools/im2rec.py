@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""im2rec — build .rec/.idx/.lst files from an image folder
+(ref: tools/im2rec.py).
+
+Usage:
+  python tools/im2rec.py prefix root --list      # write prefix.lst
+  python tools/im2rec.py prefix root             # write prefix.rec/.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def list_images(root, recursive, exts):
+    i = 0
+    cat = {}
+    for path, dirs, files in os.walk(root, followlinks=True):
+        dirs.sort()
+        files.sort()
+        for fname in files:
+            fpath = os.path.join(path, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                if path not in cat:
+                    cat[path] = len(cat)
+                yield (i, os.path.relpath(fpath, root), cat[path])
+                i += 1
+        if not recursive:
+            break
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for item in image_list:
+            line = "%d\t%f\t%s\n" % (item[0], item[2], item[1])
+            fout.write(line)
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield (int(parts[0]), parts[-1],
+                   [float(x) for x in parts[1:-1]])
+
+
+def make_rec(args, image_list):
+    from mxnet_tpu import recordio
+
+    record = recordio.MXIndexedRecordIO(
+        args.prefix + ".idx", args.prefix + ".rec", "w")
+    for idx, fname, labels in image_list:
+        fpath = os.path.join(args.root, fname)
+        label = labels[0] if len(labels) == 1 else labels
+        header = recordio.IRHeader(0, label, idx, 0)
+        if args.pass_through:
+            with open(fpath, "rb") as f:
+                record.write_idx(idx, recordio.pack(header, f.read()))
+        else:
+            from PIL import Image
+            import numpy as np
+
+            img = Image.open(fpath).convert("RGB")
+            if args.resize > 0:
+                w, h = img.size
+                if w < h:
+                    img = img.resize((args.resize,
+                                      int(h * args.resize / w)))
+                else:
+                    img = img.resize((int(w * args.resize / h),
+                                      args.resize))
+            record.write_idx(idx, recordio.pack_img(
+                header, np.asarray(img), quality=args.quality))
+    record.close()
+
+
+def main():
+    parser = argparse.ArgumentParser(description="make image record files")
+    parser.add_argument("prefix", help="output prefix")
+    parser.add_argument("root", help="image root folder")
+    parser.add_argument("--list", action="store_true",
+                        help="only create the .lst file")
+    parser.add_argument("--recursive", action="store_true", default=True)
+    parser.add_argument("--shuffle", action="store_true", default=True)
+    parser.add_argument("--resize", type=int, default=0)
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--pass-through", action="store_true",
+                        help="store raw bytes, no re-encode")
+    parser.add_argument("--exts", nargs="+",
+                        default=[".jpg", ".jpeg", ".png"])
+    args = parser.parse_args()
+
+    if args.list:
+        images = list(list_images(args.root, args.recursive, args.exts))
+        if args.shuffle:
+            random.seed(100)
+            random.shuffle(images)
+        write_list(args.prefix + ".lst", images)
+        return
+
+    lst = args.prefix + ".lst"
+    if os.path.exists(lst):
+        images = list(read_list(lst))
+    else:
+        raw = list(list_images(args.root, args.recursive, args.exts))
+        if args.shuffle:
+            random.seed(100)
+            random.shuffle(raw)
+        images = [(i, fname, [float(label)]) for i, fname, label in raw]
+    make_rec(args, images)
+
+
+if __name__ == "__main__":
+    main()
