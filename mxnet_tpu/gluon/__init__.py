@@ -4,6 +4,7 @@ from .parameter import Parameter, Constant, ParameterDict
 from .block import Block, HybridBlock
 from .trainer import Trainer
 from . import nn
+from . import rnn
 from . import loss
 from . import utils
 from . import data
@@ -11,4 +12,5 @@ from . import model_zoo
 from .utils import split_and_load, split_data
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
-           "Trainer", "nn", "loss", "utils", "split_and_load", "split_data"]
+           "Trainer", "nn", "rnn", "loss", "utils", "split_and_load",
+           "split_data"]

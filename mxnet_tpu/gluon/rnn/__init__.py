@@ -1,0 +1,14 @@
+"""Gluon recurrent layers and cells (ref: python/mxnet/gluon/rnn/)."""
+from .rnn_layer import RNN, LSTM, GRU
+from .rnn_cell import (
+    RecurrentCell, HybridRecurrentCell, RNNCell, LSTMCell, GRUCell,
+    SequentialRNNCell, HybridSequentialRNNCell, DropoutCell, ModifierCell,
+    ZoneoutCell, ResidualCell, BidirectionalCell,
+)
+
+__all__ = [
+    "RNN", "LSTM", "GRU", "RecurrentCell", "HybridRecurrentCell", "RNNCell",
+    "LSTMCell", "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
+    "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell",
+    "BidirectionalCell",
+]

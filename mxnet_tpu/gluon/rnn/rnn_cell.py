@@ -1,0 +1,629 @@
+"""Recurrent cells + unroll (ref: python/mxnet/gluon/rnn/rnn_cell.py).
+
+Cells are the step-granular API; ``unroll`` lays the steps out in Python so
+the whole unrolled sequence traces into one XLA program under hybridize —
+the reference's unfused fallback path, which on TPU is also fast because XLA
+fuses across steps. Variable-length handling uses SequenceMask/SequenceLast,
+like the reference.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ..block import Block, HybridBlock
+from ..parameter import DeferredInitializationError
+
+__all__ = [
+    "RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+    "SequentialRNNCell", "HybridSequentialRNNCell", "DropoutCell",
+    "ModifierCell", "ZoneoutCell", "ResidualCell", "BidirectionalCell",
+]
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+def _format_sequence(length, inputs, layout, merge, in_layout=None):
+    """Normalize inputs to (list-of-steps | time-major tensor) form
+    (ref: rnn_cell.py — _format_sequence)."""
+    from ... import ndarray as F
+
+    axis = layout.find("T")
+    batch_axis = layout.find("N")
+    if isinstance(inputs, NDArray):
+        batch_size = inputs.shape[batch_axis]
+        if merge is False:
+            if length is not None and inputs.shape[axis] != length:
+                raise MXNetError(
+                    "unroll(length=%s) does not match input sequence "
+                    "length %d" % (length, inputs.shape[axis]))
+            inputs = list(F.split(
+                inputs, axis=axis, num_outputs=inputs.shape[axis],
+                squeeze_axis=1))
+    else:
+        assert length is None or len(inputs) == length
+        batch_size = inputs[0].shape[batch_axis]
+        if merge is True:
+            inputs = F.stack(*inputs, axis=axis)
+    del in_layout
+    return inputs, axis, batch_size
+
+
+def _mask_sequence_variable_length(F, data, length, valid_length, time_axis,
+                                   merge):
+    assert valid_length is not None
+    if not isinstance(data, NDArray):
+        data = F.stack(*data, axis=time_axis)
+    outputs = F.SequenceMask(data, valid_length,
+                             use_sequence_length=True, axis=time_axis)
+    if not merge:
+        outputs = list(F.split(outputs, num_outputs=data.shape[time_axis],
+                               axis=time_axis, squeeze_axis=True))
+    return outputs
+
+
+class RecurrentCell(Block):
+    """Abstract base for recurrent cells (ref: rnn_cell.py — RecurrentCell)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called " \
+            "directly. Call the modifier cell instead."
+        from ... import ndarray as F
+
+        if func is None:
+            func = F.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            kw = dict(kwargs)
+            if info is not None:
+                kw.update(info)
+            shape = kw.pop("shape")
+            kw.pop("__layout__", None)
+            states.append(func(shape=shape, **kw))
+        return states
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        return super().__call__(inputs, states)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell for ``length`` steps (ref: rnn_cell.py — unroll)."""
+        from ... import ndarray as F
+
+        self.reset()
+        inputs, axis, batch_size = _format_sequence(
+            length, inputs, layout, False)
+        begin_state = self._get_begin_state(inputs, begin_state, batch_size)
+
+        states = begin_state
+        outputs = []
+        all_states = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+            if valid_length is not None:
+                all_states.append(states)
+        if valid_length is not None:
+            states = [
+                F.SequenceLast(
+                    F.stack(*[st[j] for st in all_states], axis=0),
+                    valid_length, use_sequence_length=True, axis=0)
+                for j in range(len(states))
+            ]
+            outputs = _mask_sequence_variable_length(
+                F, outputs, length, valid_length, axis, True)
+            if merge_outputs is False:
+                outputs = list(F.split(outputs, num_outputs=length, axis=axis,
+                                       squeeze_axis=True))
+        elif merge_outputs is True:
+            outputs = F.stack(*outputs, axis=axis)
+        # merge_outputs None keeps the per-step list (no valid_length) /
+        # the merged tensor (valid_length path), matching the reference
+        return outputs, states
+
+    def _get_begin_state(self, inputs, begin_state, batch_size):
+        if begin_state is None:
+            if isinstance(inputs, NDArray):
+                dtype = inputs.dtype
+            else:
+                dtype = inputs[0].dtype
+            begin_state = self.begin_state(batch_size, dtype=dtype)
+        return begin_state
+
+
+class HybridRecurrentCell(RecurrentCell, HybridBlock):
+    """RecurrentCell whose step is hybridizable."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def forward(self, x, *args):
+        try:
+            params = {k: p.data() for k, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._deferred_infer(x, *args)
+            params = {k: p.data() for k, p in self._reg_params.items()}
+        from ... import ndarray as F
+
+        return self.hybrid_forward(F, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+class RNNCell(HybridRecurrentCell):
+    """Elman RNN cell (ref: rnn_cell.py — RNNCell)."""
+
+    def __init__(self, hidden_size, activation="tanh",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight=None,
+                       h2h_weight=None, i2h_bias=None, h2h_bias=None):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        output = F.Activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class LSTMCell(HybridRecurrentCell):
+    """LSTM cell, gate order [i,f,g,o] (ref: rnn_cell.py — LSTMCell)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight=None,
+                       h2h_weight=None, i2h_bias=None, h2h_bias=None):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        slice_gates = F.split(gates, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(slice_gates[0])
+        forget_gate = F.sigmoid(slice_gates[1])
+        in_transform = F.tanh(slice_gates[2])
+        out_gate = F.sigmoid(slice_gates[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * F.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(HybridRecurrentCell):
+    """GRU cell, gate order [r,z,n] (ref: rnn_cell.py — GRUCell)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(3 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(3 * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(3 * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(3 * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight=None,
+                       h2h_weight=None, i2h_bias=None, h2h_bias=None):
+        prev_state_h = states[0]
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        h2h = F.FullyConnected(prev_state_h, h2h_weight, h2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        i2h_r, i2h_z, i2h = F.split(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h = F.split(h2h, num_outputs=3, axis=1)
+        reset_gate = F.sigmoid(i2h_r + h2h_r)
+        update_gate = F.sigmoid(i2h_z + h2h_z)
+        next_h_tmp = F.tanh(i2h + reset_gate * h2h)
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_state_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Sequentially stacked cells (ref: rnn_cell.py — SequentialRNNCell)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(),
+                                  batch_size=batch_size, **kwargs)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            assert not isinstance(cell, BidirectionalCell)
+            n = len(cell.state_info())
+            inputs, state = cell(inputs, states[p: p + n])
+            p += n
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        _, _, batch_size = _format_sequence(length, inputs, layout, None)
+        num_cells = len(self._children)
+        begin_state = self._get_begin_state(inputs, begin_state, batch_size)
+        p = 0
+        next_states = []
+        for i, cell in enumerate(self._children.values()):
+            n = len(cell.state_info())
+            states = begin_state[p: p + n]
+            p += n
+            inputs, states = cell.unroll(
+                length, inputs=inputs, begin_state=states, layout=layout,
+                merge_outputs=None if i < num_cells - 1 else merge_outputs,
+                valid_length=valid_length)
+            next_states.extend(states)
+        return inputs, next_states
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def __len__(self):
+        return len(self._children)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class HybridSequentialRNNCell(HybridRecurrentCell):
+    """Hybridizable sequential stack (ref: rnn_cell.py)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(),
+                                  batch_size=batch_size, **kwargs)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            assert not isinstance(cell, BidirectionalCell)
+            n = len(cell.state_info())
+            inputs, state = cell(inputs, states[p: p + n])
+            p += n
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        return SequentialRNNCell.unroll(
+            self, length, inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=merge_outputs, valid_length=valid_length)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def __len__(self):
+        return len(self._children)
+
+
+class DropoutCell(HybridRecurrentCell):
+    """Applies dropout on input (ref: rnn_cell.py — DropoutCell)."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert isinstance(rate, (int, float))
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _alias(self):
+        return "dropout"
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ModifierCell(HybridRecurrentCell):
+    """Base for cells that modify another cell (ref: rnn_cell.py)."""
+
+    def __init__(self, base_cell):
+        assert not base_cell._modified, \
+            "Cell %s is already modified." % base_cell.name
+        base_cell._modified = True
+        super().__init__(prefix=base_cell.prefix + self._alias(),
+                         params=None)
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        return self.base_cell.params
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size=batch_size, func=func,
+                                           **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (ref: rnn_cell.py — ZoneoutCell)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, BidirectionalCell)
+        self._alias_name = "zoneout"
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def hybrid_forward(self, F, inputs, states):
+        import mxnet_tpu.autograd as ag
+
+        cell = self.base_cell
+        next_output, next_states = cell(inputs, states)
+        if not ag.is_training():
+            return next_output, next_states
+
+        def mask(p, like):
+            return F.Dropout(F.ones_like(like), p=p)
+
+        prev_output = self._prev_output
+        if prev_output is None:
+            prev_output = F.zeros_like(next_output)
+        p_outputs = self.zoneout_outputs
+        p_states = self.zoneout_states
+        output = (F.where(mask(p_outputs, next_output), next_output,
+                          prev_output)
+                  if p_outputs != 0.0 else next_output)
+        new_states = ([F.where(mask(p_states, new_s), new_s, old_s)
+                       for new_s, old_s in zip(next_states, states)]
+                      if p_states != 0.0 else next_states)
+        self._prev_output = output
+        return output, new_states
+
+
+class ResidualCell(ModifierCell):
+    """Adds input to output (ref: rnn_cell.py — ResidualCell)."""
+
+    def hybrid_forward(self, F, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = output + inputs
+        return output, states
+
+    def _alias(self):
+        return "residual"
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as F
+
+        self.reset()
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=merge_outputs, valid_length=valid_length)
+        self.base_cell._modified = True
+
+        merge_outputs = (isinstance(outputs, NDArray)
+                         if merge_outputs is None else merge_outputs)
+        inputs, axis, _ = _format_sequence(
+            length, inputs, layout, merge_outputs)
+        if valid_length is not None:
+            inputs = _mask_sequence_variable_length(
+                F, inputs, length, valid_length, axis, merge_outputs)
+        if merge_outputs:
+            outputs = outputs + inputs
+        else:
+            outputs = [out + inp for out, inp in zip(outputs, inputs)]
+        return outputs, states
+
+
+class BidirectionalCell(HybridRecurrentCell):
+    """Runs forward + backward cells over the sequence
+    (ref: rnn_cell.py — BidirectionalCell)."""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+        self._output_prefix = output_prefix
+
+    def __call__(self, inputs, states):
+        raise MXNetError(
+            "Bidirectional cannot be stepped. Please use unroll")
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(),
+                                  batch_size=batch_size, **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as F
+
+        self.reset()
+        inputs, axis, batch_size = _format_sequence(
+            length, inputs, layout, False)
+        if valid_length is None:
+            reversed_inputs = list(reversed(inputs))
+        else:
+            # reverse only the valid prefix so the backward cell sees real
+            # tokens first, not padding (ref: rnn_cell.py — BidirectionalCell
+            # uses SequenceReverse with sequence_length)
+            rev = F.SequenceReverse(F.stack(*inputs, axis=0), valid_length,
+                                    use_sequence_length=True)
+            reversed_inputs = list(F.split(
+                rev, num_outputs=length, axis=0, squeeze_axis=True))
+        begin_state = self._get_begin_state(inputs, begin_state, batch_size)
+
+        states = begin_state
+        l_cell, r_cell = self._children.values()
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs,
+            begin_state=states[: len(l_cell.state_info(batch_size))],
+            layout=layout, merge_outputs=merge_outputs,
+            valid_length=valid_length)
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=reversed_inputs,
+            begin_state=states[len(l_cell.state_info(batch_size)):],
+            layout=layout, merge_outputs=False, valid_length=valid_length)
+        if valid_length is None:
+            reversed_r_outputs = list(reversed(r_outputs))
+        else:
+            stacked = F.stack(*r_outputs, axis=0)
+            rev = F.SequenceReverse(stacked, valid_length,
+                                    use_sequence_length=True)
+            reversed_r_outputs = list(F.split(
+                rev, num_outputs=length, axis=0, squeeze_axis=True))
+        if merge_outputs is None:
+            merge_outputs = isinstance(l_outputs, NDArray)
+        if merge_outputs:
+            if not isinstance(l_outputs, NDArray):
+                l_outputs = F.stack(*l_outputs, axis=axis)
+            reversed_r_outputs = F.stack(*reversed_r_outputs, axis=axis)
+            outputs = F.concat(l_outputs, reversed_r_outputs, dim=2)
+        else:
+            if isinstance(l_outputs, NDArray):
+                l_outputs = list(F.split(
+                    l_outputs, num_outputs=length, axis=axis,
+                    squeeze_axis=True))
+            outputs = [F.concat(l_o, r_o, dim=1)
+                       for l_o, r_o in zip(l_outputs, reversed_r_outputs)]
+        if valid_length is not None:
+            outputs = _mask_sequence_variable_length(
+                F, outputs, length, valid_length, axis, merge_outputs)
+        states = l_states + r_states
+        return outputs, states

@@ -1,0 +1,227 @@
+"""Fused recurrent layers (ref: python/mxnet/gluon/rnn/rnn_layer.py).
+
+The reference backs RNN/LSTM/GRU with the single fused ``RNN`` op (cuDNN
+path, packed flat weights). Here the same fused op lowers to one
+``lax.scan`` per layer inside the jitted program (ops/rnn.py): the input
+projection for the whole sequence is one big MXU matmul and only the
+recurrent part scans. Parameters stay registered *unfused* (per
+layer/direction ``l0_i2h_weight`` …, matching the reference's param names
+and checkpoint format) and are packed at trace time — XLA folds the
+concatenation away.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ..block import HybridBlock
+from ..parameter import DeferredInitializationError
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    """Base for fused-op recurrent layers (ref: rnn_layer.py — _RNNLayer)."""
+
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, dtype="float32", prefix=None,
+                 params=None):
+        self._mode = mode  # before super(): _alias() uses it
+        super().__init__(prefix=prefix, params=params)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(
+                "Invalid layout %r; must be one of ['TNC', 'NTC']" % layout)
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._dtype = dtype
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in ["l", "r"][: self._dir]:
+                    self._register_param(
+                        "%s%d_i2h_weight" % (j, i), (ng * nh, ni),
+                        i2h_weight_initializer, dtype)
+                    self._register_param(
+                        "%s%d_h2h_weight" % (j, i), (ng * nh, nh),
+                        h2h_weight_initializer, dtype)
+                    self._register_param(
+                        "%s%d_i2h_bias" % (j, i), (ng * nh,),
+                        i2h_bias_initializer, dtype)
+                    self._register_param(
+                        "%s%d_h2h_bias" % (j, i), (ng * nh,),
+                        h2h_bias_initializer, dtype)
+                ni = nh * self._dir
+
+    def _register_param(self, name, shape, init, dtype):
+        p = self.params.get(name, shape=shape, init=init, dtype=dtype,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+
+    def _alias(self):
+        return self._mode
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        shape = self.l0_i2h_weight.shape
+        mapping = "%s -> %s" % (
+            shape[1] if shape[1] else None, shape[0] // self._gates)
+        return s.format(name=type(self).__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def infer_shape(self, x, *args):
+        ni = x.shape[2] if self._layout == "TNC" else x.shape[-1]
+        for i in range(self._num_layers):
+            for j in ["l", "r"][: self._dir]:
+                p = getattr(self, "%s%d_i2h_weight" % (j, i))
+                p.shape = (self._gates * self._hidden_size, ni)
+            ni = self._hidden_size * self._dir
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial recurrent states (ref: rnn_layer.py — begin_state)."""
+        from ... import ndarray as F
+
+        if func is None:
+            func = F.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            kw = dict(kwargs)
+            kw.update(info)
+            shape = kw.pop("shape")
+            kw.pop("__layout__", None)
+            states.append(func(shape=shape, **kw))
+        return states
+
+    def forward(self, inputs, states=None):
+        """Run the fused recurrence. With ``states=None`` begins from zeros
+        and returns only the output; otherwise returns
+        ``(output, new_states)`` (ref: rnn_layer.py — forward)."""
+        from ... import ndarray as F
+
+        batch_axis = self._layout.find("N")
+        batch_size = inputs.shape[batch_axis]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size, dtype=inputs.dtype)
+        if isinstance(states, NDArray):
+            states = [states]
+        for info, state in zip(self.state_info(batch_size), states):
+            if state.shape != info["shape"]:
+                raise MXNetError(
+                    "Invalid recurrent state shape. Expecting %s, got %s."
+                    % (str(info["shape"]), str(state.shape)))
+
+        try:
+            params = {k: p.data() for k, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._deferred_infer(inputs)
+            params = {k: p.data() for k, p in self._reg_params.items()}
+
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+
+        flat = []
+        for group in ("weight", "bias"):
+            for i in range(self._num_layers):
+                for j in ["l", "r"][: self._dir]:
+                    for conn in ("i2h", "h2h"):
+                        flat.append(F.reshape(
+                            params["%s%d_%s_%s" % (j, i, conn, group)],
+                            shape=(-1,)))
+        packed = F.concat(*flat, dim=0)
+
+        import mxnet_tpu.autograd as ag
+
+        rnn_args = [inputs, packed, states[0]]
+        if self._mode == "lstm":
+            rnn_args.append(states[1])
+        out = F.RNN(
+            *rnn_args, mode=self._mode, state_size=self._hidden_size,
+            num_layers=self._num_layers, bidirectional=self._dir == 2,
+            p=self._dropout, state_outputs=True,
+            train_mode=ag.is_training())
+        outputs, new_states = out[0], list(out[1:])
+
+        if self._layout == "NTC":
+            outputs = F.swapaxes(outputs, dim1=0, dim2=1)
+        if skip_states:
+            return outputs
+        return outputs, new_states
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN with tanh/relu (ref: rnn_layer.py — RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, dtype="float32", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation,
+                         dtype=dtype, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{
+            "shape": (self._num_layers * self._dir, batch_size,
+                      self._hidden_size),
+            "__layout__": "LNC",
+        }]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (ref: rnn_layer.py — LSTM; gate order [i,f,g,o])."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype="float32", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", dtype=dtype, **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (ref: rnn_layer.py — GRU; gate order [r,z,n])."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype="float32", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", dtype=dtype, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{
+            "shape": (self._num_layers * self._dir, batch_size,
+                      self._hidden_size),
+            "__layout__": "LNC",
+        }]

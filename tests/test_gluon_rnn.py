@@ -1,0 +1,305 @@
+"""gluon.rnn tests (modeled on tests/python/unittest/test_gluon_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon import rnn
+from mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+
+@with_seed()
+@pytest.mark.parametrize("mode,cls", [
+    ("rnn", rnn.RNN), ("lstm", rnn.LSTM), ("gru", rnn.GRU)])
+def test_layer_forward_shapes(mode, cls):
+    layer = cls(hidden_size=16, num_layers=2)
+    layer.initialize()
+    x = nd.random.uniform(shape=(5, 3, 8))  # (T, N, C)
+    out = layer(x)
+    assert out.shape == (5, 3, 16)
+    states = layer.begin_state(batch_size=3)
+    out, new_states = layer(x, states)
+    assert out.shape == (5, 3, 16)
+    assert len(new_states) == len(states)
+    for s in new_states:
+        assert s.shape == (2, 3, 16)
+
+
+@with_seed()
+def test_layer_ntc_layout():
+    layer = rnn.LSTM(hidden_size=8, layout="NTC")
+    layer.initialize()
+    x = nd.random.uniform(shape=(4, 6, 5))  # (N, T, C)
+    out = layer(x)
+    assert out.shape == (4, 6, 8)
+
+
+@with_seed()
+def test_layer_bidirectional_shapes():
+    layer = rnn.GRU(hidden_size=12, num_layers=2, bidirectional=True)
+    layer.initialize()
+    x = nd.random.uniform(shape=(7, 2, 4))
+    out, states = layer(x, layer.begin_state(2))
+    assert out.shape == (7, 2, 24)
+    assert states[0].shape == (4, 2, 12)
+
+
+@with_seed()
+def test_lstm_layer_vs_cell_unroll():
+    """Fused packed-weight layer must agree with the step-level cell."""
+    T, N, C, H = 6, 3, 5, 7
+    layer = rnn.LSTM(hidden_size=H, input_size=C)
+    layer.initialize()
+    cell = rnn.LSTMCell(H, input_size=C)
+    cell.initialize()
+    for conn in ("i2h", "h2h"):
+        for kind in ("weight", "bias"):
+            getattr(cell, "%s_%s" % (conn, kind)).set_data(
+                getattr(layer, "l0_%s_%s" % (conn, kind)).data())
+
+    x = nd.random.uniform(shape=(T, N, C))
+    h0 = nd.zeros((1, N, H))
+    c0 = nd.zeros((1, N, H))
+    out_l, states_l = layer(x, [h0, c0])
+
+    outs_c, states_c = cell.unroll(
+        T, x, begin_state=[h0[0], c0[0]], layout="TNC", merge_outputs=True)
+    assert_almost_equal(out_l, outs_c.asnumpy(), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(states_l[0][0], states_c[0].asnumpy(), rtol=1e-4,
+                        atol=1e-5)
+    assert_almost_equal(states_l[1][0], states_c[1].asnumpy(), rtol=1e-4,
+                        atol=1e-5)
+
+
+@with_seed()
+@pytest.mark.parametrize("mode,cls", [
+    ("rnn", rnn.RNN), ("gru", rnn.GRU)])
+def test_single_gate_layer_vs_cell(mode, cls):
+    T, N, C, H = 4, 2, 3, 5
+    layer = cls(hidden_size=H, input_size=C) if mode == "gru" else \
+        cls(hidden_size=H, input_size=C, activation="tanh")
+    layer.initialize()
+    cell = (rnn.GRUCell(H, input_size=C) if mode == "gru"
+            else rnn.RNNCell(H, activation="tanh", input_size=C))
+    cell.initialize()
+    for conn in ("i2h", "h2h"):
+        for kind in ("weight", "bias"):
+            getattr(cell, "%s_%s" % (conn, kind)).set_data(
+                getattr(layer, "l0_%s_%s" % (conn, kind)).data())
+    x = nd.random.uniform(shape=(T, N, C))
+    out_l = layer(x)
+    outs_c, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    assert_almost_equal(out_l, outs_c.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+@with_seed()
+def test_layer_backward():
+    layer = rnn.LSTM(hidden_size=8)
+    layer.initialize()
+    x = nd.random.uniform(shape=(5, 4, 3))
+    x.attach_grad()
+    with ag.record():
+        out = layer(x)
+        loss = out.sum()
+    loss.backward()
+    assert x.grad.shape == x.shape
+    assert float(np.abs(x.grad.asnumpy()).sum()) > 0
+    g = layer.l0_i2h_weight.grad()
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+
+
+@with_seed()
+def test_layer_deferred_input_size():
+    layer = rnn.GRU(hidden_size=10, num_layers=2)
+    layer.initialize()
+    assert layer.l0_i2h_weight.shape[1] == 0
+    out = layer(nd.ones((3, 2, 6)))
+    assert layer.l0_i2h_weight.shape == (30, 6)
+    assert layer.l1_i2h_weight.shape == (30, 10)
+    assert out.shape == (3, 2, 10)
+
+
+@with_seed()
+def test_layer_save_load_roundtrip(tmp_path):
+    f = str(tmp_path / "lstm.params")
+    layer = rnn.LSTM(hidden_size=6, num_layers=2, input_size=4)
+    layer.initialize()
+    x = nd.random.uniform(shape=(3, 2, 4))
+    y0 = layer(x).asnumpy()
+    layer.save_parameters(f)
+    layer2 = rnn.LSTM(hidden_size=6, num_layers=2, input_size=4)
+    layer2.load_parameters(f)
+    assert_almost_equal(layer2(x), y0)
+
+
+@with_seed()
+@pytest.mark.parametrize("cell_cls,n_states", [
+    (rnn.RNNCell, 1), (rnn.LSTMCell, 2), (rnn.GRUCell, 1)])
+def test_cell_step_and_unroll(cell_cls, n_states):
+    cell = cell_cls(20, input_size=10)
+    cell.initialize()
+    x = nd.random.uniform(shape=(4, 10))
+    states = cell.begin_state(4)
+    assert len(states) == n_states
+    out, new_states = cell(x, states)
+    assert out.shape == (4, 20)
+    assert len(new_states) == n_states
+
+    seq = nd.random.uniform(shape=(4, 3, 10))
+    outs, last = cell.unroll(3, seq, layout="NTC", merge_outputs=True)
+    assert outs.shape == (4, 3, 20)
+    outs_list, _ = cell.unroll(3, seq, layout="NTC", merge_outputs=False)
+    assert len(outs_list) == 3
+    assert outs_list[0].shape == (4, 20)
+
+
+@with_seed()
+def test_sequential_rnn_cell():
+    stack = rnn.SequentialRNNCell()
+    with stack.name_scope():
+        stack.add(rnn.LSTMCell(12, input_size=6))
+        stack.add(rnn.DropoutCell(0.3))
+        stack.add(rnn.GRUCell(8, input_size=12))
+    stack.initialize()
+    seq = nd.random.uniform(shape=(2, 5, 6))
+    outs, states = stack.unroll(5, seq, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 5, 8)
+    assert len(states) == 3  # lstm h,c + gru h
+    assert len(stack) == 3
+    assert isinstance(stack[0], rnn.LSTMCell)
+
+
+@with_seed()
+def test_residual_cell():
+    cell = rnn.ResidualCell(rnn.GRUCell(6, input_size=6))
+    cell.initialize()
+    seq = nd.random.uniform(shape=(3, 4, 6))
+    outs, _ = cell.unroll(4, seq, layout="NTC", merge_outputs=True)
+    assert outs.shape == (3, 4, 6)
+    # residual really adds the input: with zeroed params GRU outputs 0,
+    # so the residual output equals the input exactly
+    zcell = rnn.ResidualCell(rnn.GRUCell(6, input_size=6))
+    zcell.initialize(init="zeros")
+    z_outs, _ = zcell.unroll(4, seq, layout="NTC", merge_outputs=True)
+    # zero weights => update gate z=0.5, candidate n=0 => h decays but
+    # starts at 0 so stays 0; residual = input
+    assert_almost_equal(z_outs, seq.asnumpy(), rtol=1e-6, atol=1e-6)
+
+
+@with_seed()
+def test_bidirectional_cell():
+    cell = rnn.BidirectionalCell(
+        rnn.LSTMCell(5, input_size=3), rnn.LSTMCell(5, input_size=3))
+    cell.initialize()
+    seq = nd.random.uniform(shape=(2, 7, 3))
+    outs, states = cell.unroll(7, seq, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 7, 10)
+    assert len(states) == 4
+
+
+@with_seed()
+def test_unroll_default_returns_step_list():
+    cell = rnn.RNNCell(4, input_size=3)
+    cell.initialize()
+    seq = nd.random.uniform(shape=(2, 5, 3))
+    outs, _ = cell.unroll(5, seq, layout="NTC")  # merge_outputs=None
+    assert isinstance(outs, list) and len(outs) == 5
+    assert outs[0].shape == (2, 4)
+
+
+@with_seed()
+def test_bidirectional_valid_length():
+    """Backward cell must consume the valid prefix reversed, not padding."""
+    H, C, T = 4, 3, 6
+    cell = rnn.BidirectionalCell(
+        rnn.LSTMCell(H, input_size=C), rnn.LSTMCell(H, input_size=C))
+    cell.initialize()
+    seq = nd.random.uniform(shape=(2, T, C))
+    vl = nd.array([3, 6])
+    outs, states = cell.unroll(T, seq, layout="NTC", merge_outputs=True,
+                               valid_length=vl)
+    assert outs.shape == (2, T, 2 * H)
+    # sample 0 (valid 3) must match unrolling just its prefix alone
+    outs_ref, _ = cell.unroll(3, seq[0:1, :3], layout="NTC",
+                              merge_outputs=True)
+    assert_almost_equal(outs.asnumpy()[0:1, :3], outs_ref.asnumpy(),
+                        rtol=1e-4, atol=1e-5)
+    # padding region masked to zero
+    assert np.abs(outs.asnumpy()[0, 3:]).sum() == 0
+
+
+@with_seed()
+def test_zoneout_cell_smoke():
+    cell = rnn.ZoneoutCell(rnn.RNNCell(4, input_size=4),
+                           zoneout_outputs=0.5, zoneout_states=0.5)
+    cell.initialize()
+    seq = nd.random.uniform(shape=(2, 3, 4))
+    outs, _ = cell.unroll(3, seq, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 3, 4)
+    with ag.record(train_mode=True):
+        outs, _ = cell.unroll(3, seq, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 3, 4)
+
+
+@with_seed()
+def test_unroll_valid_length():
+    cell = rnn.LSTMCell(4, input_size=2)
+    cell.initialize()
+    seq = nd.random.uniform(shape=(3, 5, 2))
+    vl = nd.array([2, 5, 3])
+    outs, states = cell.unroll(5, seq, layout="NTC", merge_outputs=True,
+                               valid_length=vl)
+    assert outs.shape == (3, 5, 4)
+    o = outs.asnumpy()
+    # steps past valid_length must be masked to zero
+    assert np.abs(o[0, 2:]).sum() == 0
+    assert np.abs(o[2, 3:]).sum() == 0
+    assert np.abs(o[0, :2]).sum() > 0
+    # final states are the state AT valid_length, not at T
+    outs2, states2 = cell.unroll(2, seq[:, :2], layout="NTC",
+                                 merge_outputs=True)
+    assert_almost_equal(states[0][0], states2[0][0].asnumpy(), rtol=1e-5,
+                        atol=1e-6)
+
+
+@with_seed()
+def test_rnn_layer_hybridize():
+    layer = rnn.LSTM(hidden_size=8, num_layers=1)
+    layer.initialize()
+    x = nd.random.uniform(shape=(5, 3, 4))
+    y0 = layer(x).asnumpy()
+    layer.hybridize()
+    y1 = layer(x).asnumpy()
+    assert_almost_equal(y0, y1, rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_rnn_layer_in_training_loop():
+    """Tiny LSTM regression converges (end-to-end train signal)."""
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        pass
+    layer = rnn.LSTM(hidden_size=16, input_size=3)
+    head = gluon.nn.Dense(1, flatten=False)
+    layer.initialize()
+    head.initialize()
+    params = gluon.ParameterDict()
+    params.update(layer.collect_params())
+    params.update(head.collect_params())
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-2})
+    loss_fn = gluon.loss.L2Loss()
+    x = nd.random.uniform(shape=(10, 8, 3))
+    target = x.sum(axis=2, keepdims=True) * 0.5  # (T,N,1)
+
+    first = None
+    for i in range(30):
+        with ag.record():
+            out = head(layer(x))
+            loss = loss_fn(out, target)
+        loss.backward()
+        trainer.step(8)
+        cur = float(loss.mean().asnumpy())
+        if first is None:
+            first = cur
+    assert cur < first * 0.5, (first, cur)
