@@ -101,14 +101,20 @@ class RecordFileDataset(Dataset):
     (ref: dataset.py — RecordFileDataset)."""
 
     def __init__(self, filename):
+        import threading
+
         from ...recordio import MXIndexedRecordIO
 
         self.idx_file = os.path.splitext(filename)[0] + ".idx"
         self.filename = filename
         self._record = MXIndexedRecordIO(self.idx_file, self.filename, "r")
+        # DataLoader workers are threads here (the reference forks
+        # processes); the seek+read pair on the shared handle must be atomic
+        self._lock = threading.Lock()
 
     def __getitem__(self, idx):
-        return self._record.read_idx(self._record.keys[idx])
+        with self._lock:
+            return self._record.read_idx(self._record.keys[idx])
 
     def __len__(self):
         return len(self._record.keys)

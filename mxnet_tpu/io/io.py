@@ -155,6 +155,7 @@ class NDArrayIter(DataIter):
         assert self.num_data >= batch_size, \
             "batch_size needs to be smaller than data size"
         self.cursor = -batch_size
+        self._roll_cache = None  # leftover sample idx carried across epochs
         self.reset()
 
     @property
@@ -168,18 +169,32 @@ class NDArrayIter(DataIter):
                 for k, v in self.label]
 
     def reset(self):
+        base = np.arange(self.data[0][1].shape[0])
         if self.shuffle:
-            np.random.shuffle(self.idx)
+            np.random.shuffle(base)
         if self.last_batch_handle == "roll_over" and \
-                self.cursor > self.num_data:
-            self.cursor = -self.batch_size + (self.cursor % self.num_data) % \
-                self.batch_size
-        else:
-            self.cursor = -self.batch_size
+                self._roll_cache is not None:
+            # leftover partial batch from last epoch leads this epoch
+            # (ref: io.py — NDArrayIter roll_over caches remainder data)
+            base = np.concatenate([self._roll_cache, base])
+            self._roll_cache = None
+        self.idx = base
+        self.num_data = self.idx.shape[0]
+        if self.last_batch_handle == "discard":
+            self.num_data = (self.num_data // self.batch_size) \
+                * self.batch_size
+        self.cursor = -self.batch_size
 
     def iter_next(self):
         self.cursor += self.batch_size
-        return self.cursor < self.num_data
+        if self.cursor >= self.num_data:
+            return False
+        if self.last_batch_handle == "roll_over" and \
+                self.cursor + self.batch_size > self.num_data:
+            # withhold the partial batch: it rolls into the next epoch
+            self._roll_cache = self.idx[self.cursor:self.num_data].copy()
+            return False
+        return True
 
     def _take(self, arrays):
         start = self.cursor
@@ -278,17 +293,34 @@ class PrefetchingIter(DataIter):
         self.current_batch = [None] * len(iters)
 
     def _start_threads(self):
-        def worker(i):
-            while not self._stop.is_set():
+        # workers capture THIS generation's stop event + queue: after
+        # reset() rebinds self._stop/_queues, a late worker still sees only
+        # its own (stopped) generation and exits instead of racing the new
+        # epoch's threads on the shared underlying iterator
+        def worker(it, q, stop):
+            def put(item):
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        return True
+                    except queue.Full:
+                        continue
+                return False
+
+            while not stop.is_set():
                 try:
-                    batch = self.iters[i].next()
+                    batch = it.next()
                 except StopIteration:
-                    self._queues[i].put(None)
+                    put(None)
                     return
-                self._queues[i].put(batch)
+                if not put(batch):
+                    return
 
         self._threads = [
-            threading.Thread(target=worker, args=(i,), daemon=True)
+            threading.Thread(target=worker,
+                             args=(self.iters[i], self._queues[i],
+                                   self._stop),
+                             daemon=True)
             for i in range(len(self.iters))]
         for t in self._threads:
             t.start()
@@ -315,11 +347,13 @@ class PrefetchingIter(DataIter):
 
     def reset(self):
         self._stop.set()
-        for q in self._queues:
-            while not q.empty():
-                q.get_nowait()
         for t in self._threads:
-            t.join(timeout=1.0)
+            while t.is_alive():
+                # drain so a worker blocked mid-put can observe the stop
+                for q in self._queues:
+                    while not q.empty():
+                        q.get_nowait()
+                t.join(timeout=0.2)
         for it in self.iters:
             it.reset()
         self._stop = threading.Event()
@@ -467,7 +501,7 @@ class ImageRecordIter(DataIter):
             self._rng.shuffle(self._order)
         self._cursor = 0
 
-    def _decode_one(self, offset, reader):
+    def _decode_one(self, offset, reader, rng):
         reader.handle.seek(offset)
         raw = reader.read()
         header, img = self._unpack_img(raw)
@@ -476,8 +510,8 @@ class ImageRecordIter(DataIter):
             img = _resize_short(img, self.resize)
         c, h, w = self.data_shape
         img = _crop(img, h, w,
-                    rand=self.rand_crop, rng=self._rng)
-        if self.rand_mirror and self._rng.rand() < 0.5:
+                    rand=self.rand_crop, rng=rng)
+        if self.rand_mirror and rng.rand() < 0.5:
             img = img[:, ::-1, :]
         img = (img - self.mean) / self.std
         img = np.transpose(img, (2, 0, 1))  # HWC → CHW
@@ -496,18 +530,25 @@ class ImageRecordIter(DataIter):
         idxs = list(self._order[self._cursor:min(end, n)])
         pad = 0
         if end > n:
-            if not self.round_batch and len(idxs) == 0:
-                raise StopIteration
-            pad = end - n
-            idxs += list(self._order[:pad])
+            if self.round_batch:
+                # wrap to the start; pad reports the duplicated count
+                pad = end - n
+                idxs += list(self._order[:pad])
+            # round_batch=False: emit the shorter final batch as-is
         self._cursor = end
 
         results = [None] * len(idxs)
+        # per-thread RNG (np.random.RandomState is not thread-safe), seeded
+        # from the iterator's stream so a fixed seed stays deterministic
+        rng_seeds = self._rng.randint(0, 2 ** 31 - 1,
+                                      size=self.preprocess_threads)
 
         def worker(tid):
             reader = MXRecordIO(self.path_imgrec, "r")
+            rng = np.random.RandomState(rng_seeds[tid])
             for j in range(tid, len(idxs), self.preprocess_threads):
-                results[j] = self._decode_one(self._offsets[idxs[j]], reader)
+                results[j] = self._decode_one(self._offsets[idxs[j]], reader,
+                                              rng)
             reader.close()
 
         threads = [threading.Thread(target=worker, args=(t,))

@@ -270,3 +270,78 @@ def test_dataloader_with_transform_pipeline():
     for x, y in loader:
         assert x.shape == (4, 3, 12, 12)
         assert y.shape == (4,)
+
+
+def test_ndarray_iter_roll_over():
+    """roll_over withholds the partial batch and rolls it into next epoch."""
+    X = np.arange(10).astype(np.float32).reshape(10, 1)
+    it = NDArrayIter(X, batch_size=4, last_batch_handle="roll_over")
+    b1 = list(it)
+    assert len(b1) == 2  # 8 samples; 2 leftover withheld
+    assert all(b.pad == 0 for b in b1)
+    it.reset()
+    b2 = list(it)
+    # next epoch leads with the 2 leftover samples: 2 + 10 = 12 → 3 batches
+    assert len(b2) == 3
+    first = b2[0].data[0].asnumpy().ravel()
+    assert first[0] == 8.0 and first[1] == 9.0
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in b2])
+    assert sorted(seen.tolist()) == sorted([8., 9.] + list(range(10)))
+
+
+def test_image_record_iter_round_batch_false(tmp_path):
+    frec, fidx = _make_rec(tmp_path, n=10)
+    it = ImageRecordIter(path_imgrec=frec, path_imgidx=fidx,
+                         data_shape=(3, 16, 16), batch_size=4,
+                         round_batch=False)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].data[0].shape[0] == 2  # short final batch, no wrap
+    assert batches[-1].pad == 0
+    # round_batch=True wraps and reports pad
+    it2 = ImageRecordIter(path_imgrec=frec, path_imgidx=fidx,
+                          data_shape=(3, 16, 16), batch_size=4)
+    batches2 = list(it2)
+    assert batches2[-1].data[0].shape[0] == 4
+    assert batches2[-1].pad == 2
+
+
+def test_record_file_dataset_threaded_reads(tmp_path):
+    """Concurrent __getitem__ must not race the shared seek+read handle."""
+    import threading as _threading
+
+    frec, fidx = _make_rec(tmp_path, n=12)
+    ds = gdata.vision.ImageRecordDataset(frec)
+    errors = []
+
+    def reader(tid):
+        rng = np.random.RandomState(tid)
+        try:
+            for _ in range(40):
+                i = int(rng.randint(0, 12))
+                img, label = ds[i]
+                assert label == float(i % 3)
+                assert img.shape == (20, 18, 3)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [_threading.Thread(target=reader, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+
+def test_prefetching_iter_reset_no_leak():
+    X = np.arange(40).astype(np.float32).reshape(20, 2)
+    base = NDArrayIter(X, batch_size=4)
+    pf = PrefetchingIter(base)
+    import threading as _threading
+
+    n0 = _threading.active_count()
+    for _ in range(5):
+        batches = list(pf)
+        assert len(batches) == 5
+        pf.reset()
+    assert _threading.active_count() <= n0 + 1  # no thread pile-up
