@@ -35,12 +35,22 @@ from . import kvstore as kv
 from . import callback
 from . import recordio
 from . import io
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
 from . import gluon
+from . import module
+from . import module as mod
+from .module import Module, BucketingModule
+from . import model
+from .model import save_checkpoint, load_checkpoint
 from . import parallel
 
 __all__ = [
     "nd", "ndarray", "autograd", "random", "context", "Context", "cpu",
     "gpu", "tpu", "NDArray", "MXNetError", "test_utils", "initializer",
     "init", "gluon", "optimizer", "opt", "metric", "kvstore", "kv",
-    "lr_scheduler", "callback", "recordio", "io", "parallel",
+    "lr_scheduler", "callback", "recordio", "io", "parallel", "symbol",
+    "sym", "Symbol", "module", "mod", "Module", "BucketingModule", "model",
+    "save_checkpoint", "load_checkpoint",
 ]

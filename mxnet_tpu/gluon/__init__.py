@@ -2,6 +2,7 @@
 (ref: python/mxnet/gluon/__init__.py)."""
 from .parameter import Parameter, Constant, ParameterDict
 from .block import Block, HybridBlock
+from .symbol_block import SymbolBlock
 from .trainer import Trainer
 from . import nn
 from . import rnn
@@ -12,5 +13,5 @@ from . import model_zoo
 from .utils import split_and_load, split_data
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
-           "Trainer", "nn", "rnn", "loss", "utils", "split_and_load",
-           "split_data"]
+           "SymbolBlock", "Trainer", "nn", "rnn", "loss", "utils",
+           "split_and_load", "split_data"]

@@ -304,11 +304,23 @@ class HybridBlock(Block):
 
     # ------------------------------------------------------------------
     def __call__(self, *args, **kwargs):
+        from ..symbol.symbol import Symbol
+
+        if args and isinstance(args[0], Symbol):
+            return Block.__call__(self, *args, **kwargs)
         if self._active and _trace_depth.depth == 0:
             return self._call_cached_op(*args, **kwargs)
         return super().__call__(*args, **kwargs)
 
     def forward(self, x, *args, **kwargs):
+        from ..symbol.symbol import Symbol
+
+        if isinstance(x, Symbol):
+            # symbolic trace (export / SymbolBlock): params become variables
+            from .. import symbol as F
+
+            params = {k: p.var() for k, p in self._reg_params.items()}
+            return self.hybrid_forward(F, x, *args, **params, **kwargs)
         try:
             params = {k: p.data() for k, p in self._reg_params.items()}
         except DeferredInitializationError:

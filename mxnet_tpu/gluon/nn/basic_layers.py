@@ -180,12 +180,15 @@ class BatchNorm(HybridBlock):
     def hybrid_forward(self, F, x, gamma=None, beta=None, running_mean=None,
                        running_var=None):
         train = autograd.is_training()
-        out, new_mean, new_var = F.BatchNorm(
+        ret = F.BatchNorm(
             x, gamma, beta, running_mean, running_var,
             eps=self._epsilon, momentum=self._momentum,
             fix_gamma=not self._scale,
             use_global_stats=self._use_global_stats,
             axis=self._axis, train_mode=train)
+        if not isinstance(ret, tuple):
+            return ret  # symbolic trace: extra outputs are hidden
+        out, new_mean, new_var = ret
         if train and not self._use_global_stats:
             with autograd.pause():
                 self.running_mean.data()._set_data(new_mean.data)

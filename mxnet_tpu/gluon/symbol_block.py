@@ -1,0 +1,148 @@
+"""SymbolBlock — run a Symbol graph as a Gluon block
+(ref: python/mxnet/gluon/block.py — SymbolBlock).
+
+The graph evaluates through the registry as one op application, so autograd
+records a single vjp over the whole program and gradients flow to the
+block's Parameters like any other layer.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..base import MXNetError
+from .. import autograd as ag
+from .. import random as _random
+from ..ndarray.ndarray import NDArray
+from ..ops.registry import Op, apply_op
+from ..symbol.symbol import Symbol, Group
+from ..symbol.executor import _build_graph_fn
+from .block import HybridBlock
+
+__all__ = ["SymbolBlock"]
+
+
+class SymbolBlock(HybridBlock):
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        if isinstance(outputs, (list, tuple)):
+            outputs = Group(list(outputs))
+        if isinstance(inputs, Symbol):
+            inputs = [inputs]
+        self._sb_symbol = outputs
+        self._input_names = [i.name for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = outputs.list_auxiliary_states()
+        self._sb_param_names = [n for n in arg_names
+                                if n not in self._input_names]
+        self._sb_aux_names = list(aux_names)
+        for n in self._sb_param_names:
+            p = self.params.get(n, allow_deferred_init=True)
+            self._reg_params[n] = p
+        for n in self._sb_aux_names:
+            p = self.params.get(n, grad_req="null",
+                                allow_deferred_init=True)
+            self._reg_params[n] = p
+        self._eval_cache = {}
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        """Load an exported model (ref: block.py — SymbolBlock.imports)."""
+        from .. import symbol as sym_mod
+        from ..ndarray import ndarray as _nd
+
+        sym = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(n) for n in input_names]
+        block = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            loaded = _nd.load(param_file)
+            for k, v in loaded.items():
+                name = k.partition(":")[2] if ":" in k else k
+                if name in block.params:
+                    block.params[name].set_data(v)
+        del ctx
+        return block
+
+    def _ensure_param_shapes(self, input_arrays):
+        need = [n for n in self._sb_param_names + self._sb_aux_names
+                if self.params[n]._shape_incomplete()
+                or self.params[n]._data is None]
+        if not any(self.params[n]._shape_incomplete() for n in need):
+            return
+        kwargs = {n: a.shape for n, a in zip(self._input_names,
+                                             input_arrays)}
+        arg_shapes, _, aux_shapes = \
+            self._sb_symbol.infer_shape_partial(**kwargs)
+        for n, s in zip(self._sb_symbol.list_arguments(), arg_shapes):
+            if n in self.params and s is not None \
+                    and self.params[n]._shape_incomplete():
+                self.params[n].shape = s
+        for n, s in zip(self._sb_symbol.list_auxiliary_states(),
+                        aux_shapes):
+            if n in self.params and s is not None \
+                    and self.params[n]._shape_incomplete():
+                self.params[n].shape = s
+
+    def forward(self, x, *args):
+        inputs = [x] + list(args)
+        if len(inputs) != len(self._input_names):
+            raise MXNetError(
+                "SymbolBlock expects %d inputs (%s), got %d"
+                % (len(self._input_names), self._input_names, len(inputs)))
+        self._ensure_param_shapes(inputs)
+        for n in self._sb_param_names + self._sb_aux_names:
+            p = self.params[n]
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+        train = ag.is_training()
+        entry = self._eval_cache.get(train)
+        if entry is None:
+            entry = self._make_op(train)
+            self._eval_cache[train] = entry
+        op, aux_out_names = entry
+
+        param_nds = [self.params[n].data() for n in self._sb_param_names]
+        aux_nds = [self.params[n].data() for n in self._sb_aux_names]
+        result = apply_op(op, *(inputs + param_nds + aux_nds))
+        if not isinstance(result, tuple):
+            result = (result,)
+        n_outs = len(self._sb_symbol._outputs)
+        outs = list(result[:n_outs])
+        aux_vals = result[n_outs:]
+        with ag.pause():
+            for name, val in zip(aux_out_names, aux_vals):
+                self.params[name].data()._set_data(val.data)
+        if n_outs == 1:
+            return outs[0]
+        return outs
+
+    def _make_op(self, train):
+        graph_fn = _build_graph_fn(self._sb_symbol, train)
+        input_names = list(self._input_names)
+        param_names = list(self._sb_param_names)
+        aux_names = list(self._sb_aux_names)
+        aux_out_names = []
+        if train:
+            # discover which aux get updates by a cheap shape-eval later;
+            # conservatively, all aux are returned and written back
+            aux_out_names = list(aux_names)
+
+        def fn(*flat):
+            n_in, n_p = len(input_names), len(param_names)
+            arg_vals = dict(zip(input_names, flat[:n_in]))
+            arg_vals.update(zip(param_names, flat[n_in:n_in + n_p]))
+            aux_vals = dict(zip(aux_names, flat[n_in + n_p:]))
+            key = _random.new_key()
+            outs, new_aux = graph_fn(arg_vals, aux_vals, key)
+            extra = tuple(new_aux.get(n, aux_vals[n])
+                          for n in aux_out_names)
+            return tuple(outs) + extra
+
+        op = Op("symbol_block_%s" % (self._sb_symbol.name or "group"),
+                fn, differentiable=True)
+        return op, aux_out_names
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError  # forward() is overridden directly

@@ -1,0 +1,36 @@
+"""Checkpoint helpers (ref: python/mxnet/model.py — save_checkpoint /
+load_checkpoint; format: prefix-symbol.json + prefix-%04d.params with
+``arg:``/``aux:`` key prefixes, identical to the reference on-disk layout).
+"""
+from __future__ import annotations
+
+from .ndarray import ndarray as _nd
+
+__all__ = ["save_checkpoint", "load_checkpoint"]
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    del remove_amp_cast
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    _nd.save(param_name, save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    from . import symbol as sym_mod
+
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    save_dict = _nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params

@@ -1,0 +1,312 @@
+"""Module — symbolic training over a bound executor
+(ref: python/mxnet/module/module.py).
+
+The reference slices each batch over a context list of GPUs
+(DataParallelExecutorGroup) and allreduces through KVStore. Here one
+executor = one jitted XLA program on the default device; data parallelism
+over TPU meshes is the parallel package's job (parallel.ShardedTrainStep —
+GSPMD shards the same program over the mesh, which is strictly more general
+than per-GPU executor groups).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import initializer as init_mod
+from .. import optimizer as opt_mod
+from ..io.io import DataDesc
+from ..ndarray.ndarray import NDArray
+from ..ndarray import ndarray as _nd
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+def _norm_shapes(shapes):
+    if shapes is None:
+        return []
+    out = []
+    for s in shapes:
+        if isinstance(s, DataDesc):
+            out.append(s)
+        else:
+            name, shape = s[0], s[1]
+            out.append(DataDesc(name, tuple(shape)))
+    return out
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None):
+        super().__init__(logger=logger)
+        del work_load_list, state_names
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._context = context
+        self._fixed_param_names = list(fixed_param_names or [])
+
+        arg_names = symbol.list_arguments()
+        input_names = set(self._data_names) | set(self._label_names)
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        for n in self._data_names:
+            if n not in arg_names:
+                raise MXNetError(
+                    "data name %r is not an argument of the symbol" % n)
+
+        self._exec = None
+        self._arg_params = None
+        self._aux_params = None
+        self._optimizer = None
+        self._updater = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._monitor = None
+
+    # -- introspection -------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(n, o.shape) for n, o in zip(self.output_names,
+                                             self._exec.outputs)] \
+            if self._exec.outputs else None
+
+    # -- binding -------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self._data_shapes = _norm_shapes(data_shapes)
+        self._label_shapes = _norm_shapes(label_shapes)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+        shape_kwargs = {d.name: d.shape for d in self._data_shapes}
+        shape_kwargs.update({d.name: d.shape for d in self._label_shapes})
+        req = {}
+        for n in self._symbol.list_arguments():
+            if n in self._param_names and n not in self._fixed_param_names \
+                    and for_training:
+                req[n] = grad_req if isinstance(grad_req, str) else \
+                    grad_req.get(n, "write")
+            elif inputs_need_grad and n in self._data_names:
+                req[n] = "write"
+            else:
+                req[n] = "null"
+        if shared_module is not None:
+            # share parameter/grad/aux STORAGE with the other module: both
+            # executors hold the same NDArray handles, so an update through
+            # either bucket is visible to all (ref: module.py —
+            # shared_module → shared_exec_group storage)
+            import jax.numpy as jnp
+
+            from ..symbol.executor import Executor
+
+            sh = shared_module._exec
+            arg_shapes, _, aux_shapes = self._symbol.infer_shape(
+                **shape_kwargs)
+            arg_names = self._symbol.list_arguments()
+            args, args_grad = {}, {}
+            for n, s in zip(arg_names, arg_shapes):
+                if n in sh.arg_dict and n in self._param_names:
+                    args[n] = sh.arg_dict[n]
+                    if req.get(n, "null") != "null" and n in sh.grad_dict:
+                        args_grad[n] = sh.grad_dict[n]
+                else:
+                    args[n] = NDArray(jnp.zeros(s, dtype="float32"))
+                if n not in args_grad and req.get(n, "null") != "null":
+                    args_grad[n] = NDArray(
+                        jnp.zeros_like(args[n].data))
+            aux = {n: sh.aux_dict[n] if n in sh.aux_dict
+                   else NDArray(jnp.zeros(s, dtype="float32"))
+                   for n, s in zip(self._symbol.list_auxiliary_states(),
+                                   aux_shapes)}
+            self._exec = Executor(self._symbol, self._context, args,
+                                  args_grad, req, aux)
+        else:
+            self._exec = self._symbol.simple_bind(
+                self._context, grad_req=req, **shape_kwargs)
+        self.binded = True
+        if self._arg_params is not None:
+            self._exec.copy_params_from(self._arg_params, self._aux_params,
+                                        allow_extra_params=True)
+            self.params_initialized = True
+            self._arg_params = None
+            self._aux_params = None
+
+    # -- params --------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing the parameters"
+        if initializer is None and not self.params_initialized:
+            initializer = init_mod.Uniform(0.01)
+
+        for name in self._param_names + self._aux_names:
+            target = self._exec.arg_dict.get(name)
+            if target is None:
+                target = self._exec.aux_dict.get(name)
+            src = None
+            if arg_params is not None and name in arg_params:
+                src = arg_params[name]
+            elif aux_params is not None and name in aux_params:
+                src = aux_params[name]
+            if src is not None:
+                target._set_data(src.data.astype(target.dtype)
+                                 if isinstance(src, NDArray)
+                                 else np.asarray(src, target.dtype))
+            elif self.params_initialized and not force_init:
+                continue
+            elif initializer is not None:
+                initializer(name, target)
+            elif not allow_missing:
+                raise MXNetError("parameter %r missing and no initializer"
+                                 % name)
+        self.params_initialized = True
+        self._arg_params = None
+        self._aux_params = None
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg = {n: self._exec.arg_dict[n].copy()
+               for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg, aux
+
+    # -- optimizer -----------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        del kvstore  # facade: single-program execution needs no kvstore
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._optimizer = optimizer
+        else:
+            opt_params = dict(optimizer_params)
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            self._optimizer = opt_mod.create(
+                optimizer, param_idx2name=idx2name, **opt_params)
+        self._updater = opt_mod.get_updater(self._optimizer)
+        self.optimizer_initialized = True
+
+    # -- execution -----------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if self._label_names and data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                if name in self._exec.arg_dict:
+                    feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+        if self._monitor is not None:
+            self._monitor.forward_hook(self)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            self._updater(i, grad, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded
+        return list(self._exec.outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.inputs_need_grad
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update_dict(
+            dict(zip(self._label_names, labels or [])),
+            dict(zip(self.output_names, self.get_outputs())))
+
+    def install_monitor(self, monitor):
+        self._monitor = monitor
+        monitor.install(self._exec)
+
+    # -- checkpointing (ref: module.py — save_checkpoint / load) -------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        from ..model import save_checkpoint
+
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+        if save_optimizer_states:
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from ..model import load_checkpoint
+
+        sym, arg, aux = load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._arg_params = arg
+        mod._aux_params = aux
+        if load_optimizer_states:
+            mod._preloaded_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # set_params comes from BaseModule; params land when bound
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        if not self.binded:
+            self._arg_params = arg_params
+            self._aux_params = aux_params
+            self.params_initialized = True
+            return
+        super().set_params(arg_params, aux_params,
+                           allow_missing=allow_missing,
+                           force_init=force_init, allow_extra=allow_extra)

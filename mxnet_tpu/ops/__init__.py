@@ -9,6 +9,7 @@ from . import nn
 from . import random_ops
 from . import rnn
 from . import optimizer_ops
+from . import loss_output
 
 from .registry import apply_op, get_op, list_ops, register, Op
 

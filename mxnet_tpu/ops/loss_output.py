@@ -1,0 +1,99 @@
+"""Legacy output-layer loss ops + gradient-control ops
+(ref: src/operator/regression_output{.cc,-inl.h}, src/operator/make_loss.cc,
+src/operator/tensor/elemwise_unary_op_basic.cc — BlockGrad).
+
+Like SoftmaxOutput, these ops' backward IGNORES the incoming cotangent and
+emits the fused loss gradient — the executor's backward() seeds loss heads
+with ones and these custom vjps produce the training signal, reproducing
+the reference's "loss layer" semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _regression_core(transform, grad_fn):
+    def fwd(data, label, grad_scale):
+        return transform(data), (data, label)
+
+    def bwd(grad_scale, res, ct):
+        data, label = res
+        num_output = max(1, int(jnp.size(data)) // max(1, data.shape[0]))
+        g = grad_fn(transform(data), label) * (grad_scale / num_output)
+        return (g.astype(data.dtype), jnp.zeros_like(label))
+
+    core = jax.custom_vjp(
+        lambda data, label, grad_scale: fwd(data, label, grad_scale)[0],
+        nondiff_argnums=(2,),
+    )
+    core.defvjp(fwd, bwd)
+    return core
+
+
+_linear_core = _regression_core(lambda d: d, lambda o, l: o - l)
+_mae_core = _regression_core(lambda d: d, lambda o, l: jnp.sign(o - l))
+_logistic_core = _regression_core(jax.nn.sigmoid, lambda o, l: o - l)
+
+
+@register("LinearRegressionOutput")
+def linear_regression_output(data, label, grad_scale=1.0):
+    """Identity forward; backward = (data - label) * scale / num_output
+    (ref: regression_output-inl.h)."""
+    return _linear_core(data, label, float(grad_scale))
+
+
+@register("MAERegressionOutput")
+def mae_regression_output(data, label, grad_scale=1.0):
+    return _mae_core(data, label, float(grad_scale))
+
+
+@register("LogisticRegressionOutput")
+def logistic_regression_output(data, label, grad_scale=1.0):
+    """Sigmoid forward; backward = (sigmoid(data) - label) * scale."""
+    return _logistic_core(data, label, float(grad_scale))
+
+
+def _make_loss_fwd(data, grad_scale, valid_thresh, normalization):
+    return data, data
+
+
+def _make_loss_bwd(grad_scale, valid_thresh, normalization, res, ct):
+    scale = jnp.asarray(grad_scale, res.dtype)
+    if normalization == "batch":
+        scale = scale / res.shape[0]
+    elif normalization == "valid":
+        # divide by the count of elements above valid_thresh
+        # (ref: make_loss.cc — MakeLossGradKernel with valid normalization)
+        num_valid = jnp.maximum(
+            jnp.sum(res > valid_thresh).astype(res.dtype), 1.0)
+        scale = scale / num_valid
+    return (jnp.broadcast_to(scale, res.shape).astype(res.dtype),)
+
+
+_make_loss_core = jax.custom_vjp(
+    lambda data, grad_scale, valid_thresh, normalization: data,
+    nondiff_argnums=(1, 2, 3),
+)
+_make_loss_core.defvjp(_make_loss_fwd, _make_loss_bwd)
+
+
+@register("MakeLoss", aliases=("make_loss",))
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    """Marks a symbol as a loss: forward passes through, backward emits
+    d(sum(data))/d(data) * grad_scale (ref: src/operator/make_loss.cc)."""
+    return _make_loss_core(data, float(grad_scale), float(valid_thresh),
+                           str(normalization))
+
+
+@register("BlockGrad", aliases=("stop_gradient",), differentiable=False)
+def block_grad(data):
+    """Gradient barrier (ref: elemwise_unary_op_basic.cc — BlockGrad)."""
+    return jax.lax.stop_gradient(data)
+
+
+@register("identity", aliases=("_copy",))
+def identity(data):
+    return data

@@ -1,0 +1,111 @@
+"""``mx.sym`` — the symbolic namespace.
+
+Generated from the SAME op registry as ``mx.nd`` (ref:
+python/mxnet/symbol/register.py — _init_op_module; SURVEY invariant "one op
+registry serves both execution modes"): every registered op becomes a
+symbol-composing function here and an eager function there.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..base import MXNetError
+from ..ops import registry as _registry
+from .symbol import (
+    Symbol, Variable, var, Group, load, load_json, _Node, _name_manager,
+    OP_INPUTS, VISIBLE_OUTPUTS, num_outputs_for,
+)
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+
+
+def _apply_sym_op(op_name, *args, name=None, attr=None, **kwargs):
+    """Compose a graph node (ref: nnvm Symbol::Compose). Missing trailing
+    inputs of table ops become auto-created Variables named
+    <node-name>_<input-name>."""
+    op = _registry.get_op(op_name)
+    inputs = []
+    for a in args:
+        if a is None:
+            inputs.append(None)
+        elif isinstance(a, Symbol):
+            if len(a) != 1:
+                raise MXNetError(
+                    "op %s: cannot take a multi-output symbol as one input"
+                    % op.name)
+            inputs.append(a._outputs[0])
+        else:
+            raise TypeError(
+                "op %s: positional inputs must be Symbols, got %r"
+                % (op.name, type(a)))
+
+    node_name = name if name is not None else _name_manager.get(
+        op.name.lower().lstrip("_"))
+
+    info = OP_INPUTS.get(op.name)
+    if info is not None:
+        in_names = info["inputs"]
+        # pull Symbol kwargs by input name (mx.sym.FC(data=..., weight=...))
+        for i, nm in enumerate(in_names):
+            if nm in kwargs and isinstance(kwargs[nm], Symbol):
+                sym_in = kwargs.pop(nm)
+                while len(inputs) <= i:
+                    inputs.append(None)
+                inputs[i] = sym_in._outputs[0]
+        n_expected = len(in_names)
+        if op.name in ("FullyConnected", "Convolution", "Deconvolution") \
+                and kwargs.get("no_bias", False):
+            n_expected -= 1
+        if op.name == "RNN" and kwargs.get("mode", "lstm") != "lstm":
+            n_expected -= 1  # no state_cell
+        while len(inputs) < n_expected:
+            inputs.append(None)
+        for i in range(len(inputs)):
+            if inputs[i] is None:
+                vname = "%s_%s" % (node_name, in_names[i])
+                inputs[i] = _Node(None, vname, {}, []), 0
+    else:
+        # Symbol kwargs not in a table op: treat as named extra inputs is
+        # unsupported — require positional
+        for k, v in list(kwargs.items()):
+            if isinstance(v, Symbol):
+                raise MXNetError(
+                    "op %s: pass array input %r positionally" % (op.name, k))
+        if any(i is None for i in inputs):
+            raise MXNetError(
+                "op %s: None input not allowed (no auto-variable table "
+                "entry)" % op.name)
+
+    attrs = dict(attr or {})
+    for k, v in kwargs.items():
+        if isinstance(v, list):
+            v = tuple(v)
+        attrs[k] = v
+    n_out = num_outputs_for(op, kwargs)
+    node = _Node(op.name, node_name, attrs, list(inputs),
+                 num_outputs=n_out)
+    n_vis = VISIBLE_OUTPUTS.get(op.name, n_out)
+    return Symbol([(node, i) for i in range(n_vis)])
+
+
+def _make_sym_func(op):
+    def fn(*args, **kwargs):
+        return _apply_sym_op(op.name, *args, **kwargs)
+
+    fn.__name__ = op.name
+    fn.__qualname__ = op.name
+    fn.__doc__ = ((op.fn.__doc__ or "")
+                  + "\n(symbolic form of registered op: %s)" % op.name)
+    return fn
+
+
+_mod = _sys.modules[__name__]
+for _name in _registry.list_ops():
+    _op = _registry.get_op(_name)
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_sym_func(_op))
+for _alias, _target in list(_registry._ALIASES.items()):
+    if not hasattr(_mod, _alias):
+        setattr(_mod, _alias, getattr(_mod, _target))
+
+from .executor import Executor  # noqa: E402,F401

@@ -1,0 +1,305 @@
+"""Executor — binds a Symbol and runs it as ONE jitted XLA program
+(ref: src/executor/graph_executor.cc — GraphExecutor::SimpleBind/Forward/
+Backward).
+
+The reference's GraphExecutor does InferShape → PlanMemory → AttachOpExecs →
+segmented engine pushes. Here bind() lowers the whole graph to a single
+``jax.jit`` function: XLA buffer assignment plays PlanMemory, XLA fusion
+plays bulk-exec segments, and ``jax.vjp`` over the traced program plays the
+Gradient pass — no per-op dispatch remains on the hot path.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .. import random as _random
+from ..ndarray.ndarray import NDArray
+from ..ops.registry import get_op
+
+__all__ = ["Executor"]
+
+# train-mode aux writebacks: op → {input_index: output_index}; in train mode
+# the op's extra outputs are the updated mutable states for those inputs
+# (ref: BatchNorm mutates moving_mean/moving_var in-kernel)
+AUX_UPDATES = {"BatchNorm": {3: 1, 4: 2}}
+
+
+@functools.lru_cache(maxsize=None)
+def _fn_params(fn):
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return None
+    return frozenset(sig.parameters)
+
+
+def _call_op_with_attrs(op, attrs, train, arrays):
+    """Invoke a registered op fn with symbol-node attrs as static params,
+    injecting train_mode when the op takes it."""
+    kwargs = {}
+    accepted = _fn_params(op.fn)
+    for k, v in attrs.items():
+        if k.startswith("__") or k == "name":
+            continue
+        if isinstance(v, list):
+            v = tuple(v)
+        if accepted is None or k in kwargs or k in accepted:
+            kwargs[k] = v
+    if accepted is not None and "train_mode" in accepted:
+        kwargs["train_mode"] = bool(train)
+    return op.fn(*arrays, **kwargs)
+
+
+def _build_graph_fn(symbol, train):
+    """Pure fn(args_dict, aux_dict, key) -> (outputs tuple, new_aux dict)."""
+    nodes = symbol._topo_nodes()
+    out_entries = [(id(n), oi) for n, oi in symbol._outputs]
+    aux_names = set(symbol.list_auxiliary_states())
+
+    def fn(arg_vals, aux_vals, key):
+        with _random.key_scope(key):
+            vals = {}
+            new_aux = {}
+            for node in nodes:
+                if node.is_var():
+                    if node.name in aux_names:
+                        vals[(id(node), 0)] = aux_vals[node.name]
+                    else:
+                        vals[(id(node), 0)] = arg_vals[node.name]
+                    continue
+                op = get_op(node.op)
+                ins = [vals[(id(inp), oi)] for inp, oi in node.inputs]
+                out = _call_op_with_attrs(op, node.attrs, train, ins)
+                outs = out if isinstance(out, tuple) else (out,)
+                for i, o in enumerate(outs):
+                    vals[(id(node), i)] = o
+                if train and node.op in AUX_UPDATES:
+                    for in_idx, out_idx in AUX_UPDATES[node.op].items():
+                        if in_idx < len(node.inputs):
+                            inp, _ = node.inputs[in_idx]
+                            if inp.is_var() and inp.name in aux_names:
+                                new_aux[inp.name] = jax.lax.stop_gradient(
+                                    outs[out_idx])
+            outputs = tuple(vals[e] for e in out_entries)
+        return outputs, new_aux
+
+    return fn
+
+
+class Executor:
+    """Bound computation (ref: include/mxnet/executor.h — Executor)."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        self.arg_dict = self._to_dict(args, arg_names, "args")
+        missing = [n for n in arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXNetError("bind: missing arguments %s" % (missing,))
+        self.aux_dict = self._to_dict(aux_states or {}, aux_names,
+                                      "aux_states")
+        for n in aux_names:
+            if n not in self.aux_dict:
+                raise MXNetError("bind: missing auxiliary state %s" % (n,))
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null") for n in arg_names}
+
+        if args_grad is None:
+            args_grad = {
+                n: NDArray(jnp.zeros_like(self.arg_dict[n].data))
+                for n in arg_names if self._grad_req[n] != "null"}
+        self.grad_dict = self._to_dict(args_grad, arg_names, "args_grad")
+
+        self.outputs = []
+        self._fwd_cache = {}
+        self._bwd_jit = None
+        self._last = None  # (arg_datas, aux_datas, key) of last train fwd
+
+    @staticmethod
+    def _to_dict(vals, names, what):
+        if isinstance(vals, dict):
+            out = {}
+            for k, v in vals.items():
+                out[k] = v if isinstance(v, NDArray) else NDArray(
+                    jnp.asarray(v))
+            return out
+        if isinstance(vals, (list, tuple)):
+            if len(vals) != len(names):
+                raise MXNetError(
+                    "%s: expected %d entries, got %d"
+                    % (what, len(names), len(vals)))
+            return {n: v if isinstance(v, NDArray) else NDArray(
+                jnp.asarray(v)) for n, v in zip(names, vals)}
+        raise MXNetError("%s must be dict or list" % what)
+
+    # -- factory -------------------------------------------------------
+    @staticmethod
+    def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None,
+                    **kwargs):
+        """Infer shapes from data shapes and allocate everything
+        (ref: graph_executor.cc — GraphExecutor::Init via SimpleBind)."""
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**kwargs)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        type_dict = type_dict or {}
+        args = {}
+        for n, s in zip(arg_names, arg_shapes):
+            dt = np.dtype(type_dict.get(n, "float32"))
+            args[n] = NDArray(jnp.zeros(s, dtype=dt))
+        aux = {}
+        for n, s in zip(aux_names, aux_shapes):
+            dt = np.dtype(type_dict.get(n, "float32"))
+            aux[n] = NDArray(jnp.zeros(s, dtype=dt))
+        return Executor(symbol, ctx, args, None, grad_req, aux)
+
+    # -- execution -----------------------------------------------------
+    def _get_fwd(self, train):
+        jf = self._fwd_cache.get(train)
+        if jf is None:
+            jf = jax.jit(_build_graph_fn(self._symbol, train))
+            self._fwd_cache[train] = jf
+        return jf
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("forward: unknown argument %r" % k)
+            data = v.data if isinstance(v, NDArray) else jnp.asarray(v)
+            self.arg_dict[k]._set_data(
+                data.astype(self.arg_dict[k].data.dtype)
+                if data.dtype != self.arg_dict[k].data.dtype else data)
+        arg_datas = {n: a.data for n, a in self.arg_dict.items()}
+        aux_datas = {n: a.data for n, a in self.aux_dict.items()}
+        key = _random.new_key()
+        outs, new_aux = self._get_fwd(bool(is_train))(
+            arg_datas, aux_datas, key)
+        for n, v in new_aux.items():
+            self.aux_dict[n]._set_data(v)
+        self.outputs = [NDArray(o) for o in outs]
+        self._last = (arg_datas, aux_datas, key) if is_train else None
+        return self.outputs
+
+    def _default_head_grads(self):
+        from .symbol import LOSS_OPS
+
+        grads = []
+        for (node, oidx), out in zip(self._symbol._outputs, self.outputs):
+            if node.op in LOSS_OPS:
+                grads.append(jnp.ones_like(out.data))
+            else:
+                grads.append(jnp.zeros_like(out.data))
+        return tuple(grads)
+
+    def backward(self, out_grads=None):
+        """Gradients of args with grad_req != 'null'
+        (ref: GraphExecutor::Backward; loss-op heads imply ones cotangent,
+        their custom vjp emits the fused loss gradient)."""
+        if self._last is None:
+            raise MXNetError(
+                "backward called without forward(is_train=True)")
+        arg_datas, aux_datas, key = self._last
+        if out_grads is None:
+            cts = self._default_head_grads()
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = tuple(
+                g.data if isinstance(g, NDArray) else jnp.asarray(g)
+                for g in out_grads)
+
+        diff_names = tuple(sorted(
+            n for n, r in self._grad_req.items() if r != "null"))
+        if not diff_names:
+            return
+
+        if self._bwd_jit is None:
+            fwd = _build_graph_fn(self._symbol, True)
+
+            @jax.jit
+            def bwd(diff_args, rest_args, aux_vals, k, cotangents):
+                def f(d):
+                    merged = dict(rest_args)
+                    merged.update(d)
+                    return fwd(merged, aux_vals, k)[0]
+
+                _, vjp_fn = jax.vjp(f, diff_args)
+                return vjp_fn(cotangents)[0]
+
+            self._bwd_jit = bwd
+
+        diff_args = {n: arg_datas[n] for n in diff_names}
+        rest_args = {n: v for n, v in arg_datas.items()
+                     if n not in diff_args}
+        grads = self._bwd_jit(diff_args, rest_args, aux_datas, key, cts)
+        for n in diff_names:
+            g = grads[n]
+            if self._grad_req[n] == "add":
+                self.grad_dict[n]._set_data(self.grad_dict[n].data + g)
+            else:
+                self.grad_dict[n]._set_data(g.astype(
+                    self.grad_dict[n].data.dtype))
+
+    # -- utilities -----------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n)
+                for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n]
+                for n in self._symbol.list_auxiliary_states()]
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(
+                    v.data.astype(self.arg_dict[k].dtype)
+                    if isinstance(v, NDArray)
+                    else jnp.asarray(v, self.arg_dict[k].dtype))
+            elif not allow_extra_params:
+                raise MXNetError("unknown parameter %r" % k)
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._set_data(
+                    v.data.astype(self.aux_dict[k].dtype)
+                    if isinstance(v, NDArray)
+                    else jnp.asarray(v, self.aux_dict[k].dtype))
+            elif not allow_extra_params:
+                raise MXNetError("unknown aux state %r" % k)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **kwargs):
+        """Rebind with new data shapes (jit specializes per shape anyway)."""
+        del partial_shaping, allow_up_sizing
+        shapes = {}
+        for n, arr in self.arg_dict.items():
+            shapes[n] = kwargs.get(n, arr.shape)
+        ex = Executor.simple_bind(
+            self._symbol, self._ctx,
+            grad_req={n: r for n, r in self._grad_req.items()},
+            **{k: v for k, v in shapes.items()})
+        ex.copy_params_from(
+            {n: v for n, v in self.arg_dict.items() if n not in kwargs},
+            dict(self.aux_dict), allow_extra_params=True)
+        return ex

@@ -1,0 +1,46 @@
+"""HybridBlock → Symbol export (ref: gluon/block.py — HybridBlock.export;
+the reference traces the CachedOp graph to symbol.json + params).
+
+Because ``mx.sym`` mirrors ``mx.nd`` over one registry, exporting is just
+re-running hybrid_forward with Symbol inputs: the same layer code that
+computed arrays now composes a graph.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ndarray import ndarray as _nd
+
+__all__ = ["export_block"]
+
+
+def export_block(block, path, epoch=0):
+    """Write path-symbol.json + path-%04d.params (arg:/aux: keyed)."""
+    from .. import autograd as ag
+    from . import var as _var
+    from ..model import save_checkpoint
+
+    params = block.collect_params()
+    for p in params.values():
+        if p._data is None:
+            raise MXNetError(
+                "export: parameter %s is not initialized; run a forward "
+                "pass first" % p.name)
+
+    data = _var("data")
+    with ag.pause(train_mode=False):
+        out = block(data)
+    if isinstance(out, (list, tuple)):
+        from . import Group
+
+        out = Group(list(out))
+
+    aux_names = set(out.list_auxiliary_states())
+    arg_params = {}
+    aux_params = {}
+    for name, p in params.items():
+        if name in aux_names:
+            aux_params[name] = p.data()
+        else:
+            arg_params[name] = p.data()
+    save_checkpoint(path, epoch, out, arg_params, aux_params)
+    return "%s-symbol.json" % path, "%s-%04d.params" % (path, epoch)

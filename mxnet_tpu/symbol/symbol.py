@@ -1,0 +1,598 @@
+"""Symbol — the lazy graph IR (ref: nnvm Symbol/Graph,
+python/mxnet/symbol/symbol.py; JSON format of nnvm pass SaveJSON).
+
+The reference's Symbol composes nnvm nodes and executes via GraphExecutor.
+Here a Symbol is a tiny DAG over the SAME op registry the imperative mode
+dispatches (SURVEY invariant: one registry, two modes); binding lowers the
+whole graph to one jitted XLA program (executor.py) — GraphExecutor's memory
+planning, op fusion, and bulk execution all fall out of XLA compilation.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import threading
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+
+
+class _SymNameManager(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.counters = {}
+
+    def get(self, hint):
+        n = self.counters.get(hint, 0)
+        self.counters[hint] = n + 1
+        return "%s%d" % (hint, n)
+
+
+_name_manager = _SymNameManager()
+
+
+class _Node:
+    """One graph node: a variable (op=None) or an op application."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs")
+
+    def __init__(self, op, name, attrs, inputs, num_outputs=1):
+        self.op = op  # None for variables, else registry op name (str)
+        self.name = name
+        self.attrs = attrs  # static params (python values)
+        self.inputs = inputs  # list[(that _Node, int output_index)]
+        self.num_outputs = num_outputs
+
+    def is_var(self):
+        return self.op is None
+
+
+# ops whose trailing array inputs are auto-created as Variables when not
+# passed (ref: nnvm Symbol::Compose creates missing inputs named
+# <op-name>_<input-name>); aux marks mutable state inputs
+# (list_auxiliary_states)
+OP_INPUTS = {
+    "FullyConnected": {"inputs": ["data", "weight", "bias"], "aux": []},
+    "Convolution": {"inputs": ["data", "weight", "bias"], "aux": []},
+    "Deconvolution": {"inputs": ["data", "weight", "bias"], "aux": []},
+    "BatchNorm": {"inputs": ["data", "gamma", "beta", "moving_mean",
+                             "moving_var"],
+                  "aux": ["moving_mean", "moving_var"]},
+    "LayerNorm": {"inputs": ["data", "gamma", "beta"], "aux": []},
+    "InstanceNorm": {"inputs": ["data", "gamma", "beta"], "aux": []},
+    "GroupNorm": {"inputs": ["data", "gamma", "beta"], "aux": []},
+    "Embedding": {"inputs": ["data", "weight"], "aux": []},
+    "RNN": {"inputs": ["data", "parameters", "state", "state_cell"],
+            "aux": []},
+    "SoftmaxOutput": {"inputs": ["data", "label"], "aux": []},
+    "LinearRegressionOutput": {"inputs": ["data", "label"], "aux": []},
+    "MAERegressionOutput": {"inputs": ["data", "label"], "aux": []},
+    "LogisticRegressionOutput": {"inputs": ["data", "label"], "aux": []},
+}
+
+LOSS_OPS = frozenset([
+    "SoftmaxOutput", "LinearRegressionOutput", "MAERegressionOutput",
+    "LogisticRegressionOutput", "MakeLoss", "softmax_cross_entropy",
+])
+
+# ops with hidden extra outputs (ref: nnvm FNumVisibleOutputs — BatchNorm's
+# saved mean/var outputs exist at runtime but don't compose)
+VISIBLE_OUTPUTS = {"BatchNorm": 1}
+
+
+def num_outputs_for(op, attrs):
+    """Per-call output arity — some ops vary by params (shared by compose
+    and JSON load so the arity survives a save/load roundtrip)."""
+    name = op.name
+    if name in ("split", "SliceChannel"):
+        return int(attrs.get("num_outputs", 1))
+    if name == "split_v2":
+        ios = attrs.get("indices_or_sections", 1)
+        return ios if isinstance(ios, int) else len(tuple(ios)) + 1
+    if name == "RNN":
+        return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+    return op.num_outputs
+
+
+class Symbol:
+    """A set of output entries over the node DAG
+    (ref: symbol.py — Symbol; multi-output via Group/slicing)."""
+
+    __slots__ = ("_outputs",)
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)  # list[(_Node, int)]
+
+    # -- identity ------------------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def __repr__(self):
+        return "<Symbol %s>" % (self.name or "Grouped",)
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        return (Symbol([e]) for e in self._outputs)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index in names:
+                return Symbol([self._outputs[names.index(index)]])
+            raise MXNetError("Cannot find output %r in %s" % (index, names))
+        if isinstance(index, slice):
+            return Symbol(self._outputs[index])
+        node, oidx = self._outputs[0] if len(self._outputs) == 1 \
+            else (None, None)
+        if node is not None and node.num_outputs > 1 and len(self) == 1:
+            # single node with multiple outputs: index selects one
+            if index >= node.num_outputs:
+                raise MXNetError("Index %d out of range" % index)
+            return Symbol([(node, index)])
+        return Symbol([self._outputs[index]])
+
+    # -- graph walks ---------------------------------------------------
+    def _topo_nodes(self):
+        """Topological order of all reachable nodes (inputs first)."""
+        seen = {}
+        order = []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen[id(node)] = node
+            for inp, _ in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for node, _ in self._outputs:
+            visit(node)
+        return order
+
+    def _aux_names_set(self):
+        aux = set()
+        for node in self._topo_nodes():
+            if node.is_var() or node.op not in OP_INPUTS:
+                continue
+            names = OP_INPUTS[node.op]["inputs"]
+            auxes = OP_INPUTS[node.op]["aux"]
+            for (inp, _), nm in zip(node.inputs, names):
+                if inp.is_var() and nm in auxes:
+                    aux.add(inp.name)
+        return aux
+
+    def list_arguments(self):
+        aux = self._aux_names_set()
+        return [n.name for n in self._topo_nodes()
+                if n.is_var() and n.name not in aux]
+
+    def list_auxiliary_states(self):
+        aux = self._aux_names_set()
+        return [n.name for n in self._topo_nodes()
+                if n.is_var() and n.name in aux]
+
+    def list_outputs(self):
+        out = []
+        for node, oidx in self._outputs:
+            n_vis = VISIBLE_OUTPUTS.get(node.op, node.num_outputs)
+            if n_vis > 1:
+                out.append("%s_output%d" % (node.name, oidx))
+            else:
+                out.append("%s_output" % node.name)
+        return out
+
+    def list_inputs(self):
+        return [n.name for n in self._topo_nodes() if n.is_var()]
+
+    def get_internals(self):
+        """Symbol whose outputs are ALL node outputs
+        (ref: symbol.py — get_internals)."""
+        outs = []
+        for node in self._topo_nodes():
+            for i in range(node.num_outputs):
+                outs.append((node, i))
+        return Symbol(outs)
+
+    def get_children(self):
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    @property
+    def attr_dict(self):
+        out = {}
+        for node in self._topo_nodes():
+            if node.attrs:
+                out[node.name] = {
+                    k: str(v) for k, v in node.attrs.items()
+                    if not k.startswith("__")}
+        return out
+
+    def attr(self, key):
+        node = self._outputs[0][0]
+        v = node.attrs.get(key)
+        return str(v) if v is not None else None
+
+    # -- shape / dtype inference --------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        arg_shapes, out_shapes, aux_shapes = self.infer_shape_partial(
+            *args, **kwargs)
+        if any(s is None or 0 in s for s in arg_shapes):
+            unknown = [n for n, s in zip(self.list_arguments(), arg_shapes)
+                       if s is None or 0 in s]
+            raise MXNetError(
+                "infer_shape: cannot fully infer shapes for arguments %s; "
+                "provide their shapes" % (unknown,))
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, *args, **kwargs):
+        """Forward shape propagation (ref: nnvm pass InferShape). Known data
+        shapes flow forward; parameter-input shapes are deduced per-op
+        (PARAM_SHAPE_RULES), everything else via jax.eval_shape on the
+        registered op fn."""
+        if args:
+            names = self.list_arguments()
+            for n, s in zip(names, args):
+                if s is not None:
+                    kwargs[n] = s
+        return _infer_shapes(self, kwargs)
+
+    def infer_type(self, **kwargs):
+        args = self.list_arguments()
+        aux = self.list_auxiliary_states()
+        dt = np.float32
+        arg_types = [kwargs.get(a, dt) for a in args]
+        out_types = [dt] * len(self._outputs)
+        aux_types = [dt] * len(aux)
+        return arg_types, out_types, aux_types
+
+    # -- serialization -------------------------------------------------
+    def tojson(self):
+        """nnvm-compatible JSON (ref: nnvm pass SaveJSON — the
+        model-symbol.json format)."""
+        nodes = self._topo_nodes()
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        arg_nodes = []
+        for i, n in enumerate(nodes):
+            if n.is_var():
+                arg_nodes.append(i)
+            jnodes.append({
+                "op": "null" if n.is_var() else n.op,
+                "name": n.name,
+                "attrs": {k: str(v) for k, v in n.attrs.items()},
+                "inputs": [[nid[id(inp)], oi, 0] for inp, oi in n.inputs],
+            })
+        heads = [[nid[id(n)], oi, 0] for n, oi in self._outputs]
+        return json.dumps({
+            "nodes": jnodes,
+            "arg_nodes": arg_nodes,
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": heads,
+            "attrs": {"mxnet_version": ["int", 10600]},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- execution -----------------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        """One-shot evaluation with NDArray args (ref: symbol.py — eval)."""
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None):
+        from .executor import Executor
+
+        return Executor(self, ctx, args or {}, args_grad, grad_req,
+                        aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    **kwargs):
+        from .executor import Executor
+
+        return Executor.simple_bind(self, ctx, grad_req, type_dict, **kwargs)
+
+    # -- arithmetic sugar (ref: symbol.py operator overloads) ----------
+    def _binop(self, other, op_name, scalar_op=None, reverse=False):
+        from . import _apply_sym_op
+
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _apply_sym_op(op_name, a, b)
+        if scalar_op is None:
+            raise TypeError("unsupported operand: %r" % (other,))
+        kw = {"scalar": float(other)}
+        if reverse:
+            kw["reverse"] = True
+        return _apply_sym_op(scalar_op, self, **kw)
+
+    def __add__(self, other):
+        return self._binop(other, "broadcast_add", "_plus_scalar")
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return self._binop(other, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        from . import _apply_sym_op
+
+        if isinstance(other, Symbol):
+            return other.__sub__(self)
+        return _apply_sym_op("_rminus_scalar", self, scalar=float(other))
+
+    def __mul__(self, other):
+        return self._binop(other, "broadcast_mul", "_mul_scalar")
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return self._binop(other, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        from . import _apply_sym_op
+
+        if isinstance(other, Symbol):
+            return other.__truediv__(self)
+        return _apply_sym_op("_rdiv_scalar", self, scalar=float(other))
+
+    def __pow__(self, other):
+        return self._binop(other, "broadcast_power", "_power_scalar")
+
+    def __neg__(self):
+        return self.__mul__(-1.0)
+
+    def reshape(self, shape, **kwargs):
+        from . import _apply_sym_op
+
+        return _apply_sym_op("reshape", self, shape=tuple(shape), **kwargs)
+
+    def __getattr__(self, name):
+        # sym.exp(), sym.sum(axis=..) style method calls forward to ops
+        if name.startswith("_"):
+            raise AttributeError(name)
+        from ..ops.registry import _OPS, _ALIASES
+
+        if name in _OPS or name in _ALIASES:
+            from . import _apply_sym_op
+
+            def method(*args, **kw):
+                return _apply_sym_op(name, self, *args, **kw)
+
+            return method
+        raise AttributeError("Symbol has no attribute %r" % name)
+
+
+def var(name, attr=None, shape=None, dtype=None, init=None, stype=None,
+        **kwargs):
+    """Create a variable symbol (ref: symbol.py — var/Variable)."""
+    del stype
+    attrs = dict(attr or {})
+    attrs.update(kwargs)
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = str(np.dtype(dtype))
+    if init is not None:
+        attrs["__init__"] = str(init)
+    return Symbol([(_Node(None, name, attrs, []), 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    """Group symbols into one multi-output symbol (ref: symbol.py — Group)."""
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    """Rebuild a Symbol from nnvm JSON (ref: nnvm pass LoadJSON)."""
+    from ..ops.registry import get_op
+
+    data = json.loads(json_str)
+    nodes = []
+    for jn in data["nodes"]:
+        attrs = {}
+        for k, v in (jn.get("attrs") or jn.get("param") or {}).items():
+            attrs[k] = _parse_attr(v)
+        if jn["op"] == "null":
+            node = _Node(None, jn["name"], attrs, [])
+        else:
+            op = get_op(jn["op"])  # raises if unknown
+            node = _Node(op.name, jn["name"], attrs, [],
+                         num_outputs=num_outputs_for(op, attrs))
+        nodes.append(node)
+    for node, jn in zip(nodes, data["nodes"]):
+        node.inputs = [(nodes[i[0]], i[1]) for i in jn["inputs"]]
+    heads = data.get("heads") or [[len(nodes) - 1, 0, 0]]
+    return Symbol([(nodes[h[0]], h[1]) for h in heads])
+
+
+def _parse_attr(v):
+    if not isinstance(v, str):
+        return v
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+# ---------------------------------------------------------------------------
+# shape inference
+# ---------------------------------------------------------------------------
+def _fc_param_shapes(data_shape, attrs, num_inputs):
+    num_hidden = int(attrs["num_hidden"])
+    flatten = attrs.get("flatten", True)
+    in_units = int(np.prod(data_shape[1:])) if flatten else data_shape[-1]
+    shapes = {"weight": (num_hidden, in_units)}
+    if num_inputs > 2:
+        shapes["bias"] = (num_hidden,)
+    return shapes
+
+
+def _conv_param_shapes(data_shape, attrs, num_inputs):
+    num_filter = int(attrs["num_filter"])
+    kernel = tuple(attrs["kernel"])
+    groups = int(attrs.get("num_group", 1))
+    shapes = {"weight": (num_filter, data_shape[1] // groups) + kernel}
+    if num_inputs > 2 and not attrs.get("no_bias", False):
+        shapes["bias"] = (num_filter,)
+    return shapes
+
+
+def _deconv_param_shapes(data_shape, attrs, num_inputs):
+    num_filter = int(attrs["num_filter"])
+    kernel = tuple(attrs["kernel"])
+    shapes = {"weight": (data_shape[1], num_filter) + kernel}
+    if num_inputs > 2 and not attrs.get("no_bias", False):
+        shapes["bias"] = (num_filter,)
+    return shapes
+
+
+def _norm_param_shapes(data_shape, attrs, num_inputs):
+    axis = int(attrs.get("axis", 1))
+    c = data_shape[axis]
+    return {"gamma": (c,), "beta": (c,), "moving_mean": (c,),
+            "moving_var": (c,)}
+
+
+def _embedding_param_shapes(data_shape, attrs, num_inputs):
+    return {"weight": (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+def _rnn_param_shapes(data_shape, attrs, num_inputs):
+    from ..ops.rnn import rnn_param_size
+
+    mode = attrs.get("mode", "lstm")
+    h = int(attrs["state_size"])
+    L = int(attrs.get("num_layers", 1))
+    bi = bool(attrs.get("bidirectional", False))
+    d = 2 if bi else 1
+    size = rnn_param_size(mode, data_shape[2], h, L, bi)
+    shapes = {"parameters": (size,),
+              "state": (L * d, data_shape[1], h)}
+    if mode == "lstm":
+        shapes["state_cell"] = (L * d, data_shape[1], h)
+    return shapes
+
+
+def _label_like_shapes(data_shape, attrs, num_inputs):
+    if attrs.get("multi_output", False):
+        return {"label": (data_shape[0],) + tuple(data_shape[2:])}
+    return {"label": tuple(data_shape[:-1])}
+
+
+def _reg_label_shapes(data_shape, attrs, num_inputs):
+    return {"label": tuple(data_shape)}
+
+
+PARAM_SHAPE_RULES = {
+    "FullyConnected": _fc_param_shapes,
+    "Convolution": _conv_param_shapes,
+    "Deconvolution": _deconv_param_shapes,
+    "BatchNorm": _norm_param_shapes,
+    "LayerNorm": _norm_param_shapes,
+    "InstanceNorm": _norm_param_shapes,
+    "GroupNorm": _norm_param_shapes,
+    "Embedding": _embedding_param_shapes,
+    "RNN": _rnn_param_shapes,
+    "SoftmaxOutput": _label_like_shapes,
+    "LinearRegressionOutput": _reg_label_shapes,
+    "MAERegressionOutput": _reg_label_shapes,
+    "LogisticRegressionOutput": _reg_label_shapes,
+}
+
+
+def _infer_shapes(sym, known):
+    """Returns (arg_shapes, out_shapes, aux_shapes) in list_* order; None
+    for unknowable entries."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.registry import get_op
+    from .executor import _call_op_with_attrs
+
+    shapes = {}  # id(node),oidx -> shape
+    dtypes = {}
+    var_shape = dict(known)
+
+    for node in sym._topo_nodes():
+        if node.is_var():
+            s = var_shape.get(node.name, node.attrs.get("__shape__"))
+            if s is not None and 0 not in tuple(s):
+                shapes[(id(node), 0)] = tuple(s)
+                dtypes[(id(node), 0)] = np.dtype(
+                    node.attrs.get("__dtype__", "float32"))
+            continue
+        in_shapes = []
+        missing = []
+        names = OP_INPUTS.get(node.op, {}).get("inputs")
+        for i, (inp, oi) in enumerate(node.inputs):
+            s = shapes.get((id(inp), oi))
+            in_shapes.append(s)
+            if s is None:
+                missing.append(i)
+        if missing and node.op in PARAM_SHAPE_RULES and \
+                in_shapes[0] is not None:
+            rule = PARAM_SHAPE_RULES[node.op]
+            deduced = rule(in_shapes[0], node.attrs, len(node.inputs))
+            for i in list(missing):
+                inp, oi = node.inputs[i]
+                nm = names[i] if names and i < len(names) else None
+                if inp.is_var() and nm in deduced:
+                    s = deduced[nm]
+                    shapes[(id(inp), oi)] = s
+                    dtypes[(id(inp), oi)] = np.dtype("float32")
+                    in_shapes[i] = s
+                    missing.remove(i)
+        if missing:
+            continue  # cannot infer this node's outputs
+        op = get_op(node.op)
+        structs = [
+            jax.ShapeDtypeStruct(s, dtypes.get((id(inp), oi), np.float32))
+            for s, (inp, oi) in zip(in_shapes, node.inputs)]
+        try:
+            out = jax.eval_shape(
+                lambda *xs: _call_op_with_attrs(op, node.attrs, False, xs),
+                *structs)
+        except Exception as e:  # noqa: BLE001
+            raise MXNetError(
+                "shape inference failed at op %s(%s): %s"
+                % (node.op, node.name, e)) from e
+        outs = out if isinstance(out, tuple) else (out,)
+        for i, o in enumerate(outs):
+            shapes[(id(node), i)] = tuple(o.shape)
+            dtypes[(id(node), i)] = np.dtype(o.dtype)
+
+    aux = sym._aux_names_set()
+    node_by_name = {n.name: n for n in sym._topo_nodes() if n.is_var()}
+    arg_shapes = [shapes.get((id(node_by_name[a]), 0))
+                  for a in sym.list_arguments()]
+    aux_shapes = [shapes.get((id(node_by_name[a]), 0))
+                  for a in sym.list_auxiliary_states()]
+    out_shapes = [shapes.get((id(n), oi)) for n, oi in sym._outputs]
+    del jnp, aux
+    return arg_shapes, out_shapes, aux_shapes

@@ -1,0 +1,328 @@
+"""Symbol / Executor / Module tests (modeled on
+tests/python/unittest/{test_symbol,test_module}.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io import NDArrayIter, DataBatch, DataDesc
+from mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+
+def _mlp_sym(hidden=16, classes=10):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_symbol_compose_and_listing():
+    out = _mlp_sym()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+    assert out.name == "softmax"
+    internals = out.get_internals()
+    assert "relu1_output" in internals.list_outputs()
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_symbol_infer_shape():
+    out = _mlp_sym(hidden=32, classes=7)
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(6, 20))
+    args = out.list_arguments()
+    d = dict(zip(args, arg_shapes))
+    assert d["fc1_weight"] == (32, 20)
+    assert d["fc1_bias"] == (32,)
+    assert d["fc2_weight"] == (7, 32)
+    assert d["softmax_label"] == (6,)
+    assert out_shapes == [(6, 7)]
+    assert aux_shapes == []
+
+
+def test_symbol_infer_shape_conv_bn():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                          name="conv1")
+    net = sym.BatchNorm(net, name="bn1")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                      name="pool1")
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["conv1_weight"] == (8, 3, 3, 3)
+    assert d["bn1_gamma"] == (8,)
+    assert aux_shapes == [(8,), (8,)]
+    assert out_shapes == [(2, 8, 4, 4)]
+    assert net.list_auxiliary_states() == ["bn1_moving_mean",
+                                           "bn1_moving_var"]
+
+
+def test_symbol_json_roundtrip(tmp_path):
+    out = _mlp_sym()
+    f = str(tmp_path / "net.json")
+    out.save(f)
+    loaded = sym.load(f)
+    assert loaded.list_arguments() == out.list_arguments()
+    assert loaded.list_outputs() == out.list_outputs()
+    a1, o1, _ = out.infer_shape(data=(3, 5))
+    a2, o2, _ = loaded.infer_shape(data=(3, 5))
+    assert a1 == a2 and o1 == o2
+
+
+def test_symbol_arithmetic_and_methods():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b) * 2.0 - a / b
+    ex = c.bind(args={"a": nd.array([2.0, 4.0]), "b": nd.array([1.0, 2.0])})
+    out = ex.forward()[0].asnumpy()
+    assert_almost_equal(out, np.array([4.0, 10.0]))
+    d = a.exp()
+    ex2 = d.bind(args={"a": nd.array([0.0, 1.0])})
+    assert_almost_equal(ex2.forward()[0], np.exp([0.0, 1.0]), rtol=1e-5)
+
+
+@with_seed()
+def test_executor_forward_backward_matches_autograd():
+    """Symbolic grads must equal imperative autograd grads."""
+    from mxnet_tpu import autograd as ag
+
+    B, D, H, C = 4, 6, 8, 5
+    rng = np.random.RandomState(0)
+    w1 = rng.normal(0, 0.1, (H, D)).astype("f4")
+    b1 = np.zeros(H, "f4")
+    w2 = rng.normal(0, 0.1, (C, H)).astype("f4")
+    b2 = np.zeros(C, "f4")
+    x = rng.normal(size=(B, D)).astype("f4")
+    y = rng.randint(0, C, B).astype("f4")
+
+    out = _mlp_sym(hidden=H, classes=C)
+    ex = out.simple_bind(data=(B, D))
+    ex.copy_params_from({"fc1_weight": w1, "fc1_bias": b1,
+                         "fc2_weight": w2, "fc2_bias": b2},
+                        allow_extra_params=True)
+    ex.forward(is_train=True, data=x, softmax_label=y)
+    ex.backward()
+    sym_grad = ex.grad_dict["fc1_weight"].asnumpy()
+
+    # imperative reference
+    w1_nd = nd.array(w1)
+    w1_nd.attach_grad()
+    with ag.record():
+        h = nd.relu(nd.FullyConnected(nd.array(x), w1_nd, nd.array(b1),
+                                      num_hidden=H))
+        logits = nd.FullyConnected(h, nd.array(w2), nd.array(b2),
+                                   num_hidden=C)
+        prob = nd.SoftmaxOutput(logits, nd.array(y))
+    prob.backward()
+    assert_almost_equal(sym_grad, w1_nd.grad.asnumpy(), rtol=1e-4,
+                        atol=1e-5)
+
+
+@with_seed()
+def test_executor_grad_req_add_and_null():
+    x_s = sym.Variable("x")
+    out = sym.MakeLoss(x_s * x_s)
+    ex = out.bind(args={"x": nd.array([1.0, 2.0])},
+                  grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.forward(is_train=True)
+    ex.backward()
+    assert_almost_equal(ex.grad_dict["x"], np.array([4.0, 8.0]), rtol=1e-5)
+
+    ex2 = out.bind(args={"x": nd.array([1.0, 2.0])}, grad_req="null")
+    ex2.forward(is_train=True)
+    ex2.backward()  # no-op
+    assert ex2.grad_dict == {}
+
+
+@with_seed()
+def test_module_fit_mlp():
+    """Module.fit on a separable problem reaches high accuracy."""
+    rng = np.random.RandomState(0)
+    n = 200
+    X = rng.normal(size=(n, 10)).astype("f4")
+    w_true = rng.normal(size=(10,)).astype("f4")
+    Y = (X @ w_true > 0).astype("f4")
+    it = NDArrayIter(X, Y, batch_size=20, shuffle=True)
+
+    out = _mlp_sym(hidden=16, classes=2)
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(it, num_epoch=12, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            eval_metric="acc",
+            initializer=mx.init.Xavier())
+    score = mod.score(it, "acc")
+    assert score[0][1] > 0.93, score
+
+
+@with_seed()
+def test_module_predict_and_checkpoint(tmp_path):
+    rng = np.random.RandomState(1)
+    X = rng.normal(size=(30, 6)).astype("f4")
+    Y = rng.randint(0, 3, 30).astype("f4")
+    it = NDArrayIter(X, Y, batch_size=10)
+    out = _mlp_sym(hidden=8, classes=3)
+    mod = mx.mod.Module(out)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    preds = mod.predict(it)
+    assert preds.shape == (30, 3)
+
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 3)
+    sym2, arg, aux = mx.model.load_checkpoint(prefix, 3)
+    assert sym2.list_arguments() == out.list_arguments()
+
+    mod2 = mx.mod.Module.load(prefix, 3)
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    it.reset()
+    preds2 = mod2.predict(it)
+    assert_almost_equal(preds, preds2.asnumpy(), rtol=1e-5)
+
+
+@with_seed()
+def test_module_batchnorm_aux_updates():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = sym.BatchNorm(net, name="bn", momentum=0.5)
+    net = sym.MakeLoss(net, name="loss")
+    mod = mx.mod.Module(net, label_names=())
+    mod.bind(data_shapes=[("data", (8, 6))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mean0 = mod._exec.aux_dict["bn_moving_mean"].asnumpy().copy()
+    batch = DataBatch(data=[nd.array(
+        np.random.RandomState(0).normal(2.0, 1.0, (8, 6)).astype("f4"))])
+    mod.forward(batch, is_train=True)
+    mean1 = mod._exec.aux_dict["bn_moving_mean"].asnumpy()
+    assert np.abs(mean1 - mean0).sum() > 0  # running stats moved
+    mod.forward(batch, is_train=False)
+    mean2 = mod._exec.aux_dict["bn_moving_mean"].asnumpy()
+    assert_almost_equal(mean1, mean2)  # inference does not move them
+
+
+@with_seed()
+def test_bucketing_module():
+    """Per-bucket executors share parameters (ref test_module.py)."""
+    buckets = [4, 8]
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=6, name="fc",
+                                 flatten=False)
+        net = sym.mean(net, axis=1, name="pool")
+        net = sym.FullyConnected(net, num_hidden=2, name="out")
+        net = sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    mod.bind(data_shapes=[DataDesc("data", (2, 8, 3))],
+             label_shapes=[DataDesc("softmax_label", (2,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+
+    rng = np.random.RandomState(0)
+    for seq_len in [8, 4, 8, 4]:
+        batch = DataBatch(
+            data=[nd.array(rng.normal(size=(2, seq_len, 3)).astype("f4"))],
+            label=[nd.array(np.array([0.0, 1.0], "f4"))],
+            bucket_key=seq_len,
+            provide_data=[DataDesc("data", (2, seq_len, 3))],
+            provide_label=[DataDesc("softmax_label", (2,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    # both buckets exist and share the same weight values
+    w4 = mod._buckets[4]._exec.arg_dict["fc_weight"].asnumpy()
+    w8 = mod._buckets[8]._exec.arg_dict["fc_weight"].asnumpy()
+    assert_almost_equal(w4, w8)
+
+
+@with_seed()
+def test_symbol_block_and_export(tmp_path):
+    """HybridBlock → export → SymbolBlock.imports roundtrip."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential(prefix="mlp_")
+    with net.name_scope():
+        net.add(nn.Dense(12, activation="relu", in_units=6))
+        net.add(nn.BatchNorm(in_channels=12))
+        net.add(nn.Dense(3, in_units=12))
+    net.initialize()
+    x = nd.random.uniform(shape=(5, 6))
+    y0 = net(x).asnumpy()
+
+    path = str(tmp_path / "mlp")
+    sym_file, param_file = net.export(path, epoch=7)
+    loaded = gluon.SymbolBlock.imports(sym_file, ["data"], param_file)
+    y1 = loaded(x).asnumpy()
+    assert_almost_equal(y0, y1, rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_symbol_block_gradients():
+    from mxnet_tpu import autograd as ag
+    from mxnet_tpu import gluon
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    blk = gluon.SymbolBlock(net, [data])
+    blk.initialize()
+    x = nd.random.uniform(shape=(2, 3))
+    with ag.record():
+        out = blk(x)
+        loss = (out * out).sum()
+    loss.backward()
+    g = blk.params["fc_weight"].grad()
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+
+
+def test_infer_shape_raises_on_unknown():
+    out = sym.FullyConnected(sym.Variable("data"), num_hidden=2)
+    with pytest.raises(mx.MXNetError, match="cannot fully infer"):
+        out.infer_shape()
+
+
+def test_split_json_roundtrip_keeps_arity():
+    parts = sym.split(sym.Variable("x"), num_outputs=3, axis=0, name="sp")
+    loaded = sym.load_json(parts.tojson())
+    assert loaded.list_outputs() == ["sp_output0", "sp_output1",
+                                     "sp_output2"]
+    ex = loaded.bind(args={"x": nd.array([[1.0], [2.0], [3.0]])})
+    outs = ex.forward()
+    assert len(outs) == 3
+    assert_almost_equal(outs[2], np.array([[3.0]]))
+
+
+def test_make_loss_valid_normalization():
+    x = sym.Variable("x")
+    out = sym.MakeLoss(x, normalization="valid", valid_thresh=0.0)
+    data = nd.array([0.0, 0.0, 2.0, 3.0])  # 2 valid elements
+    ex = out.bind(args={"x": data})
+    ex.forward(is_train=True)
+    ex.backward()
+    assert_almost_equal(ex.grad_dict["x"],
+                        np.full(4, 0.5, "f4"), rtol=1e-6)
+
+
+def test_group_and_multi_output():
+    a = sym.Variable("a")
+    b = a * 2.0
+    c = a + 1.0
+    g = sym.Group([b, c])
+    assert len(g.list_outputs()) == 2
+    ex = g.bind(args={"a": nd.array([1.0, 2.0])})
+    outs = ex.forward()
+    assert_almost_equal(outs[0], np.array([2.0, 4.0]))
+    assert_almost_equal(outs[1], np.array([2.0, 3.0]))
+    parts = sym.split(sym.Variable("x"), num_outputs=2, axis=0)
+    assert len(parts.list_outputs()) == 2
+    first = parts[0]
+    ex2 = first.bind(args={"x": nd.array([[1.0], [2.0]])})
+    assert_almost_equal(ex2.forward()[0], np.array([[1.0]]))
