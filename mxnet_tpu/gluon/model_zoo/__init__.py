@@ -1,5 +1,12 @@
 """Model zoo (ref: python/mxnet/gluon/model_zoo/__init__.py)."""
 from . import vision
 from .vision import get_model
+from . import bert
+from .bert import (
+    BERTModel, BERTEncoder, get_bert_model, bert_12_768_12, bert_6_512_8,
+    bert_3_64_2,
+)
 
-__all__ = ["vision", "get_model"]
+__all__ = ["vision", "get_model", "bert", "BERTModel", "BERTEncoder",
+           "get_bert_model", "bert_12_768_12", "bert_6_512_8",
+           "bert_3_64_2"]

@@ -10,6 +10,7 @@ from . import random_ops
 from . import rnn
 from . import optimizer_ops
 from . import loss_output
+from . import attention
 
 from .registry import apply_op, get_op, list_ops, register, Op
 

@@ -1,0 +1,393 @@
+"""Flash attention — Pallas TPU kernel (SURVEY §5 long-context plan; the
+reference composes attention from batch_dot+softmax at GluonNLP level with
+O(T^2) memory — no fused kernel exists there, this is the TPU-native
+upgrade).
+
+Forward is an online-softmax Pallas kernel: Q blocks stream over K/V blocks
+held in VMEM, never materializing the (T, T) score matrix in HBM. Backward
+recomputes scores blockwise in XLA from the saved logsumexp (standard
+flash-v2 recipe; XLA fuses the recompute into the dq/dk/dv matmuls).
+
+Layout: (B, H, T, D) with D the head dim — MXU-friendly (T, D) @ (D, T)
+tiles, fp32 accumulation via preferred_element_type.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _attention_reference(q, k, v, bias, causal, sm_scale):
+    """Plain-XLA reference (also the CPU path). O(T^2) memory."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores * sm_scale
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
+                      block_k, causal, sm_scale, kv_len, q_len):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32)  # (BQ, D)
+    block_q = q.shape[0]
+    iq = pl.program_id(1)
+    q_off = iq * block_q
+
+    m = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+
+    num_kv = pl.cdiv(kv_len, block_k)
+
+    def body(ik, carry):
+        m_i, l_i, acc_i = carry
+        k_blk = k_ref[0, pl.ds(ik * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ik * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (BQ, BK)
+        if bias_ref is not None:
+            s = s + bias_ref[0, pl.ds(ik * block_k, block_k)].astype(
+                jnp.float32)[None, :]
+        col = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = col < kv_len  # tail-block padding mask
+        if causal:
+            # bottom-right alignment (matches reference tril(k=Tk-Tq)):
+            # query row i attends keys up to i + (Tk - Tq)
+            row = q_off + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, col <= row + (kv_len - q_len))
+        s = jnp.where(valid, s, _NEG_INF)
+
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_i - m_new)
+        l_new = l_i * alpha + jnp.sum(p, axis=1)
+        acc_new = acc_i * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m, l, acc))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _flash_forward_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k,
+                          interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    # pad sequence dims to block multiples: partial blocks would otherwise
+    # hit dynamic-slice start clamping and read/write shifted rows
+    pad_q = (-Tq) % block_q
+    pad_k = (-Tk) % block_k
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        if bias is not None:
+            bias = jnp.pad(bias, ((0, 0), (0, 0), (0, 0), (0, pad_k)))
+    Tqp, Tkp = Tq + pad_q, Tk + pad_k
+    qf = q.reshape(B * H, Tqp, D)
+    kf = k.reshape(B * H, Tkp, D)
+    vf = v.reshape(B * H, Tkp, D)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda bh, iq: (bh, iq, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, Tkp, D), lambda bh, iq: (bh, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, Tkp, D), lambda bh, iq: (bh, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [qf, kf, vf]
+    if bias is not None:
+        # additive key-bias (B, H, 1, Tk) or (B, 1, 1, Tk) → (B*H, Tk)
+        bflat = jnp.broadcast_to(bias, (B, H, 1, Tkp)).reshape(B * H, Tkp)
+        in_specs.append(pl.BlockSpec((1, Tkp), lambda bh, iq: (bh, 0),
+                                     memory_space=pltpu.VMEM))
+        args.append(bflat)
+
+    if bias is not None:
+        def kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref):
+            _flash_fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+                              block_k=block_k, causal=causal,
+                              sm_scale=sm_scale, kv_len=Tk, q_len=Tq)
+    else:
+        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
+            _flash_fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                              block_k=block_k, causal=causal,
+                              sm_scale=sm_scale, kv_len=Tk, q_len=Tq)
+
+    grid = (B * H, Tqp // block_q)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, iq: (bh, iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda bh, iq: (bh, iq),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tqp, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tqp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    out = out.reshape(B, H, Tqp, D)[:, :, :Tq]
+    lse = lse.reshape(B, H, Tqp)[:, :, :Tq]
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# chunked-XLA path for long sequences (K/V too big for whole-sequence VMEM
+# residency; lax.scan streams KV chunks with the same online softmax —
+# O(Tq * chunk) memory, fused by XLA)
+# ---------------------------------------------------------------------------
+_VMEM_KV_BYTES = 4 * 1024 * 1024  # per-(batch,head) K+V budget
+LONG_CHUNK = 1024
+
+
+def _kv_fits_vmem(k):
+    return 2 * k.shape[2] * k.shape[3] * k.dtype.itemsize <= _VMEM_KV_BYTES
+
+
+def _chunk_kv(x, chunk):
+    B, H, Tk, D = x.shape
+    pad = (-Tk) % chunk
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    return x.reshape(B, H, (Tk + pad) // chunk, chunk, D), pad
+
+
+def _attention_scan_fwd(q, k, v, bias, causal, sm_scale, chunk=LONG_CHUNK):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    kc, pad = _chunk_kv(k, chunk)
+    vc, _ = _chunk_kv(v, chunk)
+    nchunks = kc.shape[2]
+    if bias is not None:
+        bias_p = jnp.pad(bias, ((0, 0), (0, 0), (0, 0), (0, pad)),
+                         constant_values=_NEG_INF)
+        bc = jnp.moveaxis(
+            bias_p.reshape(bias.shape[0], bias.shape[1], 1, nchunks, chunk),
+            3, 0)
+    qf = q.astype(jnp.float32)
+
+    def body(carry, xs):
+        m_i, l_i, acc_i = carry
+        if bias is not None:
+            k_c, v_c, b_c, idx = xs
+        else:
+            k_c, v_c, idx = xs
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_c.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * sm_scale
+        if bias is not None:
+            s = s + b_c.astype(jnp.float32)
+        col = idx * chunk + jnp.arange(chunk)
+        valid = col[None, :] < Tk
+        if causal:
+            row = jnp.arange(Tq)
+            valid = jnp.logical_and(
+                valid, col[None, :] <= row[:, None] + (Tk - Tq))
+        s = jnp.where(valid[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m_i - m_new)
+        l_new = l_i * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc_i * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_c.astype(jnp.float32))
+        return (m_new, l_new, acc_new), None
+
+    init = (jnp.full((B, H, Tq), _NEG_INF, jnp.float32),
+            jnp.zeros((B, H, Tq), jnp.float32),
+            jnp.zeros((B, H, Tq, D), jnp.float32))
+    idxs = jnp.arange(nchunks)
+    xs = (jnp.moveaxis(kc, 2, 0), jnp.moveaxis(vc, 2, 0), bc, idxs) \
+        if bias is not None else \
+        (jnp.moveaxis(kc, 2, 0), jnp.moveaxis(vc, 2, 0), idxs)
+    (m, l, acc), _ = jax.lax.scan(body, init, xs)
+    l = jnp.maximum(l, 1e-30)
+    return (acc / l[..., None]).astype(q.dtype), m + jnp.log(l)
+
+
+def _bwd_chunked(q, k, v, bias, out, lse, do, causal, sm_scale,
+                 chunk=LONG_CHUNK):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (B,H,Tq)
+    kc, pad = _chunk_kv(k, chunk)
+    vc, _ = _chunk_kv(v, chunk)
+    nchunks = kc.shape[2]
+    if bias is not None:
+        bias_p = jnp.pad(bias, ((0, 0), (0, 0), (0, 0), (0, pad)),
+                         constant_values=_NEG_INF)
+        bc = jnp.moveaxis(
+            bias_p.reshape(bias.shape[0], bias.shape[1], 1, nchunks, chunk),
+            3, 0)
+
+    def body(dq_acc, xs):
+        if bias is not None:
+            k_c, v_c, b_c, idx = xs
+        else:
+            k_c, v_c, idx = xs
+        kcf = k_c.astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kcf,
+                       preferred_element_type=jnp.float32) * sm_scale
+        if bias is not None:
+            s = s + b_c.astype(jnp.float32)
+        col = idx * chunk + jnp.arange(chunk)
+        valid = col[None, :] < Tk
+        if causal:
+            row = jnp.arange(Tq)
+            valid = jnp.logical_and(
+                valid, col[None, :] <= row[:, None] + (Tk - Tq))
+        s = jnp.where(valid[None, None], s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])
+        dv_c = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v_c.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * sm_scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, kcf)
+        dk_c = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        db_c = jnp.sum(ds, axis=2) / sm_scale  # (B,H,chunk)
+        return dq_acc, (dk_c, dv_c, db_c)
+
+    idxs = jnp.arange(nchunks)
+    xs = (jnp.moveaxis(kc, 2, 0), jnp.moveaxis(vc, 2, 0), bc, idxs) \
+        if bias is not None else \
+        (jnp.moveaxis(kc, 2, 0), jnp.moveaxis(vc, 2, 0), idxs)
+    dq, (dk_s, dv_s, db_s) = jax.lax.scan(body, jnp.zeros_like(qf), xs)
+    dk = jnp.moveaxis(dk_s, 0, 2).reshape(B, H, Tk + pad, D)[:, :, :Tk]
+    dv = jnp.moveaxis(dv_s, 0, 2).reshape(B, H, Tk + pad, D)[:, :, :Tk]
+    dbias = None
+    if bias is not None:
+        db = jnp.moveaxis(db_s, 0, 2).reshape(B, H, Tk + pad)[:, :, :Tk]
+        dbias = db[:, :, None, :]
+        if bias.shape[1] == 1:
+            dbias = jnp.sum(dbias, axis=1, keepdims=True)
+        dbias = dbias.astype(bias.dtype)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dbias)
+
+
+# ---------------------------------------------------------------------------
+# custom vjp: pallas forward, XLA-recompute backward
+# ---------------------------------------------------------------------------
+def _use_pallas():
+    # the TPU backend registers as 'tpu' (or 'axon' via the PJRT tunnel
+    # plugin); anything else (cpu, gpu) takes the XLA paths
+    return jax.default_backend() in ("tpu", "axon")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_core(q, k, v, bias, causal, sm_scale):
+    out, _ = _flash_fwd(q, k, v, bias, causal, sm_scale)
+    return out
+
+
+def _flash_fwd(q, k, v, bias, causal, sm_scale):
+    if not _kv_fits_vmem(k):
+        out, lse = _attention_scan_fwd(q, k, v, bias, causal, sm_scale)
+    elif _use_pallas():
+        out, lse = _flash_forward_pallas(
+            q, k, v, bias, causal, sm_scale,
+            DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret=False)
+    else:
+        out = _attention_reference(q, k, v, bias, causal, sm_scale)
+        lse = None
+    return out, (q, k, v, bias, out, lse)
+
+
+def _flash_bwd(causal, sm_scale, res, do):
+    q, k, v, bias, out, lse = res
+    if not _kv_fits_vmem(k):
+        if lse is None:
+            _, lse = _attention_scan_fwd(q, k, v, bias, causal, sm_scale)
+        return _bwd_chunked(q, k, v, bias, out, lse, do, causal, sm_scale)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        s = jnp.where(mask, s, _NEG_INF)
+    if lse is not None:
+        p = jnp.exp(s - lse[..., None])
+    else:
+        p = jax.nn.softmax(s, axis=-1)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * sm_scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf).astype(q.dtype)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf).astype(k.dtype)
+    dbias = None
+    if bias is not None:
+        db = ds / sm_scale
+        # reduce over broadcast dims of the (B, H|1, 1, Tk) bias
+        dbias = jnp.sum(db, axis=2, keepdims=True)
+        if bias.shape[1] == 1:
+            dbias = jnp.sum(dbias, axis=1, keepdims=True)
+        dbias = dbias.astype(bias.dtype)
+    return dq, dk, dv.astype(v.dtype), dbias
+
+
+_flash_core.defvjp(_flash_fwd, _flash_bwd)
+
+
+@register("flash_attention", aliases=("_contrib_flash_attention",))
+def flash_attention(query, key, value, bias=None, causal=False,
+                    sm_scale=None):
+    """Fused scaled-dot-product attention. query/key/value: (B, H, T, D);
+    bias: optional additive (B, H|1, 1, Tk) mask (use large negatives to
+    mask). Returns (B, H, Tq, D)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(query.shape[-1]))
+    return _flash_core(query, key, value, bias, bool(causal),
+                       float(sm_scale))
+
+
+@register("attention_padding_bias", differentiable=False)
+def make_padding_bias(valid_length, max_len=0, dtype="float32"):
+    """(B,) lengths → additive (B, 1, 1, T) bias: 0 for valid, -1e30 after."""
+    idx = jnp.arange(max_len)[None, :]
+    mask = idx < valid_length.astype(jnp.int32)[:, None]
+    bias = jnp.where(mask, 0.0, _NEG_INF).astype(jnp.dtype(dtype))
+    return bias[:, None, None, :]

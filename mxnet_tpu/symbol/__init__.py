@@ -71,10 +71,12 @@ def _apply_sym_op(op_name, *args, name=None, attr=None, **kwargs):
             if isinstance(v, Symbol):
                 raise MXNetError(
                     "op %s: pass array input %r positionally" % (op.name, k))
+        while inputs and inputs[-1] is None:
+            inputs.pop()  # trailing None = optional input left at default
         if any(i is None for i in inputs):
             raise MXNetError(
-                "op %s: None input not allowed (no auto-variable table "
-                "entry)" % op.name)
+                "op %s: non-trailing None input not allowed (no "
+                "auto-variable table entry)" % op.name)
 
     attrs = dict(attr or {})
     for k, v in kwargs.items():

@@ -1,0 +1,219 @@
+"""Flash attention kernel + BERT model tests."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon import model_zoo
+from mxnet_tpu.ops import attention as A
+from mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+
+@with_seed()
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_flash_kernel_vs_reference(causal, with_bias):
+    """Pallas kernel (interpret mode) must match the O(T^2) reference."""
+    rng = np.random.RandomState(0)
+    B, H, T, D = 2, 3, 80, 32
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype("f4"))
+    k = jnp.asarray(rng.normal(size=(B, H, T, D)).astype("f4"))
+    v = jnp.asarray(rng.normal(size=(B, H, T, D)).astype("f4"))
+    bias = A.make_padding_bias(jnp.asarray([37, 80]), T) if with_bias \
+        else None
+    ref = A._attention_reference(q, k, v, bias, causal, 0.125)
+    out, lse = A._flash_forward_pallas(q, k, v, bias, causal, 0.125,
+                                       32, 32, interpret=True)
+    assert_almost_equal(np.asarray(out), np.asarray(ref), rtol=1e-5,
+                        atol=1e-5)
+    # lse-based backward must match autodiff-of-reference
+    do = jnp.asarray(rng.normal(size=(B, H, T, D)).astype("f4"))
+    dq, dk, dv, _ = A._flash_bwd(causal, 0.125,
+                                 (q, k, v, bias, out, lse), do)
+    g_ref = jax.grad(
+        lambda q_, k_, v_: jnp.sum(
+            A._attention_reference(q_, k_, v_, bias, causal, 0.125) * do),
+        argnums=(0, 1, 2))(q, k, v)
+    assert_almost_equal(np.asarray(dq), np.asarray(g_ref[0]), rtol=1e-4,
+                        atol=1e-4)
+    assert_almost_equal(np.asarray(dk), np.asarray(g_ref[1]), rtol=1e-4,
+                        atol=1e-4)
+    assert_almost_equal(np.asarray(dv), np.asarray(g_ref[2]), rtol=1e-4,
+                        atol=1e-4)
+
+
+@with_seed()
+def test_flash_attention_op_and_grad():
+    """Registered op works through nd + autograd."""
+    rng = np.random.RandomState(1)
+    q = nd.array(rng.normal(size=(2, 2, 16, 8)).astype("f4"))
+    k = nd.array(rng.normal(size=(2, 2, 16, 8)).astype("f4"))
+    v = nd.array(rng.normal(size=(2, 2, 16, 8)).astype("f4"))
+    q.attach_grad()
+    with ag.record():
+        out = nd.flash_attention(q, k, v)
+        loss = (out * out).sum()
+    loss.backward()
+    assert out.shape == (2, 2, 16, 8)
+    assert float(np.abs(q.grad.asnumpy()).sum()) > 0
+
+
+@with_seed()
+def test_bert_forward_shapes():
+    net = model_zoo.bert_3_64_2(dropout=0.0)
+    net.initialize()
+    B, T = 2, 12
+    tokens = nd.array(np.random.RandomState(0).randint(0, 1000, (B, T)))
+    types = nd.zeros((B, T))
+    vl = nd.array([8, 12])
+    seq, pooled = net(tokens, types, vl)
+    assert seq.shape == (B, T, 64)
+    assert pooled.shape == (B, 64)
+    scores = net.decode_mlm(seq)
+    assert scores.shape == (B, T, 1000)
+    nsp = net.classify_nsp(pooled)
+    assert nsp.shape == (B, 2)
+
+
+@with_seed()
+def test_bert_padding_invariance():
+    """Tokens past valid_length must not affect valid positions."""
+    net = model_zoo.bert_3_64_2(dropout=0.0)
+    net.initialize()
+    rng = np.random.RandomState(0)
+    t1 = rng.randint(0, 1000, (1, 10))
+    t2 = t1.copy()
+    t2[0, 6:] = rng.randint(0, 1000, 4)  # change only padding region
+    vl = nd.array([6])
+    types = nd.zeros((1, 10))
+    s1, _ = net(nd.array(t1), types, vl)
+    s2, _ = net(nd.array(t2), types, vl)
+    assert_almost_equal(s1.asnumpy()[:, :6], s2.asnumpy()[:, :6],
+                        rtol=1e-4, atol=1e-5)
+
+
+@with_seed()
+def test_bert_mlm_training_step():
+    """One hybridized MLM pretraining step decreases loss over iterations."""
+    net = model_zoo.bert_3_64_2(dropout=0.0)
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    params = net.collect_params()
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-3})
+    rng = np.random.RandomState(0)
+    B, T = 4, 16
+    tokens = nd.array(rng.randint(0, 1000, (B, T)))
+    types = nd.zeros((B, T))
+    labels = nd.array(rng.randint(0, 1000, (B, T)))
+
+    losses = []
+    for _ in range(12):
+        with ag.record():
+            seq, pooled = net(tokens, types)
+            scores = net.decode_mlm(seq)
+            loss = loss_fn(scores.reshape((-1, 1000)),
+                           labels.reshape((-1,)))
+        loss.backward()
+        trainer.step(B * T)
+        losses.append(float(loss.mean().asnumpy()))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+@with_seed()
+def test_bert_hybridize_consistency():
+    net = model_zoo.bert_3_64_2(dropout=0.0)
+    net.initialize()
+    tokens = nd.array(np.random.RandomState(0).randint(0, 1000, (2, 8)))
+    types = nd.zeros((2, 8))
+    s0, p0 = net(tokens, types)
+    net.hybridize()
+    s1, p1 = net(tokens, types)
+    assert_almost_equal(s0.asnumpy(), s1.asnumpy(), rtol=1e-5, atol=1e-5)
+    assert_almost_equal(p0.asnumpy(), p1.asnumpy(), rtol=1e-5, atol=1e-5)
+
+
+@with_seed()
+def test_causal_cross_length_alignment():
+    """Tq != Tk causal must be bottom-right aligned in ALL paths."""
+    rng = np.random.RandomState(2)
+    B, H, Tq, Tk, D = 1, 1, 4, 12, 8
+    q = jnp.asarray(rng.normal(size=(B, H, Tq, D)).astype("f4"))
+    k = jnp.asarray(rng.normal(size=(B, H, Tk, D)).astype("f4"))
+    v = jnp.asarray(rng.normal(size=(B, H, Tk, D)).astype("f4"))
+    ref = A._attention_reference(q, k, v, None, True, 0.3)
+    out_p, _ = A._flash_forward_pallas(q, k, v, None, True, 0.3, 4, 4,
+                                       interpret=True)
+    assert_almost_equal(np.asarray(out_p), np.asarray(ref), rtol=1e-5,
+                        atol=1e-5)
+    out_s, _ = A._attention_scan_fwd(q, k, v, None, True, 0.3, chunk=4)
+    assert_almost_equal(np.asarray(out_s), np.asarray(ref), rtol=1e-5,
+                        atol=1e-5)
+
+
+@with_seed()
+def test_long_sequence_chunked_path():
+    """KV beyond the VMEM budget takes the scan path; fwd+bwd match ref."""
+    rng = np.random.RandomState(3)
+    B, H, T, D = 1, 1, 64, 16
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype("f4"))
+    k = jnp.asarray(rng.normal(size=(B, H, T, D)).astype("f4"))
+    v = jnp.asarray(rng.normal(size=(B, H, T, D)).astype("f4"))
+    bias = A.make_padding_bias(jnp.asarray([50]), T)
+    out, lse = A._attention_scan_fwd(q, k, v, bias, False, 0.25, chunk=16)
+    ref = A._attention_reference(q, k, v, bias, False, 0.25)
+    assert_almost_equal(np.asarray(out), np.asarray(ref), rtol=1e-5,
+                        atol=1e-5)
+    do = jnp.asarray(rng.normal(size=(B, H, T, D)).astype("f4"))
+    dq, dk, dv, db = A._bwd_chunked(q, k, v, bias, out, lse, do, False,
+                                    0.25, chunk=16)
+    g_ref = jax.grad(
+        lambda q_, k_, v_, b_: jnp.sum(
+            A._attention_reference(q_, k_, v_, b_, False, 0.25) * do),
+        argnums=(0, 1, 2, 3))(q, k, v, bias)
+    assert_almost_equal(np.asarray(dq), np.asarray(g_ref[0]), rtol=1e-4,
+                        atol=1e-4)
+    assert_almost_equal(np.asarray(dk), np.asarray(g_ref[1]), rtol=1e-4,
+                        atol=1e-4)
+    assert_almost_equal(np.asarray(dv), np.asarray(g_ref[2]), rtol=1e-4,
+                        atol=1e-4)
+    assert_almost_equal(np.asarray(db), np.asarray(g_ref[3]), rtol=1e-3,
+                        atol=1e-3)
+
+
+@with_seed()
+def test_bert_mlm_weight_tying():
+    net = model_zoo.bert_3_64_2(dropout=0.0)
+    net.initialize()
+    embed_w = net.word_embed.weight
+    dec_w = net.mlm_decoder.weight
+    assert embed_w is dec_w  # literally the same Parameter
+
+
+@with_seed()
+def test_bert_export_symbol_block(tmp_path):
+    """BERT must trace symbolically (shape-free hybrid_forward)."""
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu import gluon
+
+    net = model_zoo.bert_3_64_2(dropout=0.0)
+    net.initialize()
+    tokens = nd.array(np.random.RandomState(0).randint(0, 1000, (2, 8)))
+    types = nd.zeros((2, 8))
+    s0, p0 = net(tokens, types)
+    data = sym.Variable("data")
+    ttypes = sym.Variable("token_types")
+    out = net(data, ttypes)  # symbolic trace
+    g = sym.Group(list(out))
+    args = g.list_arguments()
+    assert "data" in args and "token_types" in args
+    blk = gluon.SymbolBlock(g, [data, ttypes])
+    for name, p in net.collect_params().items():
+        if name in blk.params:
+            blk.params[name].set_data(p.data())
+    s1, p1 = blk(tokens, types)
+    assert_almost_equal(s0.asnumpy(), s1.asnumpy(), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(p0.asnumpy(), p1.asnumpy(), rtol=1e-4, atol=1e-5)
