@@ -210,7 +210,12 @@ class BERTModel(HybridBlock):
         return seq, pooled
 
     def decode_mlm(self, sequence_output):
-        from ... import ndarray as F
+        from ...symbol.symbol import Symbol
+
+        if isinstance(sequence_output, Symbol):
+            from ... import symbol as F
+        else:
+            from ... import ndarray as F
 
         if self.mlm_dense is None:
             raise MXNetError("model built with use_decoder=False")

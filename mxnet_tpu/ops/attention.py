@@ -330,12 +330,23 @@ def _flash_fwd(q, k, v, bias, causal, sm_scale):
     return out, (q, k, v, bias, out, lse)
 
 
+_BWD_SCORE_BYTES = 256 * 1024 * 1024  # peak score-matrix budget in backward
+
+
 def _flash_bwd(causal, sm_scale, res, do):
     q, k, v, bias, out, lse = res
-    if not _kv_fits_vmem(k):
+    B, H, Tq, _ = q.shape
+    Tk = k.shape[2]
+    score_bytes = B * H * Tq * Tk * 4
+    if not _kv_fits_vmem(k) or score_bytes > _BWD_SCORE_BYTES:
+        # keep backward O(Tq * chunk): a forward that fit VMEM can still
+        # have a score matrix far too big to materialize (e.g. T=8k)
         if lse is None:
             _, lse = _attention_scan_fwd(q, k, v, bias, causal, sm_scale)
-        return _bwd_chunked(q, k, v, bias, out, lse, do, causal, sm_scale)
+        chunk = int(max(128, min(
+            Tk, _BWD_SCORE_BYTES // max(1, B * H * Tq * 4))))
+        return _bwd_chunked(q, k, v, bias, out, lse, do, causal, sm_scale,
+                            chunk=chunk)
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
@@ -385,8 +396,12 @@ def flash_attention(query, key, value, bias=None, causal=False,
 
 
 @register("attention_padding_bias", differentiable=False)
-def make_padding_bias(valid_length, max_len=0, dtype="float32"):
-    """(B,) lengths → additive (B, 1, 1, T) bias: 0 for valid, -1e30 after."""
+def make_padding_bias(valid_length, max_len=None, dtype="float32"):
+    """(B,) lengths → additive (B, 1, 1, T) bias: 0 for valid, -1e30 after.
+    ``max_len`` (the key sequence length) is required."""
+    if not max_len:
+        raise ValueError("attention_padding_bias requires max_len= (the "
+                         "key sequence length)")
     idx = jnp.arange(max_len)[None, :]
     mask = idx < valid_length.astype(jnp.int32)[:, None]
     bias = jnp.where(mask, 0.0, _NEG_INF).astype(jnp.dtype(dtype))

@@ -16,7 +16,9 @@ from .mesh import (
 from .sharded import (
     ShardedTrainStep, shard_params, sharding_rule, allreduce_across_processes,
 )
+from .sequence import ring_attention, ulysses_attention
 
 __all__ = ["make_mesh", "data_parallel_mesh", "init_distributed",
            "local_device_count", "ShardedTrainStep", "shard_params",
-           "sharding_rule", "allreduce_across_processes"]
+           "sharding_rule", "allreduce_across_processes", "ring_attention",
+           "ulysses_attention"]

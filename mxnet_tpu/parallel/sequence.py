@@ -1,0 +1,155 @@
+"""Sequence/context parallelism — ring attention + Ulysses all-to-all
+(SURVEY §5: absent from the reference, a first-class new capability here).
+
+Both are shard_map programs over a mesh sequence axis:
+
+- **Ring attention**: Q stays put, K/V blocks rotate around the ring via
+  ``ppermute`` (ICI neighbor exchange); each hop folds one KV block into the
+  running online-softmax state. Peak memory per chip is O(T/n), enabling
+  sequences n× longer than one chip's HBM would allow. Collective order:
+  hop i holds the block originally on device (idx - i) mod n.
+
+- **Ulysses**: ``all_to_all`` reshards (T-sharded, all heads) →
+  (H-sharded, full T), runs dense local attention, reshards back. One
+  collective pair instead of n hops — better when heads ≥ devices and T
+  fits per-chip.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import _attention_reference, _NEG_INF
+
+__all__ = ["ring_attention", "ulysses_attention"]
+
+
+def _ring_local(q_loc, k_loc, v_loc, bias_loc, *, axis_name, causal,
+                sm_scale, n_shards):
+    """Per-device body. q_loc/k_loc/v_loc: (B, H, Tl, D); bias_loc:
+    (B, 1, 1, Tl) additive key bias or None."""
+    B, H, Tl, D = q_loc.shape
+    idx = jax.lax.axis_index(axis_name)
+    qf = q_loc.astype(jnp.float32)
+
+    m0 = jnp.full((B, H, Tl), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl), jnp.float32)
+    acc0 = jnp.zeros((B, H, Tl, D), jnp.float32)
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    def body(i, carry):
+        k_cur, v_cur, b_cur, m, l, acc = carry
+        src = (idx - i) % n_shards  # which global block k_cur is
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * sm_scale
+        if b_cur is not None:
+            s = s + b_cur.astype(jnp.float32)
+        if causal:
+            row = idx * Tl + jnp.arange(Tl)
+            col = src * Tl + jnp.arange(Tl)
+            mask = col[None, :] <= row[:, None]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        b_nxt = None if b_cur is None else jax.lax.ppermute(
+            b_cur, axis_name, perm)
+        return k_nxt, v_nxt, b_nxt, m_new, l_new, acc_new
+
+    carry = (k_loc, v_loc, bias_loc, m0, l0, acc0)
+    # n_shards hops: python loop keeps b_cur=None branch static; XLA still
+    # pipelines the ppermutes against the matmuls
+    for i in range(n_shards):
+        carry = body(i, carry)
+    _, _, _, m, l, acc = carry
+    l = jnp.maximum(l, 1e-30)
+    return (acc / l[..., None]).astype(q_loc.dtype)
+
+
+def ring_attention(q, k, v, bias=None, mesh=None, seq_axis="data",
+                   causal=False, sm_scale=None):
+    """Sequence-parallel attention with ring KV rotation.
+
+    q/k/v: (B, H, T, D) with T sharded over ``mesh[seq_axis]``; bias:
+    optional additive (B, 1, 1, T) key bias (sharded on its T too).
+    Returns (B, H, T, D) sharded like q.
+    """
+    shard_map = jax.shard_map
+
+    if mesh is None:
+        raise ValueError("ring_attention requires mesh= (a jax Mesh with "
+                         "a %r axis)" % (seq_axis,))
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    n_shards = mesh.shape[seq_axis]
+    if q.shape[2] % n_shards:
+        raise ValueError("sequence length %d not divisible by %d shards"
+                         % (q.shape[2], n_shards))
+
+    qkv_spec = P(None, None, seq_axis, None)
+    fn = functools.partial(_ring_local, axis_name=seq_axis, causal=causal,
+                           sm_scale=float(sm_scale), n_shards=n_shards)
+    if bias is not None:
+        sm = shard_map(
+            lambda q_, k_, v_, b_: fn(q_, k_, v_, b_),
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec,
+                      P(None, None, None, seq_axis)),
+            out_specs=qkv_spec,
+        )
+        return sm(q, k, v, bias)
+    sm = shard_map(
+        lambda q_, k_, v_: fn(q_, k_, v_, None),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=qkv_spec,
+    )
+    return sm(q, k, v)
+
+
+def _ulysses_local(q_loc, k_loc, v_loc, *, axis_name, causal, sm_scale):
+    """(B, H, Tl, D) T-sharded → all_to_all → (B, H/n, T, D) H-sharded →
+    dense local attention → reshard back."""
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            tiled=True)
+    q2 = a2a(q_loc, split_axis=1, concat_axis=2)
+    k2 = a2a(k_loc, split_axis=1, concat_axis=2)
+    v2 = a2a(v_loc, split_axis=1, concat_axis=2)
+    out = _attention_reference(q2, k2, v2, None, causal, sm_scale)
+    return a2a(out, split_axis=2, concat_axis=1)
+
+
+def ulysses_attention(q, k, v, mesh=None, seq_axis="data", causal=False,
+                      sm_scale=None):
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism. Heads must
+    be divisible by the mesh axis size."""
+    shard_map = jax.shard_map
+
+    if mesh is None:
+        raise ValueError("ulysses_attention requires mesh= (a jax Mesh "
+                         "with a %r axis)" % (seq_axis,))
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    n_shards = mesh.shape[seq_axis]
+    if q.shape[1] % n_shards:
+        raise ValueError("num_heads %d not divisible by %d shards"
+                         % (q.shape[1], n_shards))
+    if q.shape[2] % n_shards:
+        raise ValueError("sequence length %d not divisible by %d shards"
+                         % (q.shape[2], n_shards))
+    spec = P(None, None, seq_axis, None)
+    sm = shard_map(
+        functools.partial(_ulysses_local, axis_name=seq_axis,
+                          causal=causal, sm_scale=float(sm_scale)),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    return sm(q, k, v)
