@@ -49,9 +49,16 @@ def log_train_metric(period, auto_reset=False):
 
 class Speedometer:
     """Log training speed every `frequent` batches — the number BASELINE
-    tracks (ref: callback.py — Speedometer)."""
+    tracks (ref: callback.py — Speedometer).
 
-    def __init__(self, batch_size, frequent=50, auto_reset=True):
+    With ``jsonl`` set, every measurement also appends a structured row
+    (the BASELINE.md harness requirement):
+    ``{config, chips, batch_size, dtype,
+       images_or_tokens_per_sec_per_chip, epoch, batch}``.
+    """
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True,
+                 jsonl=None, config=None, dtype=None, chips=1):
         self.batch_size = batch_size
         self.frequent = frequent
         self.init = False
@@ -59,6 +66,25 @@ class Speedometer:
         self.last_count = 0
         self.auto_reset = auto_reset
         self.last_speed = None
+        self.jsonl = jsonl
+        self.config = config
+        self.dtype = dtype
+        self.chips = max(1, int(chips))
+
+    def _emit_jsonl(self, speed, param):
+        import json
+
+        row = {
+            "config": self.config or "unnamed",
+            "chips": self.chips,
+            "batch_size": self.batch_size,
+            "dtype": self.dtype or "float32",
+            "images_or_tokens_per_sec_per_chip": round(speed / self.chips, 2),
+            "epoch": getattr(param, "epoch", 0),
+            "batch": getattr(param, "nbatch", 0),
+        }
+        with open(self.jsonl, "a") as f:
+            f.write(json.dumps(row) + "\n")
 
     def __call__(self, param):
         count = param.nbatch
@@ -70,6 +96,8 @@ class Speedometer:
                 speed = self.frequent * self.batch_size / \
                     (time.time() - self.tic)
                 self.last_speed = speed
+                if self.jsonl:
+                    self._emit_jsonl(speed, param)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:

@@ -24,11 +24,13 @@ def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
     x = data
     if flatten and x.ndim > 2:
         x = x.reshape(x.shape[0], -1)
+    # no preferred_element_type: the TPU MXU already accumulates bf16
+    # operands in f32, and requesting an f32 output breaks the conv/dot
+    # transpose rule in backward (dtype-mismatched cotangent)
     out = jax.lax.dot_general(
         x, weight,
         dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
-    ).astype(x.dtype)
+    )
     if not no_bias and bias is not None:
         out = out + bias.astype(out.dtype)
     return out
@@ -66,8 +68,7 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
-    ).astype(data.dtype)
+    )
     if not no_bias and bias is not None:
         c_ax = dn[2].index("C")
         shape = [1] * out.ndim

@@ -202,6 +202,32 @@ class ShardedTrainStep:
         spec = P(self.data_axis, *([None] * (data.ndim - 1)))
         return jax.device_put(data, NamedSharding(self.mesh, spec))
 
+    def flops_per_step(self, x, y):
+        """Total FLOPs of one compiled step per XLA cost analysis, or None
+        if the backend doesn't report it. Used by bench.py for MFU."""
+        train_vals = tuple(self._all_params[n].data().data
+                           for n in self._train_names)
+        aux_vals = tuple(self._all_params[n].data().data
+                         for n in self._aux_names)
+        states = tuple(self._states[n] for n in self._train_names)
+        # fixed key: only its aval matters for lower(), and drawing from the
+        # global stream here would perturb subsequent training randomness
+        key = jax.random.key(0)
+        try:
+            lowered = self._jit.lower(
+                train_vals, states, aux_vals, self._shard_batch(x),
+                self._shard_batch(y), key, self._t + 1)
+            try:
+                cost = lowered.cost_analysis()  # no compile needed
+            except Exception:  # noqa: BLE001 — older backends
+                cost = lowered.compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            flops = float(cost.get("flops", 0.0)) if cost else 0.0
+            return flops or None
+        except Exception:  # noqa: BLE001 — cost analysis is best-effort
+            return None
+
     def __call__(self, x, y):
         self._t += 1
         train_vals = tuple(self._all_params[n].data().data
