@@ -24,6 +24,7 @@ from .registry import register
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
+_LSE_LANES = 128  # lane-pad for the lse output (TPU (8,128) tiling)
 
 
 def _attention_reference(q, k, v, bias, causal, sm_scale):
@@ -52,8 +53,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
     block_q = q.shape[0]
     iq = pl.program_id(1)
     q_off = iq * block_q
+    # pin scalars to 32-bit: with jax_enable_x64 on, Python floats trace as
+    # f64 and Mosaic cannot lower the resulting f64 constants/casts
+    sm_scale = jnp.float32(sm_scale)
+    neg_inf = jnp.float32(_NEG_INF)
 
-    m = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    m = jnp.full((block_q,), neg_inf, jnp.float32)
     l = jnp.zeros((block_q,), jnp.float32)
     acc = jnp.zeros((block_q, q.shape[1]), jnp.float32)
 
@@ -67,7 +72,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # (BQ, BK)
         if bias_ref is not None:
-            s = s + bias_ref[0, pl.ds(ik * block_k, block_k)].astype(
+            s = s + bias_ref[0, 0, pl.ds(ik * block_k, block_k)].astype(
                 jnp.float32)[None, :]
         col = ik * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
@@ -78,7 +83,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
             row = q_off + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             valid = jnp.logical_and(valid, col <= row + (kv_len - q_len))
-        s = jnp.where(valid, s, _NEG_INF)
+        s = jnp.where(valid, s, neg_inf)
 
         m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
@@ -89,10 +94,17 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m, l, acc))
-    l = jnp.maximum(l, 1e-30)
+    # i32 bounds: with jax_enable_x64 on (MXNet dtype parity) a plain
+    # Python-int loop index traces as i64, which Mosaic cannot lower
+    m, l, acc = jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_kv), body,
+                                  (m, l, acc))
+    l = jnp.maximum(l, jnp.float32(1e-30))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    # lse is stored lane-broadcast as (block_q, 128): Mosaic rejects a
+    # (1, block_q) block on a 2-D output (sublane dim of 1), so we follow
+    # the official TPU flash kernel's MIN_BLOCK_SIZE padding layout
+    lse_ref[0] = jnp.broadcast_to((m + jnp.log(l))[:, None],
+                                  (block_q, _LSE_LANES))
 
 
 def _flash_forward_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k,
@@ -120,19 +132,24 @@ def _flash_forward_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k,
     kf = k.reshape(B * H, Tkp, D)
     vf = v.reshape(B * H, Tkp, D)
 
+    # index maps return np.int32 zeros: under jax_enable_x64 a literal 0
+    # traces as i64, which Mosaic rejects in the index-map signature
+    z = np.int32(0)
     in_specs = [
-        pl.BlockSpec((1, block_q, D), lambda bh, iq: (bh, iq, 0),
+        pl.BlockSpec((1, block_q, D), lambda bh, iq: (bh, iq, z),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, Tkp, D), lambda bh, iq: (bh, 0, 0),
+        pl.BlockSpec((1, Tkp, D), lambda bh, iq: (bh, z, z),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, Tkp, D), lambda bh, iq: (bh, 0, 0),
+        pl.BlockSpec((1, Tkp, D), lambda bh, iq: (bh, z, z),
                      memory_space=pltpu.VMEM),
     ]
     args = [qf, kf, vf]
     if bias is not None:
-        # additive key-bias (B, H, 1, Tk) or (B, 1, 1, Tk) → (B*H, Tk)
-        bflat = jnp.broadcast_to(bias, (B, H, 1, Tkp)).reshape(B * H, Tkp)
-        in_specs.append(pl.BlockSpec((1, Tkp), lambda bh, iq: (bh, 0),
+        # additive key-bias (B, H, 1, Tk) or (B, 1, 1, Tk) → (B*H, 1, Tk);
+        # kept 3-D so the (1, 1, Tkp) block satisfies Mosaic's tiling rule
+        # (a (1, Tkp) block on a 2-D array has an untiled sublane dim)
+        bflat = jnp.broadcast_to(bias, (B, H, 1, Tkp)).reshape(B * H, 1, Tkp)
+        in_specs.append(pl.BlockSpec((1, 1, Tkp), lambda bh, iq: (bh, z, z),
                                      memory_space=pltpu.VMEM))
         args.append(bflat)
 
@@ -153,19 +170,19 @@ def _flash_forward_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k,
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, iq: (bh, iq, 0),
+            pl.BlockSpec((1, block_q, D), lambda bh, iq: (bh, iq, z),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda bh, iq: (bh, iq),
+            pl.BlockSpec((1, block_q, _LSE_LANES), lambda bh, iq: (bh, iq, z),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Tqp, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Tqp), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Tqp, _LSE_LANES), jnp.float32),
         ],
         interpret=interpret,
     )(*args)
     out = out.reshape(B, H, Tqp, D)[:, :, :Tq]
-    lse = lse.reshape(B, H, Tqp)[:, :, :Tq]
+    lse = lse[:, :, 0].reshape(B, H, Tqp)[:, :, :Tq]
     return out, lse
 
 

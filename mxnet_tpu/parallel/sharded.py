@@ -220,6 +220,8 @@ class ShardedTrainStep:
             try:
                 cost = lowered.cost_analysis()  # no compile needed
             except Exception:  # noqa: BLE001 — older backends
+                cost = None
+            if not cost:  # axon returns None from the lowered analysis
                 cost = lowered.compile().cost_analysis()
             if isinstance(cost, (list, tuple)):
                 cost = cost[0] if cost else {}

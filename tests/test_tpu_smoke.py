@@ -1,0 +1,233 @@
+"""TPU hardware smoke lane (run: ``MXT_TEST_TPU=1 python -m pytest -m tpu``).
+
+Every test here executes on the real chip — no interpret mode, no CPU
+forcing. This lane exists because round 2 shipped a Pallas kernel that was
+correct under ``interpret=True`` but failed Mosaic lowering on hardware
+(invalid BlockSpec); hardware-only failure modes must have hardware tests.
+
+Models the reference's GPU test tier (SURVEY §4: tests/python/gpu re-runs
+the op suite under a GPU context) at smoke-test size: flash attention
+fwd/bwd vs the XLA reference, one hybridized ResNet step, one BERT step,
+fused RNN, fused optimizer updates, and async sync-point semantics.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.tpu
+
+
+def _require_tpu():
+    if jax.default_backend() not in ("tpu", "axon"):
+        pytest.skip("no TPU backend available (got %s)"
+                    % jax.default_backend())
+
+
+@pytest.fixture(autouse=True)
+def _tpu_only():
+    _require_tpu()
+
+
+def _maxerr(a, b):
+    return float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                 - b.astype(jnp.float32))))
+
+
+# ---------------------------------------------------------------------------
+# flash attention on hardware
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fwd_hardware(causal):
+    from mxnet_tpu.ops import attention as A
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(s, (2, 4, 384, 64), jnp.bfloat16)
+               for s in jax.random.split(key, 3))
+    out, _ = A._flash_forward_pallas(q, k, v, None, causal, 0.125,
+                                     128, 128, interpret=False)
+    ref = A._attention_reference(q, k, v, None, causal, 0.125)
+    assert _maxerr(out, ref) < 2e-2  # bf16 inputs, f32 accumulation
+
+
+def test_flash_fwd_bias_hardware():
+    from mxnet_tpu.ops import attention as A
+    key = jax.random.PRNGKey(1)
+    q, k, v = (jax.random.normal(s, (2, 4, 384, 64), jnp.bfloat16)
+               for s in jax.random.split(key, 3))
+    bias = A.make_padding_bias(jnp.asarray([300, 150]), max_len=384)
+    out, _ = A._flash_forward_pallas(q, k, v, bias, True, 0.125,
+                                     128, 128, interpret=False)
+    ref = A._attention_reference(q, k, v, bias, True, 0.125)
+    assert _maxerr(out, ref) < 2e-2
+
+
+def test_flash_fwd_ragged_seqlen_hardware():
+    """T=300 is not a block multiple — exercises the padding path."""
+    from mxnet_tpu.ops import attention as A
+    key = jax.random.PRNGKey(2)
+    q, k, v = (jax.random.normal(s, (2, 2, 300, 64), jnp.bfloat16)
+               for s in jax.random.split(key, 3))
+    out, _ = A._flash_forward_pallas(q, k, v, None, True, 0.125,
+                                     128, 128, interpret=False)
+    ref = A._attention_reference(q, k, v, None, True, 0.125)
+    assert _maxerr(out, ref) < 2e-2
+
+
+def test_flash_lse_hardware():
+    from mxnet_tpu.ops import attention as A
+    key = jax.random.PRNGKey(3)
+    q, k, v = (jax.random.normal(s, (1, 2, 256, 64), jnp.float32)
+               for s in jax.random.split(key, 3))
+    _, lse = A._flash_forward_pallas(q, k, v, None, False, 0.125,
+                                     128, 128, interpret=False)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.125
+    ref_lse = jax.scipy.special.logsumexp(scores, axis=-1)
+    assert _maxerr(lse, ref_lse) < 2e-2
+
+
+def test_flash_grads_hardware():
+    from mxnet_tpu.ops import attention as A
+    key = jax.random.PRNGKey(4)
+    q, k, v = (jax.random.normal(s, (2, 4, 384, 64), jnp.bfloat16)
+               for s in jax.random.split(key, 3))
+    bias = A.make_padding_bias(jnp.asarray([384, 200]), max_len=384)
+
+    def loss(q, k, v):
+        o = A.flash_attention(q, k, v, bias=bias, causal=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        o = A._attention_reference(q, k, v, bias, True,
+                                   1.0 / np.sqrt(q.shape[-1]))
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g, gr):
+        assert _maxerr(a, b) < 1e-1  # bf16 grads
+
+
+def test_flash_long_seq_chunked_hardware():
+    """T long enough that K/V exceed the VMEM budget → lax.scan path."""
+    from mxnet_tpu.ops import attention as A
+    key = jax.random.PRNGKey(5)
+    T = 20480  # 2*20480*64*2B = 5.2 MB > _VMEM_KV_BYTES (4 MB)
+    q, k, v = (jax.random.normal(s, (1, 1, T, 64), jnp.bfloat16)
+               for s in jax.random.split(key, 3))
+    assert not A._kv_fits_vmem(k)
+    out = A.flash_attention(q, k, v, causal=True)
+    assert bool(jnp.all(jnp.isfinite(out.astype(jnp.float32))))
+
+
+# ---------------------------------------------------------------------------
+# framework paths on hardware
+# ---------------------------------------------------------------------------
+def test_resnet18_train_step_hardware():
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu import parallel
+    from mxnet_tpu.gluon import model_zoo
+
+    mx.random.seed(0)
+    net = model_zoo.get_model("resnet18_v1", classes=10)
+    net.initialize()
+    net.cast("bfloat16")
+    x = nd.array(np.random.RandomState(0)
+                 .uniform(-1, 1, (8, 3, 64, 64)).astype("f4"))
+    x = x.astype("bfloat16")
+    y = nd.array(np.random.RandomState(1).randint(0, 10, (8,)).astype("f4"))
+    net(x)
+    step = parallel.ShardedTrainStep(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.02, "momentum": 0.9})
+    losses = [float(step(x, y).asnumpy()) for _ in range(6)]
+    assert all(np.isfinite(losses))
+    # optimizing, not just running (early bf16 steps can overshoot, so
+    # check the best later loss rather than strict monotonicity)
+    assert min(losses[1:]) < losses[0]
+
+
+def test_bert_mini_train_step_hardware():
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu import autograd as ag
+    from mxnet_tpu.gluon import model_zoo
+
+    mx.random.seed(0)
+    bert = model_zoo.bert.bert_3_64_2(use_classifier=False, dropout=0.0)
+    bert.initialize()
+    trainer = mx.gluon.Trainer(bert.collect_params(), "adam",
+                               {"learning_rate": 1e-4})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randint(0, 1000, (4, 48)).astype("f4"))
+    y = nd.array(rng.randint(0, 1000, (4, 48)).astype("f4"))
+    with ag.record():
+        seq, _ = bert(x, nd.zeros_like(x))
+        out = bert.decode_mlm(seq)
+        loss = loss_fn(out.reshape((-1, out.shape[-1])), y.reshape((-1,)))
+        loss = loss.mean()
+    loss.backward()
+    trainer.step(1)
+    assert np.isfinite(float(loss.asnumpy()))
+
+
+def test_fused_rnn_hardware():
+    from mxnet_tpu import nd
+    from mxnet_tpu import autograd as ag
+    from mxnet_tpu.gluon import rnn
+
+    layer = rnn.LSTM(hidden_size=32, num_layers=2)
+    layer.initialize()
+    x = nd.array(np.random.RandomState(0)
+                 .normal(size=(20, 4, 16)).astype("f4"))
+    x.attach_grad()
+    with ag.record():
+        out = layer(x)
+        loss = (out * out).sum()
+    loss.backward()
+    assert np.all(np.isfinite(out.asnumpy()))
+    assert np.all(np.isfinite(x.grad.asnumpy()))
+
+
+def test_fused_optimizer_update_hardware():
+    """Fused adam_update on device matches the CPU-side numpy recipe."""
+    from mxnet_tpu import nd
+    w = nd.array(np.linspace(-1, 1, 64).astype("f4"))
+    g = nd.array(np.linspace(1, -1, 64).astype("f4"))
+    m = nd.zeros((64,))
+    v = nd.zeros((64,))
+    out = nd.adam_update(w, g, m, v, lr=0.1, beta1=0.9, beta2=0.999,
+                         epsilon=1e-8)
+    wn, gn = np.linspace(-1, 1, 64, dtype="f4"), np.linspace(
+        1, -1, 64, dtype="f4")
+    mn = 0.1 * gn
+    vn = 0.001 * gn * gn
+    exp = wn - 0.1 * mn / (np.sqrt(vn) + 1e-8)
+    np.testing.assert_allclose(out.asnumpy(), exp, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_jit_cache_hardware():
+    """hybridize() compiles once and reuses the executable on hardware."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(64, activation="relu"),
+            mx.gluon.nn.Dense(10))
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.RandomState(0).normal(size=(8, 32)).astype("f4"))
+    out1 = net(x)
+    out2 = net(x)
+    np.testing.assert_allclose(out1.asnumpy(), out2.asnumpy(), rtol=1e-6)
+
+
+def test_asnumpy_sync_point_hardware():
+    """asnumpy() is the sync point and round-trips device data exactly."""
+    from mxnet_tpu import nd
+    a = nd.array(np.arange(1024, dtype="f4").reshape(32, 32))
+    b = (a * 2 + 1).reshape((16, 64))
+    expected = (np.arange(1024, dtype="f4") * 2 + 1).reshape(16, 64)
+    np.testing.assert_array_equal(b.asnumpy(), expected)
