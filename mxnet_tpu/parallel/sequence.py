@@ -28,10 +28,24 @@ from ..ops.attention import _attention_reference, _NEG_INF
 __all__ = ["ring_attention", "ulysses_attention"]
 
 
-def _ring_local(q_loc, k_loc, v_loc, bias_loc, *, axis_name, causal,
-                sm_scale, n_shards):
-    """Per-device body. q_loc/k_loc/v_loc: (B, H, Tl, D); bias_loc:
-    (B, 1, 1, Tl) additive key bias or None."""
+def _ring_hop_scores(qf, k_cur, b_cur, idx, src, Tl, causal, sm_scale):
+    """Masked score block for one ring hop: (B, H, Tl, Tl) in f32."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * sm_scale
+    if b_cur is not None:
+        s = s + b_cur.astype(jnp.float32)
+    if causal:
+        row = idx * Tl + jnp.arange(Tl)
+        col = src * Tl + jnp.arange(Tl)
+        mask = col[None, :] <= row[:, None]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    return s
+
+
+def _ring_fwd_pass(q_loc, k_loc, v_loc, bias_loc, axis_name, causal,
+                   sm_scale, n_shards):
+    """Per-device online-softmax ring. q_loc/k_loc/v_loc: (B, H, Tl, D);
+    bias_loc: (B, 1, 1, Tl) additive key bias or None. Returns (out, lse)."""
     B, H, Tl, D = q_loc.shape
     idx = jax.lax.axis_index(axis_name)
     qf = q_loc.astype(jnp.float32)
@@ -44,15 +58,8 @@ def _ring_local(q_loc, k_loc, v_loc, bias_loc, *, axis_name, causal,
     def body(i, carry):
         k_cur, v_cur, b_cur, m, l, acc = carry
         src = (idx - i) % n_shards  # which global block k_cur is
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32),
-                       preferred_element_type=jnp.float32) * sm_scale
-        if b_cur is not None:
-            s = s + b_cur.astype(jnp.float32)
-        if causal:
-            row = idx * Tl + jnp.arange(Tl)
-            col = src * Tl + jnp.arange(Tl)
-            mask = col[None, :] <= row[:, None]
-            s = jnp.where(mask[None, None], s, _NEG_INF)
+        s = _ring_hop_scores(qf, k_cur, b_cur, idx, src, Tl, causal,
+                             sm_scale)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[..., None])
         alpha = jnp.exp(m - m_new)
@@ -72,7 +79,85 @@ def _ring_local(q_loc, k_loc, v_loc, bias_loc, *, axis_name, causal,
         carry = body(i, carry)
     _, _, _, m, l, acc = carry
     l = jnp.maximum(l, 1e-30)
-    return (acc / l[..., None]).astype(q_loc.dtype)
+    out = (acc / l[..., None]).astype(q_loc.dtype)
+    return out, m + jnp.log(l)
+
+
+# --------------------------------------------------------------------------
+# custom VJP: the naive autodiff of the unrolled ring saves every hop's
+# (B, H, Tl, Tl) probability block, making backward O(T^2/n) memory
+# (round-1 ADVICE #1). Instead we save only out + lse — O(T/n) — and the
+# backward re-runs the ring, recomputing each hop's scores from lse and
+# rotating dk/dv accumulators along with their K/V blocks so every
+# gradient lands back on the chip that owns the block.
+# --------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _ring_core(q_loc, k_loc, v_loc, bias_loc, axis_name, causal, sm_scale,
+               n_shards):
+    out, _ = _ring_fwd_pass(q_loc, k_loc, v_loc, bias_loc, axis_name,
+                            causal, sm_scale, n_shards)
+    return out
+
+
+def _ring_core_fwd(q_loc, k_loc, v_loc, bias_loc, axis_name, causal,
+                   sm_scale, n_shards):
+    out, lse = _ring_fwd_pass(q_loc, k_loc, v_loc, bias_loc, axis_name,
+                              causal, sm_scale, n_shards)
+    return out, (q_loc, k_loc, v_loc, bias_loc, out, lse)
+
+
+def _ring_core_bwd(axis_name, causal, sm_scale, n_shards, res, do):
+    q_loc, k_loc, v_loc, bias_loc, out, lse = res
+    B, H, Tl, D = q_loc.shape
+    idx = jax.lax.axis_index(axis_name)
+    qf = q_loc.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (B, H, Tl)
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    dq = jnp.zeros((B, H, Tl, D), jnp.float32)
+    dk_acc = jnp.zeros((B, H, Tl, D), jnp.float32)
+    dv_acc = jnp.zeros((B, H, Tl, D), jnp.float32)
+    # accumulator matches bias's own shape so broadcast dims (e.g. a
+    # (1, 1, 1, T) shared bias with B > 1) get summed, not silently
+    # expanded to a wrong-shaped per-example grad
+    db_acc = None if bias_loc is None else jnp.zeros(bias_loc.shape,
+                                                     jnp.float32)
+
+    k_cur, v_cur, b_cur = k_loc, v_loc, bias_loc
+    for i in range(n_shards):
+        src = (idx - i) % n_shards
+        s = _ring_hop_scores(qf, k_cur, b_cur, idx, src, Tl, causal,
+                             sm_scale)
+        p = jnp.exp(s - lse[..., None])  # exact probs from saved lse
+        dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof,
+                        v_cur.astype(jnp.float32))
+        ds = p * (dp - delta[..., None])  # dL/ds_total (pre-scale)
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds,
+                             k_cur.astype(jnp.float32)) * sm_scale
+        dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * sm_scale
+        if b_cur is not None:
+            db = jnp.sum(ds, axis=(1, 2))[:, None, None, :]
+            if bias_loc.shape[0] == 1:  # batch-broadcast bias
+                db = jnp.sum(db, axis=0, keepdims=True)
+            db_acc = db_acc + db
+        # rotate the block with its accumulators; after n hops each dk/dv
+        # (and db) lands back on the chip that owns its K/V block
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+        if b_cur is not None:
+            b_cur = jax.lax.ppermute(b_cur, axis_name, perm)
+            db_acc = jax.lax.ppermute(db_acc, axis_name, perm)
+
+    dbias = None if bias_loc is None else db_acc.astype(bias_loc.dtype)
+    return (dq.astype(q_loc.dtype), dk_acc.astype(k_loc.dtype),
+            dv_acc.astype(v_loc.dtype), dbias)
+
+
+_ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
 
 
 def ring_attention(q, k, v, bias=None, mesh=None, seq_axis="data",
@@ -96,11 +181,11 @@ def ring_attention(q, k, v, bias=None, mesh=None, seq_axis="data",
                          % (q.shape[2], n_shards))
 
     qkv_spec = P(None, None, seq_axis, None)
-    fn = functools.partial(_ring_local, axis_name=seq_axis, causal=causal,
-                           sm_scale=float(sm_scale), n_shards=n_shards)
+    scale = float(sm_scale)
     if bias is not None:
         sm = shard_map(
-            lambda q_, k_, v_, b_: fn(q_, k_, v_, b_),
+            lambda q_, k_, v_, b_: _ring_core(q_, k_, v_, b_, seq_axis,
+                                              causal, scale, n_shards),
             mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec,
                       P(None, None, None, seq_axis)),
@@ -108,7 +193,8 @@ def ring_attention(q, k, v, bias=None, mesh=None, seq_axis="data",
         )
         return sm(q, k, v, bias)
     sm = shard_map(
-        lambda q_, k_, v_: fn(q_, k_, v_, None),
+        lambda q_, k_, v_: _ring_core(q_, k_, v_, None, seq_axis,
+                                      causal, scale, n_shards),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec),
         out_specs=qkv_spec,

@@ -49,7 +49,7 @@ def _call_op_with_attrs(op, attrs, train, arrays):
             continue
         if isinstance(v, list):
             v = tuple(v)
-        if accepted is None or k in kwargs or k in accepted:
+        if accepted is None or k in accepted:
             kwargs[k] = v
     if accepted is not None and "train_mode" in accepted:
         kwargs["train_mode"] = bool(train)

@@ -247,13 +247,16 @@ class Symbol:
         return _infer_shapes(self, kwargs)
 
     def infer_type(self, **kwargs):
-        args = self.list_arguments()
-        aux = self.list_auxiliary_states()
-        dt = np.float32
-        arg_types = [kwargs.get(a, dt) for a in args]
-        out_types = [dt] * len(self._outputs)
-        aux_types = [dt] * len(aux)
-        return arg_types, out_types, aux_types
+        """Forward dtype propagation (ref: nnvm pass InferType). Known arg
+        dtypes flow through the same walk as shape inference: where input
+        shapes are known, jax.eval_shape gives the op's exact output dtype;
+        where they are not, a ``dtype`` node attr (Cast, zeros, …) or
+        numpy promotion of the input dtypes is used."""
+        known_dt = {k: np.dtype(v) for k, v in kwargs.items()
+                    if v is not None}
+        _, _, _, arg_t, out_t, aux_t = _infer_shapes(
+            self, {}, known_dtypes=known_dt, want_types=True)
+        return arg_t, out_t, aux_t
 
     # -- serialization -------------------------------------------------
     def tojson(self):
@@ -526,26 +529,81 @@ PARAM_SHAPE_RULES = {
 }
 
 
-def _infer_shapes(sym, known):
+def _unify_types(sym, known_dtypes):
+    """Bidirectional dtype unification (ref: nnvm InferType's ElemwiseType
+    unification). Forward: explicit ``dtype`` attrs and promotion of known
+    input dtypes; backward: a var with no declared dtype (e.g. an FC
+    weight) takes the dtype its consumer settled on, so
+    ``infer_type(data=float16)`` makes the whole layer float16 instead of
+    promoting against a float32 default. Unknowns stay None."""
+    node_dt = {}
+    topo = sym._topo_nodes()
+    for node in topo:
+        if node.is_var():
+            dt = known_dtypes.get(node.name)
+            if dt is None:
+                declared = node.attrs.get("__dtype__")
+                dt = np.dtype(declared) if declared is not None else None
+            node_dt[(id(node), 0)] = dt
+            continue
+        if "dtype" in node.attrs:
+            dt = np.dtype(node.attrs["dtype"])
+        else:
+            ins = [node_dt.get((id(inp), oi)) for inp, oi in node.inputs]
+            ins = [d for d in ins if d is not None]
+            dt = None
+            if ins:
+                try:
+                    # jnp.promote_types, not np.result_type: numpy raises
+                    # DTypePromotionError for bfloat16 vs float16/int
+                    import jax.numpy as jnp
+                    dt = ins[0]
+                    for d in ins[1:]:
+                        dt = np.dtype(jnp.promote_types(dt, d))
+                except Exception:  # noqa: BLE001 — exotic pair: unknown
+                    dt = None
+        for i in range(node.num_outputs):
+            node_dt[(id(node), i)] = dt
+    for node in reversed(topo):
+        if node.is_var() or "dtype" in node.attrs:
+            continue  # Cast-like ops don't constrain their input dtype
+        dt = node_dt.get((id(node), 0))
+        if dt is None:
+            continue
+        for inp, oi in node.inputs:
+            if node_dt.get((id(inp), oi)) is None:
+                node_dt[(id(inp), oi)] = dt
+    return node_dt
+
+
+def _infer_shapes(sym, known, known_dtypes=None, want_types=False):
     """Returns (arg_shapes, out_shapes, aux_shapes) in list_* order; None
-    for unknowable entries."""
+    for unknowable entries. With ``want_types`` also returns
+    (arg_types, out_types, aux_types): output dtypes come from
+    jax.eval_shape where input shapes are known, otherwise from a
+    ``dtype`` node attr or numpy promotion of the input dtypes."""
     import jax
     import jax.numpy as jnp
 
     from ..ops.registry import get_op
     from .executor import _call_op_with_attrs
 
+    known_dtypes = known_dtypes or {}
     shapes = {}  # id(node),oidx -> shape
     dtypes = {}
     var_shape = dict(known)
+    pre_dt = _unify_types(sym, known_dtypes)
+
+    def _dtype_of(inp, oi):
+        return dtypes.get((id(inp), oi),
+                          pre_dt.get((id(inp), oi)) or np.dtype("float32"))
 
     for node in sym._topo_nodes():
         if node.is_var():
+            dtypes[(id(node), 0)] = _dtype_of(node, 0)
             s = var_shape.get(node.name, node.attrs.get("__shape__"))
             if s is not None and 0 not in tuple(s):
                 shapes[(id(node), 0)] = tuple(s)
-                dtypes[(id(node), 0)] = np.dtype(
-                    node.attrs.get("__dtype__", "float32"))
             continue
         in_shapes = []
         missing = []
@@ -565,14 +623,18 @@ def _infer_shapes(sym, known):
                 if inp.is_var() and nm in deduced:
                     s = deduced[nm]
                     shapes[(id(inp), oi)] = s
-                    dtypes[(id(inp), oi)] = np.dtype("float32")
                     in_shapes[i] = s
                     missing.remove(i)
         if missing:
-            continue  # cannot infer this node's outputs
+            # shapes unknowable — dtypes still flow via the unification
+            # pre-pass (explicit dtype attr, else promotion of inputs)
+            for i in range(node.num_outputs):
+                dtypes[(id(node), i)] = \
+                    pre_dt.get((id(node), i)) or np.dtype("float32")
+            continue  # cannot infer this node's output shapes
         op = get_op(node.op)
         structs = [
-            jax.ShapeDtypeStruct(s, dtypes.get((id(inp), oi), np.float32))
+            jax.ShapeDtypeStruct(s, _dtype_of(inp, oi))
             for s, (inp, oi) in zip(in_shapes, node.inputs)]
         try:
             out = jax.eval_shape(
@@ -595,4 +657,11 @@ def _infer_shapes(sym, known):
                   for a in sym.list_auxiliary_states()]
     out_shapes = [shapes.get((id(n), oi)) for n, oi in sym._outputs]
     del jnp, aux
-    return arg_shapes, out_shapes, aux_shapes
+    if not want_types:
+        return arg_shapes, out_shapes, aux_shapes
+    arg_types = [_dtype_of(node_by_name[a], 0) for a in sym.list_arguments()]
+    aux_types = [_dtype_of(node_by_name[a], 0)
+                 for a in sym.list_auxiliary_states()]
+    out_types = [_dtype_of(n, oi) for n, oi in sym._outputs]
+    return (arg_shapes, out_shapes, aux_shapes,
+            arg_types, out_types, aux_types)

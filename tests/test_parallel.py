@@ -212,3 +212,66 @@ def test_ring_attention_grads_flow():
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-5)
+
+
+def test_ring_attention_grads_causal_bias():
+    """Backward through the custom VJP: causal mask + bias riding the ring."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import _attention_reference
+    from mxnet_tpu.ops.attention import make_padding_bias
+
+    mesh = parallel.make_mesh((4,), ("sp",), devices=jax.devices()[:4])
+    rng = np.random.RandomState(3)
+    B, H, T, D = 2, 2, 32, 8
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype("f4"))
+    k = jnp.asarray(rng.normal(size=(B, H, T, D)).astype("f4"))
+    v = jnp.asarray(rng.normal(size=(B, H, T, D)).astype("f4"))
+    bias = make_padding_bias(jnp.asarray([20, 32]), T)
+
+    def loss_ring(q_, k_, v_, b_):
+        return jnp.sum(parallel.ring_attention(
+            q_, k_, v_, bias=b_, mesh=mesh, seq_axis="sp",
+            causal=True) ** 2)
+
+    def loss_ref(q_, k_, v_, b_):
+        return jnp.sum(_attention_reference(q_, k_, v_, b_, True,
+                                            1.0 / np.sqrt(D)) ** 2)
+
+    g1 = jax.grad(loss_ring, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_ring_attention_backward_memory_is_o_t_over_n():
+    """The VJP residuals must be O(T/n) per shard — NOT the O(T^2/n) that
+    naive autodiff of the unrolled ring produces by saving every hop's
+    (B, H, Tl, Tl) probability block (round-1 ADVICE #1)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel import sequence as seq
+
+    n = 8
+    mesh = parallel.make_mesh((n,), ("sp",))
+    B, H, T, D = 1, 2, 8 * n, 8  # T = 8·n per the verdict's test spec
+    spec = P(None, None, "sp", None)
+
+    def fwd_residuals(q_, k_, v_):
+        _, res = seq._ring_core_fwd(q_, k_, v_, None, "sp", True,
+                                    0.35, n)
+        return [r for r in res if r is not None]
+
+    out_specs = [spec] * 4 + [P(None, None, "sp")]  # q,k,v,out + lse
+    shapes = jax.eval_shape(
+        jax.shard_map(fwd_residuals, mesh=mesh,
+                      in_specs=(spec, spec, spec), out_specs=out_specs),
+        *[jax.ShapeDtypeStruct((B, H, T, D), jnp.float32)] * 3)
+    total = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
+    # per-shard budget: q,k,v,out (B*H*Tl*D each) + lse (B*H*Tl), n shards.
+    # The old path saved n extra (B,H,Tl,Tl) blocks per shard on top.
+    tl = T // n
+    budget = n * (4 * B * H * tl * D + B * H * tl)
+    assert total <= budget, (total, budget)

@@ -326,3 +326,34 @@ def test_group_and_multi_output():
     first = parts[0]
     ex2 = first.bind(args={"x": nd.array([[1.0], [2.0]])})
     assert_almost_equal(ex2.forward()[0], np.array([[1.0]]))
+
+
+def test_infer_type_mixed_dtypes():
+    """infer_type propagates real dtypes (ref: nnvm InferType pass), not a
+    blanket float32: explicit arg dtypes flow forward, Cast overrides, and
+    promotion applies where shapes are unknown."""
+    import numpy as np
+
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    net = sym.FullyConnected(data=data, weight=w, no_bias=True,
+                             num_hidden=8, name="fc")
+    arg_t, out_t, _ = net.infer_type(data=np.float16, w=np.float16)
+    names = net.list_arguments()
+    assert dict(zip(names, arg_t))["data"] == np.dtype("float16")
+    assert out_t[0] == np.dtype("float16")
+
+    # defaults stay float32
+    arg_t, out_t, _ = net.infer_type()
+    assert all(t == np.dtype("float32") for t in arg_t)
+    assert out_t[0] == np.dtype("float32")
+
+    # Cast overrides regardless of input dtype
+    casted = sym.Cast(net, dtype="bfloat16", name="c")
+    _, out_t, _ = casted.infer_type(data=np.float16, w=np.float16)
+    assert out_t[0] == np.dtype("bfloat16")
+
+    # promotion when dtypes disagree (shape-free walk)
+    mixed = data + w
+    _, out_t, _ = mixed.infer_type(data=np.float16, w=np.float64)
+    assert out_t[0] == np.dtype("float64")
