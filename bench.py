@@ -85,15 +85,18 @@ def _emit_jsonl(row):
 
 
 def _timed_steps(step, x, y, iters, warmup):
+    # sync EVERY step: wait_to_read is the only true wait on the axon
+    # tunnel, and queueing many un-synced steps (a) measures dispatch, not
+    # compute, and (b) can wedge the single-client tunnel if the process
+    # dies with a deep queue (both observed in round 3)
     loss = None
     for _ in range(warmup):
         loss = step(x, y)
-    if loss is not None:
         loss.wait_to_read()
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step(x, y)
-    loss.wait_to_read()
+        loss.wait_to_read()
     return time.perf_counter() - t0
 
 
@@ -226,46 +229,184 @@ def bench_bert_mlm(platform, dtype):
     return tok_s, row
 
 
+def bench_lstm_ptb(platform, dtype):
+    """LSTM language model, PTB 'medium' shape (BASELINE config 4;
+    fused lax.scan RNN, ref: src/operator/rnn.cc cuDNN fused RNN)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import Block, nn, rnn
+    from mxnet_tpu import parallel
+
+    small = platform == "cpu"
+    seq_len = int(os.environ.get("BENCH_LSTM_SEQLEN", "8" if small
+                                 else "35"))
+    batch = int(os.environ.get("BENCH_LSTM_BATCH", "4" if small else "32"))
+    iters = int(os.environ.get("BENCH_LSTM_ITERS", "2" if small else "10"))
+    warmup = int(os.environ.get("BENCH_LSTM_WARMUP", "1" if small else "2"))
+    hidden = 64 if small else 650
+    layers = 1 if small else 2
+    vocab = 1000 if small else 10000
+
+    mx.random.seed(0)
+
+    class _LM(Block):
+        def __init__(self):
+            super().__init__(prefix="ptb_")
+            with self.name_scope():
+                self.embed = nn.Embedding(vocab, hidden)
+                self.lstm = rnn.LSTM(hidden_size=hidden, num_layers=layers,
+                                     layout="NTC")
+                self.decoder = nn.Dense(vocab, flatten=False)
+
+        def forward(self, x):
+            return self.decoder(self.lstm(self.embed(x)))
+
+    net = _LM()
+    net.initialize()
+    if dtype == "bfloat16":
+        net.cast("bfloat16")
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randint(0, vocab, (batch, seq_len)).astype(np.float32))
+    y = nd.array(rng.randint(0, vocab, (batch, seq_len)).astype(np.float32))
+    net(x)
+
+    step = parallel.ShardedTrainStep(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 1.0})
+
+    dt = _timed_steps(step, x, y, iters, warmup)
+    tok_s = batch * seq_len * iters / dt
+    flops_per_tok = step.flops_per_step(x, y)
+    if flops_per_tok:
+        flops_per_tok /= batch * seq_len
+
+    row = {
+        "config": "lstm_ptb_train", "chips": 1, "batch_size": batch,
+        "seq_len": seq_len, "dtype": dtype,
+        "images_or_tokens_per_sec_per_chip": round(tok_s, 2),
+        "mfu": _mfu(tok_s, flops_per_tok, platform), "platform": platform,
+        "flops_per_sample": flops_per_tok,
+    }
+    _emit_jsonl(row)
+    return tok_s, row
+
+
+def bench_wide_deep(platform, dtype):
+    """Wide&Deep CTR throughput (BASELINE config 5; ref:
+    example/sparse/wide_deep). The jitted step keeps embeddings dense
+    (XLA scatter-add); the framework-level sparse path is covered by
+    tests/test_sparse.py."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import Block, model_zoo
+    from mxnet_tpu import parallel
+
+    small = platform == "cpu"
+    batch = int(os.environ.get("BENCH_WD_BATCH", "16" if small else "2048"))
+    iters = int(os.environ.get("BENCH_WD_ITERS", "2" if small else "20"))
+    warmup = int(os.environ.get("BENCH_WD_WARMUP", "1" if small else "3"))
+    n_wide, n_deep = 8, 4
+    wide_vocab = 1000 if small else 100000
+    deep_vocab = 500 if small else 10000
+
+    mx.random.seed(0)
+    wd = model_zoo.wide_deep(
+        wide_vocab=wide_vocab, deep_vocab=deep_vocab,
+        embed_dim=16, hidden=(64, 32), classes=2, sparse_grad=False)
+
+    class _Packed(Block):
+        """Single-input wrapper: columns [0:n_wide) are wide ids, the
+        rest deep ids — lets ShardedTrainStep drive the two towers."""
+
+        def __init__(self):
+            super().__init__(prefix="wd_pack_")
+            with self.name_scope():
+                self.wd = wd
+
+        def forward(self, x):
+            return self.wd(x[:, :n_wide], x[:, n_wide:])
+
+    net = _Packed()
+    net.initialize()
+    if dtype == "bfloat16":
+        net.cast("bfloat16")
+
+    rng = np.random.RandomState(0)
+    xw = rng.randint(0, wide_vocab, (batch, n_wide))
+    xd = rng.randint(0, deep_vocab, (batch, n_deep))
+    x = nd.array(np.concatenate([xw, xd], axis=1).astype(np.float32))
+    y = nd.array(rng.randint(0, 2, (batch,)).astype(np.float32))
+    net(x)
+
+    step = parallel.ShardedTrainStep(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-3})
+
+    dt = _timed_steps(step, x, y, iters, warmup)
+    samp_s = batch * iters / dt
+    flops = step.flops_per_step(x, y)
+    if flops:
+        flops /= batch
+
+    row = {
+        "config": "wide_deep_train", "chips": 1, "batch_size": batch,
+        "dtype": dtype,
+        "images_or_tokens_per_sec_per_chip": round(samp_s, 2),
+        "mfu": _mfu(samp_s, flops, platform), "platform": platform,
+        "flops_per_sample": flops,
+    }
+    _emit_jsonl(row)
+    return samp_s, row
+
+
 def main():
     platform, note = _init_backend()
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
-    configs = os.environ.get("BENCH_CONFIGS", "resnet50,bert").split(",")
+    configs = os.environ.get(
+        "BENCH_CONFIGS", "resnet50,bert,lstm_ptb,wide_deep").split(",")
 
+    # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
+    metric_info = {
+        "resnet50": ("resnet50_train_throughput", "images/sec/chip",
+                     bench_resnet50),
+        "bert": ("bert_base_mlm_throughput", "tokens/sec/chip",
+                 bench_bert_mlm),
+        "lstm_ptb": ("lstm_ptb_train_throughput", "tokens/sec/chip",
+                     bench_lstm_ptb),
+        "wide_deep": ("wide_deep_train_throughput", "samples/sec/chip",
+                      bench_wide_deep),
+    }
     headline = None
     errors = []
-    if "resnet50" in configs:
+    for name in ("resnet50", "bert", "lstm_ptb", "wide_deep"):
+        if name not in configs:
+            continue
+        metric, unit, fn = metric_info[name]
         try:
-            img_s, row = bench_resnet50(platform, dtype)
-            headline = {
-                "metric": "resnet50_train_throughput",
-                "value": round(img_s, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-                "mfu": row["mfu"],
-                "platform": platform,
-            }
-        except Exception as e:  # noqa: BLE001 — diagnostic JSON, not a crash
-            errors.append("resnet50: %r" % (e,))
-    if "bert" in configs:
-        try:
-            tok_s, brow = bench_bert_mlm(platform, dtype)
+            val, row = fn(platform, dtype)
             if headline is None:
                 headline = {
-                    "metric": "bert_base_mlm_throughput",
-                    "value": round(tok_s, 2),
-                    "unit": "tokens/sec/chip",
-                    "vs_baseline": 0.0,  # no published reference number
-                    "mfu": brow["mfu"],
+                    "metric": metric,
+                    "value": round(val, 2),
+                    "unit": unit,
+                    # only resnet50 has a (stand-in) published baseline
+                    "vs_baseline": round(val / BASELINE_IMG_S, 3)
+                    if name == "resnet50" else 0.0,
+                    "mfu": row["mfu"],
                     "platform": platform,
                 }
-        except Exception as e:  # noqa: BLE001
-            errors.append("bert: %r" % (e,))
+        except Exception as e:  # noqa: BLE001 — diagnostic JSON, not crash
+            errors.append("%s: %r" % (name, e))
 
     if headline is None:
-        if "resnet50" in configs:
-            metric, unit = "resnet50_train_throughput", "images/sec/chip"
-        else:
-            metric, unit = "bert_base_mlm_throughput", "tokens/sec/chip"
+        first = next((c for c in ("resnet50", "bert", "lstm_ptb",
+                                  "wide_deep") if c in configs), "resnet50")
+        metric, unit, _ = metric_info[first]
         headline = {"metric": metric, "value": 0.0,
                     "unit": unit, "vs_baseline": 0.0,
                     "platform": platform,

@@ -45,6 +45,8 @@ from .module import Module, BucketingModule
 from . import model
 from .model import save_checkpoint, load_checkpoint
 from . import parallel
+from . import profiler
+from . import monitor
 
 __all__ = [
     "nd", "ndarray", "autograd", "random", "context", "Context", "cpu",
@@ -52,5 +54,5 @@ __all__ = [
     "init", "gluon", "optimizer", "opt", "metric", "kvstore", "kv",
     "lr_scheduler", "callback", "recordio", "io", "parallel", "symbol",
     "sym", "Symbol", "module", "mod", "Module", "BucketingModule", "model",
-    "save_checkpoint", "load_checkpoint",
+    "save_checkpoint", "load_checkpoint", "profiler", "monitor",
 ]

@@ -6,7 +6,9 @@ from .bert import (
     BERTModel, BERTEncoder, get_bert_model, bert_12_768_12, bert_6_512_8,
     bert_3_64_2,
 )
+from . import wide_deep as wide_deep_mod
+from .wide_deep import WideDeep, wide_deep
 
 __all__ = ["vision", "get_model", "bert", "BERTModel", "BERTEncoder",
            "get_bert_model", "bert_12_768_12", "bert_6_512_8",
-           "bert_3_64_2"]
+           "bert_3_64_2", "WideDeep", "wide_deep"]

@@ -1,0 +1,56 @@
+"""Wide & Deep (BASELINE config 5; models the reference's
+example/sparse/wide_deep — wide = sparse linear over one-hot features,
+deep = embeddings + MLP; the sparse side exercises row_sparse Embedding
+gradients, sparse optimizer updates, and KVStore row-sparse pull).
+
+TPU-native notes: inside the jitted step both towers are dense XLA
+gathers/scatters (static shapes); sparsity pays at the framework boundary
+— see mxnet_tpu/sparse.py's design note.
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..block import HybridBlock
+
+__all__ = ["WideDeep", "wide_deep"]
+
+
+class WideDeep(HybridBlock):
+    """Two-tower CTR model.
+
+    Inputs: ``wide_x`` (B, num_wide) int feature ids into one shared wide
+    vocabulary; ``deep_x`` (B, num_deep) int ids into the deep vocabulary.
+    Output: (B, classes) scores = wide linear score + deep MLP score.
+    """
+
+    def __init__(self, wide_vocab, deep_vocab, embed_dim=16,
+                 hidden=(64, 32), classes=2, sparse_grad=True,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            # wide tower: Embedding(output_dim=classes) == per-feature
+            # weight rows of a sparse linear layer; summing over the
+            # feature axis gives w . x for the one-hot encoding
+            self.wide = nn.Embedding(wide_vocab, classes,
+                                     sparse_grad=sparse_grad,
+                                     prefix="wide_")
+            self.deep_embedding = nn.Embedding(deep_vocab, embed_dim,
+                                               sparse_grad=sparse_grad,
+                                               prefix="deep_embed_")
+            self.deep = nn.HybridSequential(prefix="deep_")
+            with self.deep.name_scope():
+                for h in hidden:
+                    self.deep.add(nn.Dense(h, activation="relu"))
+                self.deep.add(nn.Dense(classes))
+
+    def hybrid_forward(self, F, wide_x, deep_x):
+        wide_score = self.wide(wide_x).sum(axis=1)        # (B, classes)
+        emb = self.deep_embedding(deep_x)                 # (B, nd, D)
+        flat = emb.reshape((emb.shape[0], -1))
+        deep_score = self.deep(flat)                      # (B, classes)
+        return wide_score + deep_score
+
+
+def wide_deep(wide_vocab=100000, deep_vocab=10000, **kwargs):
+    """Factory matching the get_model convention."""
+    return WideDeep(wide_vocab, deep_vocab, **kwargs)

@@ -196,6 +196,15 @@ class Parameter:
             raise MXNetError(
                 "parameter %s has grad_req='null'; no gradient buffer"
                 % (self.name,))
+        if self._grad_stype == "row_sparse":
+            # TPU-native split (sparse.py design note): inside XLA the
+            # embedding backward is a dense scatter-add; the row_sparse
+            # view materializes here, at the framework boundary, so
+            # Trainer/KVStore push and the optimizer update touch only
+            # the rows this batch hit (ref: Embedding sparse_grad +
+            # _sparse_*_update lazy semantics)
+            from ..sparse import row_sparse_array
+            return row_sparse_array(d._grad)
         return d._grad
 
     def list_grad(self):

@@ -77,6 +77,8 @@ class Trainer:
         config = self._kvstore_params
         kvstore_arg = config["kvstore"]
         update_on_kvstore = config["update_on_kvstore"]
+        has_sparse = any(getattr(p, "_grad_stype", "default") != "default"
+                         for p in self._params)
         kvstore = None
         if kvstore_arg:
             if isinstance(kvstore_arg, kvs.KVStore):
@@ -85,7 +87,19 @@ class Trainer:
                 kvstore = kvs.create(kvstore_arg)
             else:
                 raise ValueError("kvstore must be a KVStore instance or name")
+        elif has_sparse:
+            # sparse grads are applied where the weight lives
+            kvstore = kvs.create("local")
         if kvstore is not None:
+            if has_sparse:
+                # ref: trainer.py — sparse gradients force
+                # update_on_kvstore=True (row_sparse rows are updated on
+                # the store that holds the full weight)
+                if update_on_kvstore is False:
+                    raise ValueError(
+                        "update_on_kvstore=False is not supported with "
+                        "sparse gradients (matches reference)")
+                update_on_kvstore = True
             if update_on_kvstore is None:
                 # reference default: update on kvstore when distributed
                 update_on_kvstore = kvstore.type.startswith("dist")

@@ -93,16 +93,25 @@ class KVStore:
         return [_key_str(key)], [value]
 
     def _merge(self, vals):
-        """Sum a list of pushed values (ref: CommCPU/CommDevice::Reduce)."""
+        """Sum a list of pushed values (ref: CommCPU/CommDevice::Reduce;
+        row_sparse lists reduce over the index union like the reference's
+        rsp reduce in comm.h)."""
         if isinstance(vals, NDArray):
             return vals
         if len(vals) == 1:
             return vals[0]
-        import jax.numpy as jnp
+        from .sparse import RowSparseNDArray, add as rsp_add
 
-        total = vals[0].data
+        if all(isinstance(v, RowSparseNDArray) for v in vals):
+            total = vals[0]
+            for v in vals[1:]:
+                total = rsp_add(total, v)
+            return total
+        total = vals[0].asnumpy() if isinstance(vals[0], RowSparseNDArray) \
+            else vals[0].data
         for v in vals[1:]:
-            total = total + v.data
+            total = total + (v.asnumpy() if isinstance(v, RowSparseNDArray)
+                             else v.data)
         return NDArray(total)
 
     def _dist_reduce(self, merged):
@@ -126,11 +135,16 @@ class KVStore:
                 self._store[k] = merged.copy()
                 continue
             if self._updater is not None:
-                # server-side update: stored value is the weight
+                # server-side update: stored value is the weight (a
+                # row_sparse merged grad routes to the sparse optimizer
+                # path via Optimizer.update's stype dispatch)
                 self._updater(int(k) if k.isdigit() else k, merged,
                               self._store[k])
             else:
-                self._store[k]._set_data(merged.data)
+                # replace semantics (ref: CopyFromTo(merged, &local)) — a
+                # row_sparse merged value zero-fills the dense store's
+                # untouched rows via RowSparseNDArray.copyto's densify
+                merged.copyto(self._store[k])
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         del priority, ignore_sparse
@@ -141,9 +155,9 @@ class KVStore:
             src = self._store[k]
             if isinstance(o, (list, tuple)):
                 for oo in o:
-                    oo._set_data(src.data)
+                    src.copyto(oo)
             else:
-                o._set_data(src.data)
+                src.copyto(o)
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)

@@ -35,6 +35,8 @@ class BucketingModule(BaseModule):
         self._curr_bucket_key = None
         self._grad_req = None
         self._for_training = False
+        self._monitor = None
+        self._monitor_all = False
 
     @property
     def default_bucket_key(self):
@@ -132,6 +134,11 @@ class BucketingModule(BaseModule):
                 module._optimizer = self._curr_module._optimizer
                 module._updater = self._curr_module._updater
                 module.optimizer_initialized = True
+            if self._monitor is not None:
+                # monitors must follow buckets created after
+                # install_monitor (ref: switch_bucket installs
+                # self._monitor on fresh modules)
+                module.install_monitor(self._monitor, self._monitor_all)
             self._buckets[bucket_key] = module
         else:
             module = self._buckets[bucket_key]
@@ -181,6 +188,8 @@ class BucketingModule(BaseModule):
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         self._curr_module.update_metric(eval_metric, labels, pre_sliced)
 
-    def install_monitor(self, monitor):
+    def install_monitor(self, monitor, monitor_all=False):
+        self._monitor = monitor
+        self._monitor_all = monitor_all
         for mod in self._buckets.values():
-            mod.install_monitor(monitor)
+            mod.install_monitor(monitor, monitor_all=monitor_all)

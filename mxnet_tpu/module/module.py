@@ -236,7 +236,11 @@ class Module(BaseModule):
                     feed[name] = arr
         self._exec.forward(is_train=is_train, **feed)
         if self._monitor is not None:
-            self._monitor.forward_hook(self)
+            # legacy hook protocol; mx.monitor.Monitor taps via the
+            # executor's monitor callback instead
+            hook = getattr(self._monitor, "forward_hook", None)
+            if hook is not None:
+                hook(self)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
@@ -264,9 +268,12 @@ class Module(BaseModule):
             dict(zip(self._label_names, labels or [])),
             dict(zip(self.output_names, self.get_outputs())))
 
-    def install_monitor(self, monitor):
+    def install_monitor(self, monitor, monitor_all=False):
+        """Attach a mx.monitor.Monitor to the bound executor
+        (ref: BaseModule.install_monitor)."""
+        assert self.binded, "call bind before install_monitor"
         self._monitor = monitor
-        monitor.install(self._exec)
+        monitor.install(self._exec, monitor_all=monitor_all)
 
     # -- checkpointing (ref: module.py — save_checkpoint / load) -------
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):

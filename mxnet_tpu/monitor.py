@@ -1,0 +1,81 @@
+"""Monitor — per-tensor training statistics (ref: python/mxnet/monitor.py
+— Monitor installs an output callback on executors; here the tap runs as a
+jitted all-intermediates graph pass, see symbol/executor.py
+_build_monitor_fn).
+
+Typical use (identical to the reference)::
+
+    mon = mx.monitor.Monitor(100, norm_stat)          # every 100 batches
+    mod.install_monitor(mon)                          # or mon.install(exe)
+    for batch in data:
+        mon.tic()
+        mod.forward_backward(batch)
+        mon.toc_print()
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+import numpy as np
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Collects a statistic of every op output each ``interval`` batches.
+
+    Parameters mirror the reference: ``interval`` (batches between
+    collections), ``stat_func`` (NDArray -> scalar/ndarray; default
+    mean(|x|)), ``pattern`` (regex on tap names), ``sort`` (sort taps by
+    name in toc output).
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(arr):
+                return np.abs(arr.asnumpy()).mean()
+        self.interval = int(interval)
+        self.stat_func = stat_func
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+        self.queue = []
+        self.step = 0
+        self.activated = False
+        self.exes = []
+
+    def install(self, exe, monitor_all=False):
+        """Attach to an Executor (ref: Monitor.install →
+        executor.set_monitor_callback)."""
+        exe.set_monitor_callback(self.stat_helper, monitor_all)
+        self.exes.append(exe)
+
+    def stat_helper(self, name, arr):
+        if not self.activated or not self.re_prog.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def tic(self):
+        """Start collecting if this step is on the interval."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+
+    def toc(self):
+        """End collection; returns [(step, tap_name, stat), ...]."""
+        if not self.activated:
+            self.step += 1
+            return []
+        self.activated = False
+        res = list(self.queue)
+        self.queue = []
+        if self.sort:
+            res.sort(key=lambda x: x[1])
+        self.step += 1
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for step, name, stat in res:
+            logging.info("Batch: %7d %30s %s", step, name, stat)
+        return res

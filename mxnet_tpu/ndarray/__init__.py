@@ -55,3 +55,7 @@ equal = getattr(_mod, "broadcast_equal")
 not_equal = getattr(_mod, "broadcast_not_equal")
 greater = getattr(_mod, "broadcast_greater")
 lesser = getattr(_mod, "broadcast_lesser")
+
+# sparse storage namespace (ref: python/mxnet/ndarray/sparse.py is exposed
+# as mx.nd.sparse); imported late to avoid a cycle with ndarray.ndarray
+from .. import sparse  # noqa: E402,F401

@@ -168,6 +168,10 @@ register = Optimizer.register
 create = Optimizer.create_optimizer
 
 
+def _is_row_sparse(grad):
+    return getattr(grad, "stype", "default") == "row_sparse"
+
+
 def _common(self, index):
     """(lr, wd) honoring multipliers + update count bump."""
     self._update_count(index)
@@ -178,6 +182,8 @@ def _common(self, index):
 class SGD(Optimizer):
     """SGD with momentum and optional multi-precision
     (ref: optimizer.py — SGD; op: sgd_update/sgd_mom_update/mp_*)."""
+
+    sparse_capable = True  # has a row_sparse update path
 
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
@@ -210,6 +216,26 @@ class SGD(Optimizer):
         lr, wd = _common(self, index)
         kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
                   clip_gradient=self.clip_gradient)
+        if _is_row_sparse(grad):
+            # lazy-update semantics: only touched rows (incl. their
+            # momentum) change — ref: _sparse_sgd_(mom_)update
+            from .. import sparse as _sp
+            ckw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                       clip_gradient=self.clip_gradient or -1.0)
+            if state is not None and not multi_precision:
+                _sp.sparse_sgd_mom_update(weight, grad, state,
+                                          momentum=self.momentum, **ckw)
+            elif state is not None and multi_precision:
+                mom, w32 = state
+                if mom is not None:
+                    _sp.sparse_sgd_mom_update(w32, grad, mom,
+                                              momentum=self.momentum, **ckw)
+                else:
+                    _sp.sparse_sgd_update(w32, grad, **ckw)
+                weight._set_data(w32.data.astype(weight.data.dtype))
+            else:
+                _sp.sparse_sgd_update(weight, grad, **ckw)
+            return
         if not multi_precision:
             if state is not None:
                 nd.sgd_mom_update(weight, grad, state, momentum=self.momentum,
@@ -253,6 +279,8 @@ class NAG(Optimizer):
 class Adam(Optimizer):
     """Adam (ref: optimizer.py — Adam; op: adam_update)."""
 
+    sparse_capable = True  # has a row_sparse update path
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -273,6 +301,14 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         lr *= math.sqrt(coef2) / coef1
         mean, var = state
+        if _is_row_sparse(grad):
+            from .. import sparse as _sp
+            _sp.sparse_adam_update(
+                weight, grad, mean, var, lr=lr, beta1=self.beta1,
+                beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient or -1.0, t=None)
+            return
         nd.adam_update(weight, grad, mean, var, lr=lr, wd=wd,
                        beta1=self.beta1, beta2=self.beta2,
                        epsilon=self.epsilon, rescale_grad=self.rescale_grad,
@@ -315,6 +351,8 @@ class AdaGrad(Optimizer):
     """AdaGrad (ref: optimizer.py — AdaGrad; python-side update in the
     reference too)."""
 
+    sparse_capable = True  # has a row_sparse update path
+
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
@@ -324,6 +362,13 @@ class AdaGrad(Optimizer):
 
     def update(self, index, weight, grad, state):
         lr, wd = _common(self, index)
+        if _is_row_sparse(grad):
+            from .. import sparse as _sp
+            _sp.sparse_adagrad_update(
+                weight, grad, state, lr=lr, epsilon=self.float_stable_eps,
+                wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient or -1.0)
+            return
         grad = grad * self.rescale_grad
         if self.clip_gradient is not None:
             grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
@@ -399,6 +444,8 @@ class AdaDelta(Optimizer):
 class Ftrl(Optimizer):
     """FTRL-proximal (ref: optimizer.py — Ftrl; op: ftrl_update)."""
 
+    sparse_capable = True  # has a row_sparse update path
+
     def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.lamda1 = lamda1
@@ -411,6 +458,13 @@ class Ftrl(Optimizer):
     def update(self, index, weight, grad, state):
         lr, wd = _common(self, index)
         z, n = state
+        if _is_row_sparse(grad):
+            from .. import sparse as _sp
+            _sp.sparse_ftrl_update(
+                weight, grad, z, n, lr=lr, lamda1=self.lamda1,
+                beta=self.beta, wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient or -1.0)
+            return
         nd.ftrl_update(weight, grad, z, n, lr=lr, wd=wd, lamda1=self.lamda1,
                        beta=self.beta, rescale_grad=self.rescale_grad,
                        clip_gradient=self.clip_gradient)
@@ -602,6 +656,13 @@ class Updater:
         else:
             indices, grads, weights = index, grad, weight
         for i, g, w in zip(indices, grads, weights):
+            if _is_row_sparse(g) and not getattr(
+                    self.optimizer, "sparse_capable", False):
+                raise MXNetError(
+                    "optimizer %s does not support row_sparse gradients; "
+                    "use sgd, adam, adagrad, or ftrl (ref: the reference's "
+                    "sparse update kernels cover the same set)"
+                    % type(self.optimizer).__name__)
             if i not in self.states:
                 self.states[i] = \
                     self.optimizer.create_state_multi_precision(i, w)

@@ -1,0 +1,227 @@
+"""Profiler — ``mx.profiler`` API over ``jax.profiler`` (SURVEY §5
+tracing/profiling: ref python/mxnet/profiler.py + src/profiler/profiler.cc;
+the engine-level ProfileOperator records collapse into XLA's own op-level
+trace, which the JAX profiler captures as Perfetto/TensorBoard data).
+
+``set_config(filename=...)`` + ``set_state('run')`` starts a JAX trace; on
+``set_state('stop')``/``dump()`` the Perfetto trace lands under the
+configured directory. User scopes (Task/Frame/Counter/Marker) annotate the
+device trace via ``jax.profiler.TraceAnnotation`` and are also timed
+host-side so ``dumps()`` can print the MXNet-style aggregate table without
+parsing protobufs.
+
+Env autostart: ``MXT_PROFILER_AUTOSTART=1`` (ref MXNET_PROFILER_AUTOSTART).
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from .base import MXNetError
+
+__all__ = ["set_config", "set_state", "state", "start", "stop", "pause",
+           "resume", "dump", "dumps", "Domain", "Task", "Frame", "Counter",
+           "Marker"]
+
+_config = {
+    "filename": "profile_output",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": True,
+    "continuous_dump": False,
+}
+_state = "stop"
+_paused = False
+_trace_dir = None
+# aggregate table: name -> [count, total_sec, min_sec, max_sec]
+_agg = {}
+_counters = {}
+
+
+def set_config(**kwargs):
+    """Configure the profiler (ref: MXSetProcessProfilerConfig). Accepts the
+    reference's kwargs; ``filename`` names the trace output directory."""
+    unknown = set(kwargs) - set(_config)
+    if unknown:
+        raise MXNetError("profiler.set_config: unknown options %s"
+                         % sorted(unknown))
+    if _state == "run":
+        raise MXNetError("cannot reconfigure profiler while running")
+    _config.update(kwargs)
+
+
+def state():
+    return _state
+
+
+def set_state(new_state="stop"):
+    """'run' starts a JAX trace; 'stop' ends it (ref:
+    MXSetProcessProfilerState)."""
+    global _state, _trace_dir
+    if new_state not in ("run", "stop"):
+        raise MXNetError("profiler state must be 'run' or 'stop', got %r"
+                         % (new_state,))
+    if new_state == _state:
+        return
+    import jax
+
+    if new_state == "run":
+        base = _config["filename"]
+        # the reference writes one chrome-trace JSON file; JAX writes a
+        # Perfetto trace directory — use the filename sans extension as dir
+        _trace_dir = base[:-5] if base.endswith(".json") else base
+        os.makedirs(_trace_dir, exist_ok=True)
+        jax.profiler.start_trace(_trace_dir)
+        _state = "run"
+    else:
+        jax.profiler.stop_trace()
+        _state = "stop"
+
+
+def start():
+    set_state("run")
+
+
+def stop():
+    set_state("stop")
+
+
+def pause():
+    """Suppress user-scope aggregation (the device trace itself cannot be
+    paused mid-flight; ref MXProfilePause pauses op recording)."""
+    global _paused
+    _paused = True
+
+
+def resume():
+    global _paused
+    _paused = False
+
+
+def dump(finished=True):
+    """Finish the trace and flush it to disk (ref: MXDumpProfile)."""
+    if _state == "run" and finished:
+        set_state("stop")
+    return _trace_dir
+
+
+def dumps(reset=False):
+    """Aggregate-stats table of user scopes (ref: MXAggregateProfileStatsPrint
+    — device-op aggregates live in the Perfetto trace; this table covers
+    profiler.Task/Frame scopes and counters)."""
+    lines = ["Profile Statistics:",
+             "    %-24s %10s %14s %14s %14s"
+             % ("Name", "Calls", "Total(ms)", "Min(ms)", "Max(ms)")]
+    for name in sorted(_agg):
+        cnt, tot, mn, mx = _agg[name]
+        lines.append("    %-24s %10d %14.3f %14.3f %14.3f"
+                     % (name, cnt, tot * 1e3, mn * 1e3, mx * 1e3))
+    for name in sorted(_counters):
+        lines.append("    %-24s value=%s" % (name, _counters[name]))
+    if reset:
+        _agg.clear()
+        _counters.clear()
+    return "\n".join(lines)
+
+
+def _record(name, dt):
+    if _paused:
+        return
+    ent = _agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
+    ent[0] += 1
+    ent[1] += dt
+    ent[2] = min(ent[2], dt)
+    ent[3] = max(ent[3], dt)
+
+
+class Domain:
+    """Grouping namespace for scopes (ref: profiler.Domain)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __repr__(self):
+        return "Domain(%s)" % self.name
+
+
+class _Scope:
+    """Timed scope: host wall-clock into the aggregate table + a
+    TraceAnnotation so device ops inside it are grouped in the trace."""
+
+    def __init__(self, name, domain=None):
+        self.name = name if domain is None else "%s::%s" % (domain.name,
+                                                            name)
+        self._t0 = None
+        self._ann = None
+
+    def start(self):
+        import jax
+        self._t0 = time.perf_counter()
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        return self
+
+    def stop(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        if self._t0 is not None:
+            _record(self.name, time.perf_counter() - self._t0)
+            self._t0 = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Task(_Scope):
+    pass
+
+
+class Frame(_Scope):
+    pass
+
+
+class Counter:
+    """Named counter (ref: profiler.Counter)."""
+
+    def __init__(self, domain, name, value=0):
+        self.name = "%s::%s" % (domain.name, name) if domain else name
+        _counters[self.name] = value
+
+    def set_value(self, value):
+        _counters[self.name] = value
+
+    def increment(self, delta=1):
+        _counters[self.name] = _counters.get(self.name, 0) + delta
+
+    def decrement(self, delta=1):
+        self.increment(-delta)
+
+    def __iadd__(self, delta):
+        self.increment(delta)
+        return self
+
+    def __isub__(self, delta):
+        self.decrement(delta)
+        return self
+
+
+class Marker:
+    """Instant event (ref: profiler.Marker.mark)."""
+
+    def __init__(self, domain, name):
+        self.name = "%s::%s" % (domain.name, name) if domain else name
+
+    def mark(self, scope="process"):
+        _record("marker:%s" % self.name, 0.0)
+
+
+if os.environ.get("MXT_PROFILER_AUTOSTART", "") == "1":
+    set_config(profile_all=True)
+    set_state("run")

@@ -92,6 +92,50 @@ def _build_graph_fn(symbol, train):
     return fn
 
 
+def _build_monitor_fn(symbol, train, monitor_all):
+    """Like _build_graph_fn but returns every op-node output as a tap
+    (plus variable nodes when ``monitor_all``) for mx.monitor.Monitor.
+    Returns (names, fn) — names are static (jit outputs must be arrays),
+    ``fn(...)`` yields the matching value tuple. Same key_scope so dropout
+    masks etc. match the main forward."""
+    nodes = symbol._topo_nodes()
+    aux_names = set(symbol.list_auxiliary_states())
+
+    names = []
+    for node in nodes:
+        if node.is_var():
+            if monitor_all:
+                names.append(node.name)
+            continue
+        for i in range(node.num_outputs):
+            names.append(node.name + ("_output" if i == 0
+                                      else "_output%d" % i))
+
+    def fn(arg_vals, aux_vals, key):
+        with _random.key_scope(key):
+            vals = {}
+            taps = []
+            for node in nodes:
+                if node.is_var():
+                    v = aux_vals[node.name] if node.name in aux_names \
+                        else arg_vals[node.name]
+                    vals[(id(node), 0)] = v
+                    if monitor_all:
+                        taps.append(v)
+                    continue
+                op = get_op(node.op)
+                ins = [vals[(id(inp), oi)] for inp, oi in node.inputs]
+                out = _call_op_with_attrs(op, node.attrs, train, ins)
+                outs = out if isinstance(out, tuple) else (out,)
+                for i in range(node.num_outputs):
+                    o = outs[i] if i < len(outs) else outs[0]
+                    vals[(id(node), i)] = o
+                    taps.append(o)
+        return tuple(taps)
+
+    return names, fn
+
+
 class Executor:
     """Bound computation (ref: include/mxnet/executor.h — Executor)."""
 
@@ -129,6 +173,9 @@ class Executor:
         self._fwd_cache = {}
         self._bwd_jit = None
         self._last = None  # (arg_datas, aux_datas, key) of last train fwd
+        self._monitor_callback = None
+        self._monitor_all = False
+        self._mon_cache = {}
 
     @staticmethod
     def _to_dict(vals, names, what):
@@ -175,6 +222,34 @@ class Executor:
             self._fwd_cache[train] = jf
         return jf
 
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """Install a per-node output tap (ref: MXExecutorSetMonitorCallbackEX
+        — the engine invoked the callback per op; here forward additionally
+        runs a jitted all-intermediates graph when a monitor is active).
+        ``callback(name, NDArray)``; ``monitor_all`` also taps op inputs
+        (the graph's variable nodes)."""
+        self._monitor_callback = callback
+        self._monitor_all = bool(monitor_all)
+        self._mon_cache = {}
+
+    def _run_monitor(self, train, arg_datas, aux_datas, key):
+        # a Monitor outside its collection interval discards everything —
+        # skip the (full duplicate) all-intermediates execution entirely
+        owner = getattr(self._monitor_callback, "__self__", None)
+        if owner is not None and hasattr(owner, "activated") \
+                and not owner.activated:
+            return
+        cached = self._mon_cache.get(train)
+        if cached is None:
+            names, fn = _build_monitor_fn(self._symbol, train,
+                                          self._monitor_all)
+            cached = (names, jax.jit(fn))
+            self._mon_cache[train] = cached
+        names, jf = cached
+        vals = jf(arg_datas, aux_datas, key)
+        for name, val in zip(names, vals):
+            self._monitor_callback(name, NDArray(val))
+
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             if k not in self.arg_dict:
@@ -192,6 +267,8 @@ class Executor:
             self.aux_dict[n]._set_data(v)
         self.outputs = [NDArray(o) for o in outs]
         self._last = (arg_datas, aux_datas, key) if is_train else None
+        if self._monitor_callback is not None:
+            self._run_monitor(bool(is_train), arg_datas, aux_datas, key)
         return self.outputs
 
     def _default_head_grads(self):

@@ -1,0 +1,132 @@
+"""Profiler + Monitor tests (models tests/python/unittest/test_profiler.py
+and the Monitor usage in python/mxnet/monitor.py docstrings)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.base import MXNetError
+
+
+def test_profiler_trace_roundtrip(tmp_path):
+    """set_config → run → ops → stop leaves a Perfetto trace on disk."""
+    trace_dir = str(tmp_path / "prof")
+    mx.profiler.set_config(filename=trace_dir, profile_all=True)
+    mx.profiler.set_state("run")
+    assert mx.profiler.state() == "run"
+    a = nd.array(np.random.RandomState(0).normal(size=(64, 64)).astype("f4"))
+    nd.dot(a, a).asnumpy()
+    mx.profiler.set_state("stop")
+    assert mx.profiler.state() == "stop"
+    # jax writes plugins/profile/<date>/*.trace.json.gz under the log dir
+    found = []
+    for root, _, files in os.walk(trace_dir):
+        found.extend(files)
+    assert found, "no trace files written under %s" % trace_dir
+
+
+def test_profiler_dump_and_state_errors(tmp_path):
+    mx.profiler.set_config(filename=str(tmp_path / "p2"))
+    with pytest.raises(MXNetError):
+        mx.profiler.set_state("bogus")
+    mx.profiler.start()
+    with pytest.raises(MXNetError):
+        mx.profiler.set_config(filename="nope")  # reconfig while running
+    out = mx.profiler.dump()
+    assert mx.profiler.state() == "stop"
+    assert out and os.path.isdir(out)
+    with pytest.raises(MXNetError):
+        mx.profiler.set_config(not_an_option=1)
+
+
+def test_profiler_scopes_and_dumps():
+    dom = mx.profiler.Domain("test")
+    task = mx.profiler.Task("work", domain=dom)
+    with task:
+        x = nd.ones((8, 8))
+        (x + x).wait_to_read()
+    with mx.profiler.Frame("frame1"):
+        pass
+    ctr = mx.profiler.Counter(dom, "steps", 0)
+    ctr.increment(3)
+    mx.profiler.Marker(dom, "tick").mark()
+    table = mx.profiler.dumps()
+    assert "test::work" in table
+    assert "frame1" in table
+    assert "test::steps" in table and "value=3" in table
+    # pause suppresses aggregation
+    mx.profiler.pause()
+    with mx.profiler.Task("paused_work"):
+        pass
+    mx.profiler.resume()
+    table = mx.profiler.dumps(reset=True)
+    assert "paused_work" not in table
+    assert mx.profiler.dumps() .count("::") == 0  # reset cleared entries
+
+
+def _mlp_module():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(data=net, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, data_names=["data"], label_names=["softmax_label"])
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    return mod
+
+
+def test_monitor_collects_op_outputs():
+    mod = _mlp_module()
+    mon = mx.monitor.Monitor(interval=1, sort=True)
+    mod.install_monitor(mon)
+    rng = np.random.RandomState(0)
+    from mxnet_tpu.io import DataBatch
+    batch = DataBatch(data=[nd.array(rng.normal(size=(8, 10)).astype("f4"))],
+                      label=[nd.array(rng.randint(0, 4, (8,)).astype("f4"))])
+    mon.tic()
+    mod.forward(batch, is_train=True)
+    stats = mon.toc()
+    names = [n for _, n, _ in stats]
+    assert any(n.startswith("fc1") for n in names), names
+    assert any(n.startswith("relu1") for n in names), names
+    assert any(n.startswith("softmax") for n in names), names
+    for _, _, v in stats:
+        assert np.isfinite(v)
+
+
+def test_monitor_interval_and_pattern():
+    mod = _mlp_module()
+    mon = mx.monitor.Monitor(interval=2, pattern=".*fc.*")
+    mod.install_monitor(mon)
+    rng = np.random.RandomState(1)
+    from mxnet_tpu.io import DataBatch
+    batch = DataBatch(data=[nd.array(rng.normal(size=(8, 10)).astype("f4"))],
+                      label=[nd.array(rng.randint(0, 4, (8,)).astype("f4"))])
+    seen = []
+    for _ in range(4):
+        mon.tic()
+        mod.forward(batch, is_train=False)
+        seen.append(mon.toc())
+    # interval=2 → batches 0 and 2 collect, 1 and 3 don't
+    assert seen[0] and not seen[1] and seen[2] and not seen[3]
+    for _, name, _ in seen[0]:
+        assert "fc" in name, name
+
+
+def test_monitor_monitor_all_includes_inputs():
+    mod = _mlp_module()
+    mon = mx.monitor.Monitor(interval=1)
+    mod.install_monitor(mon, monitor_all=True)
+    rng = np.random.RandomState(2)
+    from mxnet_tpu.io import DataBatch
+    batch = DataBatch(data=[nd.array(rng.normal(size=(8, 10)).astype("f4"))],
+                      label=[nd.array(rng.randint(0, 4, (8,)).astype("f4"))])
+    mon.tic()
+    mod.forward(batch, is_train=False)
+    names = [n for _, n, _ in mon.toc()]
+    assert "data" in names  # variable nodes tapped too
+    assert any(n.endswith("_output") for n in names)

@@ -1,0 +1,252 @@
+"""Sparse subsystem tests (models tests/python/unittest/test_sparse_ndarray.py
++ test_sparse_operator.py + the sparse optimizer coverage in
+test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.test_utils import rand_ndarray, with_seed
+
+sparse = nd.sparse
+
+
+# ---------------------------------------------------------------------------
+# storage types
+# ---------------------------------------------------------------------------
+@with_seed()
+def test_row_sparse_roundtrip():
+    d = np.zeros((8, 4), "f4")
+    d[[1, 5, 6]] = np.random.rand(3, 4).astype("f4")
+    rsp = sparse.row_sparse_array(d)
+    assert rsp.stype == "row_sparse"
+    assert rsp.shape == (8, 4)
+    assert rsp.num_rows == 3
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 5, 6])
+    np.testing.assert_array_equal(rsp.asnumpy(), d)
+    # (data, indices) constructor, unsorted indices get sorted
+    rsp2 = sparse.row_sparse_array(
+        (d[[5, 1, 6]], np.array([5, 1, 6])), shape=(8, 4))
+    np.testing.assert_array_equal(rsp2.asnumpy(), d)
+    # dense round-trips
+    back = rsp.tostype("default")
+    assert isinstance(back, nd.NDArray)
+    np.testing.assert_array_equal(back.asnumpy(), d)
+
+
+@with_seed()
+def test_csr_roundtrip_and_slice():
+    d = np.zeros((6, 5), "f4")
+    d[0, 1] = 1.0
+    d[2, [0, 4]] = [2.0, 3.0]
+    d[5, 2] = 4.0
+    csr = sparse.csr_matrix(d)
+    assert csr.stype == "csr"
+    np.testing.assert_array_equal(csr.asnumpy(), d)
+    np.testing.assert_array_equal(csr.indptr.asnumpy(),
+                                  [0, 1, 1, 3, 3, 3, 4])
+    # row slicing keeps csr storage
+    sl = csr[2:6]
+    assert sl.shape == (4, 5)
+    np.testing.assert_array_equal(sl.asnumpy(), d[2:6])
+    one = csr[2]
+    np.testing.assert_array_equal(one.asnumpy(), d[2:3])
+
+
+def test_cast_storage_matrix():
+    d = np.diag(np.arange(1, 5)).astype("f4")
+    dn = nd.array(d)
+    for stype, cls in (("row_sparse", sparse.RowSparseNDArray),
+                       ("csr", sparse.CSRNDArray)):
+        s = sparse.cast_storage(dn, stype)
+        assert isinstance(s, cls)
+        np.testing.assert_array_equal(s.asnumpy(), d)
+        back = sparse.cast_storage(s, "default")
+        np.testing.assert_array_equal(back.asnumpy(), d)
+    with pytest.raises(MXNetError):
+        sparse.cast_storage(sparse.cast_storage(dn, "row_sparse"), "csr")
+
+
+def test_sparse_zeros_and_rand_ndarray():
+    z = sparse.zeros("row_sparse", (5, 3))
+    assert z.num_rows == 0
+    np.testing.assert_array_equal(z.asnumpy(), np.zeros((5, 3)))
+    zc = sparse.zeros("csr", (5, 3))
+    np.testing.assert_array_equal(zc.asnumpy(), np.zeros((5, 3)))
+    # the latent ImportError from round 2: rand_ndarray(stype="row_sparse")
+    r = rand_ndarray((10, 4), stype="row_sparse", density=0.5)
+    assert r.stype == "row_sparse"
+    assert r.shape == (10, 4)
+
+
+@with_seed()
+def test_sparse_retain_and_add():
+    d = np.zeros((10, 2), "f4")
+    d[[1, 3, 7]] = np.random.rand(3, 2).astype("f4")
+    rsp = sparse.row_sparse_array(d)
+    kept = sparse.sparse_retain(rsp, nd.array([3.0, 7.0, 9.0]))
+    exp = np.zeros_like(d)
+    exp[[3, 7]] = d[[3, 7]]
+    np.testing.assert_array_equal(kept.asnumpy(), exp)
+
+    d2 = np.zeros((10, 2), "f4")
+    d2[[3, 4]] = np.random.rand(2, 2).astype("f4")
+    total = sparse.add(rsp, sparse.row_sparse_array(d2))
+    np.testing.assert_allclose(total.asnumpy(), d + d2, rtol=1e-6)
+    np.testing.assert_array_equal(total.indices.asnumpy(), [1, 3, 4, 7])
+
+
+@with_seed()
+def test_sparse_dot():
+    d = np.zeros((6, 5), "f4")
+    d[[0, 2, 4]] = np.random.rand(3, 5).astype("f4")
+    csr = sparse.csr_matrix(d)
+    rhs = np.random.rand(5, 3).astype("f4")
+    out = sparse.dot(csr, nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), d @ rhs, rtol=1e-5)
+    outT = sparse.dot(csr, nd.array(np.random.rand(6, 3).astype("f4")),
+                      transpose_a=True)
+    assert outT.shape == (5, 3)
+
+
+# ---------------------------------------------------------------------------
+# sparse optimizer updates — lazy semantics (ref: _sparse_sgd_update etc.)
+# ---------------------------------------------------------------------------
+def _rsp_grad(shape, rows, seed=0):
+    g = np.zeros(shape, "f4")
+    g[rows] = np.random.RandomState(seed).rand(len(rows), *shape[1:])
+    return sparse.row_sparse_array(g)
+
+
+def test_sparse_sgd_lazy_update():
+    w0 = np.ones((6, 3), "f4")
+    w = nd.array(w0.copy())
+    mom = nd.zeros((6, 3))
+    g = _rsp_grad((6, 3), [1, 4])
+    opt = mx.optimizer.SGD(learning_rate=0.5, momentum=0.9)
+    opt.update(0, w, g, mom)
+    wn = w.asnumpy()
+    # untouched rows identical; touched rows moved
+    np.testing.assert_array_equal(wn[[0, 2, 3, 5]], w0[[0, 2, 3, 5]])
+    assert not np.allclose(wn[[1, 4]], w0[[1, 4]])
+    # momentum of untouched rows stays zero (lazy update!)
+    mn = mom.asnumpy()
+    np.testing.assert_array_equal(mn[[0, 2, 3, 5]], 0)
+    assert np.abs(mn[[1, 4]]).sum() > 0
+
+
+def test_sparse_adam_matches_dense_on_touched_rows():
+    shape = (5, 2)
+    rows = [0, 3]
+    w_s = nd.array(np.ones(shape, "f4"))
+    w_d = nd.array(np.ones(shape, "f4"))
+    gd = np.zeros(shape, "f4")
+    gd[rows] = 0.5
+    opt_s = mx.optimizer.Adam(learning_rate=0.1)
+    opt_d = mx.optimizer.Adam(learning_rate=0.1)
+    st_s = opt_s.create_state(0, w_s)
+    st_d = opt_d.create_state(0, w_d)
+    opt_s.update(0, w_s, sparse.row_sparse_array(gd), st_s)
+    opt_d.update(0, w_d, nd.array(gd), st_d)
+    # touched rows agree with the dense update
+    np.testing.assert_allclose(w_s.asnumpy()[rows], w_d.asnumpy()[rows],
+                               rtol=1e-5, atol=1e-6)
+    # untouched rows agree with init (dense adam moves them only via eps)
+    np.testing.assert_array_equal(w_s.asnumpy()[[1, 2, 4]],
+                                  np.ones(shape, "f4")[[1, 2, 4]])
+
+
+def test_sparse_adagrad_and_ftrl_update_touched_only():
+    for name in ("adagrad", "ftrl"):
+        opt = mx.optimizer.create(name, learning_rate=0.1)
+        w0 = np.ones((6, 2), "f4")
+        w = nd.array(w0.copy())
+        st = opt.create_state(0, w)
+        opt.update(0, w, _rsp_grad((6, 2), [2, 5]), st)
+        wn = w.asnumpy()
+        np.testing.assert_array_equal(wn[[0, 1, 3, 4]], w0[[0, 1, 3, 4]])
+        assert not np.allclose(wn[[2, 5]], w0[[2, 5]])
+
+
+# ---------------------------------------------------------------------------
+# kvstore row_sparse
+# ---------------------------------------------------------------------------
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = np.arange(12, dtype="f4").reshape(6, 2)
+    kv.init("emb", nd.array(w))
+    out = sparse.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1.0, 4.0]))
+    assert out.num_rows == 2
+    np.testing.assert_array_equal(out.indices.asnumpy(), [1, 4])
+    np.testing.assert_array_equal(out.data.asnumpy(), w[[1, 4]])
+
+
+def test_kvstore_sparse_push_with_optimizer():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.array(np.ones((6, 2), "f4")))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    g = _rsp_grad((6, 2), [0, 2])
+    kv.push("w", g)
+    out = nd.zeros((6, 2))
+    kv.pull("w", out=out)
+    wn = out.asnumpy()
+    np.testing.assert_array_equal(wn[[1, 3, 4, 5]], 1.0)
+    assert not np.allclose(wn[[0, 2]], 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Embedding sparse_grad end-to-end
+# ---------------------------------------------------------------------------
+@with_seed()
+def test_embedding_sparse_grad_training():
+    from mxnet_tpu import autograd as ag
+
+    net = mx.gluon.nn.Embedding(20, 4, sparse_grad=True)
+    net.initialize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.5})
+    x = nd.array(np.array([[1, 3], [3, 7]], "f4"))
+    w_before = net.weight.data().asnumpy().copy()
+    with ag.record():
+        out = net(x)
+        loss = (out * out).sum()
+    loss.backward()
+    g = net.weight.grad()
+    assert g.stype == "row_sparse"
+    touched = set(g.indices.asnumpy().tolist())
+    assert touched == {1, 3, 7}
+    trainer.step(1)
+    w_after = net.weight.data().asnumpy()
+    untouched = [i for i in range(20) if i not in touched]
+    np.testing.assert_array_equal(w_after[untouched], w_before[untouched])
+    assert not np.allclose(w_after[sorted(touched)],
+                           w_before[sorted(touched)])
+
+
+@with_seed()
+def test_wide_deep_trains():
+    from mxnet_tpu import autograd as ag
+    from mxnet_tpu.gluon.model_zoo import wide_deep
+
+    net = wide_deep(wide_vocab=50, deep_vocab=30, embed_dim=4,
+                    hidden=(8,), classes=2)
+    net.initialize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "adagrad",
+                               {"learning_rate": 0.1})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    wide_x = nd.array(rng.randint(0, 50, (8, 5)).astype("f4"))
+    deep_x = nd.array(rng.randint(0, 30, (8, 3)).astype("f4"))
+    y = nd.array(rng.randint(0, 2, (8,)).astype("f4"))
+    losses = []
+    for _ in range(5):
+        with ag.record():
+            out = net(wide_x, deep_x)
+            loss = loss_fn(out, y).mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
