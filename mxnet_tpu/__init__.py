@@ -18,6 +18,7 @@ from . import base
 from .base import MXNetError
 from . import context
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus
+from . import operator  # registers the 'Custom' op before nd codegen
 from . import ndarray
 from . import ndarray as nd
 from .ndarray.ndarray import NDArray
@@ -47,6 +48,10 @@ from .model import save_checkpoint, load_checkpoint
 from . import parallel
 from . import profiler
 from . import monitor
+from . import image
+from . import config
+from . import amp
+from . import contrib
 
 __all__ = [
     "nd", "ndarray", "autograd", "random", "context", "Context", "cpu",
@@ -55,4 +60,5 @@ __all__ = [
     "lr_scheduler", "callback", "recordio", "io", "parallel", "symbol",
     "sym", "Symbol", "module", "mod", "Module", "BucketingModule", "model",
     "save_checkpoint", "load_checkpoint", "profiler", "monitor",
+    "operator", "image", "config", "amp", "contrib",
 ]

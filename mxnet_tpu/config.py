@@ -1,0 +1,121 @@
+"""Runtime configuration — the ``MXT_*`` env-var tier (SURVEY §5 config
+tier 2; ref: docs/faq/env_var.md — ~80 MXNET_* vars read via dmlc::GetEnv
+at use sites. Here every variable is DECLARED in one registry with type,
+default, and doc, read via :func:`get`).
+
+Variables whose reference meaning is owned by XLA/JAX (engine thread
+counts, GPU memory pool knobs, exec bulking) have no analog — the XLA
+runtime owns scheduling and memory. What remains meaningful on TPU is
+declared below; ``describe()`` prints the table (the env_var.md analog).
+"""
+from __future__ import annotations
+
+import os
+from collections import namedtuple
+
+from .base import MXNetError
+
+__all__ = ["get", "set_default", "describe", "variables", "naive_engine"]
+
+_Var = namedtuple("_Var", ["name", "type", "default", "doc"])
+
+_REGISTRY = {}
+
+
+def _declare(name, typ, default, doc):
+    _REGISTRY[name] = _Var(name, typ, default, doc)
+
+
+_declare("MXT_TEST_SEED", int, None,
+         "Seed forced into @with_seed tests for exact repro "
+         "(ref: MXNET_TEST_SEED).")
+_declare("MXT_PROFILER_AUTOSTART", bool, False,
+         "Start a jax.profiler trace at import "
+         "(ref: MXNET_PROFILER_AUTOSTART).")
+_declare("MXT_ENGINE_TYPE", str, "XLA",
+         "'NaiveEngine' disables jit for op-by-op debugging "
+         "(ref: MXNET_ENGINE_TYPE=NaiveEngine).")
+_declare("MXT_DEFAULT_DTYPE", str, "float32",
+         "Default dtype for creation ops without an explicit dtype.")
+_declare("MXT_SAFE_ACCUMULATION", bool, True,
+         "Accumulate bf16/f16 reductions in float32 "
+         "(ref: MXNET_SAFE_ACCUMULATION).")
+_declare("MXT_TEST_TPU", bool, False,
+         "Enable the hardware test lane (pytest -m tpu).")
+_declare("MXT_COORDINATOR", str, None,
+         "jax.distributed coordinator address, set by tools/launch.py "
+         "(ref: DMLC_PS_ROOT_URI/PORT).")
+_declare("MXT_NUM_WORKERS", int, 1,
+         "World size under tools/launch.py (ref: DMLC_NUM_WORKER).")
+_declare("MXT_WORKER_ID", int, 0,
+         "This process's rank under tools/launch.py "
+         "(ref: DMLC_WORKER_ID).")
+_declare("MXT_KVSTORE_BIGARRAY_BOUND", int, 1000000,
+         "Size above which dist pushes chunk the array "
+         "(ref: MXNET_KVSTORE_BIGARRAY_BOUND; advisory — XLA collectives "
+         "handle chunking internally).")
+
+_overrides = {}
+
+
+def variables():
+    return dict(_REGISTRY)
+
+
+def _coerce(var, raw):
+    if raw is None:
+        return None
+    if var.type is bool:
+        return str(raw).lower() in ("1", "true", "yes", "on")
+    try:
+        return var.type(raw)
+    except (TypeError, ValueError) as e:
+        raise MXNetError("config %s expects %s, got %r"
+                         % (var.name, var.type.__name__, raw)) from e
+
+
+def get(name):
+    """Typed value: env var > set_default override > declared default."""
+    if name not in _REGISTRY:
+        raise MXNetError("unknown config variable %r (declare it in "
+                         "mxnet_tpu/config.py)" % (name,))
+    var = _REGISTRY[name]
+    raw = os.environ.get(name)
+    if raw is not None:
+        return _coerce(var, raw)
+    if name in _overrides:
+        return _overrides[name]
+    return var.default
+
+
+def set_default(name, value):
+    """Process-level override (below env in precedence)."""
+    if name not in _REGISTRY:
+        raise MXNetError("unknown config variable %r" % (name,))
+    _overrides[name] = _coerce(_REGISTRY[name], value)
+
+
+def describe():
+    """Human-readable table of every variable (env_var.md analog)."""
+    lines = ["%-32s %-8s %-12s %s" % ("Variable", "Type", "Current",
+                                      "Description")]
+    for name in sorted(_REGISTRY):
+        var = _REGISTRY[name]
+        lines.append("%-32s %-8s %-12s %s"
+                     % (name, var.type.__name__, get(name), var.doc))
+    return "\n".join(lines)
+
+
+class naive_engine:
+    """Context manager: run ops one-by-one without jit — the debugging
+    analog of MXNET_ENGINE_TYPE=NaiveEngine (SURVEY §5 race/debug
+    posture)."""
+
+    def __enter__(self):
+        import jax
+        self._ctx = jax.disable_jit()
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)

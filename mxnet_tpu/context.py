@@ -87,16 +87,20 @@ class Context:
     # -- JAX resolution ----------------------------------------------------
     @property
     def jax_device(self):
-        """Resolve this context to a concrete jax.Device."""
+        """Resolve this context to a concrete jax.Device. Under
+        jax.distributed, contexts index this process's LOCAL devices
+        (ref: a Context is per-worker; global placement is the mesh's
+        job) — jax.devices() lists remote devices a process cannot
+        address directly."""
         import jax
 
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
             try:
-                devs = jax.devices("cpu")
+                devs = jax.local_devices(backend="cpu")
             except RuntimeError:
-                devs = jax.devices()
+                devs = jax.local_devices()
         else:  # tpu / gpu → default accelerator backend
-            devs = jax.devices()
+            devs = jax.local_devices()
         if self.device_id >= len(devs):
             raise MXNetError(
                 "context %s out of range: only %d %s device(s) visible"

@@ -1,0 +1,3 @@
+"""contrib namespace (ref: python/mxnet/contrib/__init__.py — the 1.x home
+of amp; exposed here as both mx.amp and mx.contrib.amp)."""
+from .. import amp  # noqa: F401

@@ -201,8 +201,13 @@ class Parameter:
             # embedding backward is a dense scatter-add; the row_sparse
             # view materializes here, at the framework boundary, so
             # Trainer/KVStore push and the optimizer update touch only
-            # the rows this batch hit (ref: Embedding sparse_grad +
-            # _sparse_*_update lazy semantics)
+            # rows with nonzero gradient (ref: Embedding sparse_grad +
+            # _sparse_*_update lazy semantics).
+            # DOCUMENTED DEVIATION: rows are recovered from the dense
+            # buffer's nonzero rows, not from the batch's index list —
+            # a batch-touched row whose gradient cancels to exactly 0
+            # is treated as untouched (skipping its wd/momentum decay),
+            # where the reference would include it.
             from ..sparse import row_sparse_array
             return row_sparse_array(d._grad)
         return d._grad

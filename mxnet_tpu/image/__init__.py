@@ -1,0 +1,9 @@
+"""``mx.image`` — pure-python image pipeline
+(ref: python/mxnet/image/image.py)."""
+from .image import (  # noqa: F401
+    imdecode, imread, imresize, resize_short, fixed_crop, random_crop,
+    center_crop, color_normalize, scale_down,
+    Augmenter, ResizeAug, ForceResizeAug, RandomCropAug, CenterCropAug,
+    HorizontalFlipAug, CastAug, ColorNormalizeAug, BrightnessJitterAug,
+    ContrastJitterAug, SaturationJitterAug, CreateAugmenter, ImageIter,
+)

@@ -1,0 +1,385 @@
+"""Pure-python image loading + augmentation (ref:
+python/mxnet/image/image.py — the python alternative to the C++
+ImageRecordIter; same function/class names and HWC uint8/float semantics).
+
+Decode runs through PIL (the reference wraps OpenCV via the imdecode op);
+augmenters operate on HWC numpy/NDArray, and ImageIter batches to NCHW —
+device transfer happens once per batch, which is the TPU-friendly split
+(host-side per-image work, one device_put per batch).
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import random as _pyrandom
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..io.io import DataBatch, DataDesc, DataIter
+
+
+def _to_np(img):
+    if isinstance(img, NDArray):
+        return img.asnumpy()
+    return np.asarray(img)
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    """Decode an encoded image buffer to an HWC uint8 NDArray
+    (ref: image.imdecode over the cv::imdecode op)."""
+    from PIL import Image
+
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    img = Image.open(_io.BytesIO(bytes(buf)))
+    img = img.convert("RGB" if flag else "L")
+    arr = np.asarray(img, dtype=np.uint8)
+    if not to_rgb and flag:
+        arr = arr[..., ::-1]  # BGR like OpenCV default
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return NDArray(arr)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=2):
+    """Resize HWC image to (h, w) (ref: image.imresize)."""
+    from PIL import Image
+
+    arr = _to_np(src)
+    pil = Image.fromarray(arr.astype(np.uint8).squeeze()
+                          if arr.shape[-1] == 1 else arr.astype(np.uint8))
+    resample = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
+                3: Image.NEAREST, 4: Image.LANCZOS}.get(interp,
+                                                        Image.BILINEAR)
+    out = np.asarray(pil.resize((w, h), resample), dtype=np.uint8)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return NDArray(out)
+
+
+def scale_down(src_size, size):
+    """Shrink (w, h) to fit inside src_size keeping aspect
+    (ref: image.scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge is ``size`` (ref: image.resize_short)."""
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(arr, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    arr = _to_np(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(arr, size[0], size[1], interp)
+    return NDArray(arr)
+
+
+def random_crop(src, size, interp=2):
+    """Random crop to (w, h); returns (img, (x0, y0, w, h))
+    (ref: image.random_crop)."""
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(arr, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(arr, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    arr = _to_np(src).astype(np.float32)
+    arr = arr - np.asarray(mean, np.float32)
+    if std is not None:
+        arr = arr / np.asarray(std, np.float32)
+    return NDArray(arr)
+
+
+# ---------------------------------------------------------------------------
+# augmenters (ref: image.py Augmenter classes; each is callable HWC->HWC)
+# ---------------------------------------------------------------------------
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return NDArray(_to_np(src)[:, ::-1].copy())
+        return src if isinstance(src, NDArray) else NDArray(_to_np(src))
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return NDArray(_to_np(src).astype(self.typ))
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean, self.std = mean, std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return NDArray(_to_np(src).astype(np.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        arr = _to_np(src).astype(np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (arr * self._coef).sum(-1).mean()
+        return NDArray(arr * alpha + gray * (1 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        arr = _to_np(src).astype(np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (arr * self._coef).sum(-1, keepdims=True)
+        return NDArray(arr * alpha + gray * (1 - alpha))
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """Standard augmenter list builder (ref: image.CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness:
+        auglist.append(BrightnessJitterAug(brightness))
+    if contrast:
+        auglist.append(ContrastJitterAug(contrast))
+    if saturation:
+        auglist.append(SaturationJitterAug(saturation))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+# ---------------------------------------------------------------------------
+# ImageIter (ref: image.py — ImageIter; .rec or .lst/imglist driven)
+# ---------------------------------------------------------------------------
+class ImageIter(DataIter):
+    """Image iterator with augmenters, reading an imglist / .lst file /
+    indexed .rec (ref: image.ImageIter). Yields NCHW float batches."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imgidx=None, path_imglist=None,
+                 path_root=None, shuffle=False, aug_list=None,
+                 imglist=None, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        if len(data_shape) != 3 or data_shape[0] != 3:
+            raise MXNetError("data_shape must be (3, H, W)")
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.aug_list = CreateAugmenter(data_shape, **kwargs) \
+            if aug_list is None else aug_list
+        self._data_name = data_name
+        self._label_name = label_name
+
+        self._rec = None
+        self.imglist = {}
+        if path_imgrec is not None:
+            from ..recordio import MXIndexedRecordIO
+            idx_path = path_imgidx or \
+                os.path.splitext(path_imgrec)[0] + ".idx"
+            self._rec = MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            self.seq = list(self._rec.keys)
+        else:
+            if imglist is None:
+                if path_imglist is None:
+                    raise MXNetError(
+                        "ImageIter needs path_imgrec, path_imglist, or "
+                        "imglist")
+                imglist = []
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        # .lst format: index \t label... \t relpath
+                        labels = [float(x) for x in parts[1:-1]]
+                        imglist.append([labels if len(labels) > 1
+                                        else labels[0], parts[-1]])
+            for i, (label, fname) in enumerate(imglist):
+                self.imglist[i] = (np.atleast_1d(
+                    np.asarray(label, np.float32)), fname)
+            self.seq = list(self.imglist)
+            self.path_root = path_root or "."
+        self.cursor = 0
+        if self.shuffle:
+            _pyrandom.shuffle(self.seq)
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc(self._label_name, shape)]
+
+    def reset(self):
+        self.cursor = 0
+        if self.shuffle:
+            _pyrandom.shuffle(self.seq)
+
+    def next_sample(self):
+        if self.cursor >= len(self.seq):
+            raise StopIteration
+        key = self.seq[self.cursor]
+        self.cursor += 1
+        if self._rec is not None:
+            from ..recordio import unpack
+            header, img_bytes = unpack(self._rec.read_idx(key))
+            label = np.atleast_1d(np.asarray(header.label, np.float32))
+            return label, img_bytes
+        label, fname = self.imglist[key]
+        with open(os.path.join(self.path_root, fname), "rb") as f:
+            return label, f.read()
+
+    def next(self):
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, h, w, c), np.float32)
+        labels = np.zeros((self.batch_size, self.label_width), np.float32)
+        i = 0
+        pad = 0
+        while i < self.batch_size:
+            try:
+                label, img_bytes = self.next_sample()
+            except StopIteration:
+                if i == 0:
+                    raise
+                pad = self.batch_size - i
+                break
+            img = imdecode(img_bytes)
+            for aug in self.aug_list:
+                img = aug(img)
+            arr = _to_np(img)
+            if arr.shape[:2] != (h, w):
+                arr = _to_np(imresize(arr, w, h))
+            data[i] = arr.astype(np.float32)
+            labels[i] = label[:self.label_width]
+            i += 1
+        batch_data = NDArray(np.transpose(data, (0, 3, 1, 2)))
+        lab = labels[:, 0] if self.label_width == 1 else labels
+        return DataBatch(data=[batch_data], label=[NDArray(lab)], pad=pad)

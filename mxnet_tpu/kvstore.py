@@ -31,11 +31,49 @@ from .ndarray.ndarray import NDArray
 from .ndarray import ndarray as _nd
 from . import optimizer as opt
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "GradientCompression", "create"]
 
 
 def _key_str(key):
     return str(key)
+
+
+class GradientCompression:
+    """2-bit quantization with error feedback (ref:
+    src/kvstore/gradient_compression.{cc,h} — GradientCompression).
+
+    Each element of (gradient + residual) quantizes to one of
+    {-threshold, 0, +threshold}; the quantization error stays in the
+    per-key residual and is added to the next push, so small gradients
+    accumulate until they cross the threshold instead of vanishing."""
+
+    def __init__(self, threshold=0.5):
+        if threshold <= 0:
+            raise MXNetError("compression threshold must be positive")
+        self.threshold = float(threshold)
+        self.residual = {}
+
+    def compress(self, key, grad):
+        import jax.numpy as jnp
+        from .sparse import BaseSparseNDArray
+
+        if isinstance(grad, BaseSparseNDArray):
+            # the reference's 2-bit kernel is dense-only (row_sparse push
+            # already sends only touched rows); error-feedback residuals
+            # also cannot align across varying per-step index sets
+            raise MXNetError(
+                "gradient compression does not support %s gradients "
+                "(matches reference: 2bit is dense-only)" % grad.stype)
+        data = grad.data
+        r = self.residual.get(key)
+        if r is not None:
+            data = data + r
+        t = self.threshold
+        q = jnp.where(data >= t, jnp.full_like(data, t),
+                      jnp.where(data <= -t, jnp.full_like(data, -t),
+                                jnp.zeros_like(data)))
+        self.residual[key] = data - q
+        return NDArray(q)
 
 
 class KVStore:
@@ -46,7 +84,7 @@ class KVStore:
         self._store = {}           # key -> NDArray (weight if updater else merged)
         self._updater = None
         self._optimizer = None
-        self._compression_params = None
+        self._compression = None
         self._str_key_dict = {}
 
     # -- identity ----------------------------------------------------------
@@ -130,6 +168,9 @@ class KVStore:
         for k, v in zip(keys, values):
             merged = self._merge(v)
             if self._type.startswith("dist"):
+                # compress this worker's contribution before it crosses
+                # the network (ref: push-side compression in kvstore_dist)
+                merged = self._maybe_compress(k, merged)
                 merged = self._dist_reduce(merged)
             if k not in self._store:
                 self._store[k] = merged.copy()
@@ -143,21 +184,35 @@ class KVStore:
             else:
                 # replace semantics (ref: CopyFromTo(merged, &local)) — a
                 # row_sparse merged value zero-fills the dense store's
-                # untouched rows via RowSparseNDArray.copyto's densify
-                merged.copyto(self._store[k])
+                # untouched rows via RowSparseNDArray.copyto's densify;
+                # a dense push into a sparse-stored key casts storage
+                from .sparse import BaseSparseNDArray, cast_storage
+                tgt = self._store[k]
+                if isinstance(tgt, BaseSparseNDArray) and \
+                        not isinstance(merged, BaseSparseNDArray):
+                    self._store[k] = cast_storage(merged, tgt.stype)
+                else:
+                    merged.copyto(tgt)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
-        del priority, ignore_sparse
+        """ref: KVStore::Pull — with ignore_sparse (the default), sparse
+        outs are skipped and must use row_sparse_pull instead."""
+        del priority
+        from .sparse import BaseSparseNDArray, cast_storage
+
         keys, outs = self._flatten(key, out)
         for k, o in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %s has not been initialized" % (k,))
             src = self._store[k]
-            if isinstance(o, (list, tuple)):
-                for oo in o:
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for oo in targets:
+                if isinstance(oo, BaseSparseNDArray):
+                    if ignore_sparse:
+                        continue
+                    cast_storage(src, oo.stype).copyto(oo)
+                else:
                     src.copyto(oo)
-            else:
-                src.copyto(o)
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)
@@ -194,7 +249,30 @@ class KVStore:
         self._updater = opt.get_updater(self._optimizer)
 
     def set_gradient_compression(self, compression_params):
-        self._compression_params = dict(compression_params)
+        """2-bit gradient compression with error-feedback residual
+        (ref: src/kvstore/gradient_compression.cc — applied on push in
+        dist mode; the residual keeps what quantization dropped so it is
+        re-sent on later pushes)."""
+        params = dict(compression_params)
+        ctype = params.pop("type", None)
+        if ctype != "2bit":
+            raise MXNetError(
+                "gradient compression type %r is not supported (the "
+                "reference implements '2bit' only)" % (ctype,))
+        if not self._type.startswith("dist"):
+            raise MXNetError(
+                "gradient compression requires a dist kvstore (ref: "
+                "kvstore_dist only; local comm is in-process)")
+        threshold = float(params.pop("threshold", 0.5))
+        if params:
+            raise MXNetError("unknown compression params %s"
+                             % sorted(params))
+        self._compression = GradientCompression(threshold)
+
+    def _maybe_compress(self, key, merged):
+        if self._compression is None:
+            return merged
+        return self._compression.compress(key, merged)
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:

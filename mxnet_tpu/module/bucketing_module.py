@@ -112,6 +112,9 @@ class BucketingModule(BaseModule):
                     shared_module=None, grad_req=grad_req)
         self._curr_module = module
         self._curr_bucket_key = self._default_bucket_key
+        if self._monitor is not None:
+            # a monitor installed before bind() follows the default bucket
+            module.install_monitor(self._monitor, self._monitor_all)
         self._buckets[self._default_bucket_key] = module
         self.binded = True
         self.for_training = for_training
@@ -189,6 +192,8 @@ class BucketingModule(BaseModule):
         self._curr_module.update_metric(eval_metric, labels, pre_sliced)
 
     def install_monitor(self, monitor, monitor_all=False):
+        """May be called before or after bind(); the monitor follows every
+        bucket, including ones created later by switch_bucket."""
         self._monitor = monitor
         self._monitor_all = monitor_all
         for mod in self._buckets.values():

@@ -1,0 +1,194 @@
+"""Custom operators — Python-defined ops usable from nd / Gluon / Symbol
+(ref: python/mxnet/operator.py — CustomOp/CustomOpProp/register;
+src/operator/custom/custom.cc ran the Python body on a dedicated thread
+pool, async under the engine).
+
+TPU-native mechanism: the Python forward/backward run on the HOST through
+``jax.pure_callback``, so a Custom op composes with jit/grad — XLA treats
+it as an opaque host call with declared output shapes (the shape contract
+comes from ``CustomOpProp.infer_shape``, exactly like the reference).
+Gradients route through a ``jax.custom_vjp`` whose backward is another
+host callback into ``CustomOp.backward``.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop"]
+
+_PROPS = {}
+
+
+class CustomOp:
+    """Base class for the Python operator body (ref: operator.py —
+    CustomOp). Subclass and implement forward/backward; use ``assign`` to
+    honor the req mode."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Write ``src`` into ``dst`` honoring req ('write'/'add'/'null');
+        dst is a host numpy buffer here."""
+        if req in ("write", "inplace"):
+            dst[...] = src
+        elif req == "add":
+            dst[...] += src
+        elif req == "null":
+            pass
+        else:
+            raise MXNetError("unknown req %r" % (req,))
+
+
+class CustomOpProp:
+    """Shape/type contract + factory (ref: operator.py — CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = bool(need_top_grad)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp under ``op_type`` for
+    ``nd.Custom(..., op_type=reg_name)`` (ref: mx.operator.register)."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _PROPS[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_prop(op_type, kwargs=None):
+    if op_type not in _PROPS:
+        raise MXNetError(
+            "custom op %r is not registered (use "
+            "@mx.operator.register(%r))" % (op_type, op_type))
+    # reference passes ctor kwargs as strings
+    return _PROPS[op_type](**{k: str(v) for k, v in (kwargs or {}).items()})
+
+
+# ---------------------------------------------------------------------------
+# the jittable bridge, registered as the 'Custom' op in the registry
+# ---------------------------------------------------------------------------
+def _make_custom_fn(prop, op_type):
+    """Build the jax-side function for one (prop, input-signature) call."""
+    import jax
+    import jax.numpy as jnp
+
+    n_out = len(prop.list_outputs())
+
+    def _infer(in_avals):
+        in_shapes = [tuple(a.shape) for a in in_avals]
+        shapes = prop.infer_shape([list(s) for s in in_shapes])
+        _, out_shapes, _ = shapes
+        types = prop.infer_type([a.dtype for a in in_avals])
+        _, out_types, _ = types
+        return [jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
+                for s, t in zip(out_shapes, out_types)]
+
+    @functools.cache
+    def _op_instance():
+        return prop.create_operator(None, None, None)
+
+    def _host_forward(is_train, *arrays):
+        op = _op_instance()
+        in_data = [np.asarray(a) for a in arrays]
+        out_structs = _infer(arrays)
+        out_data = [np.zeros(s.shape, s.dtype) for s in out_structs]
+        op.forward(bool(is_train), ["write"] * len(out_data), in_data,
+                   out_data, [])
+        return tuple(out_data)
+
+    def _host_backward(n_in, *arrays):
+        op = _op_instance()
+        out_grad = [np.asarray(a) for a in arrays[:n_out]]
+        in_data = [np.asarray(a) for a in arrays[n_out:n_out + n_in]]
+        out_data = [np.asarray(a) for a in arrays[n_out + n_in:]]
+        in_grad = [np.zeros_like(a) for a in in_data]
+        op.backward(["write"] * len(in_grad), out_grad, in_data, out_data,
+                    in_grad, [])
+        return tuple(in_grad)
+
+    @jax.custom_vjp
+    def custom_apply(*inputs):
+        outs = tuple(jax.pure_callback(
+            functools.partial(_host_forward, False), _infer(inputs),
+            *inputs))
+        return outs if n_out > 1 else outs[0]
+
+    def custom_fwd(*inputs):
+        outs = tuple(jax.pure_callback(
+            functools.partial(_host_forward, True), _infer(inputs),
+            *inputs))
+        result = outs if n_out > 1 else outs[0]
+        return result, (inputs, outs)
+
+    def custom_bwd(res, cts):
+        inputs, outs = res
+        cts = cts if isinstance(cts, tuple) else (cts,)
+        in_structs = [jax.ShapeDtypeStruct(i.shape, i.dtype)
+                      for i in inputs]
+        grads = jax.pure_callback(
+            functools.partial(_host_backward, len(inputs)), in_structs,
+            *(tuple(cts) + tuple(inputs) + tuple(outs)))
+        return tuple(grads)
+
+    custom_apply.defvjp(custom_fwd, custom_bwd)
+    custom_apply.__name__ = "Custom_%s" % op_type
+    return custom_apply
+
+
+_FN_CACHE = {}
+
+
+def custom(*inputs, op_type=None, **kwargs):
+    """The registered ``Custom`` op body (ref: nd.Custom)."""
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    cache_key = (op_type, tuple(sorted(kwargs.items())))
+    fn = _FN_CACHE.get(cache_key)
+    if fn is None:
+        prop = get_prop(op_type, kwargs)
+        fn = _make_custom_fn(prop, op_type)
+        _FN_CACHE[cache_key] = fn
+    return fn(*inputs)
+
+
+# register into the central op registry so nd.Custom / sym.Custom exist
+from .ops.registry import register as _register_op  # noqa: E402
+
+
+@_register_op("Custom", aliases=("_custom",))
+def Custom(*inputs, op_type=None, **kwargs):  # noqa: N802 — reference name
+    return custom(*inputs, op_type=op_type, **kwargs)

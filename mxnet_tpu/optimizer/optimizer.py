@@ -216,6 +216,10 @@ class SGD(Optimizer):
         lr, wd = _common(self, index)
         kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
                   clip_gradient=self.clip_gradient)
+        if _is_row_sparse(grad) and not self.lazy_update:
+            # std_update semantics (ref: sgd lazy_update=False): ALL rows
+            # see wd/momentum decay every step — densify and fall through
+            grad = grad.todense()
         if _is_row_sparse(grad):
             # lazy-update semantics: only touched rows (incl. their
             # momentum) change — ref: _sparse_sgd_(mom_)update
@@ -301,6 +305,8 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         lr *= math.sqrt(coef2) / coef1
         mean, var = state
+        if _is_row_sparse(grad) and not self.lazy_update:
+            grad = grad.todense()  # std_update: decay every row's m/v
         if _is_row_sparse(grad):
             from .. import sparse as _sp
             _sp.sparse_adam_update(

@@ -26,7 +26,15 @@ def init_distributed(coordinator_address=None, num_processes=None,
     """Multi-host init (ref: the DMLC_PS_ROOT_URI/DMLC_ROLE rendezvous in
     ps-lite — here a single coordinator handshake).
 
-    No-arg form reads the standard JAX env (or cloud TPU metadata)."""
+    No-arg form reads the MXT_* env set by tools/launch.py, falling back
+    to the standard JAX env (or cloud TPU metadata)."""
+    import os
+
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MXT_COORDINATOR")
+        if coordinator_address is not None:
+            num_processes = int(os.environ["MXT_NUM_WORKERS"])
+            process_id = int(os.environ["MXT_WORKER_ID"])
     if coordinator_address is None:
         jax.distributed.initialize()
     else:

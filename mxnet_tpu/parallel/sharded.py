@@ -257,7 +257,20 @@ def allreduce_across_processes(value):
         return value
     from jax.experimental import multihost_utils
 
+    sparse_stype = None
+    if getattr(value, "stype", "default") != "default":
+        # workers' index sets differ, so positional allgather of value
+        # blocks would sum misaligned rows — reduce densely, re-sparsify
+        sparse_stype = value.stype
+        value = value.tostype("default")
     data = value.data if isinstance(value, NDArray) else value
-    summed = multihost_utils.process_allgather(data)
-    out = jnp.sum(summed, axis=0)
+    gathered = multihost_utils.process_allgather(data)
+    # materialize on host: the allgather result is a GLOBAL (replicated)
+    # array, and letting it flow into single-device NDArray ops trips
+    # "Cannot reshard an input that is not fully addressable" — a host
+    # copy re-enters as a plain process-local array
+    out = jnp.asarray(np.asarray(gathered).sum(axis=0))
+    if sparse_stype is not None:
+        from ..sparse import cast_storage
+        return cast_storage(NDArray(out), sparse_stype)
     return NDArray(out) if isinstance(value, NDArray) else out

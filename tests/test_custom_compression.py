@@ -1,0 +1,131 @@
+"""CustomOp escape hatch + gradient compression tests (models
+tests/python/unittest/test_operator.py::test_custom_op and the
+compression coverage in tests/nightly/dist_sync_kvstore.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.base import MXNetError
+
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], 1.0 / (1.0 + np.exp(-in_data[0])))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1.0 - y))
+
+
+@mx.operator.register("test_sigmoid")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _Sigmoid()
+
+
+class _SplitHalf(mx.operator.CustomOp):
+    """Two-output custom op: splits the last axis in half."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]
+        h = x.shape[-1] // 2
+        self.assign(out_data[0], req[0], x[..., :h])
+        self.assign(out_data[1], req[1], x[..., h:])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    np.concatenate([out_grad[0], out_grad[1]], axis=-1))
+
+
+@mx.operator.register("test_split_half")
+class _SplitHalfProp(mx.operator.CustomOpProp):
+    def list_outputs(self):
+        return ["left", "right"]
+
+    def infer_shape(self, in_shape):
+        s = list(in_shape[0])
+        half = s[:-1] + [s[-1] // 2]
+        return in_shape, [half, half], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _SplitHalf()
+
+
+def test_custom_op_forward_backward():
+    x = nd.array(np.linspace(-3, 3, 24).astype("f4").reshape(4, 6))
+    x.attach_grad()
+    with ag.record():
+        y = nd.Custom(x, op_type="test_sigmoid")
+        loss = (y * y).sum()
+    loss.backward()
+    ref = 1.0 / (1.0 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), ref, rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               2 * ref * ref * (1 - ref), rtol=1e-5)
+
+
+def test_custom_op_under_jit():
+    """pure_callback keeps the op jit-compatible (the hybridize path)."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a: mx.operator.custom(a, op_type="test_sigmoid"))
+    xn = np.linspace(-1, 1, 8).astype("f4")
+    np.testing.assert_allclose(np.asarray(f(jnp.asarray(xn))),
+                               1 / (1 + np.exp(-xn)), rtol=1e-6)
+
+
+def test_custom_op_multi_output():
+    x = nd.array(np.arange(12, dtype="f4").reshape(2, 6))
+    x.attach_grad()
+    with ag.record():
+        left, right = nd.Custom(x, op_type="test_split_half")
+        loss = left.sum() + (2 * right).sum()
+    loss.backward()
+    np.testing.assert_array_equal(left.asnumpy(), x.asnumpy()[:, :3])
+    np.testing.assert_array_equal(right.asnumpy(), x.asnumpy()[:, 3:])
+    g = x.grad.asnumpy()
+    np.testing.assert_array_equal(g[:, :3], 1.0)
+    np.testing.assert_array_equal(g[:, 3:], 2.0)
+
+
+def test_custom_op_unregistered_raises():
+    with pytest.raises(MXNetError):
+        nd.Custom(nd.ones((2, 2)), op_type="nope_not_registered")
+
+
+# ---------------------------------------------------------------------------
+# gradient compression
+# ---------------------------------------------------------------------------
+def test_gradient_compression_quantize_and_residual():
+    from mxnet_tpu.kvstore import GradientCompression
+
+    gc = GradientCompression(threshold=0.5)
+    g = nd.array(np.array([0.7, -0.9, 0.2, -0.3], "f4"))
+    q1 = gc.compress("k", g).asnumpy()
+    np.testing.assert_allclose(q1, [0.5, -0.5, 0.0, 0.0])
+    # error feedback: 0.2 + 0.2 + 0.2 crosses 0.5 on the third push
+    small = nd.array(np.array([0.2, 0.0, 0.0, 0.0], "f4"))
+    q2 = gc.compress("k2", small).asnumpy()
+    q3 = gc.compress("k2", small).asnumpy()
+    q4 = gc.compress("k2", small).asnumpy()
+    assert q2[0] == 0.0 and q3[0] == 0.0 and q4[0] == 0.5
+    # residual after emission is 0.6 - 0.5 = 0.1
+    np.testing.assert_allclose(
+        np.asarray(gc.residual["k2"])[0], 0.1, atol=1e-6)
+
+
+def test_gradient_compression_requires_dist():
+    kv = mx.kv.create("local")
+    with pytest.raises(MXNetError):
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv2 = mx.kv.create("dist_sync")
+    with pytest.raises(MXNetError):
+        kv2.set_gradient_compression({"type": "1bit"})
+    kv2.set_gradient_compression({"type": "2bit", "threshold": 0.25})
+    assert kv2._compression.threshold == 0.25

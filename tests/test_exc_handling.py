@@ -1,0 +1,71 @@
+"""Async-error semantics (models tests/python/unittest/test_exc_handling.py
+— ops dispatch asynchronously; failures must surface at the sync points
+(asnumpy / wait_to_read / asscalar), never pass silently).
+
+The device-side failure is produced by a Custom op whose host callback
+raises — the same mechanism the reference tests with a throwing CustomOp.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+class _Raiser(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise ValueError("deliberate failure inside the operator")
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise ValueError("deliberate failure inside backward")
+
+
+@mx.operator.register("test_exc_raiser")
+class _RaiserProp(mx.operator.CustomOpProp):
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _Raiser()
+
+
+def test_error_surfaces_at_asnumpy():
+    with pytest.raises(Exception) as ei:
+        y = nd.Custom(nd.ones((2, 2)), op_type="test_exc_raiser")
+        y.asnumpy()  # the sync point — the error must surface by here
+    assert "deliberate failure" in str(ei.value)
+
+
+def test_error_surfaces_at_wait_to_read():
+    with pytest.raises(Exception) as ei:
+        y = nd.Custom(nd.ones((2, 2)), op_type="test_exc_raiser")
+        y.wait_to_read()
+    assert "deliberate failure" in str(ei.value)
+
+
+def test_error_surfaces_at_asscalar():
+    with pytest.raises(Exception) as ei:
+        y = nd.Custom(nd.ones((1,)), op_type="test_exc_raiser")
+        y.asscalar()
+    assert "deliberate failure" in str(ei.value)
+
+
+def test_error_does_not_poison_later_ops():
+    """After a failed computation, fresh ops keep working (the reference's
+    engine keeps scheduling after an op failure)."""
+    try:
+        nd.Custom(nd.ones((2, 2)), op_type="test_exc_raiser").asnumpy()
+    except Exception:
+        pass
+    a = nd.array(np.arange(4.0, dtype="f4"))
+    np.testing.assert_array_equal((a + 1).asnumpy(), [1, 2, 3, 4])
+
+
+def test_backward_error_surfaces():
+    from mxnet_tpu import autograd as ag
+
+    x = nd.ones((2, 2))
+    x.attach_grad()
+    with pytest.raises(Exception) as ei:
+        with ag.record():
+            y = nd.Custom(x, op_type="test_exc_raiser")
+        y.backward()
+        x.grad.asnumpy()
+    assert "deliberate failure" in str(ei.value)

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Cluster launcher (ref: tools/launch.py + dmlc-core tracker — which
+started a scheduler plus ssh/mpi/local worker+server processes with
+DMLC_* rendezvous env).
+
+TPU-native topology has no parameter servers: workers are SPMD peers that
+rendezvous through the JAX coordination service (`jax.distributed`), and
+gradients ride XLA collectives. So this launcher starts N *worker*
+processes with the coordinator env set; ``-s/--num-servers`` is accepted
+for command-line parity and ignored (documented reference deviation).
+
+Local mode (the ``--launcher local`` test pattern from
+tests/nightly/dist_sync_kvstore.py):
+
+    python tools/launch.py -n 4 --launcher local python my_train.py
+
+SSH mode reads ``-H hostfile`` (one host per line, first host also runs
+the coordinator) and launches one worker per host:
+
+    python tools/launch.py -n 4 --launcher ssh -H hosts python my_train.py
+
+Workers read MXT_COORDINATOR / MXT_NUM_WORKERS / MXT_WORKER_ID (set
+here) via ``mxnet_tpu.parallel.init_distributed()``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _worker_env(base, coordinator, n, i):
+    env = dict(base)
+    env["MXT_COORDINATOR"] = coordinator
+    env["MXT_NUM_WORKERS"] = str(n)
+    env["MXT_WORKER_ID"] = str(i)
+    # reference-compatible spellings, for scripts that read DMLC_*
+    env["DMLC_NUM_WORKER"] = str(n)
+    env["DMLC_WORKER_ID"] = str(i)
+    env["DMLC_ROLE"] = "worker"
+    return env
+
+
+def launch_local(n, command):
+    coordinator = "127.0.0.1:%d" % _free_port()
+    procs = []
+    for i in range(n):
+        procs.append(subprocess.Popen(
+            command, env=_worker_env(os.environ, coordinator, n, i)))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+def launch_ssh(n, hostfile, command):
+    with open(hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()
+                 and not h.startswith("#")]
+    if len(hosts) < n:
+        raise SystemExit("hostfile has %d hosts, need %d" % (len(hosts), n))
+    coordinator = "%s:%d" % (hosts[0], 9378)
+    procs = []
+    for i in range(n):
+        env = _worker_env({}, coordinator, n, i)
+        envs = " ".join("%s=%s" % kv for kv in env.items())
+        remote = "cd %s && %s %s" % (os.getcwd(), envs,
+                                     " ".join(command))
+        procs.append(subprocess.Popen(["ssh", "-o",
+                                       "StrictHostKeyChecking=no",
+                                       hosts[i], remote]))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference CLI parity; the TPU "
+                         "topology has no parameter servers (ignored)")
+    ap.add_argument("--launcher", choices=("local", "ssh"),
+                    default="local")
+    ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command to launch")
+    if args.launcher == "local":
+        return launch_local(args.num_workers, args.command)
+    if not args.hostfile:
+        ap.error("ssh launcher requires -H hostfile")
+    return launch_ssh(args.num_workers, args.hostfile, args.command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
