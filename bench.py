@@ -229,6 +229,63 @@ def bench_bert_mlm(platform, dtype):
     return tok_s, row
 
 
+def bench_lenet_mnist(platform, dtype):
+    """LeNet-5 on MNIST-shaped data via Gluon (BASELINE config 1)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu import parallel
+
+    small = platform == "cpu"
+    batch = int(os.environ.get("BENCH_LENET_BATCH", "32" if small
+                               else "256"))
+    iters = int(os.environ.get("BENCH_LENET_ITERS", "3" if small else "20"))
+    warmup = int(os.environ.get("BENCH_LENET_WARMUP", "1" if small
+                                else "3"))
+
+    mx.random.seed(0)
+    net = nn.HybridSequential(prefix="lenet_")
+    with net.name_scope():
+        net.add(nn.Conv2D(20, kernel_size=5, activation="tanh"),
+                nn.MaxPool2D(pool_size=2, strides=2),
+                nn.Conv2D(50, kernel_size=5, activation="tanh"),
+                nn.MaxPool2D(pool_size=2, strides=2),
+                nn.Flatten(),
+                nn.Dense(500, activation="tanh"),
+                nn.Dense(10))
+    net.initialize()
+    if dtype == "bfloat16":
+        net.cast("bfloat16")
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.uniform(0, 1, (batch, 1, 28, 28)).astype(np.float32))
+    x = x.astype(dtype)
+    y = nd.array(rng.randint(0, 10, (batch,)).astype(np.float32))
+    net(x)
+
+    step = parallel.ShardedTrainStep(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.05, "momentum": 0.9})
+
+    dt = _timed_steps(step, x, y, iters, warmup)
+    img_s = batch * iters / dt
+    flops = step.flops_per_step(x, y)
+    if flops:
+        flops /= batch
+
+    row = {
+        "config": "lenet_mnist_train", "chips": 1, "batch_size": batch,
+        "dtype": dtype,
+        "images_or_tokens_per_sec_per_chip": round(img_s, 2),
+        "mfu": _mfu(img_s, flops, platform), "platform": platform,
+        "flops_per_sample": flops,
+    }
+    _emit_jsonl(row)
+    return img_s, row
+
+
 def bench_lstm_ptb(platform, dtype):
     """LSTM language model, PTB 'medium' shape (BASELINE config 4;
     fused lax.scan RNN, ref: src/operator/rnn.cc cuDNN fused RNN)."""
@@ -368,7 +425,8 @@ def main():
     platform, note = _init_backend()
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     configs = os.environ.get(
-        "BENCH_CONFIGS", "resnet50,bert,lstm_ptb,wide_deep").split(",")
+        "BENCH_CONFIGS",
+        "resnet50,bert,lstm_ptb,wide_deep,lenet").split(",")
 
     # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
     metric_info = {
@@ -380,10 +438,12 @@ def main():
                      bench_lstm_ptb),
         "wide_deep": ("wide_deep_train_throughput", "samples/sec/chip",
                       bench_wide_deep),
+        "lenet": ("lenet_mnist_train_throughput", "images/sec/chip",
+                  bench_lenet_mnist),
     }
     headline = None
     errors = []
-    for name in ("resnet50", "bert", "lstm_ptb", "wide_deep"):
+    for name in ("resnet50", "bert", "lstm_ptb", "wide_deep", "lenet"):
         if name not in configs:
             continue
         metric, unit, fn = metric_info[name]
@@ -405,7 +465,8 @@ def main():
 
     if headline is None:
         first = next((c for c in ("resnet50", "bert", "lstm_ptb",
-                                  "wide_deep") if c in configs), "resnet50")
+                                  "wide_deep", "lenet") if c in configs),
+                     "resnet50")
         metric, unit, _ = metric_info[first]
         headline = {"metric": metric, "value": 0.0,
                     "unit": unit, "vs_baseline": 0.0,

@@ -42,7 +42,7 @@ from .symbol import Symbol
 from . import gluon
 from . import module
 from . import module as mod
-from .module import Module, BucketingModule
+from .module import Module, BucketingModule, SequentialModule
 from . import model
 from .model import save_checkpoint, load_checkpoint
 from . import parallel
@@ -50,6 +50,8 @@ from . import profiler
 from . import monitor
 from . import image
 from . import config
+from . import visualization
+from . import visualization as viz
 from . import amp
 from . import contrib
 
@@ -61,4 +63,5 @@ __all__ = [
     "sym", "Symbol", "module", "mod", "Module", "BucketingModule", "model",
     "save_checkpoint", "load_checkpoint", "profiler", "monitor",
     "operator", "image", "config", "amp", "contrib",
+    "SequentialModule", "visualization", "viz",
 ]

@@ -1,0 +1,222 @@
+"""SequentialModule — a chain of modules where each consumes the previous
+one's outputs (ref: python/mxnet/module/sequential_module.py).
+
+Middle modules are bound with ``inputs_need_grad=True`` so the backward
+pass can thread gradients back through the chain (the reference does the
+same via META_TAKE_LABELS / data-grad plumbing).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..io.io import DataBatch, DataDesc
+from .base_module import BaseModule
+
+__all__ = ["SequentialModule"]
+
+
+class SequentialModule(BaseModule):
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=None):
+        import logging
+        super().__init__(logger=logger or logging)
+        self._modules = []
+        self._metas = []
+        self._label_shapes = None
+        self._data_shapes = None
+
+    def add(self, module, **kwargs):
+        """Append a module; kwargs may include take_labels=True for the
+        module that consumes the loss labels (ref: SequentialModule.add)."""
+        if self.binded:
+            raise MXNetError("cannot add modules after bind()")
+        unknown = set(kwargs) - {self.META_TAKE_LABELS,
+                                 self.META_AUTO_WIRING}
+        if unknown:
+            raise MXNetError("unknown meta keys %s" % sorted(unknown))
+        self._modules.append(module)
+        self._metas.append(dict(kwargs))
+        return self
+
+    def __len__(self):
+        return len(self._modules)
+
+    @property
+    def data_names(self):
+        if self._modules:
+            return self._modules[0].data_names
+        return []
+
+    @property
+    def output_names(self):
+        if self._modules:
+            return self._modules[-1].output_names
+        return []
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._modules[0].data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._modules[-1].output_shapes
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg_params = {}
+        aux_params = {}
+        for mod in self._modules:
+            arg, aux = mod.get_params()
+            arg_params.update(arg)
+            aux_params.update(aux)
+        return arg_params, aux_params
+
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False,
+                    force_init=False, allow_extra=False):
+        assert self.binded
+        # each child sees only its slice of arg_params, so children run
+        # permissive and the strictness flags are enforced chain-wide here
+        for mod in self._modules:
+            mod.init_params(initializer=initializer, arg_params=arg_params,
+                            aux_params=aux_params,
+                            allow_missing=True, force_init=force_init,
+                            allow_extra=True)
+        self.params_initialized = True
+        if arg_params is None and aux_params is None:
+            return
+        all_args, all_aux = self.get_params()
+        if not allow_missing:
+            missing = [n for n in all_args if n not in (arg_params or {})]
+            missing += [n for n in all_aux if n not in (aux_params or {})]
+            if missing:
+                raise MXNetError(
+                    "init_params: %s not found in the provided params "
+                    "(pass allow_missing=True to initialize them)"
+                    % sorted(missing))
+        if not allow_extra:
+            known = set(all_args) | set(all_aux)
+            extra = [n for n in list(arg_params or {})
+                     + list(aux_params or {}) if n not in known]
+            if extra:
+                raise MXNetError(
+                    "init_params: provided params %s match no module "
+                    "parameter (pass allow_extra=True to ignore)"
+                    % sorted(extra))
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        if shared_module is not None:
+            raise MXNetError("shared_module is not supported for "
+                             "SequentialModule")
+        if not self._modules:
+            raise MXNetError("add modules before bind()")
+        self._label_shapes = label_shapes
+        cur_shapes = data_shapes
+        for i, (mod, meta) in enumerate(zip(self._modules, self._metas)):
+            last = i == len(self._modules) - 1
+            labels = label_shapes if meta.get(self.META_TAKE_LABELS) or \
+                (last and label_shapes is not None
+                 and not any(m.get(self.META_TAKE_LABELS)
+                             for m in self._metas)) else None
+            # middle modules need input grads so backward can chain —
+            # but only when training (inference shouldn't allocate them)
+            need_grad = inputs_need_grad if i == 0 else for_training
+            mod.bind(cur_shapes, labels, for_training=for_training,
+                     inputs_need_grad=need_grad,
+                     force_rebind=force_rebind, grad_req=grad_req)
+            if not last:
+                # output shapes at bind time come from shape inference
+                # (Module.output_shapes is only populated after forward)
+                if not hasattr(mod, "_symbol"):
+                    raise MXNetError(
+                        "SequentialModule children must be symbol-backed "
+                        "Modules; got %s at position %d (matches "
+                        "reference: only Module composes)"
+                        % (type(mod).__name__, i))
+                known = {d[0] if isinstance(d, tuple) else d.name:
+                         d[1] if isinstance(d, tuple) else d.shape
+                         for d in cur_shapes}
+                _, out_shapes, _ = \
+                    mod._symbol.infer_shape_partial(**known)
+                nxt = self._modules[i + 1].data_names
+                if len(nxt) != len(out_shapes):
+                    raise MXNetError(
+                        "module %d produces %d outputs but module %d "
+                        "expects %d inputs"
+                        % (i, len(out_shapes), i + 1, len(nxt)))
+                cur_shapes = [DataDesc(n, s)
+                              for n, s in zip(nxt, out_shapes)]
+        self.binded = True
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        assert self.binded and self.params_initialized
+        for mod in self._modules:
+            mod.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                               optimizer_params=optimizer_params,
+                               force_init=force_init)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        batch = data_batch
+        for i, mod in enumerate(self._modules):
+            mod.forward(batch, is_train=is_train)
+            if i == len(self._modules) - 1:
+                break
+            batch = DataBatch(data=mod.get_outputs(),
+                              label=data_batch.label)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        grads = out_grads
+        for i, mod in reversed(list(enumerate(self._modules))):
+            mod.backward(out_grads=grads)
+            if i == 0:
+                break
+            grads = mod.get_input_grads()
+
+    def update(self):
+        assert self.binded and self.params_initialized
+        for mod in self._modules:
+            mod.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded
+        return self._modules[-1].get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.inputs_need_grad
+        return self._modules[0].get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        any_take = False
+        for mod, meta in zip(self._modules, self._metas):
+            if meta.get(self.META_TAKE_LABELS):
+                # every loss-bearing module contributes (a chain can
+                # carry an auxiliary loss plus the final head)
+                mod.update_metric(eval_metric, labels, pre_sliced)
+                any_take = True
+        if not any_take:
+            self._modules[-1].update_metric(eval_metric, labels,
+                                            pre_sliced)
+
+    def install_monitor(self, monitor, monitor_all=False):
+        assert self.binded
+        for mod in self._modules:
+            mod.install_monitor(monitor, monitor_all=monitor_all)

@@ -11,6 +11,7 @@ from . import rnn
 from . import optimizer_ops
 from . import loss_output
 from . import attention
+from . import linalg
 
 from .registry import apply_op, get_op, list_ops, register, Op
 

@@ -1,0 +1,156 @@
+"""Linear-algebra operators — the ``linalg_*`` family
+(ref: src/operator/tensor/la_op.{cc,h} — gemm/potrf/trsm/… backed by
+cuBLAS/cuSOLVER; here each lowers to the XLA linalg primitives, which
+map Cholesky/triangular-solve onto the MXU-friendly blocked algorithms).
+
+All ops operate on the last two axes and broadcast over leading batch
+axes, like the reference. Differentiability comes from jax's built-in
+rules (jnp.linalg / lax.linalg are fully differentiable).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _t(x):
+    return jnp.swapaxes(x, -1, -2)
+
+
+@register("linalg_gemm", aliases=("_linalg_gemm",))
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    """C <- alpha * op(A) op(B) + beta * C (ref: la_op — linalg_gemm)."""
+    del axis
+    a = _t(A) if transpose_a else A
+    b = _t(B) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("linalg_gemm2", aliases=("_linalg_gemm2",))
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0,
+                 axis=-2):
+    del axis
+    a = _t(A) if transpose_a else A
+    b = _t(B) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf", aliases=("_linalg_potrf",))
+def linalg_potrf(A):
+    """Cholesky factor L with A = L L^T (ref: la_op — linalg_potrf)."""
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_potri", aliases=("_linalg_potri",))
+def linalg_potri(A):
+    """Inverse from a Cholesky factor: out = (L L^T)^-1 given L
+    (ref: la_op — linalg_potri)."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = jax.scipy.linalg.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(_t(linv), linv)
+
+
+@register("linalg_trsm", aliases=("_linalg_trsm",))
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Solve op(A) X = alpha B (or X op(A) = alpha B)
+    (ref: la_op — linalg_trsm)."""
+    b = alpha * B
+    if rightside:
+        # X op(A) = b  <=>  op(A)^T X^T = b^T
+        sol = jax.scipy.linalg.solve_triangular(
+            _t(A), _t(b), lower=not lower, trans=1 if transpose else 0)
+        return _t(sol)
+    return jax.scipy.linalg.solve_triangular(
+        A, b, lower=lower, trans=1 if transpose else 0)
+
+
+@register("linalg_trmm", aliases=("_linalg_trmm",))
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular matmul: out = alpha op(tri(A)) B (or B op(tri(A)))
+    (ref: la_op — linalg_trmm)."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    op_a = _t(tri) if transpose else tri
+    if rightside:
+        return alpha * jnp.matmul(B, op_a)
+    return alpha * jnp.matmul(op_a, B)
+
+
+@register("linalg_syrk", aliases=("_linalg_syrk",))
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    """Symmetric rank-k: alpha A A^T (or alpha A^T A)
+    (ref: la_op — linalg_syrk)."""
+    if transpose:
+        return alpha * jnp.matmul(_t(A), A)
+    return alpha * jnp.matmul(A, _t(A))
+
+
+@register("linalg_makediag", aliases=("_linalg_makediag",))
+def linalg_makediag(A, offset=0):
+    """Vector(s) → diagonal matrix (ref: la_op — linalg_makediag)."""
+    n = A.shape[-1] + abs(offset)
+    base = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    rows = idx if offset >= 0 else idx - offset
+    cols = idx + offset if offset >= 0 else idx
+    return base.at[..., rows, cols].set(A)
+
+
+@register("linalg_extractdiag", aliases=("_linalg_extractdiag",))
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_maketrian", aliases=("_linalg_maketrian",))
+def linalg_maketrian(A, offset=0, lower=True):
+    """Packed vector → triangular matrix (ref: la_op — linalg_maketrian).
+    Only offset=0 packing is supported (the common case)."""
+    if offset != 0:
+        raise NotImplementedError("linalg_maketrian supports offset=0")
+    k = A.shape[-1]
+    n = int((-1 + (1 + 8 * k) ** 0.5) / 2)
+    rows, cols = jnp.tril_indices(n)
+    if not lower:
+        rows, cols = cols, rows
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    return out.at[..., rows, cols].set(A)
+
+
+@register("linalg_extracttrian", aliases=("_linalg_extracttrian",))
+def linalg_extracttrian(A, offset=0, lower=True):
+    if offset != 0:
+        raise NotImplementedError("linalg_extracttrian supports offset=0")
+    n = A.shape[-1]
+    rows, cols = jnp.tril_indices(n)
+    if not lower:
+        rows, cols = cols, rows
+    return A[..., rows, cols]
+
+
+@register("linalg_sumlogdiag", aliases=("_linalg_sumlogdiag",))
+def linalg_sumlogdiag(A):
+    """sum(log(diag(A))) per matrix (ref: la_op — linalg_sumlogdiag)."""
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_det", aliases=("_linalg_det", "det"))
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("linalg_slogdet", aliases=("_linalg_slogdet", "slogdet"),
+          num_outputs=2)
+def linalg_slogdet(A):
+    sign, logabs = jnp.linalg.slogdet(A)
+    return sign, logabs
+
+
+@register("linalg_inverse", aliases=("_linalg_inverse", "inverse"))
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)

@@ -1,0 +1,126 @@
+"""Network visualization (ref: python/mxnet/visualization.py —
+print_summary + plot_network).
+
+``print_summary`` is pure text (always available); ``plot_network``
+returns a graphviz Digraph when the ``graphviz`` package is installed and
+raises ImportError otherwise, exactly like the reference.
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _graph_nodes(symbol):
+    conf = json.loads(symbol.tojson())
+    return conf["nodes"], set(conf["arg_nodes"]), conf["heads"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Layer-table summary with output shapes and parameter counts
+    (ref: visualization.print_summary)."""
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    nodes, arg_nodes, _ = _graph_nodes(symbol)
+
+    shape_dict = {}
+    if shape is not None:
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape)
+        names = symbol.list_arguments()
+        shape_dict.update(zip(names, arg_shapes))
+        # per-node output shapes via an internal-output walk
+        internals = symbol.get_internals()
+        _, int_shapes, _ = internals.infer_shape_partial(**shape)
+        for name, s in zip(internals.list_outputs(), int_shapes):
+            shape_dict[name] = s
+
+    positions = [int(line_length * p) for p in positions]
+    header = ["Layer (type)", "Output Shape", "Param #",
+              "Previous Layer"]
+
+    lines = ["_" * line_length]
+    row = ""
+    for fld, pos in zip(header, positions):
+        row = (row + fld).ljust(pos)
+    lines.append(row)
+    lines.append("=" * line_length)
+
+    total_params = 0
+    for i, node in enumerate(nodes):
+        if node["op"] == "null" and i in arg_nodes:
+            continue
+        name = node["name"]
+        out_shape = shape_dict.get(name + "_output",
+                                   shape_dict.get(name, ""))
+        n_params = 0
+        prevs = []
+        data_names = set(shape or {})
+        for inp in node.get("inputs", []):
+            src = nodes[inp[0]]
+            if src["op"] == "null":
+                if src["name"] in data_names:
+                    continue  # data inputs are not parameters
+                s = shape_dict.get(src["name"])
+                if s:
+                    cnt = 1
+                    for d in s:
+                        cnt *= d
+                    n_params += cnt
+            else:
+                prevs.append(src["name"])
+        total_params += n_params
+        row = ""
+        for fld, pos in zip(["%s (%s)" % (name, node["op"]),
+                             str(out_shape), str(n_params),
+                             ", ".join(prevs)], positions):
+            row = (row + str(fld)).ljust(pos)
+        lines.append(row)
+        lines.append("_" * line_length)
+    lines.append("Total params: %d" % total_params)
+    lines.append("_" * line_length)
+    summary = "\n".join(lines)
+    print(summary)
+    return summary
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz rendering of the symbol graph
+    (ref: visualization.plot_network)."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError(
+            "plot_network requires the graphviz python package "
+            "(matches reference behavior)") from e
+    if not hasattr(symbol, "tojson"):
+        raise MXNetError("plot_network expects a Symbol")
+    nodes, arg_nodes, _ = _graph_nodes(symbol)
+    node_attrs = dict({"shape": "box", "fixedsize": "false"},
+                      **(node_attrs or {}))
+    param_suffixes = ("_weight", "_bias", "_gamma", "_beta",
+                     "_moving_mean", "_moving_var")
+
+    def _hidden(i, node):
+        return (node["op"] == "null" and i in arg_nodes and hide_weights
+                and node["name"].endswith(param_suffixes))
+
+    dot = Digraph(name=title, format=save_format)
+    drawn = set()
+    for i, node in enumerate(nodes):
+        if _hidden(i, node):
+            continue
+        drawn.add(str(i))
+        if node["op"] == "null":
+            dot.node(str(i), node["name"], **dict(node_attrs,
+                                                  shape="oval"))
+        else:
+            dot.node(str(i), "%s\n%s" % (node["name"], node["op"]),
+                     **node_attrs)
+    for i, node in enumerate(nodes):
+        for inp in node.get("inputs", []):
+            if str(inp[0]) in drawn and str(i) in drawn:
+                dot.edge(str(inp[0]), str(i))
+    return dot

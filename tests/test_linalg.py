@@ -1,0 +1,89 @@
+"""linalg op family vs numpy (models the la_op coverage in
+tests/python/unittest/test_operator.py::test_laop*)."""
+import numpy as np
+
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import with_seed
+
+
+def _spd(n, batch=(), seed=0):
+    rng = np.random.RandomState(seed)
+    m = rng.rand(*batch, n, n)
+    return m @ np.swapaxes(m, -1, -2) + n * np.eye(n)
+
+
+@with_seed()
+def test_gemm_and_gemm2():
+    rng = np.random.RandomState(0)
+    A = rng.rand(2, 3, 4)
+    B = rng.rand(2, 4, 5)
+    C = rng.rand(2, 3, 5)
+    out = nd.linalg_gemm(nd.array(A), nd.array(B), nd.array(C),
+                         alpha=2.0, beta=0.5)
+    np.testing.assert_allclose(out.asnumpy(), 2.0 * A @ B + 0.5 * C,
+                               rtol=1e-5)
+    outT = nd.linalg_gemm2(nd.array(A), nd.array(np.swapaxes(B, 1, 2)),
+                           transpose_b=True)
+    np.testing.assert_allclose(outT.asnumpy(), A @ B, rtol=1e-5)
+
+
+def test_potrf_potri_roundtrip():
+    A = _spd(4, batch=(2,))
+    L = nd.linalg_potrf(nd.array(A))
+    np.testing.assert_allclose(
+        L.asnumpy() @ np.swapaxes(L.asnumpy(), -1, -2), A, rtol=1e-5)
+    Ainv = nd.linalg_potri(L)
+    np.testing.assert_allclose(Ainv.asnumpy() @ A,
+                               np.broadcast_to(np.eye(4), (2, 4, 4)),
+                               atol=1e-8)
+
+
+def test_trsm_trmm():
+    rng = np.random.RandomState(1)
+    L = np.linalg.cholesky(_spd(3)) + np.eye(3)
+    B = rng.rand(3, 2)
+    X = nd.linalg_trsm(nd.array(L), nd.array(B), alpha=2.0)
+    np.testing.assert_allclose(L @ X.asnumpy(), 2.0 * B, rtol=1e-6)
+    Xr = nd.linalg_trsm(nd.array(L), nd.array(B.T), rightside=True)
+    np.testing.assert_allclose(Xr.asnumpy() @ L, B.T, rtol=1e-6)
+    M = rng.rand(3, 3)
+    out = nd.linalg_trmm(nd.array(M), nd.array(B))
+    np.testing.assert_allclose(out.asnumpy(), np.tril(M) @ B, rtol=1e-6)
+
+
+def test_syrk_diag_trian():
+    rng = np.random.RandomState(2)
+    A = rng.rand(3, 4)
+    np.testing.assert_allclose(nd.linalg_syrk(nd.array(A)).asnumpy(),
+                               A @ A.T, rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.linalg_syrk(nd.array(A), transpose=True).asnumpy(),
+        A.T @ A, rtol=1e-6)
+    v = rng.rand(4)
+    D = nd.linalg_makediag(nd.array(v))
+    np.testing.assert_allclose(D.asnumpy(), np.diag(v))
+    np.testing.assert_allclose(
+        nd.linalg_extractdiag(D).asnumpy(), v)
+    off = nd.linalg_makediag(nd.array(v), offset=1)
+    np.testing.assert_allclose(off.asnumpy(), np.diag(v, k=1))
+    packed = rng.rand(6)
+    T = nd.linalg_maketrian(nd.array(packed))
+    np.testing.assert_allclose(
+        nd.linalg_extracttrian(T).asnumpy(), packed)
+    assert np.allclose(np.triu(T.asnumpy(), 1), 0)
+
+
+def test_det_inverse_sumlogdiag():
+    A = _spd(3, batch=(2,))
+    np.testing.assert_allclose(nd.linalg_det(nd.array(A)).asnumpy(),
+                               np.linalg.det(A), rtol=1e-5)
+    sign, logabs = nd.linalg_slogdet(nd.array(A))
+    s_ref, l_ref = np.linalg.slogdet(A)
+    np.testing.assert_allclose(sign.asnumpy(), s_ref)
+    np.testing.assert_allclose(logabs.asnumpy(), l_ref, rtol=1e-5)
+    inv = nd.linalg_inverse(nd.array(A))
+    np.testing.assert_allclose(inv.asnumpy(), np.linalg.inv(A), rtol=1e-4)
+    L = np.linalg.cholesky(_spd(3))
+    np.testing.assert_allclose(
+        nd.linalg_sumlogdiag(nd.array(L)).asnumpy(),
+        np.log(np.diag(L)).sum(), rtol=1e-6)

@@ -234,6 +234,36 @@ SPEC = {
     "flash_attention": ([_unit(1, 2, 4, 8), _unit(1, 2, 4, 8),
                          _unit(1, 2, 4, 8)], {}, None),
 }
+
+
+def _spd(n, seed=3):
+    m = np.random.RandomState(seed).rand(n, n)
+    return m @ m.T + n * np.eye(n)
+
+
+def _chol(n, seed=3):
+    return np.linalg.cholesky(_spd(n, seed))
+
+
+SPEC.update({
+    # linalg family (ref: la_op) — SPD/triangular inputs where required
+    "linalg_gemm": ([_any(3, 4), _any(4, 2), _any(3, 2)],
+                    {"alpha": 1.3, "beta": 0.7}, None),
+    "linalg_gemm2": ([_any(3, 4), _any(4, 2)], {"alpha": 1.3}, None),
+    "linalg_potrf": ([_spd(3)], {}, None),
+    "linalg_potri": ([_chol(3)], {}, None),
+    "linalg_trsm": ([_chol(3) + np.eye(3), _any(3, 2)], {}, None),
+    "linalg_trmm": ([_any(3, 3), _any(3, 2)], {}, None),
+    "linalg_syrk": ([_any(3, 4)], {}, None),
+    "linalg_makediag": ([_any(4)], {}, None),
+    "linalg_extractdiag": ([_any(4, 4)], {}, None),
+    "linalg_maketrian": ([_any(6)], {}, None),
+    "linalg_extracttrian": ([_any(3, 3)], {}, None),
+    "linalg_sumlogdiag": ([_chol(3) + np.eye(3)], {}, None),
+    "linalg_det": ([_spd(3)], {}, None),
+    "linalg_slogdet": ([_spd(3)], {}, [0]),
+    "linalg_inverse": ([_spd(3)], {}, None),
+})
 del SPEC["one_hot_like_ops"]
 
 # ops whose internals compute in float32 regardless of input dtype (BN/LN

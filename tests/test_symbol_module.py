@@ -357,3 +357,64 @@ def test_infer_type_mixed_dtypes():
     mixed = data + w
     _, out_t, _ = mixed.infer_type(data=np.float16, w=np.float64)
     assert out_t[0] == np.dtype("float64")
+
+
+def test_sequential_module_train():
+    """SequentialModule chains modules; grads thread back through the
+    chain (ref: module/sequential_module.py)."""
+    import numpy as np
+    from mxnet_tpu.io import DataBatch, DataDesc
+
+    feat = sym.FullyConnected(sym.Variable("data"), num_hidden=8,
+                              name="feat")
+    feat = sym.Activation(feat, act_type="relu", name="feat_relu")
+    head = sym.FullyConnected(sym.Variable("feat_relu_output"),
+                              num_hidden=2, name="head")
+    head = sym.SoftmaxOutput(head, name="softmax")
+
+    mod = mx.mod.SequentialModule()
+    mod.add(mx.mod.Module(feat, data_names=["data"], label_names=[]))
+    mod.add(mx.mod.Module(head, data_names=["feat_relu_output"],
+                          label_names=["softmax_label"]),
+            take_labels=True)
+    mod.bind(data_shapes=[DataDesc("data", (4, 6))],
+             label_shapes=[DataDesc("softmax_label", (4,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.normal(size=(4, 6)).astype("f4"))
+    y = nd.array(np.array([0.0, 1.0, 0.0, 1.0], "f4"))
+    metric = mx.metric.Accuracy()
+    for _ in range(25):
+        batch = DataBatch(data=[x], label=[y])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    mod.update_metric(metric, [y])
+    out = mod.get_outputs()[0].asnumpy()
+    assert out.shape == (4, 2)
+    # the chain actually learned the labels (grads crossed the boundary)
+    assert (out.argmax(axis=1) == y.asnumpy()).all()
+    # and the metric routed labels to the loss-bearing module
+    assert metric.get()[1] == 1.0
+
+
+def test_print_summary_and_plot_network():
+    import pytest as _pytest
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(data=net, num_hidden=2, name="fc2")
+    out = mx.viz.print_summary(net, shape={"data": (4, 10)})
+    assert "fc1" in out and "fc2" in out
+    assert "Total params: %d" % (10 * 8 + 8 + 8 * 2 + 2) in out  # 106
+    try:
+        import graphviz  # noqa: F401
+        dot = mx.viz.plot_network(net)
+        assert "fc1" in dot.source
+    except ImportError:
+        with _pytest.raises(ImportError):
+            mx.viz.plot_network(net)
