@@ -55,6 +55,11 @@ _declare("MXT_KVSTORE_BIGARRAY_BOUND", int, 1000000,
          "(ref: MXNET_KVSTORE_BIGARRAY_BOUND; advisory — XLA collectives "
          "handle chunking internally).")
 
+_declare("MXT_RNN_UNROLL", int, None,
+         "Unroll factor for the fused-RNN recurrent scan (0 disables "
+         "unrolling; unset = auto: full unroll up to T=128, else 16). "
+         "Unrolling amortizes per-iteration loop overhead on the TPU.")
+
 _overrides = {}
 
 

@@ -17,6 +17,7 @@ class _DenseLayer(HybridBlock):
 
     def __init__(self, growth_rate, bn_size, dropout, **kwargs):
         super().__init__(**kwargs)
+        self._caxis = nn.channel_axis()
         self.body = nn.HybridSequential(prefix="")
         self.body.add(nn.BatchNorm())
         self.body.add(nn.Activation("relu"))
@@ -31,7 +32,7 @@ class _DenseLayer(HybridBlock):
 
     def hybrid_forward(self, F, x):
         out = self.body(x)
-        return F.concat(x, out, dim=1)
+        return F.concat(x, out, dim=self._caxis)
 
 
 def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):

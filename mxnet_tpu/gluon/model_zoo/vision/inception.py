@@ -35,9 +35,9 @@ class _Concurrent(HybridBlock):
     """Parallel branches, channel-concat outputs (plays the role of
     gluon.contrib HybridConcurrent used by the reference)."""
 
-    def __init__(self, axis=1, prefix=None, params=None):
+    def __init__(self, axis=None, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
-        self._axis = axis
+        self._axis = nn.channel_axis() if axis is None else axis
 
     def add(self, block):
         self.register_child(block)
@@ -102,6 +102,7 @@ class _InceptionE(HybridBlock):
 
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
+        self._caxis = nn.channel_axis()
         with self.name_scope():
             self.branch1 = _make_branch(None, (320, 1, None, None))
             self.branch2_stem = _make_basic_conv(channels=384, kernel_size=1)
@@ -122,11 +123,13 @@ class _InceptionE(HybridBlock):
     def hybrid_forward(self, F, x):
         b1 = self.branch1(x)
         b2 = self.branch2_stem(x)
-        b2 = F.concat(self.branch2_a(b2), self.branch2_b(b2), dim=1)
+        b2 = F.concat(self.branch2_a(b2), self.branch2_b(b2),
+                      dim=self._caxis)
         b3 = self.branch3_stem(x)
-        b3 = F.concat(self.branch3_a(b3), self.branch3_b(b3), dim=1)
+        b3 = F.concat(self.branch3_a(b3), self.branch3_b(b3),
+                      dim=self._caxis)
         b4 = self.branch4(x)
-        return F.concat(b1, b2, b3, b4, dim=1)
+        return F.concat(b1, b2, b3, b4, dim=self._caxis)
 
 
 class Inception3(HybridBlock):

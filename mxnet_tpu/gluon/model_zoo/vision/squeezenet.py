@@ -15,6 +15,7 @@ class _Fire(HybridBlock):
     def __init__(self, squeeze_channels, expand1x1_channels,
                  expand3x3_channels, **kwargs):
         super().__init__(**kwargs)
+        self._caxis = nn.channel_axis()
         self.squeeze = nn.Conv2D(squeeze_channels, kernel_size=1,
                                  activation="relu")
         self.expand1x1 = nn.Conv2D(expand1x1_channels, kernel_size=1,
@@ -24,7 +25,7 @@ class _Fire(HybridBlock):
 
     def hybrid_forward(self, F, x):
         x = self.squeeze(x)
-        return F.concat(self.expand1x1(x), self.expand3x3(x), dim=1)
+        return F.concat(self.expand1x1(x), self.expand3x3(x), dim=self._caxis)
 
 
 class SqueezeNet(HybridBlock):

@@ -3,10 +3,12 @@ from ..block import Block, HybridBlock
 from .basic_layers import *
 from .conv_layers import *
 from .activations import *
+from .layout import *
 
 from .basic_layers import __all__ as _basic_all
 from .conv_layers import __all__ as _conv_all
 from .activations import __all__ as _act_all
+from .layout import __all__ as _layout_all
 
 __all__ = ["Block", "HybridBlock"] + list(_basic_all) + list(_conv_all) + \
-    list(_act_all)
+    list(_act_all) + list(_layout_all)

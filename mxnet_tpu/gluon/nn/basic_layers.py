@@ -6,6 +6,7 @@ import numpy as np
 from ... import autograd
 from ...base import MXNetError
 from ..block import Block, HybridBlock
+from .layout import resolve_norm_axis
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
            "Embedding", "Flatten", "Lambda", "HybridLambda", "Activation",
@@ -141,14 +142,16 @@ class BatchNorm(HybridBlock):
     src/operator/nn/batch_norm.cc). Running stats are aux params mutated on
     training forwards, exactly like the reference."""
 
-    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+    def __init__(self, axis=None, momentum=0.9, epsilon=1e-5, center=True,
                  scale=True, use_global_stats=False, beta_initializer="zeros",
                  gamma_initializer="ones",
                  running_mean_initializer="zeros",
                  running_variance_initializer="ones", in_channels=0,
                  prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
-        self._axis = axis
+        # axis=None resolves against nn.layout_scope (1, the reference
+        # default, unless a channels-last scope is active)
+        self._axis = resolve_norm_axis(axis)
         self._momentum = momentum
         self._epsilon = epsilon
         self._center = center

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from ...base import MXNetError
 from ..block import HybridBlock
+from .layout import resolve_layout
 
 __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose",
            "MaxPool1D", "MaxPool2D", "MaxPool3D",
@@ -37,7 +38,7 @@ class _Conv(HybridBlock):
         self._pad = padding
         self._dilate = dilation
         self._groups = groups
-        self._layout = layout
+        self._layout = resolve_layout(layout, nd_)
         self._op_name = op_name
         self._adj = adj
         self._nd = nd_
@@ -88,7 +89,7 @@ class _Conv(HybridBlock):
 
 class Conv1D(_Conv):
     def __init__(self, channels, kernel_size, strides=1, padding=0,
-                 dilation=1, groups=1, layout="NCW", activation=None,
+                 dilation=1, groups=1, layout=None, activation=None,
                  use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
         super().__init__(channels, _tup(kernel_size, 1), _tup(strides, 1),
@@ -99,7 +100,7 @@ class Conv1D(_Conv):
 
 class Conv2D(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
-                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 dilation=(1, 1), groups=1, layout=None, activation=None,
                  use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
         super().__init__(channels, _tup(kernel_size, 2), _tup(strides, 2),
@@ -111,7 +112,7 @@ class Conv2D(_Conv):
 class Conv3D(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1, 1),
                  padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
-                 layout="NCDHW", activation=None, use_bias=True,
+                 layout=None, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         super().__init__(channels, _tup(kernel_size, 3), _tup(strides, 3),
@@ -123,7 +124,7 @@ class Conv3D(_Conv):
 class Conv2DTranspose(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
                  output_padding=(0, 0), dilation=(1, 1), groups=1,
-                 layout="NCHW", activation=None, use_bias=True,
+                 layout=None, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         super().__init__(channels, _tup(kernel_size, 2), _tup(strides, 2),
@@ -145,7 +146,7 @@ class _Pooling(HybridBlock):
             kernel=pool_size, stride=strides, pad=padding,
             global_pool=global_pool, pool_type=pool_type,
             pooling_convention="full" if ceil_mode else "valid",
-            layout=layout)
+            layout=resolve_layout(layout, len(pool_size)))
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 
@@ -157,7 +158,7 @@ class _Pooling(HybridBlock):
 
 
 class MaxPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+    def __init__(self, pool_size=2, strides=None, padding=0, layout=None,
                  ceil_mode=False, **kwargs):
         super().__init__(_tup(pool_size, 1),
                          _tup(strides, 1) if strides is not None else None,
@@ -167,7 +168,7 @@ class MaxPool1D(_Pooling):
 
 class MaxPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
-                 layout="NCHW", ceil_mode=False, **kwargs):
+                 layout=None, ceil_mode=False, **kwargs):
         super().__init__(_tup(pool_size, 2),
                          _tup(strides, 2) if strides is not None else None,
                          _tup(padding, 2), ceil_mode, False, "max", layout,
@@ -176,7 +177,7 @@ class MaxPool2D(_Pooling):
 
 class MaxPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
-                 layout="NCDHW", ceil_mode=False, **kwargs):
+                 layout=None, ceil_mode=False, **kwargs):
         super().__init__(_tup(pool_size, 3),
                          _tup(strides, 3) if strides is not None else None,
                          _tup(padding, 3), ceil_mode, False, "max", layout,
@@ -184,7 +185,7 @@ class MaxPool3D(_Pooling):
 
 
 class AvgPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+    def __init__(self, pool_size=2, strides=None, padding=0, layout=None,
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_tup(pool_size, 1),
                          _tup(strides, 1) if strides is not None else None,
@@ -194,7 +195,7 @@ class AvgPool1D(_Pooling):
 
 class AvgPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
-                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 layout=None, ceil_mode=False, count_include_pad=True,
                  **kwargs):
         super().__init__(_tup(pool_size, 2),
                          _tup(strides, 2) if strides is not None else None,
@@ -204,7 +205,7 @@ class AvgPool2D(_Pooling):
 
 class AvgPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
-                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 layout=None, ceil_mode=False, count_include_pad=True,
                  **kwargs):
         super().__init__(_tup(pool_size, 3),
                          _tup(strides, 3) if strides is not None else None,
@@ -213,34 +214,34 @@ class AvgPool3D(_Pooling):
 
 
 class GlobalMaxPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1,), None, (0,), False, True, "max", layout, **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1), None, (0, 0), False, True, "max", layout,
                          **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "max",
                          layout, **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1,), None, (0,), False, True, "avg", layout, **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1), None, (0, 0), False, True, "avg", layout,
                          **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "avg",
                          layout, **kwargs)

@@ -1,0 +1,78 @@
+"""Channels-last layout scope — TPU-native addition (no reference analog).
+
+The reference API is NCHW-first: every conv/pool layer defaults to
+``layout="NCHW"`` and BatchNorm to ``axis=1`` (ref:
+python/mxnet/gluon/nn/conv_layers.py signatures). On TPU the MXU tiles
+best when the channel dimension is minor (channels-last): with NCHW HLO
+the compiler has to insert transpose fusions around every conv, which
+shows up directly as lost MFU. Rather than threading a ``layout``
+argument through every model-zoo constructor, ``layout_scope("NHWC")``
+rewrites the *defaults* for layers constructed under it::
+
+    with nn.layout_scope("NHWC"):
+        net = model_zoo.get_model("resnet50_v1")   # whole net channels-last
+    net.initialize()
+    out = net(nhwc_batch)                          # input is (N, H, W, C)
+
+An explicit ``layout=`` / ``axis=`` passed by the caller always wins over
+the scope. The scope is captured at *construction* time (layers remember
+their layout), so it does not need to be re-entered for forward passes.
+Weight layout stays logical OIHW either way, so checkpoints are
+layout-portable.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+__all__ = ["layout_scope", "current_layout", "channel_axis",
+           "resolve_layout", "resolve_norm_axis"]
+
+_state = threading.local()
+
+# rank-indexed spellings of the two layout families
+_CHANNELS_FIRST = {1: "NCW", 2: "NCHW", 3: "NCDHW"}
+_CHANNELS_LAST = {1: "NWC", 2: "NHWC", 3: "NDHWC"}
+
+
+def current_layout():
+    """The active scope's layout family ("NCHW" / "NHWC") or None."""
+    return getattr(_state, "layout", None)
+
+
+@contextmanager
+def layout_scope(layout):
+    """Set the default layout family for layers constructed in the scope."""
+    if layout not in ("NCHW", "NHWC"):
+        raise ValueError(
+            "layout_scope expects the 2-D family name 'NCHW' or 'NHWC'; "
+            "got %r" % (layout,))
+    prev = current_layout()
+    _state.layout = layout
+    try:
+        yield
+    finally:
+        _state.layout = prev
+
+
+def channel_axis():
+    """Channel axis implied by the active scope (for concat/split sites):
+    1 for channels-first (the default), -1 under a channels-last scope."""
+    return -1 if current_layout() == "NHWC" else 1
+
+
+def resolve_layout(layout, nd):
+    """Resolve a layer's layout argument: an explicit value wins; None
+    falls back to the scope (or channels-first, matching the reference)."""
+    if layout is not None:
+        return layout
+    family = _CHANNELS_LAST if current_layout() == "NHWC" \
+        else _CHANNELS_FIRST
+    return family[nd]
+
+
+def resolve_norm_axis(axis):
+    """Resolve BatchNorm's axis argument against the scope."""
+    if axis is not None:
+        return axis
+    return -1 if current_layout() == "NHWC" else 1

@@ -1,0 +1,142 @@
+"""nn.layout_scope: channels-last models must match channels-first ones.
+
+Weights stay logical OIHW in both layouts, so a state_dict copied across
+layouts must produce identical outputs (up to float assoc) when the input
+is transposed — this is the checkpoint-portability contract of
+gluon/nn/layout.py.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.gluon import nn, model_zoo
+
+
+def _copy_params(src, dst):
+    """Positional copy: the two nets differ only in the auto-generated
+    top-level prefix (resnetv10_ vs resnetv11_), structure is identical."""
+    sp = src.collect_params()
+    dp = dst.collect_params()
+    assert len(sp) == len(dp)
+    for ks, kd in zip(sorted(sp.keys()), sorted(dp.keys())):
+        assert ks.split("_", 1)[-1] == kd.split("_", 1)[-1], (ks, kd)
+        assert sp[ks].shape == dp[kd].shape, (ks, kd)
+        dp[kd].data()._set_data(sp[ks].data().data)
+
+
+def _check_model(name, hw, classes=10, tol=1e-4):
+    mx.random.seed(0)
+    net_cf = model_zoo.get_model(name, classes=classes)
+    net_cf.initialize()
+    with nn.layout_scope("NHWC"):
+        net_cl = model_zoo.get_model(name, classes=classes)
+    net_cl.initialize()
+
+    x = nd.array(np.random.RandomState(0)
+                 .uniform(-1, 1, (2, 3, hw, hw)).astype("f4"))
+    x_cl = nd.array(x.asnumpy().transpose(0, 2, 3, 1))
+    net_cf(x)
+    net_cl(x_cl)  # resolve deferred shapes before copying
+    _copy_params(net_cf, net_cl)
+
+    np.testing.assert_allclose(net_cl(x_cl).asnumpy(),
+                               net_cf(x).asnumpy(), rtol=tol, atol=tol)
+
+
+def test_resnet18_nhwc_matches_nchw():
+    _check_model("resnet18_v1", 64)
+
+
+def test_resnet50_v2_nhwc_matches_nchw():
+    _check_model("resnet50_v2", 64, tol=5e-4)
+
+
+def test_squeezenet_nhwc_matches_nchw():
+    _check_model("squeezenet1.0", 96, tol=5e-4)
+
+
+def test_densenet_nhwc_matches_nchw():
+    # head is a fixed 7x7 AvgPool -> input must be the full 224
+    _check_model("densenet121", 224, tol=5e-4)
+
+
+def test_mobilenet_nhwc_matches_nchw():
+    _check_model("mobilenetv2_0.5", 64, tol=5e-4)
+
+
+def test_layout_scope_restores_default():
+    with nn.layout_scope("NHWC"):
+        assert nn.current_layout() == "NHWC"
+        assert nn.channel_axis() == -1
+        with nn.layout_scope("NCHW"):
+            assert nn.channel_axis() == 1
+        assert nn.current_layout() == "NHWC"
+    assert nn.current_layout() is None
+    assert nn.channel_axis() == 1
+
+
+def test_explicit_layout_wins_over_scope():
+    with nn.layout_scope("NHWC"):
+        conv = nn.Conv2D(8, kernel_size=3, layout="NCHW")
+        bn = nn.BatchNorm(axis=1)
+    assert conv._layout == "NCHW"
+    assert bn._axis == 1
+
+
+def _small_convnet():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, use_bias=False),
+            nn.BatchNorm(),
+            nn.Activation("relu"),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(16, kernel_size=3, padding=1, use_bias=False),
+            nn.BatchNorm(),
+            nn.Activation("relu"),
+            nn.GlobalAvgPool2D(),
+            nn.Flatten(),
+            nn.Dense(10))
+    return net
+
+
+def test_nhwc_train_step_gradients():
+    """Backward through conv/BN/pool in each layout gives the same grads.
+
+    Deliberately a small, well-conditioned net: a full untrained resnet18
+    has near-zero-variance BN channels whose rsqrt amplifies the
+    layout-dependent f32 reduction order into O(1) grad differences on
+    CPU (on TPU both layouts match bit-exactly) — that's conditioning,
+    not a layout bug, and it would make any tolerance meaningless."""
+    mx.random.seed(0)
+    net_cf = _small_convnet()
+    net_cf.initialize()
+    with nn.layout_scope("NHWC"):
+        net_cl = _small_convnet()
+    net_cl.initialize()
+
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.uniform(-1, 1, (4, 3, 16, 16)).astype("f4"))
+    x_cl = nd.array(x.asnumpy().transpose(0, 2, 3, 1))
+    y = nd.array(rng.randint(0, 10, (4,)).astype("f4"))
+    net_cf(x)
+    net_cl(x_cl)
+    _copy_params(net_cf, net_cl)
+
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    grads = []
+    for net, xin in ((net_cf, x), (net_cl, x_cl)):
+        params = net.collect_params()
+        for p in params.values():
+            if p.grad_req != "null":
+                p.zero_grad()
+        with ag.record():
+            loss = loss_fn(net(xin), y).mean()
+        loss.backward()
+        grads.append({k.split("_", 1)[-1]: p.grad().asnumpy()
+                      for k, p in params.items() if p.grad_req != "null"})
+    a, b = grads
+    assert sorted(a.keys()) == sorted(b.keys())
+    for k in a:
+        np.testing.assert_allclose(b[k], a[k], rtol=1e-3, atol=1e-4,
+                                   err_msg=k)
