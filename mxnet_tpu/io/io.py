@@ -466,22 +466,64 @@ class ImageRecordIter(DataIter):
         self.preprocess_threads = max(1, preprocess_threads)
         self._rng = np.random.RandomState(seed)
 
-        # index all record offsets once (via .idx if present, else a scan)
-        if path_imgidx:
+        # index all record offsets once. Fast path: the native engine
+        # (src/recordio.cc) magic-scans the shard in C++ and its (payload
+        # offset, length) index lets decode workers read records natively,
+        # GIL-free — the reference's C++ parser role. Fallback: .idx
+        # sidecar or a pure-Python scan.
+        self._native = None
+        self._payload = None  # (offsets, lengths) parallel to _offsets
+        try:
+            from .. import native as _native_mod
+
+            if _native_mod.available():
+                nat = _native_mod.NativeRecordReader(path_imgrec)
+                offs, lens = nat.scan()
+                nat.close()
+                self._native = _native_mod
+                self._offsets = list(offs - 8)  # record starts
+                self._payload = (offs, lens)
+        except Exception:  # noqa: BLE001 — fall back to Python paths
+            self._native = None
+            self._payload = None
+        if self._native is None:
+            if path_imgidx:
+                rec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+                self._offsets = [rec.idx[k] for k in rec.keys]
+                rec.close()
+            else:
+                rec = MXRecordIO(path_imgrec, "r")
+                self._offsets = []
+                while True:
+                    pos = rec.tell()
+                    if rec.read() is None:
+                        break
+                    self._offsets.append(pos)
+                rec.close()
+        elif path_imgidx:
+            # honor the sidecar's key order/subset when it exists; a stale
+            # .idx (offsets not matching any scanned record) drops us back
+            # to the Python reader, whose first read surfaces the clear
+            # invalid-magic error
             rec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
-            self._offsets = [rec.idx[k] for k in rec.keys]
+            wanted = [rec.idx[k] for k in rec.keys]
             rec.close()
-        else:
-            rec = MXRecordIO(path_imgrec, "r")
-            self._offsets = []
-            while True:
-                pos = rec.tell()
-                if rec.read() is None:
-                    break
-                self._offsets.append(pos)
-            rec.close()
+            by_start = {int(o) - 8: i
+                        for i, o in enumerate(self._payload[0])}
+            self._offsets = wanted
+            try:
+                sel = [by_start[int(w)] for w in wanted]
+            except KeyError:
+                self._native = None
+                self._payload = None
+            else:
+                self._payload = (self._payload[0][sel],
+                                 self._payload[1][sel])
         # distributed sharding (part_index/num_parts — dmlc InputSplit)
         self._offsets = self._offsets[part_index::num_parts]
+        if self._payload is not None:
+            self._payload = (self._payload[0][part_index::num_parts],
+                             self._payload[1][part_index::num_parts])
         self.path_imgrec = path_imgrec
         self.reset()
 
@@ -501,9 +543,7 @@ class ImageRecordIter(DataIter):
             self._rng.shuffle(self._order)
         self._cursor = 0
 
-    def _decode_one(self, offset, reader, rng):
-        reader.handle.seek(offset)
-        raw = reader.read()
+    def _decode_one(self, raw, rng):
         header, img = self._unpack_img(raw)
         img = img.astype(np.float32)
         if self.resize > 0:
@@ -544,12 +584,27 @@ class ImageRecordIter(DataIter):
                                       size=self.preprocess_threads)
 
         def worker(tid):
-            reader = MXRecordIO(self.path_imgrec, "r")
+            # one file handle per thread (neither the Python reader nor the
+            # native FILE* is safe to share across seeking threads)
+            if self._native is not None:
+                nat = self._native.NativeRecordReader(self.path_imgrec)
+                offs, lens = self._payload
+
+                def fetch(i):
+                    return nat.read_at(int(offs[i]), int(lens[i]))
+            else:
+                reader = MXRecordIO(self.path_imgrec, "r")
+
+                def fetch(i):
+                    reader.handle.seek(self._offsets[i])
+                    return reader.read()
             rng = np.random.RandomState(rng_seeds[tid])
             for j in range(tid, len(idxs), self.preprocess_threads):
-                results[j] = self._decode_one(self._offsets[idxs[j]], reader,
-                                              rng)
-            reader.close()
+                results[j] = self._decode_one(fetch(idxs[j]), rng)
+            if self._native is not None:
+                nat.close()
+            else:
+                reader.close()
 
         threads = [threading.Thread(target=worker, args=(t,))
                    for t in range(self.preprocess_threads)]

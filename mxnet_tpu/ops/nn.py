@@ -9,6 +9,8 @@ default for parity.
 """
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -268,6 +270,63 @@ def dropout(data, p=0.5, mode="training", axes=(), train_mode=False):
 # --------------------------------------------------------------------------
 # normalization
 # --------------------------------------------------------------------------
+def _bn_stats(x32, red):
+    """Batch mean/var via the centered two-pass form. NOT E[x^2]-E[x]^2:
+    that cancels catastrophically for |mean|/std >~ 1e3 (raw un-centered
+    features straight into BN), clamping var to 0 and scaling outputs by
+    rsqrt(eps). The second pass fuses with the normalize pass anyway."""
+    mean = jnp.mean(x32, axis=red)
+    shape = [1] * x32.ndim
+    for i in range(x32.ndim):
+        if i not in red:
+            shape[i] = x32.shape[i]
+    d = x32 - mean.reshape(shape)
+    var = jnp.mean(d * d, axis=red)
+    return mean, var
+
+
+def _bn_core_fwd(eps, red, x, g, b):
+    x32 = x.astype(jnp.float32)
+    mean, var = _bn_stats(x32, red)
+    inv = jax.lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    ax = [i for i in range(x.ndim) if i not in red][0]
+    shape[ax] = x.shape[ax]
+    out = (x32 - mean.reshape(shape)) * (
+        inv * g.astype(jnp.float32)).reshape(shape) \
+        + b.astype(jnp.float32).reshape(shape)
+    # residuals are the bf16 input + per-channel stats — backward
+    # recomputes x32/xhat on the fly, so no f32 activation tensor is ever
+    # written to HBM (the main BN traffic saving vs autodiff)
+    return (out.astype(x.dtype), mean, var), (x, g, mean, inv)
+
+
+def _bn_core_bwd(eps, red, res, cts):
+    x, g, mean, inv = res
+    ct_out = cts[0]  # mean/var outputs feed stop_gradient paths only
+    ax = [i for i in range(x.ndim) if i not in red][0]
+    shape = [1] * x.ndim
+    shape[ax] = x.shape[ax]
+    n = 1
+    for i in red:
+        n *= x.shape[i]
+    dy = ct_out.astype(jnp.float32)
+    xhat = (x.astype(jnp.float32) - mean.reshape(shape)) * inv.reshape(shape)
+    db = jnp.sum(dy, axis=red)
+    dg = jnp.sum(dy * xhat, axis=red)
+    dx = (g.astype(jnp.float32) * inv).reshape(shape) * (
+        dy - (db / n).reshape(shape) - xhat * (dg / n).reshape(shape))
+    return dx.astype(x.dtype), dg.astype(g.dtype), db.astype(g.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _bn_core(eps, red, x, g, b):
+    return _bn_core_fwd(eps, red, x, g, b)[0]
+
+
+_bn_core.defvjp(_bn_core_fwd, _bn_core_bwd)
+
+
 @register("BatchNorm", aliases=("batch_norm", "BatchNorm_v1"), num_outputs=3)
 def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
                momentum=0.9, fix_gamma=True, use_global_stats=False,
@@ -275,7 +334,9 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
                train_mode=False):
     """ref: src/operator/nn/batch_norm.cc. Returns (out, mean, var); in
     training mode mean/var are the *updated running stats* the layer writes
-    back (the reference mutates aux states in-place inside the kernel)."""
+    back (the reference mutates aux states in-place inside the kernel).
+    Train-mode normalize+stats is a custom-VJP kernel: single-pass f32
+    stats, bf16-only residuals (backward recomputes x_hat)."""
     del output_mean_var, cudnn_off
     ax = axis % data.ndim
     red = tuple(i for i in range(data.ndim) if i != ax)
@@ -283,36 +344,64 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
     shape[ax] = data.shape[ax]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if train_mode and not use_global_stats:
-        x32 = data.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=red)
-        var = jnp.var(x32, axis=red)
+        out, mean, var = _bn_core(float(eps), red, data, g, beta)
         new_mean = momentum * moving_mean + (1 - momentum) * mean
         new_var = momentum * moving_var + (1 - momentum) * var
-    else:
-        mean, var = moving_mean, moving_var
-        new_mean, new_var = moving_mean, moving_var
+        return (out,
+                jax.lax.stop_gradient(new_mean),
+                jax.lax.stop_gradient(new_var))
+    mean, var = moving_mean, moving_var
     inv = jax.lax.rsqrt(var + eps)
     out = (data.astype(jnp.float32) - mean.reshape(shape)) * (
         inv * g.astype(jnp.float32)
     ).reshape(shape) + beta.astype(jnp.float32).reshape(shape)
-    return (out.astype(data.dtype),
-            jax.lax.stop_gradient(new_mean),
-            jax.lax.stop_gradient(new_var))
+    return (out.astype(data.dtype), moving_mean, moving_var)
+
+
+def _ln_fwd(eps, ax, x, g, b):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=ax, keepdims=True)
+    # centered two-pass variance — see _bn_stats for why not E[x^2]-E[x]^2
+    var = jnp.mean(jnp.square(x32 - mean), axis=ax, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[ax] = x.shape[ax]
+    out = (x32 - mean) * inv * g.astype(jnp.float32).reshape(shape) + \
+        b.astype(jnp.float32).reshape(shape)
+    return out.astype(x.dtype), (x, g, mean, inv)
+
+
+def _ln_bwd(eps, ax, res, ct):
+    x, g, mean, inv = res
+    n = x.shape[ax]
+    shape = [1] * x.ndim
+    shape[ax] = n
+    dy = ct.astype(jnp.float32) * g.astype(jnp.float32).reshape(shape)
+    xhat = (x.astype(jnp.float32) - mean) * inv
+    dy_ct = ct.astype(jnp.float32)
+    other = tuple(i for i in range(x.ndim) if i != ax % x.ndim)
+    dg = jnp.sum(dy_ct * xhat, axis=other)
+    db = jnp.sum(dy_ct, axis=other)
+    m1 = jnp.mean(dy, axis=ax, keepdims=True)
+    m2 = jnp.mean(dy * xhat, axis=ax, keepdims=True)
+    dx = inv * (dy - m1 - xhat * m2)
+    return dx.astype(x.dtype), dg.astype(g.dtype), db.astype(g.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ln_core(eps, ax, x, g, b):
+    return _ln_fwd(eps, ax, x, g, b)[0]
+
+
+_ln_core.defvjp(_ln_fwd, _ln_bwd)
 
 
 @register("LayerNorm", aliases=("layer_norm",))
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
-    """ref: src/operator/nn/layer_norm.cc — normalizes along one axis."""
-    x32 = data.astype(jnp.float32)
-    mean = jnp.mean(x32, axis=axis, keepdims=True)
-    var = jnp.var(x32, axis=axis, keepdims=True)
-    inv = jax.lax.rsqrt(var + eps)
-    shape = [1] * data.ndim
-    ax = axis % data.ndim
-    shape[ax] = data.shape[ax]
-    out = (x32 - mean) * inv * gamma.astype(jnp.float32).reshape(shape) + \
-        beta.astype(jnp.float32).reshape(shape)
-    return out.astype(data.dtype)
+    """ref: src/operator/nn/layer_norm.cc — normalizes along one axis.
+    Custom-VJP kernel: single-pass f32 stats, bf16-only residuals
+    (backward recomputes x_hat instead of saving f32 intermediates)."""
+    return _ln_core(float(eps), axis % data.ndim, data, gamma, beta)
 
 
 @register("InstanceNorm")
