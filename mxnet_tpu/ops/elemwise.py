@@ -292,3 +292,38 @@ def smooth_l1(a, scalar=1.0):
     s2 = float(scalar) ** 2
     absa = jnp.abs(a)
     return jnp.where(absa < 1.0 / s2, 0.5 * s2 * jnp.square(a), absa - 0.5 / s2)
+
+
+@register("digamma")
+def digamma(a):
+    return jax.scipy.special.digamma(a)
+
+
+@register("hardshrink")
+def hardshrink(data, lambd=0.5):
+    """ref: src/operator/tensor/elemwise_unary_op_basic.cc hard_shrink."""
+    return jnp.where(jnp.abs(data) > lambd, data, 0.0).astype(data.dtype)
+
+
+@register("softshrink")
+def softshrink(data, lambd=0.5):
+    """ref: elemwise_unary_op_basic.cc soft_shrink."""
+    return (jnp.sign(data)
+            * jnp.maximum(jnp.abs(data) - lambd, 0.0)).astype(data.dtype)
+
+
+@register("amp_cast")
+def amp_cast(data, dtype="float32"):
+    """ref: src/operator/tensor/amp_cast.cc — AMP's dtype bridge."""
+    from ..base import get_dtype
+
+    return data.astype(get_dtype(dtype))
+
+
+@register("amp_multicast")
+def amp_multicast(*data, num_outputs=None):
+    """ref: amp_cast.cc AMPMultiCast — cast all inputs to the widest
+    floating dtype among them."""
+    del num_outputs
+    widest = jnp.result_type(*[d.dtype for d in data])
+    return tuple(d.astype(widest) for d in data)

@@ -319,3 +319,138 @@ def shape_array(a):
 @register("size_array", differentiable=False)
 def size_array(a):
     return jnp.asarray([a.size], dtype=jnp.int64)
+
+
+# --------------------------------------------------------------------------
+# block/space rearrangement + index transforms
+# (ref: src/operator/tensor/matrix_op.cc DepthToSpace/SpaceToDepth,
+#  ravel.cc, src/operator/tensor/indexing_op.cc batch_take)
+# --------------------------------------------------------------------------
+@register("tril")
+def tril(a, k=0):
+    return jnp.tril(a, k)
+
+
+@register("triu")
+def triu(a, k=0):
+    return jnp.triu(a, k)
+
+
+@register("depth_to_space")
+def depth_to_space(data, block_size=1):
+    """ref: matrix_op.cc DepthToSpace (DCR): (N, C*b^2, H, W) ->
+    (N, C, H*b, W*b), y[n,c,h*b+i,w*b+j] = x[n,(i*b+j)*C+c,h,w]."""
+    n, cbb, h, w = data.shape
+    b = block_size
+    c = cbb // (b * b)
+    x = data.reshape(n, b, b, c, h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c, h * b, w * b)
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size=1):
+    """Inverse of depth_to_space (ref: matrix_op.cc SpaceToDepth)."""
+    n, c, hb, wb = data.shape
+    b = block_size
+    h, w = hb // b, wb // b
+    x = data.reshape(n, c, h, b, w, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h, w)
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    """ref: matrix_op.cc ReshapeLike — reshape lhs's [lhs_begin, lhs_end)
+    dims to rhs's [rhs_begin, rhs_end) dims (whole shape by default)."""
+    ls, rs = list(lhs.shape), list(rhs.shape)
+    lb = 0 if lhs_begin is None else lhs_begin % (len(ls) + 1)
+    le = len(ls) if lhs_end is None else lhs_end % (len(ls) + 1)
+    rb = 0 if rhs_begin is None else rhs_begin % (len(rs) + 1)
+    re_ = len(rs) if rhs_end is None else rhs_end % (len(rs) + 1)
+    new_shape = ls[:lb] + rs[rb:re_] + ls[le:]
+    return lhs.reshape(new_shape)
+
+
+@register("unravel_index", differentiable=False)
+def unravel_index(data, shape=()):
+    """ref: ravel.cc — flat indices -> (ndim, ...) coordinates."""
+    coords = jnp.unravel_index(data.astype(jnp.int64), tuple(shape))
+    return jnp.stack(coords, axis=0)
+
+
+@register("ravel_multi_index", differentiable=False)
+def ravel_multi_index(data, shape=()):
+    """ref: ravel.cc — (ndim, ...) coordinates -> flat indices."""
+    shape = tuple(shape)
+    strides = np.cumprod((1,) + shape[:0:-1])[::-1]
+    flat = sum(data[i].astype(jnp.int64) * int(strides[i])
+               for i in range(len(shape)))
+    return flat
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    """ref: indexing_op.cc BatchTake — out[i] = a[i, indices[i]]."""
+    idx = indices.astype(jnp.int32).reshape(-1)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register("choose_element_0index")
+def choose_element_0index(lhs, rhs):
+    """Legacy alias of batch_take with float indices
+    (ref: src/operator/swapaxis.cc-era legacy ops)."""
+    return batch_take(lhs, rhs)
+
+
+@register("fill_element_0index")
+def fill_element_0index(lhs, mhs, rhs):
+    """ref: legacy op — out = lhs with out[i, rhs[i]] = mhs[i]."""
+    idx = rhs.astype(jnp.int32).reshape(-1)
+    return lhs.at[jnp.arange(lhs.shape[0]), idx].set(mhs)
+
+
+# --------------------------------------------------------------------------
+# im2col / col2im (ref: src/operator/nn/im2col.h — the reference's conv
+# lowering helpers, exposed as ops)
+# --------------------------------------------------------------------------
+def _im2col_raw(data, kernel, stride, dilate, pad):
+    patches = jax.lax.conv_general_dilated_patches(
+        data,
+        filter_shape=tuple(kernel),
+        window_strides=tuple(stride),
+        padding=[(p, p) for p in pad],
+        rhs_dilation=tuple(dilate),
+    )  # (N, C*prod(kernel), *out_spatial)
+    return patches.reshape(patches.shape[0], patches.shape[1], -1)
+
+
+@register("im2col")
+def im2col(data, kernel=(), stride=(), dilate=(), pad=()):
+    """ref: im2col.h — (N, C, *spatial) -> (N, C*prod(kernel), L)."""
+    nd_ = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nd_
+    dilate = tuple(dilate) if dilate else (1,) * nd_
+    pad = tuple(pad) if pad else (0,) * nd_
+    return _im2col_raw(data, kernel, stride, dilate, pad)
+
+
+@register("col2im")
+def col2im(data, output_size=(), kernel=(), stride=(), dilate=(), pad=()):
+    """ref: im2col.h col2im — scatter-add patches back to an image.
+    Exactly the linear transpose of im2col, computed as such."""
+    nd_ = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nd_
+    dilate = tuple(dilate) if dilate else (1,) * nd_
+    pad = tuple(pad) if pad else (0,) * nd_
+    n = data.shape[0]
+    spatial = tuple(output_size)
+    c = data.shape[1] // int(np.prod(kernel))
+    img_aval = jax.ShapeDtypeStruct((n, c) + spatial, data.dtype)
+
+    def fwd(img):
+        return _im2col_raw(img, kernel, stride, dilate, pad)
+
+    (img,) = jax.linear_transpose(fwd, img_aval)(data)
+    return img

@@ -355,7 +355,9 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
     out = (data.astype(jnp.float32) - mean.reshape(shape)) * (
         inv * g.astype(jnp.float32)
     ).reshape(shape) + beta.astype(jnp.float32).reshape(shape)
-    return (out.astype(data.dtype), moving_mean, moving_var)
+    return (out.astype(data.dtype),
+            jax.lax.stop_gradient(moving_mean),
+            jax.lax.stop_gradient(moving_var))
 
 
 def _ln_fwd(eps, ax, x, g, b):
@@ -545,3 +547,137 @@ def bilinear_sampler(data, grid):
     wy = wy[:, None]
     return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
             + v10 * (1 - wx) * wy + v11 * wx * wy)
+
+
+# --------------------------------------------------------------------------
+# spatial-transform / detection ops
+# (ref: src/operator/{spatial_transformer,grid_generator,roi_pooling,
+#  correlation}.cc)
+# --------------------------------------------------------------------------
+@register("GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """ref: src/operator/grid_generator.cc. affine: (N, 6) theta ->
+    (N, 2, H, W) sampling grid in [-1, 1]; warp: (N, 2, H, W) flow ->
+    grid (flow added to the identity grid, normalized)."""
+    if transform_type == "affine":
+        h, w = target_shape
+        n = data.shape[0]
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx.ravel(), gy.ravel(),
+                          ones.ravel()]).astype(data.dtype)  # (3, HW)
+        theta = data.reshape(n, 2, 3)
+        out = jnp.einsum("nij,jk->nik", theta, base)  # (N, 2, HW)
+        return out.reshape(n, 2, h, w)
+    if transform_type == "warp":
+        n, _, h, w = data.shape
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        fx = data[:, 0].astype(jnp.float32) + gx
+        fy = data[:, 1].astype(jnp.float32) + gy
+        nx = fx * 2.0 / max(w - 1, 1) - 1.0
+        ny = fy * 2.0 / max(h - 1, 1) - 1.0
+        return jnp.stack([nx, ny], axis=1).astype(data.dtype)
+    raise ValueError("unknown transform_type %r" % (transform_type,))
+
+
+@register("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=None):
+    """ref: src/operator/spatial_transformer.cc — affine grid + bilinear
+    sampling of the input feature map."""
+    del cudnn_off
+    if sampler_type != "bilinear":
+        raise ValueError("only bilinear sampler_type is supported")
+    grid = grid_generator(loc, transform_type, target_shape)
+    return bilinear_sampler(data, grid)
+
+
+@register("ROIPooling")
+def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """ref: src/operator/roi_pooling.cc — max pool each ROI into a fixed
+    (ph, pw) grid. rois: (R, 5) [batch_idx, x1, y1, x2, y2] in image
+    coords; boundaries replicate the reference's floor/ceil rounding."""
+    ph, pw = pooled_size
+    n, c, h, w = data.shape
+    # at least f32 for the bin geometry, but never BELOW the input's
+    # precision (f64 numeric-grad sweeps would otherwise see f32 noise)
+    ct = jnp.promote_types(data.dtype, jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(ct)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(ct)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(ct)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(ct)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        fmap = data[b]  # (C, H, W)
+        iy = jnp.arange(h, dtype=ct)
+        ix = jnp.arange(w, dtype=ct)
+        # bin index boundaries: [start, end) per output cell
+        ys = y1 + jnp.arange(ph, dtype=ct) * bin_h
+        ye = y1 + (jnp.arange(ph, dtype=ct) + 1) * bin_h
+        xs_ = x1 + jnp.arange(pw, dtype=ct) * bin_w
+        xe = x1 + (jnp.arange(pw, dtype=ct) + 1) * bin_w
+        row_m = (iy[None, :] >= jnp.floor(ys)[:, None]) & \
+                (iy[None, :] < jnp.ceil(ye)[:, None])      # (ph, H)
+        col_m = (ix[None, :] >= jnp.floor(xs_)[:, None]) & \
+                (ix[None, :] < jnp.ceil(xe)[:, None])      # (pw, W)
+        mask = row_m[:, None, :, None] & col_m[None, :, None, :]
+        neg = jnp.asarray(-jnp.inf, ct)
+        vals = jnp.where(mask[None], fmap[:, None, None, :, :]
+                         .astype(ct), neg)
+        out = jnp.max(vals, axis=(3, 4))  # (C, ph, pw)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    out = jax.vmap(one_roi)(rois.astype(ct))
+    return out.astype(data.dtype)
+
+
+@register("Correlation")
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """ref: src/operator/correlation.cc (FlowNet cost volume). Output
+    channel k is the per-pixel patch correlation of data1 with data2
+    shifted by the k-th displacement in a (2d+1)^2 grid."""
+    n, c, h, w = data1.shape
+    d = max_displacement // stride2
+    if pad_size:
+        pad = [(0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size)]
+        data1 = jnp.pad(data1, pad)
+        data2 = jnp.pad(data2, pad)
+    # zero-pad by the displacement range so shifts bring in zeros at the
+    # borders (the reference zero-pads; jnp.roll would wrap the far edge
+    # around and correlate opposite borders)
+    m2 = d * stride2
+    hh, ww = data1.shape[2], data1.shape[3]
+    data2p = jnp.pad(data2, [(0, 0), (0, 0), (m2, m2), (m2, m2)])
+    outs = []
+    for dy in range(-d, d + 1):
+        for dx in range(-d, d + 1):
+            oy = m2 + dy * stride2
+            ox = m2 + dx * stride2
+            shifted = data2p[:, :, oy:oy + hh, ox:ox + ww]
+            if is_multiply:
+                prod = data1 * shifted
+            else:
+                prod = jnp.abs(data1 - shifted)
+            m = jnp.mean(prod, axis=1)  # mean over channels
+            if kernel_size > 1:
+                k = kernel_size
+                m = jax.lax.reduce_window(
+                    m, m.dtype.type(0), jax.lax.add, (1, k, k), (1, 1, 1),
+                    [(0, 0), (k // 2, k // 2), (k // 2, k // 2)]
+                ) / (k * k)
+            outs.append(m)
+    out = jnp.stack(outs, axis=1)  # (N, (2d+1)^2, H', W')
+    if stride1 > 1:
+        out = out[:, :, ::stride1, ::stride1]
+    return out

@@ -92,7 +92,8 @@ def sample_gamma(alpha, beta, shape=(), dtype=None):
     return g * beta.reshape(beta.shape + (1,) * len(shape)).astype(g.dtype)
 
 
-@register("_sample_multinomial", aliases=("sample_multinomial",),
+@register("_sample_multinomial",
+          aliases=("sample_multinomial", "multinomial"),
           differentiable=False)
 def sample_multinomial(data, shape=(), get_prob=False, dtype="int32"):
     """Sample category indices from probability rows

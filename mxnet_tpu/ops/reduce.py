@@ -71,3 +71,31 @@ def logsumexp(a, axis=None, keepdims=False):
     import jax.scipy.special as jsp
 
     return jsp.logsumexp(a, axis=axis, keepdims=bool(keepdims))
+
+
+@register("cumsum")
+def cumsum(a, axis=None, dtype=None):
+    """ref: src/operator/numpy/np_cumsum.cc."""
+    from ..base import get_dtype
+
+    dt = get_dtype(dtype) if dtype else None
+    return jnp.cumsum(a, axis=axis, dtype=dt)
+
+
+@register("cumprod")
+def cumprod(a, axis=None, dtype=None):
+    from ..base import get_dtype
+
+    dt = get_dtype(dtype) if dtype else None
+    return jnp.cumprod(a, axis=axis, dtype=dt)
+
+
+@register("moments", num_outputs=2)
+def moments(data, axes=None, keepdims=False):
+    """ref: src/operator/nn/moments.cc — (mean, var) in one op."""
+    ax = tuple(axes) if axes is not None else None
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.mean(jnp.square(data - mean), axis=ax, keepdims=keepdims)
+    if not keepdims:
+        mean = mean.reshape(var.shape) if var.ndim else mean.reshape(())
+    return mean, var

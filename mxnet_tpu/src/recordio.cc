@@ -166,7 +166,12 @@ void* mxt_rio_prefetch_start(const char* path, const int64_t* offsets,
       // consumer blocked in pop()
       FILE* f = std::fopen(p->path.c_str(), "rb");
       if (!f) {
-        p->error.store(true);
+        {
+          // store+notify under the mutex: a consumer between its predicate
+          // check and its block would otherwise miss the only wakeup
+          std::lock_guard<std::mutex> lk(p->mu);
+          p->error.store(true);
+        }
         p->cv_full.notify_all();
         return;
       }
@@ -178,7 +183,10 @@ void* mxt_rio_prefetch_start(const char* path, const int64_t* offsets,
         std::vector<uint8_t> buf(p->lengths[i]);
         std::fseek(f, p->offsets[i], SEEK_SET);
         if (std::fread(buf.data(), 1, buf.size(), f) != buf.size()) {
-          p->error.store(true);
+          {
+            std::lock_guard<std::mutex> lk(p->mu);
+            p->error.store(true);
+          }
           p->cv_full.notify_all();
           break;
         }

@@ -1,0 +1,180 @@
+"""Extended op families added in round 3: block/space rearrangement,
+index transforms, im2col/col2im, cumulative reductions, shrink
+activations, AMP casts, multinomial sampling, and the spatial-transform /
+detection ops (ref: src/operator/tensor/matrix_op.cc, ravel.cc,
+nn/im2col.h, nn/moments.cc, amp_cast.cc, random/multisample_op.cc,
+spatial_transformer.cc, grid_generator.cc, roi_pooling.cc,
+correlation.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import autograd as ag
+
+
+def test_tril_triu():
+    a = nd.array(np.arange(16, dtype="f4").reshape(4, 4))
+    np.testing.assert_array_equal(nd.tril(a).asnumpy(),
+                                  np.tril(a.asnumpy()))
+    np.testing.assert_array_equal(nd.triu(a, k=1).asnumpy(),
+                                  np.triu(a.asnumpy(), 1))
+
+
+def test_depth_space_roundtrip():
+    x = nd.array(np.random.RandomState(0).rand(2, 12, 4, 6).astype("f4"))
+    y = nd.depth_to_space(x, block_size=2)
+    assert y.shape == (2, 3, 8, 12)
+    z = nd.space_to_depth(y, block_size=2)
+    np.testing.assert_array_equal(z.asnumpy(), x.asnumpy())
+
+
+def test_depth_to_space_dcr_semantics():
+    # y[n, c, h*b+i, w*b+j] = x[n, (i*b+j)*C + c, h, w]
+    x = np.arange(1 * 8 * 2 * 2, dtype="f4").reshape(1, 8, 2, 2)
+    y = nd.depth_to_space(nd.array(x), block_size=2).asnumpy()
+    b, c = 2, 2
+    for i in range(b):
+        for j in range(b):
+            for ch in range(c):
+                np.testing.assert_array_equal(
+                    y[0, ch, i::b, j::b], x[0, (i * b + j) * c + ch])
+
+
+def test_reshape_like():
+    lhs = nd.array(np.arange(24, dtype="f4"))
+    rhs = nd.zeros((2, 3, 4))
+    assert nd.reshape_like(lhs, rhs).shape == (2, 3, 4)
+    lhs2 = nd.array(np.arange(24, dtype="f4").reshape(6, 4))
+    out = nd.reshape_like(lhs2, nd.zeros((2, 3)), lhs_begin=0, lhs_end=1,
+                          rhs_begin=0, rhs_end=2)
+    assert out.shape == (2, 3, 4)
+
+
+def test_ravel_unravel_roundtrip():
+    shape = (3, 4, 5)
+    flat = nd.array(np.array([0, 7, 59, 23], dtype="f4"))
+    coords = nd.unravel_index(flat, shape=shape)
+    back = nd.ravel_multi_index(coords, shape=shape)
+    np.testing.assert_array_equal(back.asnumpy(), [0, 7, 59, 23])
+
+
+def test_batch_take_and_fill():
+    a = nd.array(np.arange(12, dtype="f4").reshape(3, 4))
+    idx = nd.array(np.array([1, 0, 3], dtype="f4"))
+    np.testing.assert_array_equal(nd.batch_take(a, idx).asnumpy(),
+                                  [1.0, 4.0, 11.0])
+    np.testing.assert_array_equal(
+        nd.choose_element_0index(a, idx).asnumpy(), [1.0, 4.0, 11.0])
+    filled = nd.fill_element_0index(a, nd.array(np.array([9, 8, 7], "f4")),
+                                    idx)
+    assert filled.asnumpy()[0, 1] == 9 and filled.asnumpy()[2, 3] == 7
+
+
+def test_im2col_col2im_transpose_pair():
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(2, 3, 6, 6).astype("f4"))
+    col = nd.im2col(x, kernel=(3, 3), stride=(1, 1), pad=(1, 1))
+    assert col.shape == (2, 27, 36)
+    img = nd.col2im(col, output_size=(6, 6), kernel=(3, 3), stride=(1, 1),
+                    pad=(1, 1))
+    # col2im(im2col(x)) multiplies each pixel by its patch-coverage count;
+    # interior pixels of a 3x3/pad-1 window are covered 9 times
+    np.testing.assert_allclose(img.asnumpy()[:, :, 2:4, 2:4],
+                               9 * x.asnumpy()[:, :, 2:4, 2:4], rtol=1e-5)
+
+
+def test_cumsum_cumprod_grad():
+    x = nd.array(np.arange(1, 7, dtype="f4").reshape(2, 3))
+    np.testing.assert_allclose(nd.cumsum(x, axis=1).asnumpy(),
+                               np.cumsum(x.asnumpy(), axis=1))
+    np.testing.assert_allclose(nd.cumprod(x, axis=0).asnumpy(),
+                               np.cumprod(x.asnumpy(), axis=0))
+    xa = nd.array(np.ones((3,), "f4"))
+    xa.attach_grad()
+    with ag.record():
+        y = nd.cumsum(xa).sum()
+    y.backward()
+    np.testing.assert_allclose(xa.grad.asnumpy(), [3.0, 2.0, 1.0])
+
+
+def test_moments():
+    x = np.random.RandomState(0).randn(4, 5).astype("f4")
+    m, v = nd.moments(nd.array(x), axes=(0,))
+    np.testing.assert_allclose(m.asnumpy(), x.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(v.asnumpy(), x.var(0), rtol=1e-4)
+
+
+def test_shrink_ops():
+    x = nd.array(np.array([-2.0, -0.3, 0.1, 0.9], dtype="f4"))
+    np.testing.assert_allclose(nd.hardshrink(x, lambd=0.5).asnumpy(),
+                               [-2.0, 0.0, 0.0, 0.9])
+    np.testing.assert_allclose(nd.softshrink(x, lambd=0.5).asnumpy(),
+                               [-1.5, 0.0, 0.0, 0.4], rtol=1e-6)
+
+
+def test_digamma():
+    from scipy.special import digamma as ref  # noqa: F401
+    # scipy may be absent; compare against the known value psi(1) = -gamma
+    out = float(nd.digamma(nd.array(np.array([1.0], "f4"))).asnumpy()[0])
+    assert abs(out - (-0.5772157)) < 1e-4
+
+
+def test_amp_cast_multicast():
+    a = nd.array(np.ones(4, "f4"))
+    assert nd.amp_cast(a, dtype="float16").dtype == np.float16
+    outs = nd.amp_multicast(nd.array(np.ones(3, "f2")),
+                            nd.array(np.ones(3, "f4")))
+    assert all(o.dtype == np.float32 for o in outs)
+
+
+def test_multinomial_distribution():
+    mx.random.seed(0)
+    p = nd.array(np.array([[0.9, 0.05, 0.05], [0.05, 0.05, 0.9]], "f4"))
+    s = nd.sample_multinomial(p, shape=(500,)).asnumpy()
+    assert np.bincount(s[0]).argmax() == 0
+    assert np.bincount(s[1]).argmax() == 2
+    s2, logp = nd.sample_multinomial(p, shape=(4,), get_prob=True)
+    assert s2.shape == (2, 4) and logp.shape == (2, 4)
+    assert np.all(logp.asnumpy() <= 0)
+
+
+def test_spatial_transformer_identity():
+    x = nd.array(np.random.RandomState(0).rand(2, 3, 8, 8).astype("f4"))
+    theta = nd.array(np.tile(np.array([1, 0, 0, 0, 1, 0], "f4"), (2, 1)))
+    out = nd.SpatialTransformer(x, theta, target_shape=(8, 8))
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy(), atol=1e-5)
+
+
+def test_grid_generator_warp_zero_flow():
+    flow = nd.zeros((1, 2, 4, 4))
+    grid = nd.GridGenerator(flow, transform_type="warp").asnumpy()
+    assert grid[0, 0, 0, 0] == -1.0 and grid[0, 0, 0, -1] == 1.0
+    assert grid[0, 1, 0, 0] == -1.0 and grid[0, 1, -1, 0] == 1.0
+
+
+def test_roi_pooling_full_roi_is_global_max():
+    x = nd.array(np.random.RandomState(1).rand(2, 4, 7, 7).astype("f4"))
+    rois = nd.array(np.array([[0, 0, 0, 6, 6], [1, 0, 0, 6, 6]], "f4"))
+    out = nd.ROIPooling(x, rois, pooled_size=(1, 1), spatial_scale=1.0)
+    np.testing.assert_allclose(out.asnumpy()[:, :, 0, 0],
+                               x.asnumpy().max(axis=(2, 3)), rtol=1e-6)
+
+
+def test_roi_pooling_quadrants():
+    x = np.zeros((1, 1, 4, 4), "f4")
+    x[0, 0, 0, 0] = 5.0   # top-left
+    x[0, 0, 3, 3] = 7.0   # bottom-right
+    out = nd.ROIPooling(nd.array(x), nd.array(np.array([[0, 0, 0, 3, 3]],
+                                                       "f4")),
+                        pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    assert out[0, 0, 0, 0] == 5.0
+    assert out[0, 0, 1, 1] == 7.0
+
+
+def test_correlation_self_zero_displacement():
+    x = nd.array(np.random.RandomState(2).rand(2, 8, 6, 6).astype("f4"))
+    out = nd.Correlation(x, x, kernel_size=1, max_displacement=1)
+    assert out.shape == (2, 9, 6, 6)
+    np.testing.assert_allclose(out.asnumpy()[:, 4],
+                               (x.asnumpy() ** 2).mean(axis=1), rtol=1e-5)

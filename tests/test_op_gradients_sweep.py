@@ -263,6 +263,46 @@ SPEC.update({
     "linalg_det": ([_spd(3)], {}, None),
     "linalg_slogdet": ([_spd(3)], {}, [0]),
     "linalg_inverse": ([_spd(3)], {}, None),
+    # round-3 extended families (matrix_op.cc block ops, ravel.cc,
+    # im2col.h, moments.cc, amp_cast.cc, shrinks, vision transforms)
+    "tril": ([_any(4, 4)], {}, None),
+    "triu": ([_any(4, 4)], dict(k=1), None),
+    "depth_to_space": ([_any(1, 8, 2, 3)], dict(block_size=2), None),
+    "space_to_depth": ([_any(1, 2, 4, 6)], dict(block_size=2), None),
+    "reshape_like": ([_any(2, 6), _any(3, 4)], {}, [0]),
+    "batch_take": ([_distinct(3, 4),
+                    np.array([1.0, 0.0, 3.0])], {}, [0]),
+    "choose_element_0index": ([_distinct(3, 4),
+                               np.array([1.0, 0.0, 3.0])], {}, [0]),
+    "fill_element_0index": ([_any(3, 4), _any(3),
+                             np.array([1.0, 0.0, 3.0])], {}, [0, 1]),
+    "im2col": ([_any(1, 2, 5, 5)],
+               dict(kernel=(3, 3), stride=(1, 1), pad=(1, 1)), None),
+    "col2im": ([_any(1, 18, 25)],
+               dict(output_size=(5, 5), kernel=(3, 3), stride=(1, 1),
+                    pad=(1, 1)), None),
+    "cumsum": ([_any(3, 4)], dict(axis=1), None),
+    "cumprod": ([_pos(3, 4)], dict(axis=1), None),
+    "moments": ([_any(3, 4)], dict(axes=(0,)), None),
+    # shrinks: inputs kept away from the |x| = lambd kink
+    "hardshrink": ([_pos(3, 4) + 1.0], dict(lambd=0.5), None),
+    "softshrink": ([_pos(3, 4) + 1.0], dict(lambd=0.5), None),
+    "digamma": ([_pos(3, 4) + 0.5], {}, None),
+    "amp_cast": ([_any(3, 4)], dict(dtype="float64"), None),
+    "amp_multicast": ([_any(3, 4), _any(3, 4)], {}, None),
+    "GridGenerator": ([_unit(2, 6)],
+                      dict(transform_type="affine",
+                           target_shape=(4, 5)), None),
+    # data grad through bilinear sampling is smooth away from integer
+    # grid lines; theta grad flows through the affine grid
+    "SpatialTransformer": ([_pos(1, 2, 6, 6), _unit(1, 6) * 0.3],
+                           dict(target_shape=(5, 5)), None),
+    "ROIPooling": ([_distinct(1, 2, 6, 6),
+                    np.array([[0.0, 0.0, 0.0, 5.0, 5.0],
+                              [0.0, 1.0, 1.0, 4.0, 4.0]])],
+                   dict(pooled_size=(2, 2), spatial_scale=1.0), [0]),
+    "Correlation": ([_any(1, 3, 5, 5), _any(1, 3, 5, 5)],
+                    dict(kernel_size=1, max_displacement=1), None),
 })
 del SPEC["one_hot_like_ops"]
 
