@@ -54,6 +54,8 @@ from . import visualization
 from . import visualization as viz
 from . import amp
 from . import contrib
+from . import runtime
+from . import util
 
 __all__ = [
     "nd", "ndarray", "autograd", "random", "context", "Context", "cpu",
@@ -63,5 +65,5 @@ __all__ = [
     "sym", "Symbol", "module", "mod", "Module", "BucketingModule", "model",
     "save_checkpoint", "load_checkpoint", "profiler", "monitor",
     "operator", "image", "config", "amp", "contrib",
-    "SequentialModule", "visualization", "viz",
+    "SequentialModule", "visualization", "viz", "runtime", "util",
 ]

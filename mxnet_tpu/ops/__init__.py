@@ -12,6 +12,7 @@ from . import optimizer_ops
 from . import loss_output
 from . import attention
 from . import linalg
+from . import contrib_ops
 
 from .registry import apply_op, get_op, list_ops, register, Op
 

@@ -1,0 +1,119 @@
+"""Contrib op family (ref: src/operator/contrib/* — "port on demand" per
+SURVEY §2.2): FFT, index_copy/index_add, count_sketch, boolean_mask, and
+the SSD MultiBoxPrior anchor generator.
+
+Registered under both the bare name and the reference's ``_contrib_``
+prefix so nd/sym namespaces resolve either spelling.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+@register("fft", aliases=("_contrib_fft",))
+def fft(data, compute_size=None):
+    """ref: src/operator/contrib/fft.cc — FFT along the last axis;
+    output interleaves (real, imag) so the last dim doubles."""
+    del compute_size
+    ct = jnp.promote_types(data.dtype, jnp.float32)
+    out = jnp.fft.fft(data.astype(ct), axis=-1)
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(
+        data.dtype)
+
+
+@register("ifft", aliases=("_contrib_ifft",))
+def ifft(data, compute_size=None):
+    """ref: contrib/fft.cc IFFT — input interleaves (real, imag); the
+    reference does NOT normalize by n (matches its docs)."""
+    del compute_size
+    n = data.shape[-1] // 2
+    ct = jnp.promote_types(data.dtype, jnp.float32)
+    x = data.astype(ct).reshape(data.shape[:-1] + (n, 2))
+    comp = jax.lax.complex(x[..., 0], x[..., 1])
+    out = jnp.fft.ifft(comp, axis=-1).real * n
+    return out.astype(data.dtype)
+
+
+@register("index_copy", aliases=("_contrib_index_copy",))
+def index_copy(old, index, new):
+    """ref: contrib/index_copy.cc — copy rows of `new` into `old` at
+    `index` positions along axis 0."""
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+@register("index_add", aliases=("_contrib_index_add",))
+def index_add(data, index, value):
+    """Scatter-add rows (companion of index_copy)."""
+    return data.at[index.astype(jnp.int32)].add(value)
+
+
+@register("count_sketch", aliases=("_contrib_count_sketch",))
+def count_sketch(data, h, s, out_dim=0):
+    """ref: contrib/count_sketch.cc — random-hash feature sketch:
+    out[n, h[i]] += s[i] * data[n, i] with sign hashes s in {-1, +1}."""
+    if int(out_dim) <= 0:
+        raise ValueError("count_sketch requires out_dim > 0 (got %r); the "
+                         "reference treats it as a required parameter"
+                         % (out_dim,))
+    n, d = data.shape
+    idx = h.astype(jnp.int32).reshape(-1)[:d]
+    sign = s.astype(data.dtype).reshape(-1)[:d]
+    out = jnp.zeros((n, int(out_dim)), data.dtype)
+    return out.at[:, idx].add(data * sign[None, :])
+
+
+@register("boolean_mask", aliases=("_contrib_boolean_mask",),
+          differentiable=False)
+def boolean_mask(data, index, axis=0):
+    """ref: contrib/boolean_mask.cc. Output shape is data-dependent —
+    usable eagerly; inside jit/symbol tracing the dynamic shape is
+    rejected by XLA (same class of limitation as the reference's
+    shape-inference pass, which special-cases this op)."""
+    mask = index.astype(bool)
+    keep = jnp.nonzero(mask)[0]
+    return jnp.take(data, keep, axis=axis)
+
+
+@register("MultiBoxPrior", aliases=("_contrib_MultiBoxPrior",),
+          differentiable=False)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """ref: src/operator/contrib/multibox_prior.cc — SSD anchor boxes.
+
+    data: (N, C, H, W) feature map (only H/W used). Returns
+    (1, H*W*(len(sizes)+len(ratios)-1), 4) corner-format anchors in
+    [0, 1] coordinates, matching the reference's anchor ordering: for
+    each pixel, every size with ratios[0] first, then the remaining
+    ratios with sizes[0].
+    """
+    h, w = data.shape[2], data.shape[3]
+    sizes = tuple(sizes)
+    ratios = tuple(ratios)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (np.arange(h) + offsets[0]) * step_y
+    cx = (np.arange(w) + offsets[1]) * step_x
+    # anchor (width, height) list per the reference's enumeration:
+    # sizes-first with ratios[0], then remaining ratios with sizes[0]
+    whs = []
+    r0 = np.sqrt(ratios[0])
+    for s in sizes:
+        whs.append((s * r0, s / r0))
+    for r in ratios[1:]:
+        sr = np.sqrt(r)
+        whs.append((sizes[0] * sr, sizes[0] / sr))
+    whs = np.asarray(whs)  # (A, 2)
+    gy, gx = np.meshgrid(cy, cx, indexing="ij")
+    centers = np.stack([gx.ravel(), gy.ravel()], axis=1)  # (HW, 2) x,y
+    half = whs / 2.0
+    mins = centers[:, None, :] - half[None, :, :]
+    maxs = centers[:, None, :] + half[None, :, :]
+    anchors = np.concatenate([mins, maxs], axis=2).reshape(-1, 4)
+    if clip:
+        anchors = np.clip(anchors, 0.0, 1.0)
+    return jnp.asarray(anchors[None], jnp.float32)

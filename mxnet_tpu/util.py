@@ -1,0 +1,68 @@
+"""Misc utilities (ref: python/mxnet/util.py). The reference's numpy-
+semantics shims (use_np_shape / use_np_array) toggle global flags that
+alter NDArray behavior; this build's NDArray already follows numpy
+zero-size/zero-dim semantics natively (jax.numpy underneath), so the
+toggles are accepted for API parity and are no-ops, documented as such.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+__all__ = ["makedirs", "use_np_shape", "np_shape", "is_np_shape",
+           "use_np_array", "np_array", "is_np_array", "use_np",
+           "get_cuda_compute_capability"]
+
+
+def makedirs(d):
+    """mkdir -p (ref: util.py — makedirs; py2 compat shim upstream)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def is_np_shape():
+    """Always True: numpy shape semantics (0-dim/0-size arrays) are
+    native to this build (ref: util.py — is_np_shape)."""
+    return True
+
+
+def is_np_array():
+    """Always True — see module docstring."""
+    return True
+
+
+class _NoOpScope:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def np_shape(active=True):
+    """No-op scope for API parity (ref: util.py — np_shape)."""
+    del active
+    return _NoOpScope()
+
+
+def np_array(active=True):
+    """No-op scope for API parity (ref: util.py — np_array)."""
+    del active
+    return _NoOpScope()
+
+
+def use_np_shape(func):
+    """Decorator form, identity here (ref: util.py — use_np_shape)."""
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        return func(*args, **kwargs)
+    return wrapper
+
+
+use_np_array = use_np_shape
+use_np = use_np_shape
+
+
+def get_cuda_compute_capability(ctx=None):
+    """No CUDA in the TPU build (ref: util.py) — explicit error beats a
+    silent wrong answer."""
+    raise RuntimeError("CUDA is not available in the TPU build")

@@ -1,0 +1,96 @@
+"""Contrib ops (ref: src/operator/contrib/*) + mx.runtime feature flags
++ mx.util parity shims."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_fft_ifft_roundtrip():
+    x = np.random.RandomState(0).randn(3, 8).astype("f4")
+    f = nd.fft(nd.array(x))
+    assert f.shape == (3, 16)
+    # interleaved (re, im) matches numpy fft
+    ref = np.fft.fft(x, axis=-1)
+    got = f.asnumpy().reshape(3, 8, 2)
+    np.testing.assert_allclose(got[..., 0], ref.real, atol=1e-4)
+    np.testing.assert_allclose(got[..., 1], ref.imag, atol=1e-4)
+    # reference ifft is unnormalized: ifft(fft(x)) == n * x
+    back = nd.ifft(f).asnumpy()
+    np.testing.assert_allclose(back, 8 * x, rtol=1e-4, atol=1e-4)
+
+
+def test_index_copy_add():
+    old = nd.zeros((4, 3))
+    new = nd.array(np.ones((2, 3), "f4"))
+    idx = nd.array(np.array([1.0, 3.0], "f4"))
+    out = nd.index_copy(old, idx, new).asnumpy()
+    assert out[1].sum() == 3 and out[3].sum() == 3 and out[0].sum() == 0
+    out2 = nd.index_add(nd.array(out), idx, new).asnumpy()
+    assert out2[1].sum() == 6
+
+
+def test_count_sketch():
+    x = np.array([[1.0, 2.0, 3.0]], dtype="f4")
+    h = nd.array(np.array([0.0, 1.0, 0.0], "f4"))
+    s = nd.array(np.array([1.0, -1.0, 1.0], "f4"))
+    out = nd.count_sketch(nd.array(x), h, s, out_dim=2).asnumpy()
+    np.testing.assert_allclose(out, [[4.0, -2.0]])
+
+
+def test_boolean_mask():
+    x = nd.array(np.arange(12, dtype="f4").reshape(4, 3))
+    m = nd.array(np.array([1.0, 0.0, 1.0, 0.0], "f4"))
+    out = nd.boolean_mask(x, m).asnumpy()
+    np.testing.assert_array_equal(out, x.asnumpy()[[0, 2]])
+
+
+def test_multibox_prior():
+    data = nd.zeros((1, 16, 4, 4))
+    anchors = nd.MultiBoxPrior(data, sizes=(0.5, 0.25), ratios=(1.0, 2.0))
+    # A = len(sizes) + len(ratios) - 1 = 3 anchors per pixel
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0]
+    # first anchor at first pixel: size .5, ratio 1 centered at (1/8, 1/8)
+    np.testing.assert_allclose(a[0], [0.125 - 0.25, 0.125 - 0.25,
+                                      0.125 + 0.25, 0.125 + 0.25],
+                               atol=1e-6)
+    # reference enumeration order: sizes-first with ratios[0], then
+    # remaining ratios with sizes[0] — anchor 1 is size .25/ratio 1,
+    # anchor 2 is size .5/ratio 2
+    np.testing.assert_allclose(a[1, 2] - a[1, 0], 0.25, atol=1e-6)
+    np.testing.assert_allclose(a[2, 2] - a[2, 0], 0.5 * np.sqrt(2),
+                               atol=1e-6)
+    np.testing.assert_allclose(a[2, 3] - a[2, 1], 0.5 / np.sqrt(2),
+                               atol=1e-6)
+    # widths/heights positive, centers inside the unit square
+    assert np.all(a[:, 2] > a[:, 0]) and np.all(a[:, 3] > a[:, 1])
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("CPU")
+    assert not feats.is_enabled("CUDA")
+    assert "NATIVE_RECORDIO" in feats
+    # flash-attention probe must agree with the op's own dispatch
+    from mxnet_tpu.ops import attention
+
+    assert feats.is_enabled("FLASH_ATTENTION") == attention._use_pallas()
+    lst = mx.runtime.feature_list()
+    assert any(f.name == "TPU" for f in lst)
+
+
+def test_util_shims():
+    assert mx.util.is_np_shape() and mx.util.is_np_array()
+    with mx.util.np_shape():
+        pass
+
+    @mx.util.use_np
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+    import pytest
+
+    with pytest.raises(RuntimeError):
+        mx.util.get_cuda_compute_capability()

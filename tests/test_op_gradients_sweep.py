@@ -303,6 +303,16 @@ SPEC.update({
                    dict(pooled_size=(2, 2), spatial_scale=1.0), [0]),
     "Correlation": ([_any(1, 3, 5, 5), _any(1, 3, 5, 5)],
                     dict(kernel_size=1, max_displacement=1), None),
+    # contrib family
+    "fft": ([_any(3, 8)], {}, None),
+    "ifft": ([_any(3, 16)], {}, None),
+    "index_copy": ([_any(5, 4), np.array([0.0, 2.0]), _any(2, 4)],
+                   {}, [0, 2]),
+    "index_add": ([_any(5, 4), np.array([1.0, 3.0]), _any(2, 4)],
+                  {}, [0, 2]),
+    "count_sketch": ([_any(3, 6), np.array([0.0, 2, 1, 3, 0, 2]),
+                      np.array([1.0, -1, 1, -1, 1, 1])],
+                     dict(out_dim=4), [0]),
 })
 del SPEC["one_hot_like_ops"]
 
