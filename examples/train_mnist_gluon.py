@@ -1,0 +1,92 @@
+"""LeNet-5 on MNIST via Gluon (ref: example/image-classification/
+train_mnist.py + gluon examples). Uses the real MNIST files if
+--data-dir has them, else synthetic digits so the example always runs.
+
+Run:  python examples/train_mnist_gluon.py --epochs 2 --batch-size 256
+"""
+import argparse
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import nn
+
+
+def lenet():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(20, kernel_size=5, activation="tanh"),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(50, kernel_size=5, activation="tanh"),
+            nn.MaxPool2D(2, 2),
+            nn.Flatten(),
+            nn.Dense(500, activation="tanh"),
+            nn.Dense(10))
+    return net
+
+
+def load_data(args):
+    try:
+        from mxnet_tpu.gluon.data.vision import MNIST
+
+        train = MNIST(root=args.data_dir, train=True)
+        x = np.stack([np.asarray(im) for im, _ in train]).astype("f4")
+        y = np.asarray([lbl for _, lbl in train]).astype("f4")
+        x = x.reshape(-1, 1, 28, 28) / 255.0
+        return x, y
+    except Exception:
+        print("MNIST files not found — using synthetic data")
+        rng = np.random.RandomState(0)
+        x = rng.rand(4096, 1, 28, 28).astype("f4")
+        y = rng.randint(0, 10, (4096,)).astype("f4")
+        return x, y
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--data-dir", default="data/mnist")
+    p.add_argument("--hybridize", action="store_true", default=True)
+    args = p.parse_args()
+
+    mx.random.seed(42)
+    net = lenet()
+    net.initialize(init=mx.init.Xavier())
+    if args.hybridize:
+        net.hybridize()  # whole net -> one XLA program
+
+    x, y = load_data(args)
+    train_iter = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True)
+
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+    speedo = mx.callback.Speedometer(args.batch_size, frequent=20)
+
+    for epoch in range(args.epochs):
+        train_iter.reset()
+        metric.reset()
+        for i, batch in enumerate(train_iter):
+            data, label = batch.data[0], batch.label[0]
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update([label], [out])
+            speedo(mx.model.BatchEndParam(epoch=epoch, nbatch=i,
+                                          eval_metric=metric, locals=None))
+        print("epoch %d: train acc %.4f" % (epoch, metric.get()[1]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""MLP on MNIST via the symbolic Module API (ref:
+example/image-classification/train_mnist.py --network mlp). The whole
+bound graph lowers to one XLA program; Module.fit drives epochs,
+metrics, and checkpoints exactly like the reference loop.
+
+Run:  python examples/train_mnist_module.py --epochs 2
+"""
+import argparse
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def mlp_symbol():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    h = mx.sym.FullyConnected(h, num_hidden=64, name="fc2")
+    h = mx.sym.Activation(h, act_type="relu", name="relu2")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc3")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--checkpoint-prefix", default=None)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(4096, 784).astype("f4")
+    y = rng.randint(0, 10, (4096,)).astype("f4")
+    train_iter = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True,
+                                   label_name="softmax_label")
+    val_iter = mx.io.NDArrayIter(x[:512], y[:512], args.batch_size,
+                                 label_name="softmax_label")
+
+    mod = mx.mod.Module(mlp_symbol(), data_names=("data",),
+                        label_names=("softmax_label",))
+    callbacks = [mx.callback.Speedometer(args.batch_size, frequent=10)]
+    epoch_cbs = []
+    if args.checkpoint_prefix:
+        epoch_cbs.append(mx.callback.module_checkpoint(
+            mod, args.checkpoint_prefix))
+    mod.fit(train_iter, eval_data=val_iter,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric="acc",
+            batch_end_callback=callbacks,
+            epoch_end_callback=epoch_cbs or None,
+            num_epoch=args.epochs)
+    score = mod.score(val_iter, mx.metric.Accuracy())
+    print("final val:", score)
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,7 @@ from ..block import Block, HybridBlock
 from .layout import resolve_norm_axis
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+           "SyncBatchNorm",
            "Embedding", "Flatten", "Lambda", "HybridLambda", "Activation",
            "LayerNorm", "InstanceNorm", "GroupNorm"]
 
@@ -197,6 +198,43 @@ class BatchNorm(HybridBlock):
                 self.running_mean.data()._set_data(new_mean.data)
                 self.running_var.data()._set_data(new_var.data)
         return out
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm
+    (ref: python/mxnet/gluon/contrib/nn — SyncBatchNorm over
+    src/operator/contrib/sync_batch_norm.cc, which runs an explicit
+    all-reduce of per-device sums inside the kernel).
+
+    TPU-native design note: no explicit collective is needed. Inside a
+    jitted SPMD step (ShardedTrainStep / pjit) the batch is a GLOBAL
+    array sharded over the mesh's data axis, so the ``jnp.mean``/var in
+    the BatchNorm kernel are already global reductions — GSPMD inserts
+    the cross-device psum automatically, and partitioning stays XLA's
+    job. This subclass therefore only exists for API parity: it IS
+    synchronized wherever the reference's would be (inside the sharded
+    step), and in pure single-device eager mode it degenerates to plain
+    BatchNorm exactly like the reference's does in a 1-GPU run.
+    ``num_devices``/``ndev`` are accepted and ignored (mesh size rules).
+    tests/test_parallel.py pins the global-stats property on an 8-device
+    mesh."""
+
+    def __init__(self, in_channels=0, num_devices=None, ndev=None,
+                 momentum=0.9, epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        del num_devices, ndev
+        super().__init__(axis=kwargs.pop("axis", None), momentum=momentum,
+                         epsilon=epsilon, center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=(
+                             running_variance_initializer),
+                         in_channels=in_channels, **kwargs)
 
 
 class Embedding(HybridBlock):

@@ -4,9 +4,16 @@ load_checkpoint; format: prefix-symbol.json + prefix-%04d.params with
 """
 from __future__ import annotations
 
+from collections import namedtuple
+
 from .ndarray import ndarray as _nd
 
-__all__ = ["save_checkpoint", "load_checkpoint"]
+__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
+
+# callback payload for batch_end/score_end callbacks
+# (ref: python/mxnet/model.py — BatchEndParam namedtuple)
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,

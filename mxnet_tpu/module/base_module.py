@@ -17,6 +17,7 @@ from ..base import MXNetError
 from .. import metric as metric_mod
 from .. import initializer as init_mod
 from ..io.io import DataBatch
+from ..model import BatchEndParam
 
 __all__ = ["BaseModule"]
 
@@ -249,13 +250,3 @@ def _as_list(obj):
     if isinstance(obj, (list, tuple)):
         return obj
     return [obj]
-
-
-class BatchEndParam:
-    """Callback payload (ref: python/mxnet/model.py — BatchEndParam)."""
-
-    def __init__(self, epoch, nbatch, eval_metric, locals):
-        self.epoch = epoch
-        self.nbatch = nbatch
-        self.eval_metric = eval_metric
-        self.locals = locals

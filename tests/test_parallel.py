@@ -275,3 +275,59 @@ def test_ring_attention_backward_memory_is_o_t_over_n():
     tl = T // n
     budget = n * (4 * B * H * tl * D + B * H * tl)
     assert total <= budget, (total, budget)
+
+
+def test_sync_batchnorm_global_stats_on_mesh():
+    """SyncBatchNorm's design claim (basic_layers.py): inside the SPMD
+    sharded step the batch is a global array, so BN batch stats are
+    global — an 8-way sharded step must update running stats and params
+    identically to a single-device run over the same global batch."""
+    np.random.seed(1)
+    x = np.random.uniform(-2, 2, (16, 6, 5, 5)).astype(np.float32)
+    y = np.random.randint(0, 3, (16,)).astype(np.float32)
+
+    def build():
+        net = mx.gluon.nn.HybridSequential()
+        net.add(mx.gluon.nn.Conv2D(8, kernel_size=3, padding=1,
+                                   use_bias=False),
+                mx.gluon.nn.SyncBatchNorm(),
+                mx.gluon.nn.Activation("relu"),
+                mx.gluon.nn.Flatten(),
+                mx.gluon.nn.Dense(3))
+        net.initialize()
+        net(nd.array(x))  # resolve shapes
+        return net
+
+    mx.random.seed(3)
+    net_a = build()
+    mx.random.seed(3)
+    net_b = build()
+
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net_a.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    with mx.autograd.record():
+        loss_a = loss_fn(net_a(nd.array(x)), nd.array(y)).mean()
+    loss_a.backward()
+    trainer.step(1)
+
+    mesh = parallel.make_mesh(axis_names=("data",))
+    step = parallel.ShardedTrainStep(net_b, loss_fn, "sgd",
+                                     {"learning_rate": 0.1}, mesh=mesh)
+    loss_b = step(nd.array(x), nd.array(y))
+
+    assert abs(float(loss_a.asscalar()) - float(loss_b.asscalar())) < 1e-5
+    pa = dict(net_a.collect_params().items())
+    pb = dict(net_b.collect_params().items())
+    for (ka, va), (kb, vb) in zip(sorted(pa.items()), sorted(pb.items())):
+        assert_almost_equal(va.data().asnumpy(), vb.data().asnumpy(),
+                            rtol=1e-4, atol=1e-5)
+    # running stats specifically: the sharded step must have used GLOBAL
+    # batch stats (a per-shard implementation would disagree here)
+    rm_a = [v.data().asnumpy() for k, v in sorted(pa.items())
+            if k.endswith("running_mean")]
+    rm_b = [v.data().asnumpy() for k, v in sorted(pb.items())
+            if k.endswith("running_mean")]
+    for a, b in zip(rm_a, rm_b):
+        assert_almost_equal(a, b, rtol=1e-4, atol=1e-6)
+    assert any(np.abs(a).max() > 0 for a in rm_a)  # stats actually moved
