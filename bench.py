@@ -146,7 +146,8 @@ def bench_resnet50(platform, dtype):
 
     step = parallel.ShardedTrainStep(
         net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
-        {"learning_rate": 0.1, "momentum": 0.9})
+        {"learning_rate": 0.1, "momentum": 0.9},
+        remat=os.environ.get("BENCH_REMAT") or None)
 
     rng = np.random.RandomState(0)
     x = nd.array(rng.uniform(-1, 1, in_shape).astype(np.float32))

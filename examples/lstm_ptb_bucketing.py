@@ -80,8 +80,7 @@ def main():
             mod.forward(batch, is_train=True)
             mod.backward()
             mod.update()
-            out = mod.get_outputs()[0].reshape((b, blen, args.vocab))
-            per.update([nd.array(y)], [out.reshape((-1, args.vocab))])
+            per.update([nd.array(y)], [mod.get_outputs()[0]])
         print("epoch %d: %s = %.2f" % (epoch, *per.get()))
 
 

@@ -75,8 +75,8 @@ def main():
     for epoch in range(100):
         it.reset()
         for batch in it:
-            x = batch.data[0].astype(args.dtype)
-            x = nd.array(x.asnumpy().transpose(0, 2, 3, 1))  # NCHW->NHWC
+            # device-side layout flip, fuses into the step
+            x = batch.data[0].astype(args.dtype).transpose((0, 2, 3, 1))
             loss = step(x, batch.label[0])
             n += 1
             speedo(mx.model.BatchEndParam(epoch=epoch, nbatch=n,

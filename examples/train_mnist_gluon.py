@@ -54,7 +54,9 @@ def main():
     p.add_argument("--batch-size", type=int, default=256)
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--data-dir", default="data/mnist")
-    p.add_argument("--hybridize", action="store_true", default=True)
+    p.add_argument("--no-hybridize", dest="hybridize",
+                   action="store_false", default=True,
+                   help="run the eager (non-jitted) path")
     args = p.parse_args()
 
     mx.random.seed(42)

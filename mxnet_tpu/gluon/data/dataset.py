@@ -98,7 +98,10 @@ class ArrayDataset(Dataset):
 
 class RecordFileDataset(Dataset):
     """Dataset over an indexed RecordIO file
-    (ref: dataset.py — RecordFileDataset)."""
+    (ref: dataset.py — RecordFileDataset). Reads go through the native
+    C++ engine when available (thread-local readers, no lock contention
+    across DataLoader worker threads); otherwise the locked Python
+    reader."""
 
     def __init__(self, filename):
         import threading
@@ -111,8 +114,37 @@ class RecordFileDataset(Dataset):
         # DataLoader workers are threads here (the reference forks
         # processes); the seek+read pair on the shared handle must be atomic
         self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._payload = None
+        try:
+            from ... import native
+
+            if native.available():
+                nat = native.NativeRecordReader(filename)
+                offs, lens = nat.scan()
+                nat.close()
+                starts = {int(o) - 8: i for i, o in enumerate(offs)}
+                # map the .idx key order onto scanned records; a stale
+                # sidecar falls back to the locked Python reader
+                sel = [starts[int(self._record.idx[k])]
+                       for k in self._record.keys]
+                self._payload = (offs[sel], lens[sel])
+                self._native = native
+        except Exception:  # noqa: BLE001 — python fallback
+            self._payload = None
+
+    def _native_reader(self):
+        r = getattr(self._tls, "reader", None)
+        if r is None:
+            r = self._native.NativeRecordReader(self.filename)
+            self._tls.reader = r
+        return r
 
     def __getitem__(self, idx):
+        if self._payload is not None:
+            offs, lens = self._payload
+            return self._native_reader().read_at(int(offs[idx]),
+                                                 int(lens[idx]))
         with self._lock:
             return self._record.read_idx(self._record.keys[idx])
 

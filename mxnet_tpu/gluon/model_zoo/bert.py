@@ -140,6 +140,7 @@ class BERTModel(HybridBlock):
         super().__init__(prefix=prefix, params=params)
         self._units = units
         self._max_length = max_length
+        self._vocab_size = vocab_size
         with self.name_scope():
             self.word_embed = nn.Embedding(vocab_size, units,
                                            prefix="word_embed_")

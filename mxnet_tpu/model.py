@@ -12,7 +12,7 @@ __all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
 
 # callback payload for batch_end/score_end callbacks
 # (ref: python/mxnet/model.py — BatchEndParam namedtuple)
-BatchEndParam = namedtuple("BatchEndParams",
+BatchEndParam = namedtuple("BatchEndParam",
                            ["epoch", "nbatch", "eval_metric", "locals"])
 
 

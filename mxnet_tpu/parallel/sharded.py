@@ -132,9 +132,15 @@ class ShardedTrainStep:
     """
 
     def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
-                 mesh=None, rules=None, data_axis="data"):
+                 mesh=None, rules=None, data_axis="data", remat=None):
+        """remat: None (save all intermediates — XLA default), "full"
+        (recompute the whole forward in backward; ~1/3 more FLOPs for far
+        less saved-activation HBM traffic — the jax.checkpoint analog of
+        the reference's mirror/memonger), or any name from
+        jax.checkpoint_policies (e.g. "dots_saveable")."""
         self.block = block
         self.loss_fn = loss_fn
+        self._remat = remat
         self.mesh = mesh or make_mesh(axis_names=(data_axis,))
         self.data_axis = data_axis
         self._all_params = OrderedDict(
@@ -187,15 +193,24 @@ class ShardedTrainStep:
             jax.lax.stop_gradient(wrappers[n].data) for n in self._aux_names)
         return loss.data, new_aux
 
+    def _loss_for_grad(self):
+        if self._remat is None:
+            return self._pure_loss
+        if self._remat == "full":
+            return jax.checkpoint(self._pure_loss)
+        policy = getattr(jax.checkpoint_policies, self._remat)
+        return jax.checkpoint(self._pure_loss, policy=policy)
+
     def _build(self):
+        loss_fn = self._loss_for_grad()
+
         def step(train_vals, states, aux_vals, x, y, base_key, t):
             # RNG key and step count are derived ON DEVICE from the carried
             # t — one launch per step, no per-step host->device transfers.
             t = t + 1
             key = jax.random.fold_in(base_key, t)
             (loss, new_aux), grads = jax.value_and_grad(
-                self._pure_loss, has_aux=True)(train_vals, aux_vals, x, y,
-                                               key)
+                loss_fn, has_aux=True)(train_vals, aux_vals, x, y, key)
             new_train = []
             new_states = []
             for w, g, s in zip(train_vals, grads, states):

@@ -108,3 +108,46 @@ def test_image_record_iter_uses_native(tmp_path):
         assert b.data[0].shape == (5, 3, 32, 32)
     labels = np.concatenate([b.label[0].asnumpy() for b in batches])
     np.testing.assert_array_equal(labels, np.arange(20) % 4)
+
+
+def test_record_file_dataset_native_path(tmp_path):
+    from mxnet_tpu.gluon.data import RecordFileDataset
+
+    p = str(tmp_path / "ds.rec")
+    rng = np.random.RandomState(3)
+    payloads = [bytes(rng.randint(0, 256, 100 + i, dtype=np.uint8))
+                for i in range(40)]
+    w = recordio.MXIndexedRecordIO(str(tmp_path / "ds.idx"), p, "w")
+    for i, pl in enumerate(payloads):
+        w.write_idx(i, pl)
+    w.close()
+
+    ds = RecordFileDataset(p)
+    assert ds._payload is not None  # native fast path engaged
+    assert len(ds) == 40
+    for i in (0, 17, 39):
+        assert ds[i] == payloads[i]
+
+    # threaded readers (DataLoader worker pattern) agree
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(4) as pool:
+        got = list(pool.map(lambda i: ds[i], range(40)))
+    assert got == payloads
+
+
+def test_record_file_dataset_stale_idx_falls_back(tmp_path):
+    from mxnet_tpu.gluon.data import RecordFileDataset
+
+    p = str(tmp_path / "ds2.rec")
+    idx = str(tmp_path / "ds2.idx")
+    w = recordio.MXIndexedRecordIO(idx, p, "w")
+    for i in range(5):
+        w.write_idx(i, b"x" * (10 + i))
+    w.close()
+    # corrupt the sidecar offsets (regenerated .rec scenario)
+    with open(idx, "w") as f:
+        for i in range(5):
+            f.write("%d\t%d\n" % (i, 1000 + i))
+    ds = RecordFileDataset(p)
+    assert ds._payload is None  # fell back to the python reader
