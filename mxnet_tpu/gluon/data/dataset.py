@@ -123,13 +123,13 @@ class RecordFileDataset(Dataset):
                 nat = native.NativeRecordReader(filename)
                 offs, lens = nat.scan()
                 nat.close()
-                starts = {int(o) - 8: i for i, o in enumerate(offs)}
                 # map the .idx key order onto scanned records; a stale
                 # sidecar falls back to the locked Python reader
-                sel = [starts[int(self._record.idx[k])]
-                       for k in self._record.keys]
-                self._payload = (offs[sel], lens[sel])
-                self._native = native
+                self._payload = native.select_payload_by_starts(
+                    offs, lens,
+                    [self._record.idx[k] for k in self._record.keys])
+                if self._payload is not None:
+                    self._native = native
         except Exception:  # noqa: BLE001 — python fallback
             self._payload = None
 

@@ -502,23 +502,15 @@ class ImageRecordIter(DataIter):
                 rec.close()
         elif path_imgidx:
             # honor the sidecar's key order/subset when it exists; a stale
-            # .idx (offsets not matching any scanned record) drops us back
-            # to the Python reader, whose first read surfaces the clear
-            # invalid-magic error
+            # .idx drops us back to the Python reader
             rec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
             wanted = [rec.idx[k] for k in rec.keys]
             rec.close()
-            by_start = {int(o) - 8: i
-                        for i, o in enumerate(self._payload[0])}
             self._offsets = wanted
-            try:
-                sel = [by_start[int(w)] for w in wanted]
-            except KeyError:
+            self._payload = self._native.select_payload_by_starts(
+                self._payload[0], self._payload[1], wanted)
+            if self._payload is None:
                 self._native = None
-                self._payload = None
-            else:
-                self._payload = (self._payload[0][sel],
-                                 self._payload[1][sel])
         # distributed sharding (part_index/num_parts — dmlc InputSplit)
         self._offsets = self._offsets[part_index::num_parts]
         if self._payload is not None:
@@ -542,6 +534,22 @@ class ImageRecordIter(DataIter):
         if self.shuffle:
             self._rng.shuffle(self._order)
         self._cursor = 0
+        # epoch-scoped native read-ahead: C++ workers pull records ahead
+        # of the decode threads in shuffled order, overlapping file IO
+        # with augmentation and the device step (the reference's
+        # ThreadedIter/prefetcher role, src/io/iter_prefetcher.h)
+        if getattr(self, "_prefetcher", None) is not None:
+            self._prefetcher.stop()
+        self._prefetcher = None
+        if self._native is not None and len(self._order):
+            try:
+                self._prefetcher = self._native.NativePrefetcher(
+                    self.path_imgrec, self._payload[0], self._payload[1],
+                    self._order,
+                    num_threads=max(2, self.preprocess_threads // 2),
+                    capacity=4 * self.batch_size)
+            except Exception:  # noqa: BLE001 — per-batch reads still work
+                self._prefetcher = None
 
     def _decode_one(self, raw, rng):
         header, img = self._unpack_img(raw)
@@ -577,6 +585,15 @@ class ImageRecordIter(DataIter):
             # round_batch=False: emit the shorter final batch as-is
         self._cursor = end
 
+        n_main = len(idxs) - pad  # in-order part; `pad` wraps to the start
+        # raw record bytes: pop the epoch prefetcher for the in-order part
+        # (already read ahead by the C++ ring); wrapped duplicates (pad)
+        # and the non-native path read directly
+        raws = [None] * len(idxs)
+        if self._prefetcher is not None:
+            for j in range(n_main):
+                raws[j] = self._prefetcher.pop()
+
         results = [None] * len(idxs)
         # per-thread RNG (np.random.RandomState is not thread-safe), seeded
         # from the iterator's stream so a fixed seed stays deterministic
@@ -586,24 +603,30 @@ class ImageRecordIter(DataIter):
         def worker(tid):
             # one file handle per thread (neither the Python reader nor the
             # native FILE* is safe to share across seeking threads)
+            nat = reader = None
             if self._native is not None:
-                nat = self._native.NativeRecordReader(self.path_imgrec)
                 offs, lens = self._payload
 
                 def fetch(i):
+                    nonlocal nat
+                    if nat is None:
+                        nat = self._native.NativeRecordReader(
+                            self.path_imgrec)
                     return nat.read_at(int(offs[i]), int(lens[i]))
             else:
-                reader = MXRecordIO(self.path_imgrec, "r")
-
                 def fetch(i):
+                    nonlocal reader
+                    if reader is None:
+                        reader = MXRecordIO(self.path_imgrec, "r")
                     reader.handle.seek(self._offsets[i])
                     return reader.read()
             rng = np.random.RandomState(rng_seeds[tid])
             for j in range(tid, len(idxs), self.preprocess_threads):
-                results[j] = self._decode_one(fetch(idxs[j]), rng)
-            if self._native is not None:
+                raw = raws[j] if raws[j] is not None else fetch(idxs[j])
+                results[j] = self._decode_one(raw, rng)
+            if nat is not None:
                 nat.close()
-            else:
+            if reader is not None:
                 reader.close()
 
         threads = [threading.Thread(target=worker, args=(t,))

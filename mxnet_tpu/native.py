@@ -17,7 +17,24 @@ import threading
 
 import numpy as np
 
-__all__ = ["available", "NativeRecordReader", "NativePrefetcher"]
+__all__ = ["available", "NativeRecordReader", "NativePrefetcher",
+           "select_payload_by_starts"]
+
+_HEADER_BYTES = 8  # [magic u32][cflag|len u32] precede every payload
+
+
+def select_payload_by_starts(offsets, lengths, wanted_starts):
+    """Map .idx sidecar offsets (record starts) onto a native scan's
+    (payload offsets, lengths), preserving the sidecar's order/subset.
+    Returns (offsets, lengths) or None when any start is unknown (stale
+    sidecar — callers fall back to the Python reader, whose first read
+    surfaces the clear invalid-magic error)."""
+    by_start = {int(o) - _HEADER_BYTES: i for i, o in enumerate(offsets)}
+    try:
+        sel = [by_start[int(w)] for w in wanted_starts]
+    except KeyError:
+        return None
+    return offsets[sel], lengths[sel]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "src", "recordio.cc")

@@ -140,6 +140,13 @@ class ShardedTrainStep:
         jax.checkpoint_policies (e.g. "dots_saveable")."""
         self.block = block
         self.loss_fn = loss_fn
+        if remat not in (None, "full") and \
+                not hasattr(jax.checkpoint_policies, str(remat)):
+            valid = [n for n in dir(jax.checkpoint_policies)
+                     if not n.startswith("_")]
+            raise MXNetError(
+                "unknown remat %r — use None, 'full', or one of %s"
+                % (remat, valid))
         self._remat = remat
         self.mesh = mesh or make_mesh(axis_names=(data_axis,))
         self.data_axis = data_axis
