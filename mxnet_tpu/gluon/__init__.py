@@ -10,6 +10,7 @@ from . import loss
 from . import utils
 from . import data
 from . import model_zoo
+from . import contrib
 from .utils import split_and_load, split_data
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",

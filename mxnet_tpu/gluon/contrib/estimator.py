@@ -1,0 +1,248 @@
+"""Estimator — high-level Gluon training facade
+(ref: python/mxnet/gluon/contrib/estimator/estimator.py +
+event_handler.py, ≥1.5). fit() drives epochs over a DataLoader with an
+event-handler pipeline (train begin/end, epoch begin/end, batch
+begin/end); handlers cover metric logging, validation, checkpointing,
+and early stopping — the same surface the reference ships.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+from ... import autograd
+from ...base import MXNetError
+from ... import metric as metric_mod
+from ..trainer import Trainer
+
+__all__ = ["Estimator", "EventHandler", "LoggingHandler",
+           "CheckpointHandler", "EarlyStoppingHandler", "StopTraining"]
+
+
+class StopTraining(Exception):
+    """Raised by a handler to end fit() early (ref: event_handler.py)."""
+
+
+class EventHandler:
+    """Base handler — override any subset of the six events
+    (ref: event_handler.py — EventHandler mixins)."""
+
+    def train_begin(self, estimator):
+        pass
+
+    def train_end(self, estimator):
+        pass
+
+    def epoch_begin(self, estimator):
+        pass
+
+    def epoch_end(self, estimator):
+        pass
+
+    def batch_begin(self, estimator):
+        pass
+
+    def batch_end(self, estimator):
+        pass
+
+
+class LoggingHandler(EventHandler):
+    """Log metrics every `log_interval` batches + per epoch
+    (ref: event_handler.py — LoggingHandler)."""
+
+    def __init__(self, log_interval=50, logger=None):
+        self.log_interval = log_interval
+        self.logger = logger or logging.getLogger("estimator")
+
+    def train_begin(self, estimator):
+        self._tic = time.time()
+
+    def batch_end(self, estimator):
+        if estimator.batch_idx % self.log_interval == 0:
+            msgs = ["%s=%.4f" % m.get() for m in estimator.train_metrics]
+            self.logger.info("epoch %d batch %d %s", estimator.epoch,
+                             estimator.batch_idx, " ".join(msgs))
+
+    def epoch_end(self, estimator):
+        msgs = ["train %s=%.4f" % m.get() for m in estimator.train_metrics]
+        msgs += ["val %s=%.4f" % m.get() for m in estimator.val_metrics
+                 if m.num_inst]
+        self.logger.info("epoch %d done (%.1fs): %s", estimator.epoch,
+                         time.time() - self._tic, " ".join(msgs))
+
+
+def _default_monitor(estimator):
+    """Prefer a validation metric that actually saw data (val_metrics are
+    always allocated but stay empty without val_data), else train."""
+    for m in estimator.val_metrics:
+        if m.num_inst:
+            return m
+    return estimator.train_metrics[0]
+
+
+class CheckpointHandler(EventHandler):
+    """Save parameters each epoch, optionally only on metric improvement
+    (ref: event_handler.py — CheckpointHandler). mode: "max" for
+    accuracy-like monitors, "min" for loss-like."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 save_best=False, mode="max"):
+        import os
+
+        os.makedirs(model_dir, exist_ok=True)
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.mode = mode
+        self._best = None
+
+    def epoch_end(self, estimator):
+        import os
+
+        path = os.path.join(self.model_dir, "%s-%04d.params"
+                            % (self.model_prefix, estimator.epoch))
+        if not self.save_best:
+            estimator.net.save_parameters(path)
+            return
+        metric = self.monitor or _default_monitor(estimator)
+        _, value = metric.get()
+        improved = self._best is None or (
+            value > self._best if self.mode == "max" else value < self._best)
+        if improved:
+            self._best = value
+            estimator.net.save_parameters(os.path.join(
+                self.model_dir, "%s-best.params" % self.model_prefix))
+
+
+class EarlyStoppingHandler(EventHandler):
+    """Stop when the monitored metric stops improving
+    (ref: event_handler.py — EarlyStoppingHandler)."""
+
+    def __init__(self, monitor=None, min_delta=0.0, patience=0,
+                 mode="max"):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.mode = mode
+        self._best = None
+        self._wait = 0
+
+    def epoch_end(self, estimator):
+        metric = self.monitor or _default_monitor(estimator)
+        _, value = metric.get()
+        improved = (self._best is None
+                    or (self.mode == "max"
+                        and value > self._best + self.min_delta)
+                    or (self.mode == "min"
+                        and value < self._best - self.min_delta))
+        if improved:
+            self._best = value
+            self._wait = 0
+        else:
+            self._wait += 1
+            if self._wait > self.patience:
+                raise StopTraining(
+                    "no improvement for %d epochs (best %.4f)"
+                    % (self._wait, self._best))
+
+
+class Estimator:
+    """fit/evaluate facade over net + loss + trainer
+    (ref: estimator.py — Estimator).
+
+    Usage::
+
+        est = Estimator(net, loss, metrics=mx.metric.Accuracy(),
+                        trainer=trainer)
+        est.fit(train_loader, val_data=val_loader, epochs=3)
+    """
+
+    def __init__(self, net, loss, metrics=None, trainer=None, context=None):
+        del context  # device placement is XLA's job in this build
+        self.net = net
+        self.loss = loss
+        metrics = metrics if isinstance(metrics, (list, tuple)) else (
+            [metrics] if metrics is not None else [])
+        for m in metrics:
+            if not isinstance(m, metric_mod.EvalMetric):
+                raise MXNetError("metrics must be EvalMetric instances, "
+                                 "got %r" % (m,))
+        self.train_metrics = list(metrics) or [metric_mod.Loss("loss")]
+        self.val_metrics = [type(m)() if type(m) is not metric_mod.Loss
+                            else metric_mod.Loss("val_loss")
+                            for m in self.train_metrics]
+        if trainer is None:
+            trainer = Trainer(net.collect_params(), "adam",
+                              {"learning_rate": 1e-3})
+        self.trainer = trainer
+        self.epoch = 0
+        self.batch_idx = 0
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _batches(data):
+        """Support re-iterable sequences, DataLoaders, and DataIter-style
+        objects (DataIter must be reset between epochs; its batches carry
+        .data/.label lists instead of being (x, y) tuples)."""
+        if hasattr(data, "reset"):
+            data.reset()
+        for batch in data:
+            if hasattr(batch, "data") and hasattr(batch, "label"):
+                yield batch.data[0], batch.label[0]
+            else:
+                yield batch[0], batch[1]
+
+    def _update_metrics(self, metrics, labels, preds, loss):
+        for m in metrics:
+            if isinstance(m, metric_mod.Loss):
+                m.update(None, [loss])
+            else:
+                m.update([labels], [preds])
+
+    def evaluate(self, val_data):
+        for m in self.val_metrics:
+            m.reset()
+        for data, label in self._batches(val_data):
+            pred = self.net(data)
+            loss = self.loss(pred, label)
+            self._update_metrics(self.val_metrics, label, pred, loss)
+        return [m.get() for m in self.val_metrics]
+
+    def fit(self, train_data, val_data=None, epochs=1, event_handlers=None,
+            batches=None):
+        handlers = list(event_handlers or [])
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler())
+
+        def fire(event):
+            for h in handlers:
+                getattr(h, event)(self)
+
+        fire("train_begin")
+        try:
+            for self.epoch in range(self.epoch, self.epoch + epochs):
+                for m in self.train_metrics:
+                    m.reset()
+                fire("epoch_begin")
+                for self.batch_idx, (data, label) in enumerate(
+                        self._batches(train_data)):
+                    fire("batch_begin")
+                    with autograd.record():
+                        pred = self.net(data)
+                        loss = self.loss(pred, label)
+                    loss.backward()
+                    batch_size = data.shape[0]
+                    self.trainer.step(batch_size)
+                    self._update_metrics(self.train_metrics, label, pred,
+                                         loss)
+                    fire("batch_end")
+                    if batches is not None and self.batch_idx + 1 >= batches:
+                        break
+                if val_data is not None:
+                    self.evaluate(val_data)
+                fire("epoch_end")
+        except StopTraining as e:
+            logging.getLogger("estimator").info("early stop: %s", e)
+        fire("train_end")
+        return self

@@ -7,3 +7,7 @@ from .image import (  # noqa: F401
     HorizontalFlipAug, CastAug, ColorNormalizeAug, BrightnessJitterAug,
     ContrastJitterAug, SaturationJitterAug, CreateAugmenter, ImageIter,
 )
+from .detection import (  # noqa: F401
+    DetAugmenter, DetBorrowAug, DetRandomSelectAug, DetHorizontalFlipAug,
+    DetRandomCropAug, DetRandomPadAug, CreateDetAugmenter, ImageDetIter,
+)

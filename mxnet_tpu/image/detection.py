@@ -1,0 +1,295 @@
+"""Detection-aware image pipeline (ref: python/mxnet/image/detection.py —
+Det*Aug augmenter classes + CreateDetAugmenter + ImageDetIter).
+
+Label convention matches the reference: each object is a row
+``[class_id, xmin, ymin, xmax, ymax, ...]`` with coordinates normalized
+to [0, 1]; a batch label is (B, max_objects, label_width), short images
+padded with class_id -1 rows.
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as np
+
+from ..base import MXNetError
+from ..io.io import DataBatch, DataDesc
+from ..ndarray.ndarray import NDArray
+from .image import Augmenter, ImageIter, _to_np, imdecode, imresize
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateDetAugmenter", "ImageDetIter"]
+
+
+class DetAugmenter:
+    """Detection augmenter: transforms (image, label) jointly
+    (ref: detection.py — DetAugmenter)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift an image-only Augmenter into the detection pipeline
+    (ref: detection.py — DetBorrowAug). Only geometry-preserving
+    augmenters (color/cast/normalize) are safe to borrow."""
+
+    def __init__(self, augmenter):
+        if not isinstance(augmenter, Augmenter):
+            raise MXNetError("DetBorrowAug needs an image Augmenter")
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly pick one of several augmenters (or skip)
+    (ref: detection.py — DetRandomSelectAug)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.skip_prob or not self.aug_list:
+            return src, label
+        return _pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image + x-coordinates with probability p
+    (ref: detection.py — DetHorizontalFlipAug)."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.p:
+            src = _to_np(src)[:, ::-1, :]
+            label = label.copy()
+            valid = label[:, 0] >= 0
+            x1 = label[valid, 1].copy()
+            label[valid, 1] = 1.0 - label[valid, 3]
+            label[valid, 3] = 1.0 - x1
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop with a minimum-object-coverage constraint
+    (ref: detection.py — DetRandomCropAug): sample crops until one keeps
+    every surviving object covered by >= min_object_covered; boxes are
+    clipped and re-normalized to the crop."""
+
+    def __init__(self, min_object_covered=0.3, aspect_ratio_range=(0.75,
+                 1.33), area_range=(0.3, 1.0), max_attempts=25):
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+
+    def _try_crop(self, h, w):
+        area = h * w
+        for _ in range(self.max_attempts):
+            target_area = _pyrandom.uniform(*self.area_range) * area
+            ratio = _pyrandom.uniform(*self.aspect_ratio_range)
+            cw = int(round(np.sqrt(target_area * ratio)))
+            ch = int(round(np.sqrt(target_area / ratio)))
+            if cw <= w and ch <= h:
+                x0 = _pyrandom.randint(0, w - cw)
+                y0 = _pyrandom.randint(0, h - ch)
+                return x0, y0, cw, ch
+        return None
+
+    def __call__(self, src, label):
+        img = _to_np(src)
+        h, w = img.shape[:2]
+        crop = self._try_crop(h, w)
+        if crop is None:
+            return img, label
+        x0, y0, cw, ch = crop
+        # crop window in normalized coords
+        nx0, ny0 = x0 / w, y0 / h
+        nx1, ny1 = (x0 + cw) / w, (y0 + ch) / h
+        out = label.copy()
+        valid = out[:, 0] >= 0
+        boxes = out[valid, 1:5]
+        if len(boxes):
+            ix0 = np.maximum(boxes[:, 0], nx0)
+            iy0 = np.maximum(boxes[:, 1], ny0)
+            ix1 = np.minimum(boxes[:, 2], nx1)
+            iy1 = np.minimum(boxes[:, 3], ny1)
+            inter = np.maximum(ix1 - ix0, 0) * np.maximum(iy1 - iy0, 0)
+            area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+            cover = np.where(area > 0, inter / np.maximum(area, 1e-12), 0)
+            keep = cover >= self.min_object_covered
+            if not keep.any():
+                return img, label  # crop would drop everything — skip
+            # clip + renormalize survivors; drop the rest
+            nb = np.stack([
+                (np.clip(boxes[:, 0], nx0, nx1) - nx0) / (nx1 - nx0),
+                (np.clip(boxes[:, 1], ny0, ny1) - ny0) / (ny1 - ny0),
+                (np.clip(boxes[:, 2], nx0, nx1) - nx0) / (nx1 - nx0),
+                (np.clip(boxes[:, 3], ny0, ny1) - ny0) / (ny1 - ny0),
+            ], axis=1)
+            rows = np.where(valid)[0]
+            out[rows, 1:5] = nb
+            out[rows[~keep], 0] = -1  # invalidate dropped objects
+        return img[y0:y0 + ch, x0:x0 + cw], out
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Pad to a random larger canvas, boxes shrink accordingly
+    (ref: detection.py — DetRandomPadAug)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=25, pad_val=127):
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        img = _to_np(src)
+        h, w = img.shape[:2]
+        for _ in range(self.max_attempts):
+            scale = _pyrandom.uniform(*self.area_range)
+            ratio = _pyrandom.uniform(*self.aspect_ratio_range)
+            nw = int(round(np.sqrt(scale * h * w * ratio)))
+            nh = int(round(np.sqrt(scale * h * w / ratio)))
+            if nw >= w and nh >= h:
+                x0 = _pyrandom.randint(0, nw - w)
+                y0 = _pyrandom.randint(0, nh - h)
+                canvas = np.full((nh, nw, img.shape[2]), self.pad_val,
+                                 img.dtype)
+                canvas[y0:y0 + h, x0:x0 + w] = img
+                out = label.copy()
+                valid = out[:, 0] >= 0
+                out[valid, 1] = (out[valid, 1] * w + x0) / nw
+                out[valid, 3] = (out[valid, 3] * w + x0) / nw
+                out[valid, 2] = (out[valid, 2] * h + y0) / nh
+                out[valid, 4] = (out[valid, 4] * h + y0) / nh
+                return canvas, out
+        return img, label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0,
+                       min_object_covered=0.3,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.3, 3.0), max_attempts=25,
+                       pad_val=(127, 127, 127)):
+    """Standard detection augmenter chain (ref: detection.py —
+    CreateDetAugmenter). rand_crop/rand_pad are application
+    probabilities."""
+    auglist = []
+    if resize > 0:
+        from .image import ResizeAug
+
+        auglist.append(DetBorrowAug(ResizeAug(resize)))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered,
+                                aspect_ratio_range,
+                                (area_range[0], min(1.0, area_range[1])),
+                                max_attempts)
+        auglist.append(DetRandomSelectAug([crop], 1.0 - rand_crop))
+    if rand_pad > 0:
+        padder = DetRandomPadAug(aspect_ratio_range,
+                                 (1.0, max(1.0, area_range[1])),
+                                 max_attempts, pad_val[0])
+        auglist.append(DetRandomSelectAug([padder], 1.0 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    # color/cast augs built directly — CreateAugmenter always appends a
+    # CenterCrop to its data_shape, which would destroy the image here
+    from .image import (BrightnessJitterAug, CastAug, ColorNormalizeAug,
+                        ContrastJitterAug, SaturationJitterAug)
+
+    if brightness:
+        auglist.append(DetBorrowAug(BrightnessJitterAug(brightness)))
+    if contrast:
+        auglist.append(DetBorrowAug(ContrastJitterAug(contrast)))
+    if saturation:
+        auglist.append(DetBorrowAug(SaturationJitterAug(saturation)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if mean is not None or std is not None:
+        mean = np.asarray(mean if mean is not None else (0, 0, 0),
+                          np.float32)
+        std = np.asarray(std if std is not None else (1, 1, 1), np.float32)
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: multi-object labels, padded to a fixed
+    max-objects width (ref: detection.py — ImageDetIter). Yields data
+    (B, 3, H, W) and label (B, max_objects, label_width)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, imglist=None,
+                 aug_list=None, label_width=5, max_objects=16, **kwargs):
+        # split base-iterator options from augmenter options
+        iter_kwargs = {k: kwargs.pop(k) for k in
+                       ("shuffle", "path_imgidx", "data_name", "label_name")
+                       if k in kwargs}
+        super().__init__(batch_size, data_shape, label_width=label_width,
+                         path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         imglist=imglist,
+                         aug_list=aug_list if aug_list is not None
+                         else CreateDetAugmenter(data_shape, **kwargs),
+                         **iter_kwargs)
+        self.max_objects = max_objects
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self._label_name,
+                         (self.batch_size, self.max_objects,
+                          self.label_width))]
+
+    def _parse_label(self, raw):
+        """Flat header label -> (max_objects, label_width), padded with
+        class -1 rows. Accepts either bare object rows or the reference's
+        [header_width, label_width, ...objects] packed form."""
+        flat = np.asarray(raw, np.float32).ravel()
+        lw = self.label_width
+        if flat.size >= 2 and float(flat[0]).is_integer() and \
+                flat.size > 2 and (flat.size - int(flat[0])) % lw == 0 \
+                and int(flat[1]) == lw:
+            flat = flat[int(flat[0]):]  # strip packed header
+        n = flat.size // lw
+        objs = flat[:n * lw].reshape(n, lw)
+        out = np.full((self.max_objects, lw), -1.0, np.float32)
+        out[:min(n, self.max_objects)] = objs[:self.max_objects]
+        return out
+
+    def next(self):
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, h, w, c), np.float32)
+        labels = np.full((self.batch_size, self.max_objects,
+                          self.label_width), -1.0, np.float32)
+        i = 0
+        pad = 0
+        while i < self.batch_size:
+            try:
+                label, img_bytes = self.next_sample()
+            except StopIteration:
+                if i == 0:
+                    raise
+                pad = self.batch_size - i
+                break
+            img = imdecode(img_bytes)
+            lbl = self._parse_label(label)
+            for aug in self.aug_list:
+                img, lbl = aug(img, lbl)
+            arr = _to_np(img)
+            if arr.shape[:2] != (h, w):
+                arr = _to_np(imresize(arr, w, h))
+            data[i] = arr.astype(np.float32)
+            labels[i] = lbl
+            i += 1
+        batch_data = NDArray(np.transpose(data, (0, 3, 1, 2)))
+        return DataBatch(data=[batch_data], label=[NDArray(labels)],
+                         pad=pad)

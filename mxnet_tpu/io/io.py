@@ -481,7 +481,8 @@ class ImageRecordIter(DataIter):
                 offs, lens = nat.scan()
                 nat.close()
                 self._native = _native_mod
-                self._offsets = list(offs - 8)  # record starts
+                self._offsets = list(
+                    offs - _native_mod._HEADER_BYTES)  # record starts
                 self._payload = (offs, lens)
         except Exception:  # noqa: BLE001 — fall back to Python paths
             self._native = None

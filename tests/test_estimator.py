@@ -1,0 +1,136 @@
+"""gluon.contrib.Estimator (ref: python/mxnet/gluon/contrib/estimator/)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib import Estimator
+from mxnet_tpu.gluon.contrib.estimator import (
+    CheckpointHandler, EarlyStoppingHandler, EventHandler, LoggingHandler)
+
+
+def _toy_loader(n=128, batch=32, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-1, 1, (n, 8)).astype("f4")
+    w = rng.uniform(-1, 1, (8,))
+    y = (x @ w > 0).astype("f4")
+    return [(nd.array(x[i:i + batch]), nd.array(y[i:i + batch]))
+            for i in range(0, n, batch)]
+
+
+def _net():
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize()
+    return net
+
+
+def test_fit_improves_accuracy():
+    net = _net()
+    est = Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy(),
+                    trainer=mx.gluon.Trainer(net.collect_params(), "adam",
+                                             {"learning_rate": 0.01}))
+    data = _toy_loader()
+    est.fit(data, epochs=5)
+    name, acc = est.train_metrics[0].get()
+    assert name == "accuracy" and acc > 0.8, acc
+
+
+def test_evaluate_and_val_metrics():
+    net = _net()
+    est = Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy())
+    vals = est.evaluate(_toy_loader(seed=1))
+    assert vals[0][0] == "accuracy" and 0.0 <= vals[0][1] <= 1.0
+
+
+def test_event_handler_order_and_counts():
+    calls = []
+
+    class Spy(EventHandler):
+        def train_begin(self, e):
+            calls.append("train_begin")
+
+        def epoch_begin(self, e):
+            calls.append("epoch_begin")
+
+        def batch_end(self, e):
+            calls.append("batch_end")
+
+        def epoch_end(self, e):
+            calls.append("epoch_end")
+
+        def train_end(self, e):
+            calls.append("train_end")
+
+    net = _net()
+    est = Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss())
+    est.fit(_toy_loader(n=64), epochs=2, event_handlers=[Spy()])
+    assert calls[0] == "train_begin" and calls[-1] == "train_end"
+    assert calls.count("epoch_begin") == 2
+    assert calls.count("batch_end") == 4  # 64/32 per epoch x 2
+
+
+def test_early_stopping(caplog):
+    net = _net()
+    est = Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy(),
+                    trainer=mx.gluon.Trainer(net.collect_params(), "sgd",
+                                             {"learning_rate": 0.0}))
+    # lr=0: nothing improves, patience=1 must cut the run short
+    stopper = EarlyStoppingHandler(patience=1)
+    est.fit(_toy_loader(), epochs=10, event_handlers=[stopper])
+    assert est.epoch < 9
+
+
+def test_checkpoint_handler(tmp_path):
+    net = _net()
+    est = Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy())
+    est.fit(_toy_loader(n=64), epochs=2,
+            event_handlers=[CheckpointHandler(str(tmp_path))])
+    saved = sorted(p.name for p in tmp_path.iterdir())
+    assert saved == ["model-0000.params", "model-0001.params"]
+    net2 = _net()
+    net2.load_parameters(str(tmp_path / "model-0001.params"))
+
+
+def test_rejects_non_metric():
+    with pytest.raises(mx.MXNetError):
+        Estimator(_net(), mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                  metrics="accuracy")
+
+
+def test_fit_with_dataiter_resets_epochs():
+    """DataIter inputs must be reset per epoch (not exhausted once)."""
+    net = _net()
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (64, 8)).astype("f4")
+    y = (x.sum(axis=1) > 0).astype("f4")
+    it = mx.io.NDArrayIter(x, y, 16)
+    counts = []
+
+    class Count(EventHandler):
+        def epoch_end(self, e):
+            counts.append(e.batch_idx + 1)
+
+    est = Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy())
+    est.fit(it, epochs=3, event_handlers=[Count()])
+    assert counts == [4, 4, 4], counts
+
+
+def test_early_stopping_without_val_uses_train_metric():
+    """Default monitor must fall back to a train metric that saw data
+    (val_metrics exist but are empty without val_data -> NaN trap)."""
+    net = _net()
+    est = Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy(),
+                    trainer=mx.gluon.Trainer(net.collect_params(), "adam",
+                                             {"learning_rate": 0.02}))
+    stopper = EarlyStoppingHandler(patience=3)
+    est.fit(_toy_loader(), epochs=6, event_handlers=[stopper])
+    assert not np.isnan(stopper._best)

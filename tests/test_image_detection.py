@@ -1,0 +1,140 @@
+"""mx.image detection pipeline (ref: python/mxnet/image/detection.py)."""
+import random as _pyrandom
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as mximg
+
+
+def _label(rows):
+    return np.asarray(rows, np.float32)
+
+
+def test_det_horizontal_flip_coords():
+    _pyrandom.seed(0)
+    img = np.arange(4 * 6 * 3, dtype=np.uint8).reshape(4, 6, 3)
+    lbl = _label([[0, 0.1, 0.2, 0.4, 0.6],
+                  [-1, 0, 0, 0, 0]])
+    aug = mximg.DetHorizontalFlipAug(p=1.0)
+    out, l2 = aug(img, lbl)
+    np.testing.assert_array_equal(np.asarray(out), img[:, ::-1, :])
+    np.testing.assert_allclose(l2[0], [0, 0.6, 0.2, 0.9, 0.6], atol=1e-6)
+    assert l2[1, 0] == -1  # padding rows untouched
+
+
+def test_det_borrow_aug_preserves_label():
+    aug = mximg.DetBorrowAug(mximg.CastAug())
+    img = np.ones((5, 5, 3), np.uint8) * 7
+    lbl = _label([[1, 0.1, 0.1, 0.9, 0.9]])
+    out, l2 = aug(img, lbl)
+    np.testing.assert_array_equal(l2, lbl)
+    assert out.asnumpy().dtype == np.float32
+
+
+def test_det_random_crop_keeps_covered_objects():
+    _pyrandom.seed(3)
+    img = np.zeros((40, 40, 3), np.uint8)
+    # big centered object — any accepted crop must keep it covered
+    lbl = _label([[2, 0.3, 0.3, 0.7, 0.7]])
+    aug = mximg.DetRandomCropAug(min_object_covered=0.5,
+                                 area_range=(0.5, 1.0))
+    for _ in range(10):
+        out, l2 = aug(img, lbl)
+        if l2[0, 0] >= 0:
+            box = l2[0, 1:5]
+            assert (box >= -1e-6).all() and (box <= 1 + 1e-6).all()
+            assert box[2] > box[0] and box[3] > box[1]
+
+
+def test_det_random_pad_shrinks_boxes():
+    _pyrandom.seed(1)
+    img = np.full((20, 20, 3), 9, np.uint8)
+    lbl = _label([[0, 0.0, 0.0, 1.0, 1.0]])
+    aug = mximg.DetRandomPadAug(area_range=(2.0, 2.5))
+    out, l2 = aug(img, lbl)
+    oh, ow = np.asarray(out).shape[:2]
+    assert oh >= 20 and ow >= 20 and (oh, ow) != (20, 20)
+    w_frac = l2[0, 3] - l2[0, 1]
+    assert w_frac < 1.0  # box occupies a smaller fraction after padding
+
+
+def test_create_det_augmenter_chain_runs():
+    _pyrandom.seed(0)
+    augs = mximg.CreateDetAugmenter((3, 32, 32), rand_crop=0.5,
+                                    rand_pad=0.5, rand_mirror=True,
+                                    brightness=0.1)
+    img = np.random.RandomState(0).randint(0, 255, (48, 48, 3),
+                                           dtype=np.uint8)
+    lbl = _label([[0, 0.2, 0.2, 0.8, 0.8]])
+    for _ in range(5):
+        out, l2 = img, lbl
+        for a in augs:
+            out, l2 = a(out, l2)
+        assert l2.shape == lbl.shape
+
+
+def test_image_det_iter(tmp_path):
+    from mxnet_tpu import recordio
+
+    _pyrandom.seed(0)
+    p = str(tmp_path / "det.rec")
+    idx = str(tmp_path / "det.idx")
+    rng = np.random.RandomState(0)
+    w = recordio.MXIndexedRecordIO(idx, p, "w")
+    for i in range(10):
+        img = rng.randint(0, 255, (40, 40, 3), dtype=np.uint8)
+        # packed label: header_width=2, label_width=5, then 2 objects
+        label = [2, 5,
+                 i % 3, 0.1, 0.1, 0.5, 0.5,
+                 (i + 1) % 3, 0.4, 0.4, 0.9, 0.9]
+        w.write_idx(i, recordio.pack_img((len(label), label, i, 0), img,
+                                         img_fmt=".png"))
+    w.close()
+
+    it = mximg.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                            path_imgrec=p, max_objects=4,
+                            rand_mirror=True)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    assert batch.label[0].shape == (4, 4, 5)
+    lbl = batch.label[0].asnumpy()
+    assert (lbl[:, 0, 0] >= 0).all()   # first two rows are objects
+    assert (lbl[:, 2:, 0] == -1).all()  # rest padded
+    assert it.provide_label[0].shape == (4, 4, 5)
+
+
+def test_create_det_augmenter_preserves_image_content():
+    """Regression: the color chain must not center-crop to 1x1."""
+    _pyrandom.seed(0)
+    augs = mximg.CreateDetAugmenter((3, 32, 32), brightness=0.0)
+    img = np.zeros((32, 32, 3), np.uint8)
+    img[:16] = 200  # top half bright: structure must survive
+    lbl = _label([[0, 0.1, 0.1, 0.9, 0.9]])
+    out, _ = img, lbl
+    for a in augs:
+        out, _ = a(out, lbl)
+    arr = np.asarray(out.asnumpy() if hasattr(out, "asnumpy") else out)
+    assert arr.shape[:2] == (32, 32)
+    assert arr[:16].mean() > arr[16:].mean() + 50
+
+
+def test_image_det_iter_shuffle_kwarg(tmp_path):
+    from mxnet_tpu import recordio
+
+    p = str(tmp_path / "s.rec")
+    w = recordio.MXIndexedRecordIO(str(tmp_path / "s.idx"), p, "w")
+    rng = np.random.RandomState(0)
+    for i in range(6):
+        img = rng.randint(0, 255, (36, 36, 3), dtype=np.uint8)
+        label = [2, 5, i, 0.1, 0.1, 0.5, 0.5]
+        w.write_idx(i, recordio.pack_img((len(label), label, i, 0), img,
+                                         img_fmt=".png"))
+    w.close()
+    it = mximg.ImageDetIter(batch_size=3, data_shape=(3, 32, 32),
+                            path_imgrec=p, max_objects=2, shuffle=True)
+    ids = []
+    for b in it:
+        ids.extend(b.label[0].asnumpy()[:, 0, 0].tolist())
+    assert sorted(int(v) for v in ids) == list(range(6))
