@@ -7,6 +7,7 @@ and early stopping — the same surface the reference ships.
 """
 from __future__ import annotations
 
+import copy
 import logging
 import time
 
@@ -55,6 +56,9 @@ class LoggingHandler(EventHandler):
         self.logger = logger or logging.getLogger("estimator")
 
     def train_begin(self, estimator):
+        self._tic = time.time()
+
+    def epoch_begin(self, estimator):
         self._tic = time.time()
 
     def batch_end(self, estimator):
@@ -168,10 +172,17 @@ class Estimator:
             if not isinstance(m, metric_mod.EvalMetric):
                 raise MXNetError("metrics must be EvalMetric instances, "
                                  "got %r" % (m,))
+            if isinstance(m, metric_mod.CompositeEvalMetric):
+                raise MXNetError(
+                    "pass the child metrics as a list instead of a "
+                    "CompositeEvalMetric — the handler pipeline reads "
+                    "each metric's (name, value) individually")
         self.train_metrics = list(metrics) or [metric_mod.Loss("loss")]
-        self.val_metrics = [type(m)() if type(m) is not metric_mod.Loss
-                            else metric_mod.Loss("val_loss")
-                            for m in self.train_metrics]
+        # deepcopy keeps each metric's configuration (top_k, axis, ...);
+        # a bare type(m)() would silently revert it
+        self.val_metrics = [copy.deepcopy(m) for m in self.train_metrics]
+        for m in self.val_metrics:
+            m.reset()
         if trainer is None:
             trainer = Trainer(net.collect_params(), "adam",
                               {"learning_rate": 1e-3})
@@ -189,7 +200,12 @@ class Estimator:
             data.reset()
         for batch in data:
             if hasattr(batch, "data") and hasattr(batch, "label"):
-                yield batch.data[0], batch.label[0]
+                d, l = batch.data[0], batch.label[0]
+                pad = getattr(batch, "pad", 0) or 0
+                if pad:  # strip wrap-around filler rows
+                    d = d[:d.shape[0] - pad]
+                    l = l[:l.shape[0] - pad]
+                yield d, l
             else:
                 yield batch[0], batch[1]
 
@@ -220,8 +236,9 @@ class Estimator:
                 getattr(h, event)(self)
 
         fire("train_begin")
+        start = self.epoch
         try:
-            for self.epoch in range(self.epoch, self.epoch + epochs):
+            for self.epoch in range(start, start + epochs):
                 for m in self.train_metrics:
                     m.reset()
                 fire("epoch_begin")
@@ -242,7 +259,9 @@ class Estimator:
                 if val_data is not None:
                     self.evaluate(val_data)
                 fire("epoch_end")
+            self.epoch = start + epochs  # a second fit() resumes here
         except StopTraining as e:
+            self.epoch += 1  # the stopped epoch completed
             logging.getLogger("estimator").info("early stop: %s", e)
         fire("train_end")
         return self

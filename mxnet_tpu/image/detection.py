@@ -234,6 +234,10 @@ class ImageDetIter(ImageIter):
         iter_kwargs = {k: kwargs.pop(k) for k in
                        ("shuffle", "path_imgidx", "data_name", "label_name")
                        if k in kwargs}
+        if aug_list is not None and kwargs:
+            raise MXNetError(
+                "augmenter options %s conflict with an explicit aug_list "
+                "— put them in the aug_list instead" % sorted(kwargs))
         super().__init__(batch_size, data_shape, label_width=label_width,
                          path_imgrec=path_imgrec,
                          path_imglist=path_imglist, path_root=path_root,

@@ -1,0 +1,37 @@
+"""Smoke-run every example with tiny settings — the examples are part of
+the user-facing surface (README/examples table) and must keep working.
+Each runs in-process via runpy with the CPU backend already forced by
+conftest."""
+import runpy
+import sys
+
+import pytest
+
+EXAMPLES = {
+    "examples/train_mnist_gluon.py": ["--epochs", "1", "--batch-size",
+                                      "128"],
+    "examples/train_mnist_module.py": ["--epochs", "1"],
+    # ShardedTrainStep shards the batch over conftest's 8-device mesh, so
+    # sharded-step examples need batch sizes divisible by 8
+    "examples/train_imagenet_resnet.py": [
+        "--synthetic", "--iters", "2", "--batch-size", "8",
+        "--image-shape", "3,32,32", "--dtype", "float32"],
+    "examples/lstm_ptb_bucketing.py": [
+        "--epochs", "1", "--batches", "4", "--batch-size", "4",
+        "--hidden", "16", "--vocab", "50"],
+    "examples/bert_mlm_pretrain.py": [
+        "--iters", "2", "--batch-size", "8", "--seq-len", "16"],
+    "examples/wide_deep_ctr.py": [
+        "--iters", "4", "--batch-size", "32", "--wide-vocab", "500",
+        "--deep-vocab", "200"],
+}
+
+
+@pytest.mark.parametrize("script", sorted(EXAMPLES))
+def test_example_runs(script, tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)  # scratch data dirs land here
+    args = list(EXAMPLES[script])
+    if "--synthetic" in args:
+        args += ["--rec", str(tmp_path / "train.rec")]
+    monkeypatch.setattr(sys, "argv", [script] + args)
+    runpy.run_path("/root/repo/" + script, run_name="__main__")
