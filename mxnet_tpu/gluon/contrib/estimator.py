@@ -237,8 +237,10 @@ class Estimator:
 
         fire("train_begin")
         start = self.epoch
+        epoch_trained = False  # did the current epoch finish its batches?
         try:
             for self.epoch in range(start, start + epochs):
+                epoch_trained = False
                 for m in self.train_metrics:
                     m.reset()
                 fire("epoch_begin")
@@ -258,10 +260,13 @@ class Estimator:
                         break
                 if val_data is not None:
                     self.evaluate(val_data)
+                epoch_trained = True
                 fire("epoch_end")
             self.epoch = start + epochs  # a second fit() resumes here
         except StopTraining as e:
-            self.epoch += 1  # the stopped epoch completed
+            if epoch_trained:  # raised from epoch_end: epoch completed
+                self.epoch += 1
+            # else (raised mid-epoch): resume repeats the cut epoch
             logging.getLogger("estimator").info("early stop: %s", e)
         fire("train_end")
         return self

@@ -15,7 +15,8 @@ from ...base import MXNetError
 from ..block import HybridBlock
 from .. import nn
 
-__all__ = ["BERTEncoder", "BERTModel", "get_bert_model", "bert_12_768_12",
+__all__ = ["tensor_parallel_rules",
+           "BERTEncoder", "BERTModel", "get_bert_model", "bert_12_768_12",
            "bert_6_512_8", "bert_3_64_2"]
 
 
@@ -254,3 +255,26 @@ def bert_3_64_2(**kwargs):
     kwargs.setdefault("vocab_size", 1000)
     kwargs.setdefault("max_length", 64)
     return get_bert_model(3, 64, 2, **kwargs)
+
+
+def tensor_parallel_rules():
+    """Megatron-style tensor-parallel PartitionSpecs for every BERT size
+    (pass to ShardedTrainStep(..., rules=...) with a ("data", "model")
+    mesh). Fused QKV and FFN-in are column-parallel (output dim sharded),
+    attention proj and FFN-out are row-parallel (input dim sharded) —
+    GSPMD then inserts the canonical all-reduce pair per block over the
+    "model" axis. Embeddings and LayerNorms stay replicated (the MLM
+    decoder ties the word embedding, so sharding it would all-gather
+    every step)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ... import parallel
+
+    return parallel.sharding_rule(
+        (r"attn_qkv_weight$", P("model", None)),
+        (r"attn_qkv_bias$", P("model")),
+        (r"attn_proj_weight$", P(None, "model")),
+        (r"ffn_ffn1_weight$", P("model", None)),
+        (r"ffn_ffn1_bias$", P("model")),
+        (r"ffn_ffn2_weight$", P(None, "model")),
+    )

@@ -2,6 +2,7 @@
 the user-facing surface (README/examples table) and must keep working.
 Each runs in-process via runpy with the CPU backend already forced by
 conftest."""
+import os
 import runpy
 import sys
 
@@ -27,11 +28,11 @@ EXAMPLES = {
 }
 
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
 @pytest.mark.parametrize("script", sorted(EXAMPLES))
-def test_example_runs(script, tmp_path, monkeypatch, capsys):
+def test_example_runs(script, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)  # scratch data dirs land here
-    args = list(EXAMPLES[script])
-    if "--synthetic" in args:
-        args += ["--rec", str(tmp_path / "train.rec")]
-    monkeypatch.setattr(sys, "argv", [script] + args)
-    runpy.run_path("/root/repo/" + script, run_name="__main__")
+    monkeypatch.setattr(sys, "argv", [script] + list(EXAMPLES[script]))
+    runpy.run_path(os.path.join(REPO_ROOT, script), run_name="__main__")

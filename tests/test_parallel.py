@@ -331,3 +331,61 @@ def test_sync_batchnorm_global_stats_on_mesh():
     for a, b in zip(rm_a, rm_b):
         assert_almost_equal(a, b, rtol=1e-4, atol=1e-6)
     assert any(np.abs(a).max() > 0 for a in rm_a)  # stats actually moved
+
+
+def test_bert_tensor_parallel_rules_match_replicated():
+    """model_zoo.bert.tensor_parallel_rules: a dp2 x tp4 sharded BERT
+    step must produce the same loss/params as pure dp (GSPMD inserts the
+    Megatron all-reduce pair; numerics must agree)."""
+    from mxnet_tpu.gluon import Block, model_zoo
+
+    class MLM(Block):
+        def __init__(self, bert):
+            super().__init__(prefix="tpmlm_")
+            with self.name_scope():
+                self.bert = bert
+
+        def forward(self, x):
+            seq, _ = self.bert(x, nd.zeros_like(x))
+            return self.bert.decode_mlm(seq)
+
+    def build():
+        mx.random.seed(11)
+        net = MLM(model_zoo.bert.bert_3_64_2(use_classifier=False,
+                                             dropout=0.0))
+        net.initialize()
+        return net
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randint(0, 1000, (8, 12)).astype("f4"))
+    y = nd.array(rng.randint(0, 1000, (8, 12)).astype("f4"))
+
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    class SeqLoss:
+        def __call__(self, out, label):
+            return loss_fn(out.reshape((-1, out.shape[-1])),
+                           label.reshape((-1,)))
+
+    net_dp = build()
+    net_dp(x)
+    mesh_dp = parallel.make_mesh(axis_names=("data",))
+    step_dp = parallel.ShardedTrainStep(net_dp, SeqLoss(), "sgd",
+                                        {"learning_rate": 0.1},
+                                        mesh=mesh_dp)
+    loss_a = step_dp(x, y)
+
+    net_tp = build()
+    net_tp(x)
+    mesh_tp = parallel.make_mesh((2, 4), ("data", "model"))
+    step_tp = parallel.ShardedTrainStep(
+        net_tp, SeqLoss(), "sgd", {"learning_rate": 0.1}, mesh=mesh_tp,
+        rules=model_zoo.bert.tensor_parallel_rules())
+    loss_b = step_tp(x, y)
+
+    assert abs(float(loss_a.asscalar()) - float(loss_b.asscalar())) < 1e-4
+    pa = dict(net_dp.collect_params().items())
+    pb = dict(net_tp.collect_params().items())
+    for (ka, va), (kb, vb) in zip(sorted(pa.items()), sorted(pb.items())):
+        assert_almost_equal(va.data().asnumpy(), vb.data().asnumpy(),
+                            rtol=2e-3, atol=2e-4)
