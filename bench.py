@@ -33,12 +33,17 @@ JSONL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 def _probe_axon(timeout):
     """Try to init the axon TPU backend in a subprocess (so a hang cannot
-    wedge the bench process). Returns (ok, error_tail)."""
+    wedge the bench process). Returns (ok, error_tail). The probe reads a
+    result element to host: block_until_ready returns before compute
+    finishes on this tunnel (PERF.md), so it alone would false-OK a
+    wedged device."""
     code = (
         "import jax; jax.config.update('jax_platforms','axon'); "
         "d = jax.devices(); assert d; "
-        "import jax.numpy as jnp; "
-        "(jnp.ones((128,128))@jnp.ones((128,128))).block_until_ready(); "
+        "import jax.numpy as jnp, numpy as np; "
+        "x = jnp.ones((128,128))@jnp.ones((128,128)); "
+        "v = np.asarray(jax.jit(lambda a: a.ravel()[:1])(x)); "
+        "assert v[0] == 128.0, v; "
         "print('PROBE_OK', d[0])"
     )
     try:
@@ -48,7 +53,10 @@ def _probe_axon(timeout):
             return True, ""
         return False, (r.stderr or r.stdout or "")[-500:]
     except subprocess.TimeoutExpired:
-        return False, "axon probe timed out after %ds" % timeout
+        # terminal: a timed-out probe means the tunnel is hung at the
+        # chip claim (and killing even a tiny probe mid-dispatch risks
+        # wedging it further) — re-probing would just burn the budget
+        return None, "axon probe timed out after %ds" % timeout
 
 
 def _init_backend():
@@ -62,8 +70,11 @@ def _init_backend():
         jax.config.update("jax_platforms", forced)
         return forced, "forced by BENCH_PLATFORM"
 
+    # healthy init is ~30s (compile included); a wedged tunnel hangs at
+    # the chip claim, so waiting longer than ~2.5 min per try only eats
+    # into the driver's overall bench budget before the CPU fallback
     tries = int(os.environ.get("BENCH_INIT_TRIES", "2"))
-    timeout = int(os.environ.get("BENCH_INIT_TIMEOUT", "300"))
+    timeout = int(os.environ.get("BENCH_INIT_TIMEOUT", "150"))
     last = ""
     for i in range(tries):
         ok, last = _probe_axon(timeout)
@@ -74,6 +85,9 @@ def _init_backend():
         print("bench: axon probe attempt %d/%d failed: %s"
               % (i + 1, tries, tail_lines[-1] if tail_lines else "?"),
               file=sys.stderr, flush=True)
+        if ok is None:  # timeout — hung tunnel, retries are wasted budget
+            tries = i + 1
+            break
         time.sleep(min(30, 10 * (i + 1)))
     jax.config.update("jax_platforms", "cpu")
     return "cpu", "axon unavailable after %d tries: %s" % (tries, last[-200:])
