@@ -21,16 +21,19 @@ __all__ = ["tensor_parallel_rules",
 
 
 class BERTSelfAttention(HybridBlock):
-    """Fused-QKV multi-head self-attention over flash_attention."""
+    """Fused-QKV multi-head self-attention over flash_attention.
+    ``causal=True`` turns it into decoder-style masked attention (used
+    by the GPT zoo model)."""
 
-    def __init__(self, units, num_heads, dropout=0.0, prefix=None,
-                 params=None):
+    def __init__(self, units, num_heads, dropout=0.0, causal=False,
+                 prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         if units % num_heads != 0:
             raise MXNetError("units %d not divisible by num_heads %d"
                              % (units, num_heads))
         self._units = units
         self._num_heads = num_heads
+        self._causal = causal
         with self.name_scope():
             self.qkv = nn.Dense(3 * units, flatten=False, in_units=units,
                                 prefix="qkv_")
@@ -48,7 +51,7 @@ class BERTSelfAttention(HybridBlock):
         q = F.transpose(q, axes=(0, 2, 1, 3))  # (B, H, T, D)
         k = F.transpose(k, axes=(0, 2, 1, 3))
         v = F.transpose(v, axes=(0, 2, 1, 3))
-        out = F.flash_attention(q, k, v, bias,
+        out = F.flash_attention(q, k, v, bias, causal=self._causal,
                                 sm_scale=1.0 / math.sqrt(D))
         out = F.transpose(out, axes=(0, 2, 1, 3))  # (B, T, H, D)
         out = F.reshape(out, shape=(0, 0, -1))
@@ -270,11 +273,14 @@ def tensor_parallel_rules():
 
     from ... import parallel
 
+    # suffix-anchored so they cover both BERT's ffn_ffn1_* and the GPT
+    # zoo model's ffn1_* parameter names (gpt.tensor_parallel_rules
+    # delegates here — one rule set to maintain)
     return parallel.sharding_rule(
         (r"attn_qkv_weight$", P("model", None)),
         (r"attn_qkv_bias$", P("model")),
         (r"attn_proj_weight$", P(None, "model")),
-        (r"ffn_ffn1_weight$", P("model", None)),
-        (r"ffn_ffn1_bias$", P("model")),
-        (r"ffn_ffn2_weight$", P(None, "model")),
+        (r"ffn1_weight$", P("model", None)),
+        (r"ffn1_bias$", P("model")),
+        (r"ffn2_weight$", P(None, "model")),
     )

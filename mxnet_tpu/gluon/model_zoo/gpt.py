@@ -1,0 +1,123 @@
+"""Decoder-only causal language model (GPT-style).
+
+No analog in the reference tree (its era predates decoder-only LMs as a
+zoo staple); included because long-context causal attention is a
+first-class target of this build: the attention runs the Pallas flash
+kernel with causal masking (ops/attention.py), scales past VMEM via the
+chunked-scan path, and shards over long sequences with
+parallel.ring_attention (causal ring schedule) — see
+tests/test_parallel.py for the sp path.
+
+Pre-LN transformer: ln -> attn -> residual, ln -> mlp -> residual, final
+ln, tied output head.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from .. import nn
+from .bert import BERTSelfAttention
+
+__all__ = ["GPTModel", "gpt_mini", "gpt_small", "tensor_parallel_rules"]
+
+
+class GPTBlock(HybridBlock):
+    """Pre-LN decoder block."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 layer_norm_eps=1e-5, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.ln1 = nn.LayerNorm(epsilon=layer_norm_eps,
+                                    in_channels=units, prefix="ln1_")
+            self.attn = BERTSelfAttention(units, num_heads, dropout,
+                                          causal=True, prefix="attn_")
+            self.ln2 = nn.LayerNorm(epsilon=layer_norm_eps,
+                                    in_channels=units, prefix="ln2_")
+            self.ffn1 = nn.Dense(hidden_size, flatten=False,
+                                 in_units=units, prefix="ffn1_")
+            self.ffn2 = nn.Dense(units, flatten=False,
+                                 in_units=hidden_size, prefix="ffn2_")
+            self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        x = x + self.attn(self.ln1(x))
+        h = self.ffn2(F.gelu(self.ffn1(self.ln2(x))))
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return x + h
+
+
+class GPTModel(HybridBlock):
+    """Causal LM: token ids (B, T) -> logits (B, T, vocab); the output
+    head ties the token embedding."""
+
+    def __init__(self, num_layers=12, units=768, num_heads=12,
+                 hidden_size=None, vocab_size=50257, max_length=1024,
+                 dropout=0.1, layer_norm_eps=1e-5, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        hidden_size = hidden_size or 4 * units
+        self._units = units
+        self._max_length = max_length
+        self._vocab_size = vocab_size
+        with self.name_scope():
+            self.tok_embed = nn.Embedding(vocab_size, units,
+                                          prefix="tok_embed_")
+            self.pos_weight = self.params.get(
+                "pos_weight", shape=(max_length, units), init="normal")
+            self.embed_dropout = nn.Dropout(dropout) if dropout else None
+            self.blocks = []
+            for i in range(num_layers):
+                blk = GPTBlock(units, hidden_size, num_heads, dropout,
+                               layer_norm_eps, prefix="layer%d_" % i)
+                self.register_child(blk, "layer%d" % i)
+                self.blocks.append(blk)
+            self.ln_f = nn.LayerNorm(epsilon=layer_norm_eps,
+                                     in_channels=units, prefix="ln_f_")
+            # tied output head: shares the (V, units) weight with
+            # tok_embed via a shared ParameterDict (same pattern as
+            # BERTModel's mlm_decoder)
+            self.head = nn.Dense(vocab_size, flatten=False,
+                                 in_units=units, use_bias=False,
+                                 prefix="head_",
+                                 params=self.tok_embed.params)
+
+    def hybrid_forward(self, F, x, pos_weight=None):
+        if hasattr(x, "shape"):  # eager; Symbol trace skips the check
+            if x.shape[1] > self._max_length:
+                raise MXNetError("sequence length %d exceeds max_length %d"
+                                 % (x.shape[1], self._max_length))
+        h = self.tok_embed(x)
+        # slice the learned position table to seq length without reading
+        # .shape (keeps the Symbol trace path working)
+        pos = F.slice_like(pos_weight, F.transpose(x), axes=(0,))
+        h = h + F.expand_dims(pos, axis=0)
+        if self.embed_dropout is not None:
+            h = self.embed_dropout(h)
+        for blk in self.blocks:
+            h = blk(h)
+        h = self.ln_f(h)
+        return self.head(h)
+
+
+def gpt_mini(**kwargs):
+    """4x128x4 toy config for tests/examples."""
+    kwargs.setdefault("vocab_size", 1000)
+    kwargs.setdefault("max_length", 256)
+    return GPTModel(num_layers=4, units=128, num_heads=4, **kwargs)
+
+
+def gpt_small(**kwargs):
+    """GPT-2 small shape (124M)."""
+    return GPTModel(num_layers=12, units=768, num_heads=12, **kwargs)
+
+
+def tensor_parallel_rules():
+    """Megatron column/row PartitionSpecs — the suffix-anchored patterns
+    in bert.tensor_parallel_rules match this model's parameter names too
+    (attn_qkv_*/attn_proj_*/ffn1_*/ffn2_*), so there is exactly one rule
+    set to maintain."""
+    from .bert import tensor_parallel_rules as _bert_rules
+
+    return _bert_rules()
