@@ -33,6 +33,7 @@ from . import optimizer as opt
 from . import metric
 from . import kvstore
 from . import kvstore as kv
+from . import kvstore_server
 from . import callback
 from . import recordio
 from . import io

@@ -1,0 +1,48 @@
+"""kvstore server-role entry (ref: python/mxnet/kvstore_server.py — the
+process that blocks in MXKVStoreRunServer under DMLC_ROLE=server).
+
+The TPU build has no parameter-server role by design: gradient exchange
+is compiled into the training step as XLA collectives over ICI/DCN
+(SURVEY §2.4 — the worker/server topology collapses into SPMD), and
+``tools/launch.py`` starts only workers. This module keeps the import
+surface so reference launch scripts fail with an explanation instead of
+an ImportError.
+"""
+from __future__ import annotations
+
+import os
+
+from .base import MXNetError
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """ref: kvstore_server.py — KVStoreServer. Not instantiable here."""
+
+    def __init__(self, kvstore):
+        raise MXNetError(
+            "the TPU build has no parameter-server role: dist training "
+            "uses SPMD collectives compiled into the step (see "
+            "parallel.ShardedTrainStep and tools/launch.py). Launch "
+            "workers only — there is nothing to run on a server node.")
+
+    def run(self):  # pragma: no cover - unreachable (init raises)
+        raise NotImplementedError
+
+
+def _init_kvstore_server_module():
+    """ref: kvstore_server.py — called at import under DMLC_ROLE=server
+    (the reference blocks in the server loop there; here a stale
+    reference-style launch fails fast with the design explanation)."""
+    role = os.environ.get("DMLC_ROLE", "")
+    if role == "server" or role == "scheduler":
+        raise MXNetError(
+            "DMLC_ROLE=%s detected: reference-style parameter-server "
+            "launches are not used by the TPU build. Use tools/launch.py "
+            "(workers only; rendezvous via MXT_COORDINATOR)." % role)
+
+
+# match the reference's import-time behavior: a server/scheduler-role
+# process must not silently proceed as a worker
+_init_kvstore_server_module()

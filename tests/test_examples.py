@@ -25,6 +25,9 @@ EXAMPLES = {
     "examples/wide_deep_ctr.py": [
         "--iters", "4", "--batch-size", "32", "--wide-vocab", "500",
         "--deep-vocab", "200"],
+    "examples/gpt_lm_pretrain.py": [
+        "--iters", "2", "--batch-size", "8", "--seq-len", "16",
+        "--tp", "2"],
 }
 
 

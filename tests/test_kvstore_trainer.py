@@ -264,3 +264,18 @@ def test_metric_registry_create():
         assert isinstance(m, mx.metric.EvalMetric)
     with pytest.raises(mx.MXNetError):
         mx.metric.create("not_a_metric")
+
+
+def test_kvstore_server_role_explains_design(monkeypatch):
+    """The server-role entry must fail with the collectives-design
+    explanation, not an ImportError (ref: kvstore_server.py; the guard
+    also runs at module import — covered in test_dist's subprocess
+    lane)."""
+    from mxnet_tpu import kvstore_server
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError, match="no parameter-server role"):
+        kvstore_server.KVStoreServer(None)
+    monkeypatch.setenv("DMLC_ROLE", "server")
+    with pytest.raises(MXNetError, match="workers only"):
+        kvstore_server._init_kvstore_server_module()
