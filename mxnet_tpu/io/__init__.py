@@ -1,8 +1,9 @@
 """Data iterators (ref: python/mxnet/io/__init__.py)."""
 from .io import (
     DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
-    CSVIter, MNISTIter, ImageRecordIter,
+    CSVIter, MNISTIter, ImageRecordIter, LibSVMIter,
 )
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "LibSVMIter"]

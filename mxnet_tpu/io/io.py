@@ -19,7 +19,7 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from ..ndarray import ndarray as _nd
 
-__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+__all__ = ["LibSVMIter", "DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
 
 
@@ -403,6 +403,108 @@ class CSVIter(NDArrayIter):
         super().__init__(
             data, label, batch_size=batch_size,
             last_batch_handle="pad" if round_batch else "discard", **kwargs)
+
+
+class LibSVMIter(DataIter):
+    """LibSVM-format iterator yielding CSR data batches
+    (ref: src/io/iter_libsvm.cc — LibSVMIter). Each line is
+    ``label idx:value idx:value ...``; ``data_shape`` gives the feature
+    dimension. Batches carry CSRNDArray data (the sparse subsystem's
+    storage class) and dense labels — the Wide&Deep/sparse training
+    input path."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size=1,
+                 label_libsvm=None, round_batch=True, num_parts=1,
+                 part_index=0):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape) if not isinstance(
+            data_shape, int) else (data_shape,)
+        ncol = int(np.prod(self.data_shape))
+        labels, data, indices, indptr = [], [], [], [0]
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    idx = int(i)
+                    if idx >= ncol:
+                        raise MXNetError(
+                            "feature index %d >= data_shape %d in %s"
+                            % (idx, ncol, data_libsvm))
+                    indices.append(idx)
+                    data.append(float(v))
+                indptr.append(len(indices))
+        if label_libsvm is not None:
+            labels = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    if line.strip():
+                        labels.append(float(line.split()[0]))
+        if len(labels) != len(indptr) - 1:
+            raise MXNetError(
+                "label file has %d rows but data file has %d"
+                % (len(labels), len(indptr) - 1))
+        self._data = np.asarray(data, np.float32)
+        self._indices = np.asarray(indices, np.int64)
+        self._indptr = np.asarray(indptr, np.int64)
+        self._labels = np.asarray(labels, np.float32)
+        # distributed sharding (dmlc InputSplit semantics)
+        if num_parts > 1:
+            keep = np.arange(part_index, len(self._labels), num_parts)
+            counts = self._indptr[keep + 1] - self._indptr[keep]
+            sel = np.concatenate([
+                np.arange(self._indptr[r], self._indptr[r + 1])
+                for r in keep]) if len(keep) else np.empty(0, np.int64)
+            self._data = self._data[sel.astype(np.int64)]
+            self._indices = self._indices[sel.astype(np.int64)]
+            self._indptr = np.concatenate([[0], np.cumsum(counts)])
+            self._labels = self._labels[keep]
+        self._n = len(self._labels)
+        self.round_batch = round_batch
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        from ..sparse import csr_matrix
+
+        if self._cursor >= self._n:
+            raise StopIteration
+        end = min(self._cursor + self.batch_size, self._n)
+        rows = np.arange(self._cursor, end)
+        pad = 0
+        if end - self._cursor < self.batch_size:
+            if not self.round_batch:
+                # reference semantics (and CSVIter above): a short final
+                # batch is discarded — provide_data's shape is a contract
+                raise StopIteration
+            pad = self.batch_size - (end - self._cursor)
+            rows = np.concatenate([rows, np.arange(pad) % self._n])
+        self._cursor = end
+        # slice CSR rows
+        counts = self._indptr[rows + 1] - self._indptr[rows]
+        new_indptr = np.concatenate([[0], np.cumsum(counts)])
+        sel = np.concatenate([
+            np.arange(self._indptr[r], self._indptr[r + 1]) for r in rows
+        ]) if len(rows) else np.empty(0, np.int64)
+        sel = sel.astype(np.int64)
+        batch = csr_matrix(
+            (self._data[sel], self._indices[sel], new_indptr),
+            shape=(len(rows), int(np.prod(self.data_shape))))
+        label = NDArray(self._labels[rows])
+        return DataBatch(data=[batch], label=[label], pad=pad)
 
 
 class MNISTIter(NDArrayIter):

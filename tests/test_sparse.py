@@ -250,3 +250,67 @@ def test_wide_deep_trains():
         losses.append(float(loss.asnumpy()))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_libsvm_iter(tmp_path):
+    """mx.io.LibSVMIter yields CSR batches matching the text file
+    (ref: src/io/iter_libsvm.cc)."""
+    p = str(tmp_path / "t.libsvm")
+    with open(p, "w") as f:
+        f.write("1 0:1.5 3:2.0\n")
+        f.write("0 1:0.5\n")
+        f.write("1 2:3.0 4:1.0\n")
+        f.write("0 0:2.5 4:0.5\n")
+        f.write("1 3:1.25\n")
+
+    it = mx.io.LibSVMIter(data_libsvm=p, data_shape=(5,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 3 and batches[-1].pad == 1
+    b0 = batches[0]
+    assert b0.data[0].stype == "csr"
+    dense = b0.data[0].tostype("default").asnumpy()
+    np.testing.assert_allclose(dense, [[1.5, 0, 0, 2.0, 0],
+                                       [0, 0.5, 0, 0, 0]])
+    np.testing.assert_allclose(b0.label[0].asnumpy(), [1.0, 0.0])
+    # wrapped row in the padded final batch duplicates row 0
+    last = batches[-1].data[0].tostype("default").asnumpy()
+    np.testing.assert_allclose(last[1], [1.5, 0, 0, 2.0, 0])
+    # bad index surfaces clearly
+    p2 = str(tmp_path / "bad.libsvm")
+    with open(p2, "w") as f:
+        f.write("1 9:1.0\n")
+    with pytest.raises(mx.MXNetError, match="feature index"):
+        mx.io.LibSVMIter(data_libsvm=p2, data_shape=(5,), batch_size=1)
+
+
+def test_libsvm_iter_edge_cases(tmp_path):
+    p = str(tmp_path / "e.libsvm")
+    with open(p, "w") as f:
+        f.write("1 0:1.0\n0 1:2.0\n")
+    # batch larger than 2x rows: wraparound must modulo, not crash
+    it = mx.io.LibSVMIter(data_libsvm=p, data_shape=(3,), batch_size=5)
+    b = next(iter(it))
+    assert b.pad == 3 and b.data[0].shape == (5, 3)
+    # round_batch=False discards the short batch (CSVIter semantics)
+    it2 = mx.io.LibSVMIter(data_libsvm=p, data_shape=(3,), batch_size=5,
+                           round_batch=False)
+    assert list(it2) == []
+    # label-count mismatch surfaces at construction
+    lbl = str(tmp_path / "l.txt")
+    with open(lbl, "w") as f:
+        f.write("1\n0\n1\n")
+    with pytest.raises(mx.MXNetError, match="label file"):
+        mx.io.LibSVMIter(data_libsvm=p, data_shape=(3,), batch_size=1,
+                         label_libsvm=lbl)
+    # num_parts sharding splits rows disjointly
+    p3 = str(tmp_path / "s.libsvm")
+    with open(p3, "w") as f:
+        for i in range(6):
+            f.write("%d %d:1.0\n" % (i, i % 3))
+    parts = []
+    for pi in range(2):
+        itp = mx.io.LibSVMIter(data_libsvm=p3, data_shape=(3,),
+                               batch_size=3, num_parts=2, part_index=pi)
+        for b in itp:
+            parts.extend(b.label[0].asnumpy().tolist())
+    assert sorted(parts) == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
