@@ -430,9 +430,9 @@ class LibSVMIter(DataIter):
                 for tok in parts[1:]:
                     i, v = tok.split(":")
                     idx = int(i)
-                    if idx >= ncol:
+                    if idx >= ncol or idx < 0:
                         raise MXNetError(
-                            "feature index %d >= data_shape %d in %s"
+                            "feature index %d out of range [0, %d) in %s"
                             % (idx, ncol, data_libsvm))
                     indices.append(idx)
                     data.append(float(v))

@@ -117,3 +117,162 @@ def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
     if clip:
         anchors = np.clip(anchors, 0.0, 1.0)
     return jnp.asarray(anchors[None], jnp.float32)
+
+
+def _corner_to_center(boxes):
+    w = boxes[..., 2] - boxes[..., 0]
+    h = boxes[..., 3] - boxes[..., 1]
+    cx = boxes[..., 0] + w * 0.5
+    cy = boxes[..., 1] + h * 0.5
+    return cx, cy, w, h
+
+
+def _iou_matrix(a, b):
+    """(N, 4) x (M, 4) corner boxes -> (N, M) IOU."""
+    ix0 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy0 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix1 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy1 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = jnp.maximum(ix1 - ix0, 0) * jnp.maximum(iy1 - iy0, 0)
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * \
+        jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1], 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-12), 0.0)
+
+
+@register("MultiBoxTarget", aliases=("_contrib_MultiBoxTarget",),
+          differentiable=False, num_outputs=3)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """ref: src/operator/contrib/multibox_target.cc — match anchors to
+    ground-truth boxes and encode regression targets.
+
+    anchor: (1, A, 4) corners; label: (B, O, 5+) rows
+    [cls, x1, y1, x2, y2] with cls -1 padding; cls_pred is unused for
+    matching here (kept for API parity; the reference uses it only for
+    negative mining order). Returns (loc_target (B, A*4),
+    loc_mask (B, A*4), cls_target (B, A)) where cls_target is
+    1 + gt class for matched anchors, 0 for background.
+    """
+    del cls_pred, negative_mining_ratio, negative_mining_thresh
+    del minimum_negative_samples
+    anchors = anchor.reshape(-1, 4)
+    a_cx, a_cy, a_w, a_h = _corner_to_center(anchors)
+    vx, vy, vw, vh = variances
+
+    def one_sample(lbl):
+        cls = lbl[:, 0]
+        boxes = lbl[:, 1:5]
+        valid = cls >= 0
+        iou = _iou_matrix(anchors, boxes)  # (A, O)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)           # per anchor
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou >= overlap_threshold
+        # reference also force-matches each gt's best anchor; padding
+        # rows (cls=-1) must not scatter at all — route their writes to
+        # an out-of-range index that mode="drop" discards, else a padded
+        # gt whose (meaningless) argmax lands on the same anchor as a
+        # valid gt would clobber the valid force-match
+        best_anchor = jnp.argmax(iou, axis=0)       # (O,)
+        n_anchor = anchors.shape[0]
+        scatter_to = jnp.where(valid, best_anchor, n_anchor)
+        forced = jnp.zeros(n_anchor, bool).at[scatter_to].set(
+            True, mode="drop")
+        gt_for_forced = jnp.zeros(n_anchor, jnp.int32).at[scatter_to].set(
+            jnp.arange(boxes.shape[0], dtype=jnp.int32), mode="drop")
+        use_forced = forced & ~matched
+        assigned = jnp.where(use_forced, gt_for_forced,
+                             best_gt.astype(jnp.int32))
+        matched = matched | forced
+        g = boxes[assigned]
+        g_cx, g_cy, g_w, g_h = _corner_to_center(g)
+        g_w = jnp.maximum(g_w, 1e-8)
+        g_h = jnp.maximum(g_h, 1e-8)
+        t = jnp.stack([
+            (g_cx - a_cx) / a_w / vx,
+            (g_cy - a_cy) / a_h / vy,
+            jnp.log(g_w / a_w) / vw,
+            jnp.log(g_h / a_h) / vh,
+        ], axis=1)  # (A, 4)
+        mask = matched[:, None].astype(t.dtype)
+        cls_t = jnp.where(matched,
+                          cls[assigned].astype(jnp.float32) + 1.0, 0.0)
+        return (t * mask).reshape(-1), jnp.broadcast_to(
+            mask, t.shape).reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one_sample)(
+        label.astype(jnp.float32))
+    return loc_t, loc_m, cls_t
+
+
+@register("MultiBoxDetection", aliases=("_contrib_MultiBoxDetection",),
+          differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                       threshold=0.01, background_id=0,
+                       nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """ref: src/operator/contrib/multibox_detection.cc — decode anchor
+    offsets and run class-wise greedy NMS with static shapes.
+
+    cls_prob: (B, C, A) softmax probs incl. background class 0;
+    loc_pred: (B, A*4); anchor: (1, A, 4). Returns (B, A, 6) rows
+    [cls_id, score, x1, y1, x2, y2]; suppressed/below-threshold rows
+    have cls_id -1 (the reference's invalid marker).
+    """
+    anchors = anchor.reshape(-1, 4)
+    a_cx, a_cy, a_w, a_h = _corner_to_center(anchors)
+    vx, vy, vw, vh = variances
+    A = anchors.shape[0]
+
+    def one_sample(probs, loc):
+        loc = loc.reshape(-1, 4)
+        cx = loc[:, 0] * vx * a_w + a_cx
+        cy = loc[:, 1] * vy * a_h + a_cy
+        w = jnp.exp(loc[:, 2] * vw) * a_w
+        h = jnp.exp(loc[:, 3] * vh) * a_h
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2], axis=1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor; the output id is 0-based
+        # over FOREGROUND classes (reference convention: class - 1)
+        if background_id != 0:
+            raise ValueError("only background_id=0 is supported "
+                             "(the reference's fixed convention)")
+        fg = probs[1:]
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.int32)
+        score = jnp.max(fg, axis=0)
+        keep = score > threshold
+        order = jnp.argsort(-jnp.where(keep, score, -jnp.inf))
+        if nms_topk > 0:
+            in_topk = jnp.arange(A) < nms_topk
+        else:
+            in_topk = jnp.ones((A,), bool)
+        iou = _iou_matrix(boxes, boxes)
+        same_cls = cls_id[:, None] == cls_id[None, :]
+        suppress_pair = (iou > nms_threshold) & \
+            (same_cls | bool(force_suppress))
+
+        def body(i, alive):
+            cand = order[i]
+            is_live = alive[cand] & keep[cand] & in_topk[i]
+            kill = suppress_pair[cand] & is_live
+            kill = kill.at[cand].set(False)
+            return alive & ~kill
+
+        alive = jax.lax.fori_loop(0, A, body, keep)
+        final = alive & keep
+        out = jnp.concatenate([
+            jnp.where(final, cls_id, -1)[:, None].astype(boxes.dtype),
+            jnp.where(final, score, -1)[:, None].astype(boxes.dtype),
+            boxes,
+        ], axis=1)
+        return out
+
+    return jax.vmap(one_sample)(cls_prob.astype(jnp.float32),
+                                loc_pred.astype(jnp.float32))

@@ -94,3 +94,61 @@ def test_util_shims():
 
     with pytest.raises(RuntimeError):
         mx.util.get_cuda_compute_capability()
+
+
+def test_multibox_target_matching_and_encoding():
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                  [0.6, 0.6, 0.9, 0.9]]], "f4"))
+    label = nd.array(np.array([[[2, 0.1, 0.1, 0.5, 0.5],
+                                [-1, 0, 0, 0, 0]]], "f4"))
+    lt, lm, ct = nd.MultiBoxTarget(anchors, label, nd.zeros((1, 4, 2)))
+    ct = ct.asnumpy()
+    assert ct[0, 0] == 3  # class 2 -> target 2+1
+    assert ct[0, 1] == 0  # unmatched -> background
+    # exact-overlap anchor encodes ~zero offsets, mask covers only it
+    np.testing.assert_allclose(lt.asnumpy()[0, :4], 0, atol=1e-5)
+    np.testing.assert_allclose(lm.asnumpy()[0], [1, 1, 1, 1, 0, 0, 0, 0])
+
+
+def test_multibox_target_force_matches_best_anchor():
+    # gt overlaps anchor0 only weakly (< threshold) but must still get
+    # its best anchor force-matched — INCLUDING when a cls=-1 padding
+    # row is present (its meaningless argmax must not clobber the match)
+    anchors = nd.array(np.array([[[0.0, 0.0, 0.4, 0.4],
+                                  [0.6, 0.6, 1.0, 1.0]]], "f4"))
+    for rows in ([[1, 0.3, 0.3, 0.7, 0.7]],
+                 [[1, 0.3, 0.3, 0.7, 0.7], [-1, 0, 0, 0, 0]]):
+        label = nd.array(np.array([rows], "f4"))
+        _, _, ct = nd.MultiBoxTarget(anchors, label,
+                                     nd.zeros((1, 3, 2)),
+                                     overlap_threshold=0.9)
+        assert (ct.asnumpy()[0] > 0).sum() == 1, rows
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                  [0.1, 0.1, 0.5, 0.5],
+                                  [0.6, 0.6, 0.9, 0.9]]], "f4"))
+    # two identical anchors with same class: NMS keeps the higher score
+    probs = nd.array(np.array([[[0.1, 0.2, 0.8],
+                                [0.9, 0.7, 0.1],
+                                [0.0, 0.1, 0.1]]], "f4"))
+    det = nd.MultiBoxDetection(probs, nd.zeros((1, 12)), anchors,
+                               nms_threshold=0.5).asnumpy()
+    assert det.shape == (1, 3, 6)
+    r0, r1, r2 = det[0]
+    assert r0[0] == 0 and abs(r0[1] - 0.9) < 1e-6  # kept winner
+    assert r1[0] == -1                              # suppressed duplicate
+    assert r2[0] == -1 or r2[1] <= 0.2              # low-score anchor
+    # decoded boxes equal anchors for zero offsets
+    np.testing.assert_allclose(r0[2:], [0.1, 0.1, 0.5, 0.5], atol=1e-5)
+
+
+def test_multibox_detection_offset_decode():
+    anchors = nd.array(np.array([[[0.2, 0.2, 0.6, 0.6]]], "f4"))
+    probs = nd.array(np.array([[[0.1], [0.9]]], "f4"))
+    # shift center by +0.1 in x: t_x = 0.1 / (0.1 variance * w 0.4) = 2.5
+    loc = nd.array(np.array([[2.5, 0, 0, 0]], "f4"))
+    det = nd.MultiBoxDetection(probs, loc, anchors).asnumpy()
+    np.testing.assert_allclose(det[0, 0, 2:], [0.3, 0.2, 0.7, 0.6],
+                               atol=1e-5)

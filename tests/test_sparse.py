@@ -281,6 +281,11 @@ def test_libsvm_iter(tmp_path):
         f.write("1 9:1.0\n")
     with pytest.raises(mx.MXNetError, match="feature index"):
         mx.io.LibSVMIter(data_libsvm=p2, data_shape=(5,), batch_size=1)
+    p3 = str(tmp_path / "neg.libsvm")
+    with open(p3, "w") as f:
+        f.write("1 -2:7.0\n")
+    with pytest.raises(mx.MXNetError, match="feature index"):
+        mx.io.LibSVMIter(data_libsvm=p3, data_shape=(5,), batch_size=1)
 
 
 def test_libsvm_iter_edge_cases(tmp_path):
