@@ -267,12 +267,21 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
 
         alive = jax.lax.fori_loop(0, A, body, keep)
         final = alive & keep
+        if nms_topk > 0:
+            # reference invalidates detections ranked beyond top-k
+            # outright (multibox_detection-inl.h: out[idx] = -1)
+            topk_mask = jnp.zeros((A,), bool).at[
+                order[:min(nms_topk, A)]].set(True)
+            final = final & topk_mask
         out = jnp.concatenate([
             jnp.where(final, cls_id, -1)[:, None].astype(boxes.dtype),
             jnp.where(final, score, -1)[:, None].astype(boxes.dtype),
             boxes,
         ], axis=1)
-        return out
+        # reference output ordering: valid detections first, sorted by
+        # descending score; suppressed rows (-1) trail
+        rank = jnp.argsort(-jnp.where(final, score, -jnp.inf))
+        return out[rank]
 
     return jax.vmap(one_sample)(cls_prob.astype(jnp.float32),
                                 loc_pred.astype(jnp.float32))

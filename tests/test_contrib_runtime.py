@@ -136,10 +136,12 @@ def test_multibox_detection_decode_and_nms():
     det = nd.MultiBoxDetection(probs, nd.zeros((1, 12)), anchors,
                                nms_threshold=0.5).asnumpy()
     assert det.shape == (1, 3, 6)
+    # rows are score-sorted: winner, the distant low-score box, then the
+    # NMS-suppressed duplicate (-1) last
     r0, r1, r2 = det[0]
-    assert r0[0] == 0 and abs(r0[1] - 0.9) < 1e-6  # kept winner
-    assert r1[0] == -1                              # suppressed duplicate
-    assert r2[0] == -1 or r2[1] <= 0.2              # low-score anchor
+    assert r0[0] == 0 and abs(r0[1] - 0.9) < 1e-6
+    assert r1[1] <= 0.2 and r1[0] >= 0
+    assert r2[0] == -1
     # decoded boxes equal anchors for zero offsets
     np.testing.assert_allclose(r0[2:], [0.1, 0.1, 0.5, 0.5], atol=1e-5)
 
@@ -152,3 +154,13 @@ def test_multibox_detection_offset_decode():
     det = nd.MultiBoxDetection(probs, loc, anchors).asnumpy()
     np.testing.assert_allclose(det[0, 0, 2:], [0.3, 0.2, 0.7, 0.6],
                                atol=1e-5)
+
+
+def test_multibox_detection_nms_topk_caps_output():
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.3, 0.3],
+                                  [0.6, 0.6, 0.9, 0.9]]], "f4"))
+    probs = nd.array(np.array([[[0.1, 0.2], [0.9, 0.8]]], "f4"))
+    det = nd.MultiBoxDetection(probs, nd.zeros((1, 8)), anchors,
+                               nms_topk=1).asnumpy()
+    assert abs(det[0, 0, 1] - 0.9) < 1e-6
+    assert det[0, 1, 0] == -1  # beyond top-k invalidated

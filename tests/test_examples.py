@@ -28,6 +28,7 @@ EXAMPLES = {
     "examples/gpt_lm_pretrain.py": [
         "--iters", "2", "--batch-size", "8", "--seq-len", "16",
         "--tp", "2"],
+    "examples/train_ssd_toy.py": ["--iters", "4", "--batch-size", "8"],
 }
 
 
