@@ -1,6 +1,7 @@
 """Contrib op family (ref: src/operator/contrib/* — "port on demand" per
-SURVEY §2.2): FFT, index_copy/index_add, count_sketch, boolean_mask, and
-the SSD MultiBoxPrior anchor generator.
+SURVEY §2.2): FFT, index_copy/index_add, count_sketch, boolean_mask, the
+SSD triple (MultiBoxPrior/MultiBoxTarget/MultiBoxDetection), and the RPN
+Proposal op — all static-shape XLA programs (greedy NMS as fori_loop).
 
 Registered under both the bare name and the reference's ``_contrib_``
 prefix so nd/sym namespaces resolve either spelling.
@@ -285,3 +286,109 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
 
     return jax.vmap(one_sample)(cls_prob.astype(jnp.float32),
                                 loc_pred.astype(jnp.float32))
+
+
+@register("Proposal", aliases=("_contrib_Proposal",),
+          differentiable=False)
+def proposal(cls_prob, bbox_pred, im_info, scales=(4, 8, 16, 32),
+             ratios=(0.5, 1, 2), feature_stride=16, threshold=0.7,
+             rpn_pre_nms_top_n=6000, rpn_post_nms_top_n=300,
+             rpn_min_size=16, output_score=False):
+    """ref: src/operator/contrib/proposal.cc — RPN region proposals.
+
+    cls_prob: (B, 2*A, H, W) objectness (bg/fg per anchor);
+    bbox_pred: (B, 4*A, H, W) deltas; im_info: (B, 3) [height, width,
+    scale]. Returns (B*post_nms, 5) rows [batch_idx, x1, y1, x2, y2]
+    (+ scores as a second output when output_score). Static shapes
+    throughout: NMS is the same greedy fori_loop as MultiBoxDetection,
+    short batches pad with the best surviving row (reference pads too).
+    """
+    B, twoA, H, W = cls_prob.shape
+    A = len(scales) * len(ratios)
+    if twoA != 2 * A:
+        raise ValueError(
+            "cls_prob has %d channels but scales x ratios implies %d "
+            "anchors (need 2 per anchor)" % (twoA, A))
+    # base anchors at stride cells, pixel coordinates (reference
+    # GenerateAnchors: centered at cell, size scale*stride)
+    whs = []
+    for r in ratios:
+        for s in scales:
+            size = s * feature_stride
+            w_a = size * np.sqrt(1.0 / r)
+            h_a = size * np.sqrt(r)
+            whs.append((w_a, h_a))
+    whs = np.asarray(whs)  # (A, 2)
+    ys = (np.arange(H) + 0.5) * feature_stride
+    xs = (np.arange(W) + 0.5) * feature_stride
+    gy, gx = np.meshgrid(ys, xs, indexing="ij")
+    centers = np.stack([gx.ravel(), gy.ravel()], axis=1)  # (HW, 2)
+    base = np.concatenate([
+        centers[:, None, :] - whs[None] / 2,
+        centers[:, None, :] + whs[None] / 2,
+    ], axis=2).reshape(-1, 4)  # (HW*A, 4) pixel corners
+    base = jnp.asarray(base, jnp.float32)
+    n_total = base.shape[0]
+    a_cx, a_cy, a_w, a_h = _corner_to_center(base)
+    pre_n = min(rpn_pre_nms_top_n, n_total)
+    post_n = min(rpn_post_nms_top_n, pre_n)
+
+    def one_sample(probs, deltas, info):
+        fg = probs[A:]  # (A, H, W) foreground scores
+        score = fg.transpose(1, 2, 0).reshape(-1)  # HW-major, anchor-minor
+        d = deltas.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        cx = d[:, 0] * a_w + a_cx
+        cy = d[:, 1] * a_h + a_cy
+        w = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * a_w
+        h = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * a_h
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2], axis=1)
+        im_h, im_w = info[0], info[1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, im_w - 1),
+            jnp.clip(boxes[:, 1], 0, im_h - 1),
+            jnp.clip(boxes[:, 2], 0, im_w - 1),
+            jnp.clip(boxes[:, 3], 0, im_h - 1),
+        ], axis=1)
+        min_size = rpn_min_size * info[2]
+        big = ((boxes[:, 2] - boxes[:, 0] + 1) >= min_size) & \
+              ((boxes[:, 3] - boxes[:, 1] + 1) >= min_size)
+        score = jnp.where(big, score, -jnp.inf)
+        # pre-NMS top-k
+        top_score, top_idx = jax.lax.top_k(score, pre_n)
+        top_boxes = boxes[top_idx]
+        iou = _iou_matrix(top_boxes, top_boxes)
+        keep0 = jnp.isfinite(top_score)
+
+        def body(i, alive):
+            is_live = alive[i] & keep0[i]
+            kill = (iou[i] > threshold) & is_live
+            kill = kill.at[i].set(False)
+            # only suppress lower-ranked (already sorted by score)
+            kill = kill & (jnp.arange(pre_n) > i)
+            return alive & ~kill
+
+        alive = jax.lax.fori_loop(0, pre_n, body, keep0)
+        # select post_n survivors in rank order; short batches cycle
+        # through the survivors, as the reference does (proposal.cc:
+        # keep[i % num_keep])
+        surv_rank = jnp.where(alive, jnp.arange(pre_n), pre_n)
+        ordered = jnp.sort(surv_rank)
+        n_keep = jnp.maximum(jnp.sum(alive), 1)
+        picked = ordered[jnp.arange(post_n) % n_keep]
+        picked = jnp.where(picked == pre_n, 0, picked)
+        # filtered-out rows carry -inf internally; the reference emits a
+        # finite -1 sentinel (FilterBox) so downstream math stays NaN-free
+        out_score = jnp.where(jnp.isfinite(top_score[picked]),
+                              top_score[picked], -1.0)
+        return top_boxes[picked], out_score
+
+    all_boxes, all_scores = jax.vmap(one_sample)(
+        cls_prob.astype(jnp.float32), bbox_pred.astype(jnp.float32),
+        im_info.astype(jnp.float32))
+    batch_idx = jnp.repeat(jnp.arange(B, dtype=jnp.float32), post_n)
+    rois = jnp.concatenate([batch_idx[:, None],
+                            all_boxes.reshape(-1, 4)], axis=1)
+    if output_score:
+        return rois, all_scores.reshape(-1, 1)
+    return rois

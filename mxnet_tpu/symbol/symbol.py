@@ -96,6 +96,8 @@ def num_outputs_for(op, attrs):
         return 3 if attrs.get("mode", "lstm") == "lstm" else 2
     if name == "_sample_multinomial":
         return 2 if attrs.get("get_prob", False) else 1
+    if name == "Proposal":
+        return 2 if attrs.get("output_score", False) else 1
     if name == "amp_multicast":
         # reference amp_multicast requires num_outputs (= input count)
         return int(attrs.get("num_outputs", 1))

@@ -164,3 +164,53 @@ def test_multibox_detection_nms_topk_caps_output():
                                nms_topk=1).asnumpy()
     assert abs(det[0, 0, 1] - 0.9) < 1e-6
     assert det[0, 1, 0] == -1  # beyond top-k invalidated
+
+
+def test_proposal_shapes_and_validity():
+    rng = np.random.RandomState(0)
+    B, A, H, W = 2, 6, 4, 4
+    cls = nd.array(rng.uniform(0, 1, (B, 2 * A, H, W)).astype("f4"))
+    bbox = nd.array((rng.randn(B, 4 * A, H, W) * 0.1).astype("f4"))
+    im_info = nd.array(np.array([[64, 64, 1.0], [64, 64, 1.0]], "f4"))
+    rois, scores = nd.Proposal(
+        cls, bbox, im_info, scales=(2, 4), ratios=(0.5, 1, 2),
+        feature_stride=16, rpn_pre_nms_top_n=50, rpn_post_nms_top_n=8,
+        rpn_min_size=4, output_score=True)
+    r = rois.asnumpy()
+    assert r.shape == (16, 5)
+    np.testing.assert_array_equal(r[:8, 0], 0)
+    np.testing.assert_array_equal(r[8:, 0], 1)
+    assert (r[:, 1:3] >= 0).all() and (r[:, 3:] <= 63).all()
+    assert (r[:, 3] >= r[:, 1]).all() and (r[:, 4] >= r[:, 2]).all()
+    s0 = scores.asnumpy()[:8, 0]
+    assert np.isfinite(s0).all()
+    assert abs(s0.max() - s0[0]) < 1e-6  # best survivor leads
+
+
+def test_proposal_nms_suppresses_duplicates():
+    # one dominant location: high fg score everywhere forces NMS to thin
+    B, A, H, W = 1, 1, 2, 2
+    cls = np.zeros((B, 2, H, W), "f4")
+    cls[0, 1] = 0.9  # all fg
+    bbox = np.zeros((B, 4, H, W), "f4")
+    im_info = nd.array(np.array([[32, 32, 1.0]], "f4"))
+    rois = nd.Proposal(nd.array(cls), nd.array(bbox), im_info,
+                       scales=(2,), ratios=(1.0,), feature_stride=16,
+                       rpn_pre_nms_top_n=4, rpn_post_nms_top_n=4,
+                       threshold=0.3, rpn_min_size=1).asnumpy()
+    # 4 anchors at stride-16 cells of a 32px image, heavily overlapping
+    # after clipping -> NMS keeps fewer distinct boxes; padding repeats
+    # the top row, so all rows must be among the survivors
+    uniq = np.unique(rois[:, 1:], axis=0)
+    assert len(uniq) <= 3
+
+
+def test_proposal_symbolic_two_outputs():
+    import mxnet_tpu as mxx
+
+    cls = mxx.sym.Variable("cls")
+    bbox = mxx.sym.Variable("bbox")
+    info = mxx.sym.Variable("info")
+    p = mxx.sym.Proposal(cls, bbox, info, scales=(2,), ratios=(1.0,),
+                         output_score=True)
+    assert len(p.list_outputs()) == 2
