@@ -143,6 +143,47 @@ def _iou_matrix(a, b):
     return jnp.where(union > 0, inter / jnp.maximum(union, 1e-12), 0.0)
 
 
+def _iou_row(boxes, cand_box):
+    """IOU of one box against (N, 4) corner boxes — O(N) per NMS step,
+    so no quadratic IOU buffer is ever materialized."""
+    ix0 = jnp.maximum(boxes[:, 0], cand_box[0])
+    iy0 = jnp.maximum(boxes[:, 1], cand_box[1])
+    ix1 = jnp.minimum(boxes[:, 2], cand_box[2])
+    iy1 = jnp.minimum(boxes[:, 3], cand_box[3])
+    inter = jnp.maximum(ix1 - ix0, 0) * jnp.maximum(iy1 - iy0, 0)
+    area = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0) * \
+        jnp.maximum(boxes[:, 3] - boxes[:, 1], 0)
+    cand_area = jnp.maximum(cand_box[2] - cand_box[0], 0) * \
+        jnp.maximum(cand_box[3] - cand_box[1], 0)
+    union = area + cand_area - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-12), 0.0)
+
+
+def _greedy_nms(boxes, order, keep, thresh, class_ids=None,
+                rank_gate=None):
+    """Shared greedy suppression (the one loop behind MultiBoxDetection,
+    Proposal, and box_nms): walk candidates in score order; a live
+    candidate kills every OTHER box with IOU > thresh (same class only,
+    unless class_ids is None). rank_gate[i] False means the i-th ranked
+    candidate cannot suppress (but can still be suppressed). Returns the
+    alive mask."""
+    n = boxes.shape[0]
+    if rank_gate is None:
+        rank_gate = jnp.ones((n,), bool)
+
+    def body(i, alive):
+        cand = order[i]
+        is_live = alive[cand] & keep[cand] & rank_gate[i]
+        pair = _iou_row(boxes, boxes[cand]) > thresh
+        if class_ids is not None:
+            pair = pair & (class_ids == class_ids[cand])
+        kill = pair & is_live
+        kill = kill.at[cand].set(False)
+        return alive & ~kill
+
+    return jax.lax.fori_loop(0, n, body, keep)
+
+
 @register("MultiBoxTarget", aliases=("_contrib_MultiBoxTarget",),
           differentiable=False, num_outputs=3)
 def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
@@ -254,19 +295,10 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
             in_topk = jnp.arange(A) < nms_topk
         else:
             in_topk = jnp.ones((A,), bool)
-        iou = _iou_matrix(boxes, boxes)
-        same_cls = cls_id[:, None] == cls_id[None, :]
-        suppress_pair = (iou > nms_threshold) & \
-            (same_cls | bool(force_suppress))
-
-        def body(i, alive):
-            cand = order[i]
-            is_live = alive[cand] & keep[cand] & in_topk[i]
-            kill = suppress_pair[cand] & is_live
-            kill = kill.at[cand].set(False)
-            return alive & ~kill
-
-        alive = jax.lax.fori_loop(0, A, body, keep)
+        alive = _greedy_nms(
+            boxes, order, keep, nms_threshold,
+            class_ids=None if force_suppress else cls_id,
+            rank_gate=in_topk)
         final = alive & keep
         if nms_topk > 0:
             # reference invalidates detections ranked beyond top-k
@@ -309,23 +341,27 @@ def proposal(cls_prob, bbox_pred, im_info, scales=(4, 8, 16, 32),
         raise ValueError(
             "cls_prob has %d channels but scales x ratios implies %d "
             "anchors (need 2 per anchor)" % (twoA, A))
-    # base anchors at stride cells, pixel coordinates (reference
-    # GenerateAnchors: centered at cell, size scale*stride)
+    # reference GenerateAnchors (py-faster-rcnn enumeration): base box
+    # [0, 0, stride-1, stride-1], ratio anchors use ROUNDED widths/
+    # heights around the (stride-1)/2 center, then scale multiplies
+    base_size = feature_stride
+    ctr = (base_size - 1) * 0.5
+    base_area = base_size * base_size
     whs = []
     for r in ratios:
-        for s in scales:
-            size = s * feature_stride
-            w_a = size * np.sqrt(1.0 / r)
-            h_a = size * np.sqrt(r)
-            whs.append((w_a, h_a))
-    whs = np.asarray(whs)  # (A, 2)
-    ys = (np.arange(H) + 0.5) * feature_stride
-    xs = (np.arange(W) + 0.5) * feature_stride
+        w_r = np.round(np.sqrt(base_area / r))
+        h_r = np.round(w_r * r)
+        for sc in scales:
+            whs.append((w_r * sc, h_r * sc))
+    whs = np.asarray(whs)  # (A, 2) — ratio-major, scale-minor (reference)
+    ys = np.arange(H) * feature_stride + ctr
+    xs = np.arange(W) * feature_stride + ctr
     gy, gx = np.meshgrid(ys, xs, indexing="ij")
     centers = np.stack([gx.ravel(), gy.ravel()], axis=1)  # (HW, 2)
+    # corner = center -+ (wh - 1) / 2, matching _mkanchors
     base = np.concatenate([
-        centers[:, None, :] - whs[None] / 2,
-        centers[:, None, :] + whs[None] / 2,
+        centers[:, None, :] - (whs[None] - 1) / 2,
+        centers[:, None, :] + (whs[None] - 1) / 2,
     ], axis=2).reshape(-1, 4)  # (HW*A, 4) pixel corners
     base = jnp.asarray(base, jnp.float32)
     n_total = base.shape[0]
@@ -357,18 +393,10 @@ def proposal(cls_prob, bbox_pred, im_info, scales=(4, 8, 16, 32),
         # pre-NMS top-k
         top_score, top_idx = jax.lax.top_k(score, pre_n)
         top_boxes = boxes[top_idx]
-        iou = _iou_matrix(top_boxes, top_boxes)
         keep0 = jnp.isfinite(top_score)
-
-        def body(i, alive):
-            is_live = alive[i] & keep0[i]
-            kill = (iou[i] > threshold) & is_live
-            kill = kill.at[i].set(False)
-            # only suppress lower-ranked (already sorted by score)
-            kill = kill & (jnp.arange(pre_n) > i)
-            return alive & ~kill
-
-        alive = jax.lax.fori_loop(0, pre_n, body, keep0)
+        # rows already score-sorted: order is the identity
+        alive = _greedy_nms(top_boxes, jnp.arange(pre_n), keep0,
+                            threshold)
         # select post_n survivors in rank order; short batches cycle
         # through the survivors, as the reference does (proposal.cc:
         # keep[i % num_keep])
@@ -392,3 +420,62 @@ def proposal(cls_prob, bbox_pred, im_info, scales=(4, 8, 16, 32),
     if output_score:
         return rois, all_scores.reshape(-1, 1)
     return rois
+
+
+@register("box_iou", aliases=("_contrib_box_iou",), differentiable=False)
+def box_iou(lhs, rhs, format="corner"):
+    """ref: src/operator/contrib/bounding_box.cc BoxIOU — pairwise IOU
+    of (..., N, 4) x (..., M, 4) boxes."""
+    if format == "center":
+        def to_corner(b):
+            cx, cy, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+            return jnp.stack([cx - w / 2, cy - h / 2,
+                              cx + w / 2, cy + h / 2], axis=-1)
+        lhs, rhs = to_corner(lhs), to_corner(rhs)
+    elif format != "corner":
+        raise ValueError("format must be 'corner' or 'center'")
+    l2 = lhs.reshape(-1, 4)
+    r2 = rhs.reshape(-1, 4)
+    iou = _iou_matrix(l2, r2)
+    return iou.reshape(lhs.shape[:-1] + rhs.shape[:-1])
+
+
+@register("box_nms", aliases=("_contrib_box_nms",), differentiable=False)
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1,
+            force_suppress=False, in_format="corner",
+            out_format="corner"):
+    """ref: bounding_box.cc BoxNMS — greedy NMS over (B, N, K) rows;
+    suppressed rows have every element set to -1, survivors are sorted
+    by descending score (the reference's output contract)."""
+    if in_format != "corner" or out_format != "corner":
+        raise ValueError("only corner box format is supported")
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+    B, N, K = data.shape
+
+    if K < coord_start + 4:
+        raise ValueError("box_nms rows have %d elements; coord_start=%d "
+                         "needs at least %d" % (K, coord_start,
+                                                coord_start + 4))
+
+    def one(batch):
+        score = batch[:, score_index]
+        boxes = batch[:, coord_start:coord_start + 4]
+        keep = score > valid_thresh
+        order = jnp.argsort(-jnp.where(keep, score, -jnp.inf))
+        if topk > 0:
+            keep = keep & (jnp.zeros((N,), bool).at[
+                order[:min(topk, N)]].set(True))
+        cls_ids = batch[:, id_index] \
+            if (id_index >= 0 and not force_suppress) else None
+        alive = _greedy_nms(boxes, order, keep, overlap_thresh,
+                            class_ids=cls_ids)
+        final = alive & keep
+        out = jnp.where(final[:, None], batch, -1.0)
+        rank = jnp.argsort(-jnp.where(final, score, -jnp.inf))
+        return out[rank]
+
+    out = jax.vmap(one)(data.astype(jnp.float32))
+    return out[0] if squeeze else out

@@ -214,3 +214,63 @@ def test_proposal_symbolic_two_outputs():
     p = mxx.sym.Proposal(cls, bbox, info, scales=(2,), ratios=(1.0,),
                          output_score=True)
     assert len(p.list_outputs()) == 2
+
+
+def test_box_iou_and_nms():
+    a = nd.array(np.array([[0, 0, 2, 2], [1, 1, 3, 3]], "f4"))
+    b = nd.array(np.array([[0, 0, 2, 2]], "f4"))
+    iou = nd.box_iou(a, b).asnumpy()
+    np.testing.assert_allclose(iou[:, 0], [1.0, 1.0 / 7.0], atol=1e-5)
+    # center format agrees with corner format
+    ac = nd.array(np.array([[1, 1, 2, 2], [2, 2, 2, 2]], "f4"))
+    bc = nd.array(np.array([[1, 1, 2, 2]], "f4"))
+    iou_c = nd.box_iou(ac, bc, format="center").asnumpy()
+    np.testing.assert_allclose(iou_c[:, 0], iou[:, 0], atol=1e-5)
+
+    rows = np.array([[[0, 0.9, 0, 0, 2, 2],
+                      [0, 0.8, 0.1, 0.1, 2, 2],
+                      [1, 0.7, 5, 5, 6, 6],
+                      [0, -1.0, 0, 0, 1, 1]]], "f4")
+    out = nd.box_nms(nd.array(rows), overlap_thresh=0.5,
+                     valid_thresh=0.0, id_index=0).asnumpy()
+    # score-sorted survivors; the overlapping same-class duplicate and
+    # the below-valid_thresh row are fully -1
+    assert abs(out[0, 0, 1] - 0.9) < 1e-6
+    assert abs(out[0, 1, 1] - 0.7) < 1e-6
+    assert (out[0, 2] == -1).all() and (out[0, 3] == -1).all()
+    # id_index + force_suppress=False: different class ids never
+    # suppress each other even with full overlap
+    rows2 = np.array([[[0, 0.9, 0, 0, 2, 2],
+                       [1, 0.8, 0, 0, 2, 2]]], "f4")
+    out2 = nd.box_nms(nd.array(rows2), id_index=0).asnumpy()
+    assert (out2[0, :, 1] > 0).all()
+    out3 = nd.box_nms(nd.array(rows2), id_index=0,
+                      force_suppress=True).asnumpy()
+    assert (out3[0, 1] == -1).all()
+
+
+def test_proposal_reference_anchor_enumeration():
+    """First anchor must equal py-faster-rcnn generate_anchors()[0] for
+    base 16, ratio 0.5, scale 8: (-84, -40, 99, 55) at cell (0, 0)."""
+    B, H, W = 1, 1, 1
+    A = 1
+    cls = np.zeros((B, 2 * A, H, W), "f4")
+    cls[0, 1] = 1.0
+    bbox = np.zeros((B, 4 * A, H, W), "f4")
+    info = nd.array(np.array([[1000, 1000, 1.0]], "f4"))
+    rois = nd.Proposal(nd.array(cls), nd.array(bbox), info,
+                       scales=(8,), ratios=(0.5,), feature_stride=16,
+                       rpn_pre_nms_top_n=1, rpn_post_nms_top_n=1,
+                       rpn_min_size=0).asnumpy()
+    # clipped to the (large) image, so the raw anchor passes through
+    np.testing.assert_allclose(rois[0, 1:], [0, 0, 99, 55], atol=1e-4)
+    # unclipped extents visible with an offset cell: anchor at cell (1,1)
+    cls2 = np.zeros((1, 2, 2, 2), "f4"); cls2[0, 1, 1, 1] = 1.0
+    bbox2 = np.zeros((1, 4, 2, 2), "f4")
+    rois2 = nd.Proposal(nd.array(cls2), nd.array(bbox2), info,
+                        scales=(8,), ratios=(0.5,), feature_stride=16,
+                        rpn_pre_nms_top_n=1, rpn_post_nms_top_n=1,
+                        rpn_min_size=0).asnumpy()
+    # negative extents clip to the image (reference clips proposals too)
+    np.testing.assert_allclose(rois2[0, 1:],
+                               [0, 0, 99 + 16, 55 + 16], atol=1e-4)
