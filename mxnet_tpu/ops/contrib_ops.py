@@ -465,13 +465,13 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
         boxes = batch[:, coord_start:coord_start + 4]
         keep = score > valid_thresh
         order = jnp.argsort(-jnp.where(keep, score, -jnp.inf))
-        if topk > 0:
-            keep = keep & (jnp.zeros((N,), bool).at[
-                order[:min(topk, N)]].set(True))
+        # reference topk semantics: only the top-k ranked boxes ACT as
+        # suppressors; beyond-topk boxes survive unless suppressed
+        rank_gate = (jnp.arange(N) < topk) if topk > 0 else None
         cls_ids = batch[:, id_index] \
             if (id_index >= 0 and not force_suppress) else None
         alive = _greedy_nms(boxes, order, keep, overlap_thresh,
-                            class_ids=cls_ids)
+                            class_ids=cls_ids, rank_gate=rank_gate)
         final = alive & keep
         out = jnp.where(final[:, None], batch, -1.0)
         rank = jnp.argsort(-jnp.where(final, score, -jnp.inf))
@@ -479,3 +479,74 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
 
     out = jax.vmap(one)(data.astype(jnp.float32))
     return out[0] if squeeze else out
+
+
+@register("ROIAlign", aliases=("_contrib_ROIAlign",))
+def roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+              sample_ratio=-1, position_sensitive=False, aligned=False):
+    """ref: src/operator/contrib/roi_align.cc (Mask R-CNN pooling):
+    average of bilinear samples on a regular grid per output bin —
+    differentiable in `data`, unlike ROIPooling's hard max.
+
+    data: (N, C, H, W); rois: (R, 5) [batch_idx, x1, y1, x2, y2] in
+    image coordinates. sample_ratio <= 0 uses 2 samples per bin axis
+    (the adaptive ceil(bin/size) of the reference collapses to 2 for the
+    common pooled sizes); position_sensitive is not supported.
+    """
+    if position_sensitive:
+        raise ValueError("position_sensitive ROIAlign is not supported")
+    ph, pw = pooled_size
+    n, c, h, w = data.shape
+    ns = sample_ratio if sample_ratio > 0 else 2
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid: ns x ns points per bin at regular offsets
+        iy = jnp.arange(ph, dtype=jnp.float32)
+        ix = jnp.arange(pw, dtype=jnp.float32)
+        sy = jnp.arange(ns, dtype=jnp.float32)
+        gy = (y1 + iy[:, None] * bin_h
+              + (sy[None, :] + 0.5) * bin_h / ns)  # (ph, ns)
+        gx = (x1 + ix[:, None] * bin_w
+              + (sy[None, :] + 0.5) * bin_w / ns)  # (pw, ns)
+        yy = gy.reshape(-1)  # (ph*ns,)
+        xx = gx.reshape(-1)  # (pw*ns,)
+        # reference bilinear_interpolate: samples beyond [-1, size] are
+        # exactly zero (roi_align.cc); inside, coords clamp to the border
+        oob_y = (yy < -1.0) | (yy > h)
+        oob_x = (xx < -1.0) | (xx > w)
+        yy = jnp.clip(yy, 0.0, h - 1.0)
+        xx = jnp.clip(xx, 0.0, w - 1.0)
+        y0f = jnp.floor(yy)
+        x0f = jnp.floor(xx)
+        y0 = y0f.astype(jnp.int32)
+        x0 = x0f.astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, h - 1)
+        x1i = jnp.minimum(x0 + 1, w - 1)
+        wy = jnp.clip(yy - y0f, 0.0, 1.0)
+        wx = jnp.clip(xx - x0f, 0.0, 1.0)
+        fmap = data[b]  # (C, H, W)
+        # gather the 4 corners for the full (ph*ns, pw*ns) grid
+        v00 = fmap[:, y0[:, None], x0[None, :]]
+        v01 = fmap[:, y0[:, None], x1i[None, :]]
+        v10 = fmap[:, y1i[:, None], x0[None, :]]
+        v11 = fmap[:, y1i[:, None], x1i[None, :]]
+        top = v00 * (1 - wx)[None, None, :] + v01 * wx[None, None, :]
+        bot = v10 * (1 - wx)[None, None, :] + v11 * wx[None, None, :]
+        vals = top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+        zero = oob_y[None, :, None] | oob_x[None, None, :]
+        vals = jnp.where(zero, 0.0, vals)
+        # average the ns x ns samples inside each bin
+        vals = vals.reshape(c, ph, ns, pw, ns)
+        return vals.mean(axis=(2, 4))  # (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))

@@ -274,3 +274,36 @@ def test_proposal_reference_anchor_enumeration():
     # negative extents clip to the image (reference clips proposals too)
     np.testing.assert_allclose(rois2[0, 1:],
                                [0, 0, 99 + 16, 55 + 16], atol=1e-4)
+
+
+def test_roi_align_bilinear_average():
+    x = nd.array(np.full((1, 2, 8, 8), 3.0, "f4"))
+    rois = nd.array(np.array([[0, 0, 0, 7, 7]], "f4"))
+    out = nd.ROIAlign(x, rois, pooled_size=(2, 2))
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(out.asnumpy(), 3.0, atol=1e-5)
+    # ramp: left bin average < right bin average, exact for 2-sample bins
+    ramp = np.tile(np.arange(8, dtype="f4")[None, None, None, :],
+                   (1, 1, 8, 1))
+    o = nd.ROIAlign(nd.array(ramp), rois, pooled_size=(1, 2)).asnumpy()
+    np.testing.assert_allclose(o[0, 0, 0], [1.75, 5.25], atol=1e-5)
+
+
+def test_box_nms_topk_beyond_survives_unless_suppressed():
+    # 3 disjoint boxes, topk=2: reference keeps all 3 (beyond-topk boxes
+    # cannot suppress but do survive)
+    rows = np.array([[[0, 0.9, 0, 0, 1, 1],
+                      [0, 0.8, 2, 2, 3, 3],
+                      [0, 0.7, 5, 5, 6, 6]]], "f4")
+    out = nd.box_nms(nd.array(rows), topk=2, id_index=0).asnumpy()
+    assert (out[0, :, 1] > 0).all()
+
+
+def test_roi_align_out_of_image_samples_are_zero():
+    x = nd.array(np.full((1, 1, 8, 8), 3.0, "f4"))
+    rois = nd.array(np.array([[0, -20, -20, 7, 7]], "f4"))
+    out = nd.ROIAlign(x, rois, pooled_size=(2, 2)).asnumpy()
+    # top-left bin samples entirely outside the map -> 0; bottom-right
+    # bin has 1 of its 4 samples inside (at 3.0) -> 0.75 exactly
+    assert out[0, 0, 0, 0] < 1e-5
+    np.testing.assert_allclose(out[0, 0, 1, 1], 0.75, atol=1e-5)

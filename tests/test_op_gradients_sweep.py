@@ -303,6 +303,11 @@ SPEC.update({
                    dict(pooled_size=(2, 2), spatial_scale=1.0), [0]),
     "Correlation": ([_any(1, 3, 5, 5), _any(1, 3, 5, 5)],
                     dict(kernel_size=1, max_displacement=1), None),
+    # bilinear sampling is smooth away from integer grid lines; the
+    # fractional roi keeps samples off them
+    "ROIAlign": ([_any(1, 2, 6, 6),
+                  np.array([[0.0, 0.3, 0.4, 4.6, 4.3]])],
+                 dict(pooled_size=(2, 2), spatial_scale=1.0), [0]),
     # contrib family
     "fft": ([_any(3, 8)], {}, None),
     "ifft": ([_any(3, 16)], {}, None),
