@@ -550,3 +550,100 @@ def roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
         return vals.mean(axis=(2, 4))  # (C, ph, pw)
 
     return jax.vmap(one_roi)(rois.astype(jnp.float32))
+
+
+@register("DeformableConvolution",
+          aliases=("_contrib_DeformableConvolution",))
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=0, num_group=1,
+                           num_deformable_group=1, no_bias=False,
+                           workspace=None, layout=None):
+    """ref: src/operator/contrib/deformable_convolution.cc (Deformable
+    ConvNets v1): each kernel tap samples the input at its regular grid
+    position PLUS a learned offset, via bilinear interpolation
+    (out-of-image samples are zero, like the reference's im2col).
+
+    data: (N, C, H, W); offset: (N, 2*G_d*kh*kw, Ho, Wo) with per-tap
+    (dy, dx) pairs; weight: (F, C/num_group, kh, kw). Built as a
+    gather-based im2col followed by one MXU matmul per group.
+    """
+    del num_filter, workspace
+    if layout not in (None, "NCHW"):
+        raise ValueError("DeformableConvolution supports NCHW only")
+    kh, kw = kernel
+    sh, sw = stride if stride else (1, 1)
+    dh, dw = dilate if dilate else (1, 1)
+    ph, pw = pad if pad else (0, 0)
+    n, c, h, w = data.shape
+    f = weight.shape[0]
+    ho = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    wo = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    gd = num_deformable_group
+    if c % num_group or f % num_group:
+        raise ValueError("channels not divisible by num_group")
+    if c % gd:
+        raise ValueError("channels not divisible by num_deformable_group")
+
+    # base sampling grid per output position and tap (pixel coords)
+    oy = jnp.arange(ho) * sh - ph
+    ox = jnp.arange(wo) * sw - pw
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+    base_y = oy[:, None, None, None] + ky[None, None, :, None]  # ho,1,kh,1
+    base_x = ox[None, :, None, None] + kx[None, None, None, :]  # 1,wo,1,kw
+    ct = jnp.promote_types(data.dtype, jnp.float32)
+    base_y = jnp.broadcast_to(base_y, (ho, wo, kh, kw)).astype(ct)
+    base_x = jnp.broadcast_to(base_x, (ho, wo, kh, kw)).astype(ct)
+
+    def one_sample(x, off):
+        # off: (2*gd*kh*kw, ho, wo) -> (gd, kh, kw, 2, ho, wo)
+        off = off.reshape(gd, kh, kw, 2, ho, wo)
+
+        def sample_group(xg, og):
+            # xg: (c/gd, H, W); og: (kh, kw, 2, ho, wo)
+            sy = base_y + og[:, :, 0].transpose(2, 3, 0, 1)  # ho,wo,kh,kw
+            sx = base_x + og[:, :, 1].transpose(2, 3, 0, 1)
+            oob = (sy <= -1.0) | (sy >= h) | (sx <= -1.0) | (sx >= w)
+            y0 = jnp.floor(sy)
+            x0 = jnp.floor(sx)
+            wy = sy - y0
+            wx = sx - x0
+            y0i = y0.astype(jnp.int32)
+            x0i = x0.astype(jnp.int32)
+            y1i = y0i + 1
+            x1i = x0i + 1
+            # reference deformable_im2col bilinear: corners OUTSIDE the
+            # image contribute zero (implicit zero padding) — this is
+            # what makes zero offsets + pad reproduce plain Convolution
+            vy0 = (y0i >= 0) & (y0i <= h - 1)
+            vy1 = (y1i >= 0) & (y1i <= h - 1)
+            vx0 = (x0i >= 0) & (x0i <= w - 1)
+            vx1 = (x1i >= 0) & (x1i <= w - 1)
+            y0c = jnp.clip(y0i, 0, h - 1)
+            y1c = jnp.clip(y1i, 0, h - 1)
+            x0c = jnp.clip(x0i, 0, w - 1)
+            x1c = jnp.clip(x1i, 0, w - 1)
+            v00 = jnp.where((vy0 & vx0)[None], xg[:, y0c, x0c], 0.0)
+            v01 = jnp.where((vy0 & vx1)[None], xg[:, y0c, x1c], 0.0)
+            v10 = jnp.where((vy1 & vx0)[None], xg[:, y1c, x0c], 0.0)
+            v11 = jnp.where((vy1 & vx1)[None], xg[:, y1c, x1c], 0.0)
+            val = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                   + v10 * wy * (1 - wx) + v11 * wy * wx)
+            return jnp.where(oob[None], 0.0, val)  # (c/gd, ho, wo, kh, kw)
+
+        cols = jax.vmap(sample_group)(
+            x.reshape(gd, c // gd, h, w), off)  # (gd, c/gd, ho,wo,kh,kw)
+        return cols.reshape(c, ho, wo, kh, kw)
+
+    cols = jax.vmap(one_sample)(data.astype(ct), offset.astype(ct))
+    # (N, C, ho, wo, kh, kw) -> grouped matmul with (F, C/g, kh, kw)
+    cg = c // num_group
+    fg = f // num_group
+    cols = cols.reshape(n, num_group, cg, ho, wo, kh, kw)
+    wg = weight.astype(ct).reshape(num_group, fg, cg, kh, kw)
+    out = jnp.einsum("ngchwyx,gfcyx->ngfhw", cols, wg)
+    out = out.reshape(n, f, ho, wo).astype(data.dtype)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape(1, f, 1, 1).astype(out.dtype)
+    return out

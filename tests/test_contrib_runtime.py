@@ -307,3 +307,43 @@ def test_roi_align_out_of_image_samples_are_zero():
     # bin has 1 of its 4 samples inside (at 3.0) -> 0.75 exactly
     assert out[0, 0, 0, 0] < 1e-5
     np.testing.assert_allclose(out[0, 0, 1, 1], 0.75, atol=1e-5)
+
+
+def test_deformable_convolution_zero_offsets_match_conv():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 9, 9).astype("f4")
+    w = rng.randn(6, 2, 3, 3).astype("f4")
+    off = np.zeros((2, 18, 5, 5), "f4")
+    od = nd.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), None, kernel=(3, 3),
+        stride=(2, 2), pad=(1, 1), num_group=2, no_bias=True).asnumpy()
+    ref = nd.Convolution(nd.array(x), nd.array(w), None, kernel=(3, 3),
+                         stride=(2, 2), pad=(1, 1), num_group=2,
+                         no_bias=True).asnumpy()
+    np.testing.assert_allclose(od, ref, atol=1e-4)
+
+
+def test_deformable_convolution_integer_shift():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 1, 6, 6).astype("f4")
+    w = np.zeros((1, 1, 3, 3), "f4")
+    w[0, 0, 0, 0] = 1.0  # kernel picks only tap (0, 0)
+    off = np.ones((1, 18, 4, 4), "f4")  # every tap shifts (+1, +1)
+    o = nd.DeformableConvolution(nd.array(x), nd.array(off), nd.array(w),
+                                 None, kernel=(3, 3),
+                                 no_bias=True).asnumpy()
+    np.testing.assert_allclose(o[0, 0], x[0, 0][1:5, 1:5], atol=1e-5)
+
+
+def test_deformable_convolution_fractional_offset_interpolates():
+    # half-pixel x-shift averages horizontal neighbors
+    x = np.zeros((1, 1, 4, 4), "f4")
+    x[0, 0, 1, 1] = 2.0
+    x[0, 0, 1, 2] = 4.0
+    w = np.ones((1, 1, 1, 1), "f4")
+    off = np.zeros((1, 2, 4, 4), "f4")
+    off[0, 1] = 0.5  # dx = +0.5
+    o = nd.DeformableConvolution(nd.array(x), nd.array(off), nd.array(w),
+                                 None, kernel=(1, 1),
+                                 no_bias=True).asnumpy()
+    np.testing.assert_allclose(o[0, 0, 1, 1], 3.0, atol=1e-5)

@@ -308,6 +308,12 @@ SPEC.update({
     "ROIAlign": ([_any(1, 2, 6, 6),
                   np.array([[0.0, 0.3, 0.4, 4.6, 4.3]])],
                  dict(pooled_size=(2, 2), spatial_scale=1.0), [0]),
+    # offsets bounded to [0.17, 0.33]: every bilinear sample stays well
+    # clear of the integer-grid kinks, so the numeric grad is defined
+    "DeformableConvolution": (
+        [_any(1, 2, 5, 5), _unit(1, 18, 3, 3) * 0.1 + 0.25,
+         _any(2, 2, 3, 3), _any(2)],
+        dict(kernel=(3, 3)), None),
     # contrib family
     "fft": ([_any(3, 8)], {}, None),
     "ifft": ([_any(3, 16)], {}, None),
