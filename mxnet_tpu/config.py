@@ -55,6 +55,12 @@ _declare("MXT_KVSTORE_BIGARRAY_BOUND", int, 1000000,
          "(ref: MXNET_KVSTORE_BIGARRAY_BOUND; advisory — XLA collectives "
          "handle chunking internally).")
 
+_declare("MXT_FUSED_TRAINER", bool, True,
+         "Fuse Trainer.step's per-parameter optimizer updates into ONE "
+         "donated XLA launch when eligible (sgd/nag/adam/adamw, dense "
+         "grads, no dist kvstore). 0 falls back to eager per-param "
+         "updates.")
+
 _declare("MXT_RNN_UNROLL", int, None,
          "Unroll factor for the fused-RNN recurrent scan (0 disables "
          "unrolling; unset = auto: full unroll up to T=128, else 16). "

@@ -233,7 +233,11 @@ class Parameter:
         self.shape = tuple(data.shape)
         if self._data is None:
             self._deferred_init_default()
-        self._data._set_data(jnp.asarray(data, dtype=self.dtype))
+        # copy, never alias: the reference's set_data writes INTO the
+        # param's own storage, and an aliased buffer would be invalidated
+        # for this param when the source param's trainer donates it
+        # (jax.jit donate_argnums in _FusedUpdate / ShardedTrainStep)
+        self._data._set_data(jnp.array(data, dtype=self.dtype, copy=True))
 
     def _deferred_init_default(self):
         if self._data is None:

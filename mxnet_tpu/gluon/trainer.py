@@ -10,6 +10,8 @@ dist types and server-side optimizers) behaves like the reference.
 """
 from __future__ import annotations
 
+import jax
+
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .. import optimizer as opt
@@ -17,6 +19,191 @@ from .. import kvstore as kvs
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
+
+
+class _FusedUpdate:
+    """ONE donated XLA launch for Trainer.step's optimizer phase.
+
+    The reference's canonical Gluon loop (record/backward/trainer.step,
+    ref: gluon/trainer.py — step) issues one engine op per parameter; its
+    async engine hides the launches. On the axon tunnel each launch costs
+    ~3.4 ms (PERF.md §1.2), so a 200-parameter model would spend ~0.7 s in
+    Trainer.step alone. This fuses every eligible parameter's update into
+    one jitted program with weights and optimizer state DONATED — the
+    static_alloc analog ShardedTrainStep already uses (parallel/sharded.py)
+    brought to the canonical path.
+
+    Eligible: optimizer is exactly SGD/NAG/Adam/AdamW, dense gradients, no
+    multi_precision, and no distributed/server-side kvstore. Anything else
+    falls back to the eager per-parameter updater (same numerics, more
+    launches). Dynamic scalars (scheduler lr, wd, rescale_grad, step t)
+    enter as traced 0-d arguments so no step ever retraces; per-parameter
+    lr_mult/wd_mult are folded in as static multipliers at build time.
+    Optimizer state stays in Updater.states in the eager layout, so
+    save_states/load_states round-trip unchanged.
+    """
+
+    _SUPPORTED = ("SGD", "NAG", "Adam", "AdamW")
+
+    @staticmethod
+    def eligible(trainer):
+        from .. import config as _config
+
+        o = trainer._optimizer
+        if not _config.get("MXT_FUSED_TRAINER"):
+            return False
+        if type(o).__name__ not in _FusedUpdate._SUPPORTED or \
+                type(o).__module__ != opt.Optimizer.__module__:
+            return False
+        if getattr(o, "multi_precision", False):
+            return False
+        if getattr(o, "aggregate_num", 0):
+            return False
+        if trainer._update_on_kvstore:
+            return False
+        kv = trainer._kvstore
+        if kv is not None and (kv.type.startswith("dist") or
+                               trainer._compression_params):
+            return False
+        if jax.process_count() > 1:
+            return False
+        for p in trainer._params:
+            if p.grad_req == "null":
+                continue
+            if getattr(p, "_grad_stype", "default") != "default":
+                return False
+        return True
+
+    def __init__(self, trainer):
+        self._trainer = trainer
+        o = trainer._optimizer
+        self._opt = o
+        self._indices = [i for i, p in enumerate(trainer._params)
+                         if p.grad_req != "null"]
+        self._upds = [self._param_update(o, i) for i in self._indices]
+        upds = self._upds
+
+        def step(ws, gs, ss, t, lr, wd, rescale):
+            out_w, out_s = [], []
+            for f, w, g, s in zip(upds, ws, gs, ss):
+                w2, s2 = f(w, g, s, t, lr, wd, rescale)
+                out_w.append(w2)
+                out_s.append(s2)
+            return tuple(out_w), tuple(out_s)
+
+        # weights + states donated: buffers are reused across steps and the
+        # params' NDArray wrappers rebind to the outputs
+        self._jit = jax.jit(step, donate_argnums=(0, 2))
+
+    @staticmethod
+    def _param_update(o, index):
+        """Per-parameter pure update (w, g, state_leaves, t, lr, wd,
+        rescale) -> (w2, leaves2), numerics identical to the eager
+        Optimizer.update path."""
+        import jax.numpy as jnp
+
+        from ..ops.registry import get_op
+
+        lr_mult = o.param_dict[index].lr_mult if index in o.param_dict \
+            else o.lr_mult.get(index, o.lr_mult.get(
+                o.idx2name.get(index), 1.0))
+        wd_mult = o.param_dict[index].wd_mult if index in o.param_dict \
+            else o.wd_mult.get(index, o.wd_mult.get(
+                o.idx2name.get(index), 1.0))
+        clip = o.clip_gradient
+        name = type(o).__name__
+        if name in ("SGD", "NAG"):
+            momentum = o.momentum
+            if momentum:
+                fn = get_op("sgd_mom_update" if name == "SGD"
+                            else "nag_mom_update").fn
+
+                def upd(w, g, s, t, lr, wd, rescale):
+                    w2, m2 = fn(w, g, s[0], lr=lr * lr_mult,
+                                momentum=momentum, wd=wd * wd_mult,
+                                rescale_grad=rescale, clip_gradient=clip)
+                    return w2, (m2,)
+            else:
+                fn = get_op("sgd_update").fn
+
+                def upd(w, g, s, t, lr, wd, rescale):
+                    return fn(w, g, lr=lr * lr_mult, wd=wd * wd_mult,
+                              rescale_grad=rescale, clip_gradient=clip), ()
+        else:  # Adam / AdamW — bias correction folded into lr, as eager
+            beta1, beta2, eps = o.beta1, o.beta2, o.epsilon
+            if name == "Adam":
+                fn = get_op("adam_update").fn
+
+                def apply(w, g, s, lr_t, wd, rescale):
+                    return fn(w, g, s[0], s[1], lr=lr_t, wd=wd,
+                              beta1=beta1, beta2=beta2, epsilon=eps,
+                              rescale_grad=rescale, clip_gradient=clip)
+            else:
+                fn = get_op("adamw_update").fn
+
+                def apply(w, g, s, lr_t, wd, rescale):
+                    return fn(w, g, s[0], s[1], lr=lr_t, wd=wd, eta=1.0,
+                              beta1=beta1, beta2=beta2, epsilon=eps,
+                              rescale_grad=rescale, clip_gradient=clip)
+
+            def upd(w, g, s, t, lr, wd, rescale):
+                coef1 = 1.0 - jnp.power(beta1, t)
+                coef2 = 1.0 - jnp.power(beta2, t)
+                lr_t = lr * lr_mult * jnp.sqrt(coef2) / coef1
+                w2, m2, v2 = apply(w, g, s, lr_t, wd * wd_mult, rescale)
+                return w2, (m2, v2)
+        return upd
+
+    @staticmethod
+    def _leaves(state):
+        if state is None:
+            return ()
+        if isinstance(state, tuple):
+            return state
+        return (state,)
+
+    def __call__(self, rescale):
+        """Run one fused update. Returns False (caller should fall back to
+        the eager path) if host-side invariants don't hold this step."""
+        tr = self._trainer
+        o = self._opt
+        updater = tr._updaters[0]
+        params = tr._params
+        for i in self._indices:
+            p = params[i]
+            if p._data is None or getattr(p._data, "_grad", None) is None:
+                return False
+            if i not in updater.states:
+                updater.states[i] = o.create_state_multi_precision(
+                    i, p.data())
+                updater.states_synced[i] = True
+        # the fused program uses ONE step count for every parameter; if a
+        # prior eager/kvstore path left counts uneven, stay eager
+        counts = {o._index_update_count.get(i, o.begin_num_update)
+                  for i in self._indices}
+        if len(counts) != 1:
+            return False
+
+        # host-side bookkeeping first, mirroring eager order (_update_count
+        # then _get_lr): scheduler sees the post-bump num_update
+        for i in self._indices:
+            o._update_count(i)
+        t = o._index_update_count[self._indices[0]] if self._indices else 1
+        lr = o.lr_scheduler(o.num_update) if o.lr_scheduler is not None \
+            else o.lr
+        wd = o.wd
+
+        ws = tuple(params[i].data().data for i in self._indices)
+        gs = tuple(params[i].grad().data for i in self._indices)
+        ss = tuple(tuple(l.data for l in self._leaves(updater.states[i]))
+                   for i in self._indices)
+        new_w, new_s = self._jit(ws, gs, ss, t, float(lr), float(wd),
+                                 float(rescale))
+        for i, w2, s2 in zip(self._indices, new_w, new_s):
+            params[i].data()._set_data(w2)
+            for leaf, v in zip(self._leaves(updater.states[i]), s2):
+                leaf._set_data(v)
+        return True
 
 
 class Trainer:
@@ -48,6 +235,7 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._params_to_init = []
+        self._fused = None  # None = undecided, False = ineligible
         self._reset_kvstore()
 
     def _init_optimizer(self, optimizer, optimizer_params):
@@ -72,6 +260,7 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._params_to_init = [p for p in self._params]
+        self._fused = None
 
     def _init_kvstore(self):
         config = self._kvstore_params
@@ -152,6 +341,11 @@ class Trainer:
             self._init_kvstore()
         if self._params_to_init:
             self._init_params()
+        if self._fused is None:
+            self._fused = _FusedUpdate(self) if _FusedUpdate.eligible(self) \
+                else False
+        if self._fused and self._fused(rescale_grad):
+            return  # one donated launch covered reduce (identity) + update
         self._allreduce_grads()
         self._update(ignore_stale_grad)
 
@@ -239,6 +433,9 @@ class Trainer:
             self._init_kvstore()
         if self._params_to_init:
             self._init_params()
+        # the fused step closes over the optimizer OBJECT (hyper-params,
+        # update counts); loading swaps it — rebuild on next step
+        self._fused = None
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
             self._optimizer = self._kvstore._updater.optimizer

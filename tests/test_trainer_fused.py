@@ -1,0 +1,167 @@
+"""Fused (one-launch, donated) Trainer.step vs the eager per-param path.
+
+The canonical Gluon loop (ref: gluon/trainer.py — step) must produce
+identical numerics whether Trainer.step runs the fused donated XLA program
+or the eager per-parameter updates; these tests pin that equivalence and
+the eligibility/fallback edges.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import Trainer, nn
+from mxnet_tpu.gluon.trainer import _FusedUpdate
+
+
+def _make_net(seed=0):
+    mx.random.seed(seed)
+    net = nn.HybridSequential(prefix="fused_")
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(4, in_units=16))
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+def _train(net, trainer, steps=4, seed=0):
+    rng = np.random.RandomState(seed)
+    losses = []
+    for _ in range(steps):
+        x = nd.array(rng.uniform(-1, 1, (8, 8)).astype(np.float32))
+        y = nd.array(rng.uniform(-1, 1, (8, 4)).astype(np.float32))
+        with ag.record():
+            out = net(x)
+            loss = ((out - y) ** 2).mean()
+        loss.backward()
+        trainer.step(8)
+        losses.append(float(loss.asnumpy()))
+    return losses
+
+
+def _weights(net):
+    return {k: v.data().asnumpy() for k, v in net.collect_params().items()}
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4}),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 1e-2}),
+    ("adamw", {"learning_rate": 1e-2, "wd": 1e-2}),
+])
+def test_fused_matches_eager(monkeypatch, optimizer, opt_params):
+    net_f = _make_net()
+    tr_f = Trainer(net_f.collect_params(), optimizer, dict(opt_params))
+    _train(net_f, tr_f)
+    assert tr_f._fused, "fused path should be eligible here"
+
+    monkeypatch.setenv("MXT_FUSED_TRAINER", "0")
+    net_e = _make_net()
+    tr_e = Trainer(net_e.collect_params(), optimizer, dict(opt_params))
+    _train(net_e, tr_e)
+    assert tr_e._fused is False
+
+    wf, we = _weights(net_f), _weights(net_e)
+    assert wf.keys() == we.keys()
+    for k in wf:
+        np.testing.assert_allclose(wf[k], we[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=k)
+    # optimizer step counters advanced identically
+    assert tr_f._optimizer.num_update == tr_e._optimizer.num_update == 4
+
+
+def test_fused_with_lr_scheduler(monkeypatch):
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    def run(env):
+        if env is not None:
+            monkeypatch.setenv("MXT_FUSED_TRAINER", env)
+        net = _make_net()
+        tr = Trainer(net.collect_params(), "sgd",
+                     {"learning_rate": 0.5, "momentum": 0.9,
+                      "lr_scheduler": FactorScheduler(step=2, factor=0.5)})
+        _train(net, tr, steps=5)
+        return _weights(net), tr
+
+    wf, tr_f = run(None)
+    assert tr_f._fused
+    we, _ = run("0")
+    for k in wf:
+        np.testing.assert_allclose(wf[k], we[k], rtol=1e-5, atol=1e-6)
+
+
+def test_fused_lr_mult(monkeypatch):
+    def run(env):
+        if env is not None:
+            monkeypatch.setenv("MXT_FUSED_TRAINER", env)
+        net = _make_net()
+        for name, p in net.collect_params().items():
+            if name.endswith("bias"):
+                p.lr_mult = 0.0  # frozen biases exercise the static fold
+        tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.2})
+        _train(net, tr)
+        return _weights(net)
+
+    wf = run(None)
+    we = run("0")
+    for k in wf:
+        np.testing.assert_allclose(wf[k], we[k], rtol=1e-5, atol=1e-6)
+    # the frozen biases really didn't move
+    net0 = _make_net()
+    w0 = _weights(net0)
+    for k in wf:
+        if k.endswith("bias"):
+            np.testing.assert_array_equal(wf[k], w0[k])
+
+
+def test_fused_save_load_states_roundtrip(tmp_path):
+    net = _make_net()
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    _train(net, tr, steps=3)
+    assert tr._fused
+    fname = str(tmp_path / "trainer.states")
+    tr.save_states(fname)
+
+    net2 = _make_net()
+    tr2 = Trainer(net2.collect_params(), "adam", {"learning_rate": 1e-2})
+    _train(net2, tr2, steps=1)  # materialize states
+    tr2.load_states(fname)
+    # the fused program closed over the pre-load optimizer — must rebuild
+    assert tr2._fused is None
+    # update counts resumed from the checkpoint, not the stale object
+    assert tr2._optimizer.num_update == tr._optimizer.num_update == 3
+    for i, s in tr._updaters[0].states.items():
+        s2 = tr2._updaters[0].states[i]
+        np.testing.assert_allclose(s[0].asnumpy(), s2[0].asnumpy(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(s[1].asnumpy(), s2[1].asnumpy(),
+                                   rtol=1e-6)
+    # training continues through the fused path after a state load
+    _train(net2, tr2, steps=1)
+
+
+def test_fused_ineligible_falls_back():
+    net = _make_net()
+    # rmsprop has no fused builder — must run eager and still train
+    tr = Trainer(net.collect_params(), "rmsprop", {"learning_rate": 1e-3})
+    losses = _train(net, tr)
+    assert tr._fused is False
+    assert np.isfinite(losses[-1])
+
+
+def test_fused_no_per_step_retrace(monkeypatch):
+    """Dynamic scalars (t, lr, rescale) are traced arguments, so the jit
+    cache must stop growing after step 1 (step 0 compiles once; step 1
+    recompiles once when the donated outputs re-enter as inputs) — a
+    growing cache would mean a compile per step."""
+    net = _make_net()
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    _train(net, tr, steps=2)
+    fused = tr._fused
+    assert isinstance(fused, _FusedUpdate)
+    steady = fused._jit._cache_size()
+    _train(net, tr, steps=3, seed=1)
+    assert fused._jit._cache_size() == steady <= 2
