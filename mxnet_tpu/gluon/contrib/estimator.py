@@ -84,13 +84,29 @@ def _default_monitor(estimator):
     return estimator.train_metrics[0]
 
 
+def _resolve_mode(mode, metric):
+    """'auto' infers the improvement direction from the metric name the way
+    the reference's handlers do (ref: event_handler.py — mode='auto':
+    loss/error-like monitors minimize, everything else maximizes)."""
+    if mode != "auto":
+        return mode
+    name = metric.get()[0]
+    name = name[0] if isinstance(name, (list, tuple)) else name
+    lowered = str(name).lower()
+    if any(k in lowered for k in ("loss", "error", "perplexity", "mae",
+                                  "mse", "rmse")):
+        return "min"
+    return "max"
+
+
 class CheckpointHandler(EventHandler):
     """Save parameters each epoch, optionally only on metric improvement
-    (ref: event_handler.py — CheckpointHandler). mode: "max" for
-    accuracy-like monitors, "min" for loss-like."""
+    (ref: event_handler.py — CheckpointHandler). mode: "auto" (default)
+    infers the direction from the monitor's name — loss-like monitors
+    minimize, accuracy-like maximize; "max"/"min" force it."""
 
     def __init__(self, model_dir, model_prefix="model", monitor=None,
-                 save_best=False, mode="max"):
+                 save_best=False, mode="auto"):
         import os
 
         os.makedirs(model_dir, exist_ok=True)
@@ -110,9 +126,10 @@ class CheckpointHandler(EventHandler):
             estimator.net.save_parameters(path)
             return
         metric = self.monitor or _default_monitor(estimator)
+        mode = _resolve_mode(self.mode, metric)
         _, value = metric.get()
         improved = self._best is None or (
-            value > self._best if self.mode == "max" else value < self._best)
+            value > self._best if mode == "max" else value < self._best)
         if improved:
             self._best = value
             estimator.net.save_parameters(os.path.join(
@@ -124,7 +141,7 @@ class EarlyStoppingHandler(EventHandler):
     (ref: event_handler.py — EarlyStoppingHandler)."""
 
     def __init__(self, monitor=None, min_delta=0.0, patience=0,
-                 mode="max"):
+                 mode="auto"):
         self.monitor = monitor
         self.min_delta = min_delta
         self.patience = patience
@@ -134,11 +151,12 @@ class EarlyStoppingHandler(EventHandler):
 
     def epoch_end(self, estimator):
         metric = self.monitor or _default_monitor(estimator)
+        mode = _resolve_mode(self.mode, metric)
         _, value = metric.get()
         improved = (self._best is None
-                    or (self.mode == "max"
+                    or (mode == "max"
                         and value > self._best + self.min_delta)
-                    or (self.mode == "min"
+                    or (mode == "min"
                         and value < self._best - self.min_delta))
         if improved:
             self._best = value

@@ -194,19 +194,22 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
     ground-truth boxes and encode regression targets.
 
     anchor: (1, A, 4) corners; label: (B, O, 5+) rows
-    [cls, x1, y1, x2, y2] with cls -1 padding; cls_pred is unused for
-    matching here (kept for API parity; the reference uses it only for
-    negative mining order). Returns (loc_target (B, A*4),
-    loc_mask (B, A*4), cls_target (B, A)) where cls_target is
-    1 + gt class for matched anchors, 0 for background.
+    [cls, x1, y1, x2, y2] with cls -1 padding; cls_pred (B, C, A) class
+    scores drive hard negative mining when negative_mining_ratio > 0: the
+    unmatched anchors with best_iou < negative_mining_thresh are ranked by
+    their hottest non-background score and only the top
+    max(ratio * num_positive, minimum_negative_samples) stay background
+    training samples — every other negative gets cls_target = ignore_label
+    so the loss skips it. Returns (loc_target (B, A*4), loc_mask (B, A*4),
+    cls_target (B, A)) where cls_target is 1 + gt class for matched
+    anchors, 0 for selected background, ignore_label for mined-out.
     """
-    del cls_pred, negative_mining_ratio, negative_mining_thresh
-    del minimum_negative_samples
+    mine = negative_mining_ratio is not None and negative_mining_ratio > 0
     anchors = anchor.reshape(-1, 4)
     a_cx, a_cy, a_w, a_h = _corner_to_center(anchors)
     vx, vy, vw, vh = variances
 
-    def one_sample(lbl):
+    def one_sample(lbl, pred):
         cls = lbl[:, 0]
         boxes = lbl[:, 1:5]
         valid = cls >= 0
@@ -242,13 +245,30 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
             jnp.log(g_h / a_h) / vh,
         ], axis=1)  # (A, 4)
         mask = matched[:, None].astype(t.dtype)
-        cls_t = jnp.where(matched,
-                          cls[assigned].astype(jnp.float32) + 1.0, 0.0)
+        if mine:
+            # hard negative mining (ref: multibox_target.cc negative
+            # mining branch): hardness = hottest non-background score
+            hardness = jnp.max(pred[1:, :], axis=0)  # (A,)
+            eligible = (~matched) & (best_iou < negative_mining_thresh)
+            num_pos = jnp.sum(matched)
+            num_neg = jnp.maximum(
+                (num_pos * negative_mining_ratio).astype(jnp.int32),
+                jnp.int32(minimum_negative_samples))
+            score = jnp.where(eligible, hardness, -jnp.inf)
+            order = jnp.argsort(-score)
+            rank = jnp.argsort(order)  # rank[i] = position of anchor i
+            keep_neg = eligible & (rank < num_neg)
+            cls_t = jnp.where(
+                matched, cls[assigned].astype(jnp.float32) + 1.0,
+                jnp.where(keep_neg, 0.0, jnp.float32(ignore_label)))
+        else:
+            cls_t = jnp.where(matched,
+                              cls[assigned].astype(jnp.float32) + 1.0, 0.0)
         return (t * mask).reshape(-1), jnp.broadcast_to(
             mask, t.shape).reshape(-1), cls_t
 
     loc_t, loc_m, cls_t = jax.vmap(one_sample)(
-        label.astype(jnp.float32))
+        label.astype(jnp.float32), cls_pred.astype(jnp.float32))
     return loc_t, loc_m, cls_t
 
 
@@ -489,15 +509,23 @@ def roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
     differentiable in `data`, unlike ROIPooling's hard max.
 
     data: (N, C, H, W); rois: (R, 5) [batch_idx, x1, y1, x2, y2] in
-    image coordinates. sample_ratio <= 0 uses 2 samples per bin axis
-    (the adaptive ceil(bin/size) of the reference collapses to 2 for the
-    common pooled sizes); position_sensitive is not supported.
+    image coordinates. sample_ratio > 0 fixes the per-bin-axis sample
+    count; <= 0 uses the reference's ADAPTIVE ceil(roi_size/pooled_size)
+    per ROI — realized under static shapes by sampling a static-bound
+    grid (bounded by the feature-map/pooled ratio, capped at 8 axes
+    samples) and mask-averaging only each ROI's own count, so the
+    numerics match the reference exactly for ROIs up to 8x the bin grid
+    and clamp to 8 beyond. position_sensitive is not supported.
     """
     if position_sensitive:
         raise ValueError("position_sensitive ROIAlign is not supported")
+    import math as _math
+
     ph, pw = pooled_size
     n, c, h, w = data.shape
-    ns = sample_ratio if sample_ratio > 0 else 2
+    adaptive = sample_ratio <= 0
+    ns = int(sample_ratio) if not adaptive else int(
+        min(8, max(1, _math.ceil(h / ph), _math.ceil(w / pw))))
     offset = 0.5 if aligned else 0.0
 
     def one_roi(roi):
@@ -510,14 +538,20 @@ def roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
         rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
         bin_w = rw / pw
         bin_h = rh / ph
-        # sample grid: ns x ns points per bin at regular offsets
+        if adaptive:  # ceil(bin size) samples, per ROI (roi_align.cc)
+            ns_h = jnp.clip(jnp.ceil(rh / ph), 1.0, float(ns))
+            ns_w = jnp.clip(jnp.ceil(rw / pw), 1.0, float(ns))
+        else:
+            ns_h = ns_w = jnp.float32(ns)
+        # sample grid: ns x ns points per bin; rows/cols past the ROI's
+        # own (ns_h, ns_w) count are masked out of the average
         iy = jnp.arange(ph, dtype=jnp.float32)
         ix = jnp.arange(pw, dtype=jnp.float32)
         sy = jnp.arange(ns, dtype=jnp.float32)
         gy = (y1 + iy[:, None] * bin_h
-              + (sy[None, :] + 0.5) * bin_h / ns)  # (ph, ns)
+              + (sy[None, :] + 0.5) * bin_h / ns_h)  # (ph, ns)
         gx = (x1 + ix[:, None] * bin_w
-              + (sy[None, :] + 0.5) * bin_w / ns)  # (pw, ns)
+              + (sy[None, :] + 0.5) * bin_w / ns_w)  # (pw, ns)
         yy = gy.reshape(-1)  # (ph*ns,)
         xx = gx.reshape(-1)  # (pw*ns,)
         # reference bilinear_interpolate: samples beyond [-1, size] are
@@ -545,9 +579,12 @@ def roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
         vals = top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
         zero = oob_y[None, :, None] | oob_x[None, None, :]
         vals = jnp.where(zero, 0.0, vals)
-        # average the ns x ns samples inside each bin
+        # average each ROI's own (ns_h x ns_w) samples inside each bin
         vals = vals.reshape(c, ph, ns, pw, ns)
-        return vals.mean(axis=(2, 4))  # (C, ph, pw)
+        my = (sy < ns_h).astype(vals.dtype)  # (ns,)
+        mw = (sy < ns_w).astype(vals.dtype)
+        wgt = my[None, None, :, None, None] * mw[None, None, None, None, :]
+        return (vals * wgt).sum(axis=(2, 4)) / (ns_h * ns_w)  # (C, ph, pw)
 
     return jax.vmap(one_roi)(rois.astype(jnp.float32))
 

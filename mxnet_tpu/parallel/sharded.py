@@ -240,13 +240,15 @@ class ShardedTrainStep:
             return data
         # memoize by source buffer: train loops pass the same batch array
         # for many steps (and bench reuses one batch for all of them) —
-        # re-sharding it every step burns host time for an identical result
+        # re-sharding it every step burns host time for an identical result.
+        # Only the latest (x, y) pair is kept: a bigger cache pins dropped
+        # batches in HBM until eviction (they hold strong refs).
         cached = self._batch_cache.get(id(data))
         if cached is not None and cached[0] is data:
             return cached[1]
         out = jax.device_put(data, sharding)
-        if len(self._batch_cache) > 8:
-            self._batch_cache.clear()
+        while len(self._batch_cache) >= 2:
+            self._batch_cache.pop(next(iter(self._batch_cache)))
         self._batch_cache[id(data)] = (data, out)
         return out
 

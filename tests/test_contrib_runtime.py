@@ -347,3 +347,63 @@ def test_deformable_convolution_fractional_offset_interpolates():
                                  None, kernel=(1, 1),
                                  no_bias=True).asnumpy()
     np.testing.assert_allclose(o[0, 0, 1, 1], 3.0, atol=1e-5)
+
+
+def test_multibox_target_hard_negative_mining():
+    # 1 gt matching anchor0; 4 pure negatives with distinct "hardness"
+    # (hottest non-background score). ratio=2 -> 2 mined negatives stay
+    # background (the 2 hottest), the rest become ignore_label.
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                  [0.52, 0.52, 0.6, 0.6],
+                                  [0.62, 0.62, 0.7, 0.7],
+                                  [0.72, 0.72, 0.8, 0.8],
+                                  [0.82, 0.82, 0.9, 0.9]]], "f4"))
+    label = nd.array(np.array([[[0, 0.1, 0.1, 0.5, 0.5]]], "f4"))
+    # scores: (B, C=2, A=5); non-background row ranks neg hardness
+    hard = np.array([[[0, 0, 0, 0, 0],
+                      [0.0, 0.9, 0.1, 0.8, 0.2]]], "f4")
+    _, _, ct = nd.MultiBoxTarget(anchors, label, nd.array(hard),
+                                 negative_mining_ratio=2.0,
+                                 negative_mining_thresh=0.5,
+                                 ignore_label=-1.0)
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 1  # matched -> class 0 + 1
+    assert ct[1] == 0 and ct[3] == 0  # two hottest negatives kept
+    assert ct[2] == -1 and ct[4] == -1  # mined out
+    # without mining every negative trains as background
+    _, _, ct0 = nd.MultiBoxTarget(anchors, label, nd.array(hard))
+    assert (ct0.asnumpy()[0][1:] == 0).all()
+
+
+def test_multibox_target_minimum_negative_samples():
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                  [0.52, 0.52, 0.6, 0.6],
+                                  [0.62, 0.62, 0.7, 0.7]]], "f4"))
+    # no gt at all -> num_pos 0 -> ratio alone keeps 0 negatives, so
+    # minimum_negative_samples must floor it
+    label = nd.array(np.array([[[-1, 0, 0, 0, 0]]], "f4"))
+    hard = np.array([[[0, 0, 0], [0.3, 0.9, 0.1]]], "f4")
+    _, _, ct = nd.MultiBoxTarget(anchors, label, nd.array(hard),
+                                 negative_mining_ratio=3.0,
+                                 minimum_negative_samples=1)
+    ct = ct.asnumpy()[0]
+    assert (ct == 0).sum() == 1 and ct[1] == 0  # the hottest one
+    assert (ct == -1).sum() == 2
+
+
+def test_roi_align_adaptive_sample_count():
+    # big square ROI: bin size 3 -> adaptive picks ceil(6/2)=3 samples
+    # per axis, identical to forcing sample_ratio=3
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.uniform(-1, 1, (1, 3, 12, 12)).astype("f4"))
+    rois = nd.array(np.array([[0, 2, 2, 8, 8]], "f4"))
+    auto = nd.ROIAlign(x, rois, pooled_size=(2, 2)).asnumpy()
+    forced = nd.ROIAlign(x, rois, pooled_size=(2, 2),
+                         sample_ratio=3).asnumpy()
+    np.testing.assert_allclose(auto, forced, atol=1e-6)
+    # tiny ROI (smaller than the pooled grid): adaptive -> 1 sample/axis
+    tiny = nd.array(np.array([[0, 3, 3, 4, 4]], "f4"))
+    auto_t = nd.ROIAlign(x, tiny, pooled_size=(2, 2)).asnumpy()
+    forced_t = nd.ROIAlign(x, tiny, pooled_size=(2, 2),
+                           sample_ratio=1).asnumpy()
+    np.testing.assert_allclose(auto_t, forced_t, atol=1e-6)
