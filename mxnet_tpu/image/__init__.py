@@ -5,9 +5,12 @@ from .image import (  # noqa: F401
     center_crop, color_normalize, scale_down,
     Augmenter, ResizeAug, ForceResizeAug, RandomCropAug, CenterCropAug,
     HorizontalFlipAug, CastAug, ColorNormalizeAug, BrightnessJitterAug,
-    ContrastJitterAug, SaturationJitterAug, CreateAugmenter, ImageIter,
+    ContrastJitterAug, SaturationJitterAug, HueJitterAug, LightingAug,
+    RandomGrayAug, RandomOrderAug, ColorJitterAug, CreateAugmenter,
+    ImageIter,
 )
 from .detection import (  # noqa: F401
     DetAugmenter, DetBorrowAug, DetRandomSelectAug, DetHorizontalFlipAug,
-    DetRandomCropAug, DetRandomPadAug, CreateDetAugmenter, ImageDetIter,
+    DetRandomCropAug, DetRandomPadAug, CreateMultiRandCropAugmenter,
+    CreateDetAugmenter, ImageDetIter,
 )

@@ -19,7 +19,8 @@ from .image import Augmenter, ImageIter, _to_np, imdecode, imresize
 
 __all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
            "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
-           "CreateDetAugmenter", "ImageDetIter"]
+           "CreateMultiRandCropAugmenter", "CreateDetAugmenter",
+           "ImageDetIter"]
 
 
 class DetAugmenter:
@@ -78,55 +79,65 @@ class DetHorizontalFlipAug(DetAugmenter):
 
 class DetRandomCropAug(DetAugmenter):
     """Random crop with a minimum-object-coverage constraint
-    (ref: detection.py — DetRandomCropAug): sample crops until one keeps
-    every surviving object covered by >= min_object_covered; boxes are
-    clipped and re-normalized to the crop."""
+    (ref: detection.py — DetRandomCropAug / _update_labels): up to
+    max_attempts candidate crops are sampled; a candidate is accepted
+    when at least one object keeps >= min_object_covered of its area
+    inside it (the sample_distorted_bounding_box contract). On accept,
+    objects covered below min_eject_coverage are ejected (class -1) and
+    the rest are clipped + re-normalized to the crop. If no candidate
+    ever satisfies the constraint the input passes through unchanged."""
 
-    def __init__(self, min_object_covered=0.3, aspect_ratio_range=(0.75,
-                 1.33), area_range=(0.3, 1.0), max_attempts=25):
+    def __init__(self, min_object_covered=0.3,
+                 aspect_ratio_range=(0.75, 1.33), area_range=(0.3, 1.0),
+                 min_eject_coverage=0.3, max_attempts=25):
         self.min_object_covered = min_object_covered
         self.aspect_ratio_range = aspect_ratio_range
         self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
         self.max_attempts = max_attempts
 
-    def _try_crop(self, h, w):
-        area = h * w
-        for _ in range(self.max_attempts):
-            target_area = _pyrandom.uniform(*self.area_range) * area
-            ratio = _pyrandom.uniform(*self.aspect_ratio_range)
-            cw = int(round(np.sqrt(target_area * ratio)))
-            ch = int(round(np.sqrt(target_area / ratio)))
-            if cw <= w and ch <= h:
-                x0 = _pyrandom.randint(0, w - cw)
-                y0 = _pyrandom.randint(0, h - ch)
-                return x0, y0, cw, ch
-        return None
+    def _sample_geometry(self, h, w):
+        target_area = _pyrandom.uniform(*self.area_range) * h * w
+        ratio = _pyrandom.uniform(*self.aspect_ratio_range)
+        cw = int(round(np.sqrt(target_area * ratio)))
+        ch = int(round(np.sqrt(target_area / ratio)))
+        if cw > w or ch > h:
+            return None
+        x0 = _pyrandom.randint(0, w - cw)
+        y0 = _pyrandom.randint(0, h - ch)
+        return x0, y0, cw, ch
+
+    @staticmethod
+    def _coverage(boxes, nx0, ny0, nx1, ny1):
+        ix0 = np.maximum(boxes[:, 0], nx0)
+        iy0 = np.maximum(boxes[:, 1], ny0)
+        ix1 = np.minimum(boxes[:, 2], nx1)
+        iy1 = np.minimum(boxes[:, 3], ny1)
+        inter = np.maximum(ix1 - ix0, 0) * np.maximum(iy1 - iy0, 0)
+        area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        return np.where(area > 0, inter / np.maximum(area, 1e-12), 0)
 
     def __call__(self, src, label):
         img = _to_np(src)
         h, w = img.shape[:2]
-        crop = self._try_crop(h, w)
-        if crop is None:
-            return img, label
-        x0, y0, cw, ch = crop
-        # crop window in normalized coords
-        nx0, ny0 = x0 / w, y0 / h
-        nx1, ny1 = (x0 + cw) / w, (y0 + ch) / h
-        out = label.copy()
-        valid = out[:, 0] >= 0
-        boxes = out[valid, 1:5]
-        if len(boxes):
-            ix0 = np.maximum(boxes[:, 0], nx0)
-            iy0 = np.maximum(boxes[:, 1], ny0)
-            ix1 = np.minimum(boxes[:, 2], nx1)
-            iy1 = np.minimum(boxes[:, 3], ny1)
-            inter = np.maximum(ix1 - ix0, 0) * np.maximum(iy1 - iy0, 0)
-            area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
-            cover = np.where(area > 0, inter / np.maximum(area, 1e-12), 0)
-            keep = cover >= self.min_object_covered
+        valid = label[:, 0] >= 0
+        boxes = label[valid, 1:5]
+        for _ in range(self.max_attempts):
+            geom = self._sample_geometry(h, w)
+            if geom is None:
+                continue  # geometry didn't fit — counts as an attempt
+            x0, y0, cw, ch = geom
+            nx0, ny0 = x0 / w, y0 / h
+            nx1, ny1 = (x0 + cw) / w, (y0 + ch) / h
+            if not len(boxes):
+                return img[y0:y0 + ch, x0:x0 + cw], label
+            cover = self._coverage(boxes, nx0, ny0, nx1, ny1)
+            if cover.max() < self.min_object_covered:
+                continue  # constraint failed — try another candidate
+            keep = cover >= self.min_eject_coverage
             if not keep.any():
-                return img, label  # crop would drop everything — skip
-            # clip + renormalize survivors; drop the rest
+                continue
+            out = label.copy()
             nb = np.stack([
                 (np.clip(boxes[:, 0], nx0, nx1) - nx0) / (nx1 - nx0),
                 (np.clip(boxes[:, 1], ny0, ny1) - ny0) / (ny1 - ny0),
@@ -135,8 +146,9 @@ class DetRandomCropAug(DetAugmenter):
             ], axis=1)
             rows = np.where(valid)[0]
             out[rows, 1:5] = nb
-            out[rows[~keep], 0] = -1  # invalidate dropped objects
-        return img[y0:y0 + ch, x0:x0 + cw], out
+            out[rows[~keep], 0] = -1  # ejected objects
+            return img[y0:y0 + ch, x0:x0 + cw], out
+        return img, label
 
 
 class DetRandomPadAug(DetAugmenter):
@@ -174,45 +186,104 @@ class DetRandomPadAug(DetAugmenter):
         return img, label
 
 
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0.0):
+    """Sampler bank: one DetRandomCropAug per parameter set, one picked
+    at random per image (ref: detection.py — CreateMultiRandCropAugmenter;
+    SSD's canonical config passes lists like min_object_covered=
+    [0.1, 0.3, 0.5, 0.7, 0.9]). Scalar arguments broadcast."""
+
+    covered = list(min_object_covered) if isinstance(
+        min_object_covered, (list, tuple)) else [min_object_covered]
+    n = len(covered)
+
+    def broad(x, pairwise=False):
+        # pairwise args are (lo, hi) pairs; a bare pair means "same for
+        # every sampler", a sequence of pairs configures each one
+        is_multi = isinstance(x, (list, tuple)) and not (
+            pairwise and x and np.isscalar(x[0]))
+        vals = list(x) if is_multi else [x] * n
+        if len(vals) != n:
+            raise MXNetError(
+                "CreateMultiRandCropAugmenter arguments must share one "
+                "length, got %d vs %d" % (len(vals), n))
+        return vals
+
+    aspects = broad(aspect_ratio_range, pairwise=True)
+    areas = broad(area_range, pairwise=True)
+    ejects = broad(min_eject_coverage)
+    attempts = broad(max_attempts)
+    crops = [DetRandomCropAug(c, asp, ar, ej, att)
+             for c, asp, ar, ej, att in zip(covered, aspects, areas,
+                                            ejects, attempts)]
+    return DetRandomSelectAug(crops, skip_prob=skip_prob)
+
+
 def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
-                       rand_mirror=False, mean=None, std=None,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
                        brightness=0, contrast=0, saturation=0,
-                       min_object_covered=0.3,
+                       pca_noise=0, hue=0, inter_method=2,
+                       min_object_covered=0.1,
                        aspect_ratio_range=(0.75, 1.33),
-                       area_range=(0.3, 3.0), max_attempts=25,
-                       pad_val=(127, 127, 127)):
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
     """Standard detection augmenter chain (ref: detection.py —
     CreateDetAugmenter). rand_crop/rand_pad are application
-    probabilities."""
+    probabilities; list-valued crop constraints build a multi-sampler
+    bank (the SSD recipe)."""
+    def _pairs(x):
+        """Normalize a (lo, hi) pair or a sequence of pairs to a list of
+        pairs (crop constraints accept both forms — the SSD recipe)."""
+        if isinstance(x, (list, tuple)) and x and \
+                isinstance(x[0], (list, tuple)):
+            return [tuple(p) for p in x]
+        return [tuple(x)]
+
     auglist = []
     if resize > 0:
         from .image import ResizeAug
 
-        auglist.append(DetBorrowAug(ResizeAug(resize)))
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
     if rand_crop > 0:
-        crop = DetRandomCropAug(min_object_covered,
-                                aspect_ratio_range,
-                                (area_range[0], min(1.0, area_range[1])),
-                                max_attempts)
-        auglist.append(DetRandomSelectAug([crop], 1.0 - rand_crop))
+        # crops never upscale: clamp every sampler's area hi to 1.0
+        crop_area = [(lo, min(1.0, hi)) for lo, hi in _pairs(area_range)]
+        if len(crop_area) == 1:
+            crop_area = crop_area[0]  # bare pair broadcasts per sampler
+        auglist.append(CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range, crop_area,
+            min_eject_coverage, max_attempts,
+            skip_prob=1.0 - rand_crop))
     if rand_pad > 0:
-        padder = DetRandomPadAug(aspect_ratio_range,
-                                 (1.0, max(1.0, area_range[1])),
-                                 max_attempts, pad_val[0])
+        # the padder is a single sampler: envelope any per-sampler lists
+        aspect_env = (min(lo for lo, _ in _pairs(aspect_ratio_range)),
+                      max(hi for _, hi in _pairs(aspect_ratio_range)))
+        area_hi = max(hi for _, hi in _pairs(area_range))
+        attempts = max(max_attempts) if isinstance(
+            max_attempts, (list, tuple)) else max_attempts
+        padder = DetRandomPadAug(aspect_env, (1.0, max(1.0, area_hi)),
+                                 attempts, pad_val[0])
         auglist.append(DetRandomSelectAug([padder], 1.0 - rand_pad))
     if rand_mirror:
         auglist.append(DetHorizontalFlipAug(0.5))
     # color/cast augs built directly — CreateAugmenter always appends a
     # CenterCrop to its data_shape, which would destroy the image here
-    from .image import (BrightnessJitterAug, CastAug, ColorNormalizeAug,
-                        ContrastJitterAug, SaturationJitterAug)
+    from .image import (CastAug, ColorJitterAug, ColorNormalizeAug,
+                        HueJitterAug, LightingAug, RandomGrayAug,
+                        _PCA_EIGVAL, _PCA_EIGVEC)
 
-    if brightness:
-        auglist.append(DetBorrowAug(BrightnessJitterAug(brightness)))
-    if contrast:
-        auglist.append(DetBorrowAug(ContrastJitterAug(contrast)))
-    if saturation:
-        auglist.append(DetBorrowAug(SaturationJitterAug(saturation)))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        auglist.append(DetBorrowAug(
+            LightingAug(pca_noise, _PCA_EIGVAL, _PCA_EIGVEC)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
     auglist.append(DetBorrowAug(CastAug()))
     if mean is not None or std is not None:
         mean = np.asarray(mean if mean is not None else (0, 0, 0),

@@ -242,10 +242,102 @@ class SaturationJitterAug(Augmenter):
         return NDArray(arr * alpha + gray * (1 - alpha))
 
 
+class HueJitterAug(Augmenter):
+    """Rotate the color cube around the gray axis by a random angle —
+    the YIQ-space hue approximation (ref: image.py — HueJitterAug)."""
+
+    _t_yiq = np.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], np.float32)
+    _t_rgb = np.linalg.inv(_t_yiq).astype(np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        arr = _to_np(src).astype(np.float32)
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u, v = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        rot = np.array([[1, 0, 0], [0, u, -v], [0, v, u]], np.float32)
+        m = self._t_rgb @ rot @ self._t_yiq
+        return NDArray(arr @ m.T)
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA color noise (ref: image.py — LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        arr = _to_np(src).astype(np.float32)
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return NDArray(arr + rgb.astype(np.float32))
+
+
+class RandomGrayAug(Augmenter):
+    """Replace the image with its luma with probability p
+    (ref: image.py — RandomGrayAug)."""
+
+    _coef = np.array([[0.299], [0.587], [0.114]], np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            arr = _to_np(src).astype(np.float32)
+            gray = arr @ self._coef  # (H, W, 1)
+            return NDArray(np.broadcast_to(gray, arr.shape).copy())
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    """Apply child augmenters in a fresh random order each call
+    (ref: image.py — RandomOrderAug)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        order = list(self.ts)
+        _pyrandom.shuffle(order)
+        for t in order:
+            src = t(src)
+        return src
+
+
+def ColorJitterAug(brightness, contrast, saturation):
+    """Brightness/contrast/saturation jitter in random order
+    (ref: image.py — ColorJitterAug)."""
+    ts = []
+    if brightness > 0:
+        ts.append(BrightnessJitterAug(brightness))
+    if contrast > 0:
+        ts.append(ContrastJitterAug(contrast))
+    if saturation > 0:
+        ts.append(SaturationJitterAug(saturation))
+    return RandomOrderAug(ts)
+
+
+# ImageNet PCA eigen-decomposition used by the reference's train scripts
+_PCA_EIGVAL = np.array([55.46, 4.794, 1.148], np.float32)
+_PCA_EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
-                    contrast=0, saturation=0, pca_noise=0, rand_gray=0,
-                    inter_method=2):
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
     """Standard augmenter list builder (ref: image.CreateAugmenter)."""
     auglist = []
     if resize > 0:
@@ -258,12 +350,14 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
-    if brightness:
-        auglist.append(BrightnessJitterAug(brightness))
-    if contrast:
-        auglist.append(ContrastJitterAug(contrast))
-    if saturation:
-        auglist.append(SaturationJitterAug(saturation))
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(pca_noise, _PCA_EIGVAL, _PCA_EIGVEC))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53])
     if std is True:

@@ -138,3 +138,103 @@ def test_image_det_iter_shuffle_kwarg(tmp_path):
     for b in it:
         ids.extend(b.label[0].asnumpy()[:, 0, 0].tolist())
     assert sorted(int(v) for v in ids) == list(range(6))
+
+
+def test_det_random_crop_retries_until_covered():
+    # tiny corner object + strict coverage: single-shot sampling almost
+    # always fails, the attempt loop must retry geometry until a crop
+    # containing the object is found (ref: DetRandomCropAug max_attempts)
+    _pyrandom.seed(0)
+    img = np.arange(48 * 48 * 3, dtype=np.uint8).reshape(48, 48, 3)
+    lbl = _label([[1, 0.05, 0.05, 0.15, 0.15]])
+    aug = mximg.DetRandomCropAug(min_object_covered=0.99,
+                                 area_range=(0.1, 0.3),
+                                 min_eject_coverage=0.5,
+                                 max_attempts=100)
+    cropped = 0
+    for _ in range(20):
+        out, l2 = aug(img, lbl)
+        if out.shape != img.shape:
+            cropped += 1
+            assert l2[0, 0] == 1  # object survived fully covered
+    assert cropped >= 10  # retries make acceptance the common case
+
+
+def test_det_random_crop_ejects_low_coverage():
+    _pyrandom.seed(1)
+    img = np.zeros((40, 40, 3), np.uint8)
+    lbl = _label([[1, 0.4, 0.4, 0.6, 0.6],
+                  [2, 0.0, 0.0, 0.08, 0.08]])
+    aug = mximg.DetRandomCropAug(min_object_covered=0.9,
+                                 area_range=(0.2, 0.4),
+                                 min_eject_coverage=0.9,
+                                 max_attempts=200)
+    saw_eject = False
+    for _ in range(30):
+        _, l2 = aug(img, lbl)
+        kept = l2[l2[:, 0] >= 0]
+        if len(kept) and len(kept) < 2:
+            saw_eject = True
+            assert kept[0, 0] == 1  # the centered box is the survivor
+    assert saw_eject
+
+
+def test_multi_rand_crop_augmenter_bank():
+    bank = mximg.CreateMultiRandCropAugmenter(
+        min_object_covered=[0.1, 0.5, 0.9],
+        aspect_ratio_range=(0.75, 1.33),
+        area_range=(0.3, 1.0))
+    assert len(bank.aug_list) == 3
+    assert [a.min_object_covered for a in bank.aug_list] == [0.1, 0.5, 0.9]
+    _pyrandom.seed(2)
+    img = np.zeros((32, 32, 3), np.uint8)
+    lbl = _label([[0, 0.2, 0.2, 0.8, 0.8]])
+    out, l2 = bank(img, lbl)
+    assert out.shape[2] == 3 and l2.shape == lbl.shape
+
+    with pytest.raises(mx.MXNetError):
+        mximg.CreateMultiRandCropAugmenter(
+            min_object_covered=[0.1, 0.5],
+            min_eject_coverage=[0.1, 0.2, 0.3])
+
+
+def test_create_det_augmenter_color_zoo():
+    augs = mximg.CreateDetAugmenter((3, 32, 32), rand_crop=0.5,
+                                    rand_pad=0.5, rand_mirror=True,
+                                    brightness=0.1, contrast=0.1,
+                                    saturation=0.1, hue=0.1,
+                                    pca_noise=0.05, rand_gray=0.2,
+                                    min_object_covered=[0.1, 0.7],
+                                    mean=(0, 0, 0), std=(1, 1, 1))
+    _pyrandom.seed(4)
+    img = np.random.RandomState(0).randint(
+        0, 255, (40, 40, 3)).astype(np.uint8)
+    lbl = _label([[1, 0.25, 0.25, 0.75, 0.75]])
+    for _ in range(5):
+        out, l2 = img, lbl
+        for a in augs:
+            out, l2 = a(out, l2)
+        arr = np.asarray(out.asnumpy() if hasattr(out, "asnumpy") else out)
+        assert np.isfinite(arr).all()
+        assert l2.shape == lbl.shape
+
+
+def test_hue_gray_lighting_augs():
+    from mxnet_tpu.image.image import (HueJitterAug, LightingAug,
+                                       RandomGrayAug, _PCA_EIGVAL,
+                                       _PCA_EIGVEC)
+
+    rng = np.random.RandomState(0)
+    img = rng.uniform(0, 255, (8, 8, 3)).astype(np.float32)
+    _pyrandom.seed(0)
+    # hue=0 is identity (rotation by 0)
+    out = HueJitterAug(0.0)(img).asnumpy()
+    np.testing.assert_allclose(out, img, atol=1e-3)
+    # gray with p=1 has equal channels preserving luma
+    g = RandomGrayAug(1.0)(img).asnumpy()
+    np.testing.assert_allclose(g[..., 0], g[..., 1], atol=1e-4)
+    luma = img @ np.array([0.299, 0.587, 0.114], np.float32)
+    np.testing.assert_allclose(g[..., 0], luma, atol=1e-3)
+    # lighting with alphastd=0 is identity
+    out = LightingAug(0.0, _PCA_EIGVAL, _PCA_EIGVEC)(img).asnumpy()
+    np.testing.assert_allclose(out, img, atol=1e-4)
