@@ -79,13 +79,14 @@ class DetHorizontalFlipAug(DetAugmenter):
 
 class DetRandomCropAug(DetAugmenter):
     """Random crop with a minimum-object-coverage constraint
-    (ref: detection.py — DetRandomCropAug / _update_labels): up to
-    max_attempts candidate crops are sampled; a candidate is accepted
-    when at least one object keeps >= min_object_covered of its area
-    inside it (the sample_distorted_bounding_box contract). On accept,
-    objects covered below min_eject_coverage are ejected (class -1) and
-    the rest are clipped + re-normalized to the crop. If no candidate
-    ever satisfies the constraint the input passes through unchanged."""
+    (ref: detection.py — DetRandomCropAug: _check_satisfy_constraints /
+    _update_labels): up to max_attempts candidate crops are sampled; a
+    candidate is accepted when it overlaps at least one object AND every
+    object it overlaps keeps > min_object_covered of its area inside it
+    (min over positive coverages). On accept, objects covered below
+    min_eject_coverage are ejected (class -1) and the rest are clipped +
+    re-normalized to the crop. If no candidate ever satisfies the
+    constraint the input passes through unchanged."""
 
     def __init__(self, min_object_covered=0.3,
                  aspect_ratio_range=(0.75, 1.33), area_range=(0.3, 1.0),
@@ -132,7 +133,9 @@ class DetRandomCropAug(DetAugmenter):
             if not len(boxes):
                 return img[y0:y0 + ch, x0:x0 + cw], label
             cover = self._coverage(boxes, nx0, ny0, nx1, ny1)
-            if cover.max() < self.min_object_covered:
+            overlapping = cover > 0
+            if not overlapping.any() or \
+                    cover[overlapping].min() <= self.min_object_covered:
                 continue  # constraint failed — try another candidate
             keep = cover >= self.min_eject_coverage
             if not keep.any():
@@ -149,6 +152,15 @@ class DetRandomCropAug(DetAugmenter):
             out[rows[~keep], 0] = -1  # ejected objects
             return img[y0:y0 + ch, x0:x0 + cw], out
         return img, label
+
+
+def _pair_list(x):
+    """Normalize a (lo, hi) pair or a sequence of pairs to a list of
+    pairs — the crop/pad constraint arguments accept both forms (the
+    SSD recipe passes per-sampler lists)."""
+    if isinstance(x, (list, tuple)) and len(x) and np.ndim(x[0]) > 0:
+        return [tuple(p) for p in x]
+    return [tuple(x)]
 
 
 class DetRandomPadAug(DetAugmenter):
@@ -203,9 +215,12 @@ def CreateMultiRandCropAugmenter(min_object_covered=0.1,
     def broad(x, pairwise=False):
         # pairwise args are (lo, hi) pairs; a bare pair means "same for
         # every sampler", a sequence of pairs configures each one
-        is_multi = isinstance(x, (list, tuple)) and not (
-            pairwise and x and np.isscalar(x[0]))
-        vals = list(x) if is_multi else [x] * n
+        if pairwise:
+            vals = _pair_list(x)
+            if len(vals) == 1:
+                vals = vals * n
+        else:
+            vals = list(x) if isinstance(x, (list, tuple)) else [x] * n
         if len(vals) != n:
             raise MXNetError(
                 "CreateMultiRandCropAugmenter arguments must share one "
@@ -234,14 +249,6 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
     CreateDetAugmenter). rand_crop/rand_pad are application
     probabilities; list-valued crop constraints build a multi-sampler
     bank (the SSD recipe)."""
-    def _pairs(x):
-        """Normalize a (lo, hi) pair or a sequence of pairs to a list of
-        pairs (crop constraints accept both forms — the SSD recipe)."""
-        if isinstance(x, (list, tuple)) and x and \
-                isinstance(x[0], (list, tuple)):
-            return [tuple(p) for p in x]
-        return [tuple(x)]
-
     auglist = []
     if resize > 0:
         from .image import ResizeAug
@@ -249,7 +256,7 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
         auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
     if rand_crop > 0:
         # crops never upscale: clamp every sampler's area hi to 1.0
-        crop_area = [(lo, min(1.0, hi)) for lo, hi in _pairs(area_range)]
+        crop_area = [(lo, min(1.0, hi)) for lo, hi in _pair_list(area_range)]
         if len(crop_area) == 1:
             crop_area = crop_area[0]  # bare pair broadcasts per sampler
         auglist.append(CreateMultiRandCropAugmenter(
@@ -258,9 +265,9 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
             skip_prob=1.0 - rand_crop))
     if rand_pad > 0:
         # the padder is a single sampler: envelope any per-sampler lists
-        aspect_env = (min(lo for lo, _ in _pairs(aspect_ratio_range)),
-                      max(hi for _, hi in _pairs(aspect_ratio_range)))
-        area_hi = max(hi for _, hi in _pairs(area_range))
+        aspect_env = (min(lo for lo, _ in _pair_list(aspect_ratio_range)),
+                      max(hi for _, hi in _pair_list(aspect_ratio_range)))
+        area_hi = max(hi for _, hi in _pair_list(area_range))
         attempts = max(max_attempts) if isinstance(
             max_attempts, (list, tuple)) else max_attempts
         padder = DetRandomPadAug(aspect_env, (1.0, max(1.0, area_hi)),

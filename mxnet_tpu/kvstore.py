@@ -298,6 +298,9 @@ _KV_TYPES = ("local", "device", "nccl", "dist", "dist_sync", "dist_async",
              "dist_device_sync", "dist_sync_device", "horovod")
 
 
+_warned_async = False
+
+
 def create(name="local"):
     """Factory (ref: kvstore.py — create / KVStore::Create)."""
     if not isinstance(name, str) or name not in _KV_TYPES:
@@ -305,4 +308,21 @@ def create(name="local"):
     if name == "horovod":
         # horovod's allreduce role is played by the same XLA collectives
         name = "device"
+    if name == "dist_async":
+        # the reference's async mode is lock-free hogwild on the server
+        # (ref: kvstore_dist_server.h — DataHandleEx async branch); XLA
+        # collectives have no pod-native analog, so pushes here are
+        # collectively reduced = synchronous semantics. Loud once, so a
+        # ported async training script knows its staleness model changed.
+        global _warned_async
+        if not _warned_async:
+            import warnings
+
+            warnings.warn(
+                "kvstore 'dist_async' runs with SYNCHRONOUS semantics on "
+                "this backend: pushes are collective psum reductions, not "
+                "hogwild server-side updates. Convergence behavior matches "
+                "dist_sync, not the reference's async mode.",
+                UserWarning, stacklevel=2)
+            _warned_async = True
     return KVStore(name)

@@ -522,7 +522,13 @@ def upsampling(data, scale=1, sample_type="nearest", num_args=1):
 
 @register("BilinearSampler")
 def bilinear_sampler(data, grid):
-    """ref: src/operator/bilinear_sampler.cc — grid in [-1, 1] NCHW."""
+    """ref: src/operator/bilinear_sampler.cc — grid in [-1, 1] NCHW.
+
+    Out-of-image corner samples contribute ZERO (the reference's
+    ``between()`` guard — zero padding, not border replication), which
+    also makes the autodiff gradients match the reference's backward:
+    d(data) scatters only into in-bounds corners and d(grid) sees no
+    pull from outside the image."""
     n, c, h, w = data.shape
     gx = (grid[:, 0] + 1) * (w - 1) / 2
     gy = (grid[:, 1] + 1) * (h - 1) / 2
@@ -532,12 +538,14 @@ def bilinear_sampler(data, grid):
     wy = gy - y0
 
     def gather(yi, xi):
-        yi = jnp.clip(yi.astype(jnp.int32), 0, h - 1)
-        xi = jnp.clip(xi.astype(jnp.int32), 0, w - 1)
+        valid = (yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1)
+        yc = jnp.clip(yi.astype(jnp.int32), 0, h - 1)
+        xc = jnp.clip(xi.astype(jnp.int32), 0, w - 1)
         flat = data.reshape(n, c, h * w)
-        idx = (yi * w + xi).reshape(n, -1)
+        idx = (yc * w + xc).reshape(n, -1)
         out = jnp.take_along_axis(flat, idx[:, None, :], axis=2)
-        return out.reshape(n, c, *gx.shape[1:])
+        out = out.reshape(n, c, *gx.shape[1:])
+        return out * valid[:, None].astype(out.dtype)
 
     v00 = gather(y0, x0)
     v01 = gather(y0, x0 + 1)

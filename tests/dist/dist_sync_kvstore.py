@@ -68,6 +68,39 @@ def main():
     kv2.pull("c", out=out)
     np.testing.assert_allclose(out.asnumpy(), 0.5 * nw)
 
+    # 4) row_sparse over the wire (models the reference nightly's sparse
+    # section, ref: kvstore_dist — PullRowSparseImpl): each worker pushes
+    # different rows; the reduced store must hold the union, and
+    # row_sparse_pull must return any requested row subset of it.
+    from mxnet_tpu import sparse
+    shape = (nw + 2, 3)
+    kv3 = mx.kv.create("dist_sync")
+    kv3.init("rs", nd.zeros(shape))
+    rows = np.array([rank, rank + 2], np.int64)  # overlaps neighbors
+    vals = np.full((2, 3), rank + 1.0, "f4")
+    kv3.push("rs", sparse.row_sparse_array((vals, rows), shape=shape))
+    expect = np.zeros(shape, "f4")
+    for r in range(nw):
+        expect[[r, r + 2]] += r + 1.0
+    dense_out = nd.zeros(shape)
+    kv3.pull("rs", out=dense_out)
+    np.testing.assert_allclose(dense_out.asnumpy(), expect, rtol=1e-6)
+    # union of every worker's touched rows
+    union = np.unique(np.concatenate(
+        [np.array([r, r + 2]) for r in range(nw)]))
+    rs_out = sparse.zeros("row_sparse", shape)
+    kv3.row_sparse_pull("rs", out=rs_out, row_ids=nd.array(
+        union.astype("f4")))
+    np.testing.assert_array_equal(rs_out.indices.asnumpy(), union)
+    np.testing.assert_allclose(rs_out.data.asnumpy(), expect[union],
+                               rtol=1e-6)
+    # a single worker's own-row view pulls just those rows
+    rs_own = sparse.zeros("row_sparse", shape)
+    kv3.row_sparse_pull("rs", out=rs_own, row_ids=nd.array(
+        rows.astype("f4")))
+    np.testing.assert_allclose(rs_own.data.asnumpy(), expect[rows],
+                               rtol=1e-6)
+
     print("DIST_PASS rank=%d/%d" % (rank, nw), flush=True)
 
 

@@ -178,3 +178,38 @@ def test_correlation_self_zero_displacement():
     assert out.shape == (2, 9, 6, 6)
     np.testing.assert_allclose(out.asnumpy()[:, 4],
                                (x.asnumpy() ** 2).mean(axis=1), rtol=1e-5)
+
+
+def test_bilinear_sampler_zero_pads_outside():
+    # grid points fully outside the image must sample ZERO (the
+    # reference's between() guard), not replicate the border
+    x = nd.array(np.full((1, 1, 4, 4), 5.0, "f4"))
+    grid = np.zeros((1, 2, 1, 2), "f4")
+    grid[0, 0, 0, 0] = -3.0  # x far left of the image
+    grid[0, 1, 0, 0] = 0.0
+    grid[0, 0, 0, 1] = 0.0   # center: in-bounds
+    grid[0, 1, 0, 1] = 0.0
+    out = nd.BilinearSampler(x, nd.array(grid)).asnumpy()
+    assert abs(out[0, 0, 0, 0]) < 1e-6
+    np.testing.assert_allclose(out[0, 0, 0, 1], 5.0, atol=1e-5)
+
+
+def test_bilinear_sampler_partial_corner_zero():
+    # a sample half a pixel past the right edge keeps only its in-bounds
+    # corner pair weighted by the bilinear weights: value * (1 - wx)
+    x = nd.array(np.full((1, 1, 4, 4), 2.0, "f4"))
+    grid = np.zeros((1, 2, 1, 1), "f4")
+    # gx = (g+1)*(w-1)/2 = 3.5 at g = 4/3 -> corners x0=3 (in),
+    # x1=4 (out), wx=0.5 -> only the in-bounds pair contributes
+    grid[0, 0, 0, 0] = 4.0 / 3.0
+    out = nd.BilinearSampler(x, nd.array(grid)).asnumpy()
+    np.testing.assert_allclose(out[0, 0, 0, 0], 1.0, atol=1e-5)
+
+
+def test_grid_generator_warp_gradient():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    flow = np.random.RandomState(3).uniform(
+        -0.3, 0.3, (1, 2, 3, 4)).astype("f8")
+    check_numeric_gradient(
+        lambda f: nd.GridGenerator(f, transform_type="warp").sum(), [flow])

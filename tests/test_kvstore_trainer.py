@@ -279,3 +279,21 @@ def test_kvstore_server_role_explains_design(monkeypatch):
     monkeypatch.setenv("DMLC_ROLE", "server")
     with pytest.raises(MXNetError, match="workers only"):
         kvstore_server._init_kvstore_server_module()
+
+
+def test_dist_async_warns_sync_semantics():
+    import warnings
+
+    import mxnet_tpu.kvstore as kvs
+
+    kvs._warned_async = False
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        kv = mx.kv.create("dist_async")
+        assert kv.type == "dist_async"
+    assert any("SYNCHRONOUS semantics" in str(x.message) for x in w), \
+        [str(x.message) for x in w]
+    with warnings.catch_warnings(record=True) as w2:  # once per process
+        warnings.simplefilter("always")
+        mx.kv.create("dist_async")
+    assert not w2

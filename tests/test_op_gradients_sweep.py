@@ -314,6 +314,10 @@ SPEC.update({
         [_any(1, 2, 5, 5), _unit(1, 18, 3, 3) * 0.1 + 0.25,
          _any(2, 2, 3, 3), _any(2)],
         dict(kernel=(3, 3)), None),
+    # grid stays in [-0.44, 0.44] -> samples land strictly inside the
+    # 6x6 map and off the integer grid lines (kink-free for numeric grad)
+    "BilinearSampler": ([_pos(1, 2, 6, 6), _unit(1, 2, 3, 3) * 0.55],
+                        {}, None),
     # contrib family
     "fft": ([_any(3, 8)], {}, None),
     "ifft": ([_any(3, 16)], {}, None),
@@ -347,8 +351,6 @@ EXEMPT = {
     "norm_like_cast": "dtype cast; gradient is the identity cast",
     "ones_like": "constant output, zero gradient by definition",
     "zeros_like": "constant output, zero gradient by definition",
-    "BilinearSampler": "grid-sample corner cases; covered by "
-                       "contrib-level tests when ported",
 }
 
 
