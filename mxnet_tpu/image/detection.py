@@ -123,6 +123,11 @@ class DetRandomCropAug(DetAugmenter):
         h, w = img.shape[:2]
         valid = label[:, 0] >= 0
         boxes = label[valid, 1:5]
+        if not len(boxes):
+            # no valid objects -> the coverage constraint can never hold
+            # (reference _check_satisfy_constraints returns False on an
+            # empty coverage set), so background-only samples pass through
+            return img, label
         for _ in range(self.max_attempts):
             geom = self._sample_geometry(h, w)
             if geom is None:
@@ -130,8 +135,6 @@ class DetRandomCropAug(DetAugmenter):
             x0, y0, cw, ch = geom
             nx0, ny0 = x0 / w, y0 / h
             nx1, ny1 = (x0 + cw) / w, (y0 + ch) / h
-            if not len(boxes):
-                return img[y0:y0 + ch, x0:x0 + cw], label
             cover = self._coverage(boxes, nx0, ny0, nx1, ny1)
             overlapping = cover > 0
             if not overlapping.any() or \
@@ -256,9 +259,8 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
         auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
     if rand_crop > 0:
         # crops never upscale: clamp every sampler's area hi to 1.0
+        # (broad() broadcasts a length-1 pair list across samplers)
         crop_area = [(lo, min(1.0, hi)) for lo, hi in _pair_list(area_range)]
-        if len(crop_area) == 1:
-            crop_area = crop_area[0]  # bare pair broadcasts per sampler
         auglist.append(CreateMultiRandCropAugmenter(
             min_object_covered, aspect_ratio_range, crop_area,
             min_eject_coverage, max_attempts,

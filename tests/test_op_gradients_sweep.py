@@ -314,9 +314,9 @@ SPEC.update({
         [_any(1, 2, 5, 5), _unit(1, 18, 3, 3) * 0.1 + 0.25,
          _any(2, 2, 3, 3), _any(2)],
         dict(kernel=(3, 3)), None),
-    # grid stays in [-0.44, 0.44] -> samples land strictly inside the
-    # 6x6 map and off the integer grid lines (kink-free for numeric grad)
-    "BilinearSampler": ([_pos(1, 2, 6, 6), _unit(1, 2, 3, 3) * 0.55],
+    # grid stays in [-0.12, 0.12] -> gx,gy in [2.2, 2.8]: strictly inside
+    # the 6x6 map AND between integer grid lines (bilinear kink-free)
+    "BilinearSampler": ([_pos(1, 2, 6, 6), _unit(1, 2, 3, 3) * 0.15],
                         {}, None),
     # contrib family
     "fft": ([_any(3, 8)], {}, None),

@@ -1,0 +1,40 @@
+#!/bin/bash
+# Round-4 recovery runbook: the moment the axon tunnel answers a probe,
+# capture everything the round needs from the real chip, in priority
+# order (VERDICT r3 #1): hardware lane -> artifact, full bench, LSTM
+# batch sweep, ResNet MFU lever sweep. Each stage is budget-bounded and
+# syncs eagerly so a mid-stage kill can't re-wedge the tunnel.
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/tpu_recover_r04.log}
+
+run() {  # run <name> <timeout> <cmd...>
+  local name=$1 t=$2; shift 2
+  echo "[$(date -u +%H:%M:%S)] start $name" >> "$LOG"
+  timeout "$t" "$@" >> "$LOG" 2>&1
+  echo "[$(date -u +%H:%M:%S)] $name rc=$?" >> "$LOG"
+}
+
+# 1) hardware lane, persisted as a committed artifact
+MXT_TEST_TPU=1 timeout 2400 python -m pytest -m tpu -q -s \
+    2>&1 | tee TPU_LANE_r04.txt >> "$LOG"
+echo "[$(date -u +%H:%M:%S)] tpu lane done rc=${PIPESTATUS[0]}" >> "$LOG"
+
+# 2) official bench sweep (headline + every config, budget-gated)
+run bench 1800 env BENCH_BUDGET=1500 python bench.py
+
+# 3) LSTM PTB batch sweep (VERDICT #3: batch 128/256 rows)
+run lstm128 600 env BENCH_CONFIGS=lstm_ptb BENCH_LSTM_BATCH=128 \
+    BENCH_BUDGET=500 python bench.py
+run lstm256 600 env BENCH_CONFIGS=lstm_ptb BENCH_LSTM_BATCH=256 \
+    BENCH_BUDGET=500 python bench.py
+
+# 4) ResNet-50 MFU levers (VERDICT #2): batch 256, remat variants
+run resnet_b256 900 env BENCH_CONFIGS=resnet50 BENCH_BATCH=256 \
+    BENCH_BUDGET=800 python bench.py
+run resnet_remat 900 env BENCH_CONFIGS=resnet50 BENCH_REMAT=full \
+    BENCH_BUDGET=800 python bench.py
+run resnet_remat_dots 900 env BENCH_CONFIGS=resnet50 \
+    BENCH_REMAT=dots_saveable BENCH_BUDGET=800 python bench.py
+
+echo "RECOVERY_DONE" >> "$LOG"
