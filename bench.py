@@ -198,6 +198,7 @@ def bench_resnet50(platform, dtype):
     row = {
         "config": "resnet50_v1_train", "chips": 1, "batch_size": batch,
         "dtype": dtype, "layout": layout,
+        "remat": os.environ.get("BENCH_REMAT") or None,
         "images_or_tokens_per_sec_per_chip": round(img_s, 2),
         "mfu": _mfu(img_s, flops_per_img, platform), "platform": platform,
         "flops_per_sample": flops_per_img,
@@ -257,19 +258,44 @@ def bench_bert_mlm(platform, dtype):
     y = nd.array(rng.randint(0, vocab, (batch, seq_len)).astype(np.float32))
     net(x)  # resolve deferred shapes
 
-    step = parallel.ShardedTrainStep(
+    # BENCH_BERT_PATH=trainer drives the CANONICAL Gluon loop
+    # (hybridize + record/backward + fused donated Trainer.step) instead
+    # of ShardedTrainStep — measures what a reference-style user script
+    # gets (SURVEY §3.1), now that Trainer.step is one donated launch.
+    # The sharded step is built either way: its XLA cost analysis is the
+    # flop accounting for BOTH paths (same model, loss, optimizer).
+    path = os.environ.get("BENCH_BERT_PATH", "sharded")
+    sharded = parallel.ShardedTrainStep(
         net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
         {"learning_rate": 1e-4})
+    if path == "trainer":
+        from mxnet_tpu import autograd as ag
+
+        bert.hybridize()  # _MLMNet is a plain Block; the BERT core jits
+        loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+        trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                                   {"learning_rate": 1e-4})
+
+        def step(xb, yb):
+            with ag.record():
+                loss = loss_fn(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(1)
+            return loss
+    else:
+        step = sharded
 
     dt = _timed_steps(step, x, y, iters, warmup)
     tok_s = batch * seq_len * iters / dt
 
-    flops_per_tok = step.flops_per_step(x, y)
+    flops_per_tok = sharded.flops_per_step(x, y)
     if flops_per_tok:
         flops_per_tok /= batch * seq_len
 
     row = {
-        "config": "bert_base_mlm_train", "chips": 1, "batch_size": batch,
+        "config": "bert_base_mlm_train" if path != "trainer"
+                  else "bert_base_mlm_train_gluon", "chips": 1,
+        "batch_size": batch,
         "seq_len": seq_len, "dtype": dtype,
         "images_or_tokens_per_sec_per_chip": round(tok_s, 2),
         "mfu": _mfu(tok_s, flops_per_tok, platform), "platform": platform,

@@ -338,7 +338,30 @@ class ParameterDict:
         """Get-or-create parameter ``prefix+name``."""
         full = self._prefix + name
         if self._shared is not None and full in self._shared._params:
-            return self._shared._params[full]
+            # record the shared hit locally too (ref: parameter.py —
+            # ParameterDict.get inserts found shared params): a tied
+            # parameter must appear in the borrowing block's
+            # collect_params(), else CachedOp traces it as a baked-in
+            # constant instead of a live input (fatal once the trainer
+            # donates the underlying buffer)
+            param = self._shared._params[full]
+            shape = kwargs.get("shape")
+            if shape is not None and param.shape is not None:
+                want, have = tuple(shape), tuple(param.shape)
+                if len(want) != len(have) or any(
+                        w and h and w != h for w, h in zip(want, have)):
+                    raise MXNetError(
+                        "tied parameter %s has shape %s, incompatible "
+                        "with requested %s (ref: get() validates against "
+                        "a shared-found parameter)" % (full, have, want))
+            dtype = kwargs.get("dtype")
+            if dtype is not None and param.dtype is not None and \
+                    np.dtype(dtype) != np.dtype(param.dtype):
+                raise MXNetError(
+                    "tied parameter %s has dtype %s, incompatible with "
+                    "requested %s" % (full, param.dtype, dtype))
+            self._params[full] = param
+            return param
         if full in self._params:
             param = self._params[full]
             for k, v in kwargs.items():

@@ -165,3 +165,63 @@ def test_fused_no_per_step_retrace(monkeypatch):
     steady = fused._jit._cache_size()
     _train(net, tr, steps=3, seed=1)
     assert fused._jit._cache_size() == steady <= 2
+
+
+def test_tied_parameters_survive_donation():
+    """Weight tying (params=other.params, the BERT MLM-decoder pattern)
+    must register the tied Parameter in the borrowing block's
+    collect_params(), so CachedOp passes it as a live input rather than
+    baking it in as a constant — which dies as soon as the fused trainer
+    donates the buffer."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import Block
+
+    mx.random.seed(0)
+
+    class Tied(Block):
+        def __init__(self):
+            super().__init__(prefix="tied_")
+            with self.name_scope():
+                self.embed = nn.Embedding(20, 8)
+                self.decoder = nn.Dense(20, flatten=False, in_units=8,
+                                        params=self.embed.params)
+
+        def forward(self, x):
+            return self.decoder(self.embed(x))
+
+    net = Tied()
+    net.initialize()
+    # the tied weight must appear in the BORROWING block's params too
+    tied_name = net.embed.weight.name
+    assert net.decoder.weight is net.embed.weight  # actually tied
+    assert tied_name in net.decoder.collect_params()
+    assert len(net.collect_params()) == 2  # tied weight + decoder bias
+    net.embed.hybridize()
+    net.decoder.hybridize()
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    x = nd.array(np.arange(6).reshape(2, 3).astype("f4"))
+    y = nd.array(np.ones((2, 3), "f4"))
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(3):  # step 2+ would hit the deleted donated buffer
+        with ag.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        tr.step(2)
+    assert np.isfinite(float(loss.asnumpy()))
+
+
+def test_tied_parameter_shape_mismatch_raises():
+    from mxnet_tpu.gluon import Block
+
+    mx.random.seed(0)
+    with pytest.raises(mx.MXNetError, match="tied parameter"):
+        class Bad(Block):
+            def __init__(self):
+                super().__init__(prefix="badtied_")
+                with self.name_scope():
+                    self.embed = nn.Embedding(20, 8)
+                    # in_units=9 conflicts with the tied (20, 8) weight
+                    self.decoder = nn.Dense(20, in_units=9,
+                                            params=self.embed.params)
+
+        Bad()
