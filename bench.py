@@ -262,12 +262,17 @@ def bench_bert_mlm(platform, dtype):
     # (hybridize + record/backward + fused donated Trainer.step) instead
     # of ShardedTrainStep — measures what a reference-style user script
     # gets (SURVEY §3.1), now that Trainer.step is one donated launch.
-    # The sharded step is built either way: its XLA cost analysis is the
-    # flop accounting for BOTH paths (same model, loss, optimizer).
+    # A sharded step provides the flop accounting for BOTH paths (same
+    # model/loss/optimizer); on the trainer path it is built only AFTER
+    # the timed window so its Adam state doesn't inflate HBM use during
+    # the measurement.
     path = os.environ.get("BENCH_BERT_PATH", "sharded")
-    sharded = parallel.ShardedTrainStep(
-        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
-        {"learning_rate": 1e-4})
+
+    def make_sharded():
+        return parallel.ShardedTrainStep(
+            net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+            {"learning_rate": 1e-4})
+
     if path == "trainer":
         from mxnet_tpu import autograd as ag
 
@@ -282,13 +287,14 @@ def bench_bert_mlm(platform, dtype):
             loss.backward()
             trainer.step(1)
             return loss
+        sharded = None
     else:
-        step = sharded
+        sharded = step = make_sharded()
 
     dt = _timed_steps(step, x, y, iters, warmup)
     tok_s = batch * seq_len * iters / dt
 
-    flops_per_tok = sharded.flops_per_step(x, y)
+    flops_per_tok = (sharded or make_sharded()).flops_per_step(x, y)
     if flops_per_tok:
         flops_per_tok /= batch * seq_len
 

@@ -1,3 +1,12 @@
 """contrib namespace (ref: python/mxnet/contrib/__init__.py — the 1.x home
-of amp; exposed here as both mx.amp and mx.contrib.amp)."""
+of amp + onnx; exposed here as both mx.amp and mx.contrib.amp)."""
+import importlib
+
 from .. import amp  # noqa: F401
+
+
+def __getattr__(name):  # PEP 562: lazy — onnx pulls in protobuf
+    if name == "onnx":
+        return importlib.import_module(".onnx", __name__)
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

@@ -1,0 +1,17 @@
+"""``mx.contrib.onnx`` — ONNX export/import
+(ref: python/mxnet/contrib/onnx — mx2onnx.export_model,
+onnx2mx.import_model/import_to_gluon/get_model_metadata).
+
+Self-contained: serialization uses a wire-compatible subset of the public
+onnx.proto compiled into ``onnx_minimal_pb2`` (same field numbers/enums),
+so no external ``onnx`` package is required and the files interoperate
+with standard ONNX tooling.
+"""
+from .export_onnx import export_model  # noqa: F401
+from .import_onnx import (  # noqa: F401
+    get_model_metadata, import_model, import_to_gluon,
+)
+
+# reference-compatible aliases (mx.contrib.onnx.mx2onnx.export_model, …)
+from . import export_onnx as mx2onnx  # noqa: F401
+from . import import_onnx as onnx2mx  # noqa: F401

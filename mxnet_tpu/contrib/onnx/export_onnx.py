@@ -1,0 +1,398 @@
+"""Symbol -> ONNX exporter (ref: python/mxnet/contrib/onnx/mx2onnx —
+export_model / MXNetGraph.create_onnx_graph_proto).
+
+Walks the Symbol node DAG in topological order, mapping each registry op
+to its ONNX opset-13 equivalent. Parameters present in the params dict
+become graph initializers; remaining variables become graph inputs.
+Serialization uses the wire-compatible minimal schema in
+``onnx_minimal.proto`` (identical field numbers to the public onnx.proto),
+so the output loads in standard ONNX tooling.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from . import onnx_minimal_pb2 as P
+
+_DTYPE_TO_ONNX = {
+    np.dtype("float32"): P.TensorProto.FLOAT,
+    np.dtype("float64"): P.TensorProto.DOUBLE,
+    np.dtype("float16"): P.TensorProto.FLOAT16,
+    np.dtype("int32"): P.TensorProto.INT32,
+    np.dtype("int64"): P.TensorProto.INT64,
+    np.dtype("int8"): P.TensorProto.INT8,
+    np.dtype("uint8"): P.TensorProto.UINT8,
+    np.dtype("bool"): P.TensorProto.BOOL,
+}
+
+
+def _tuple(v, n=2):
+    if v is None or v == ():
+        return (1,) * n if n else ()
+    if isinstance(v, (int, float)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+class _GraphBuilder:
+    def __init__(self, graph):
+        self.graph = graph
+        self._const_id = 0
+        self._param_shapes = {}
+
+    def param_shape(self, name):
+        return self._param_shapes.get(name)
+
+    def node(self, op_type, inputs, outputs, name, **attrs):
+        n = self.graph.node.add()
+        n.op_type = op_type
+        n.name = name
+        n.input.extend(inputs)
+        n.output.extend(outputs)
+        for k, v in attrs.items():
+            if v is None:
+                continue
+            a = n.attribute.add()
+            a.name = k
+            if isinstance(v, float):
+                a.f = v
+                a.type = P.AttributeProto.FLOAT
+            elif isinstance(v, bool):
+                a.i = int(v)
+                a.type = P.AttributeProto.INT
+            elif isinstance(v, int):
+                a.i = v
+                a.type = P.AttributeProto.INT
+            elif isinstance(v, str):
+                a.s = v.encode()
+                a.type = P.AttributeProto.STRING
+            elif isinstance(v, (tuple, list)):
+                if v and isinstance(v[0], float):
+                    a.floats.extend(float(x) for x in v)
+                    a.type = P.AttributeProto.FLOATS
+                else:
+                    a.ints.extend(int(x) for x in v)
+                    a.type = P.AttributeProto.INTS
+            else:
+                raise MXNetError("unsupported attribute %s=%r" % (k, v))
+        return n
+
+    def initializer(self, name, array):
+        array = np.ascontiguousarray(array)
+        self._param_shapes[name] = tuple(array.shape)
+        t = self.graph.initializer.add()
+        t.name = name
+        t.dims.extend(array.shape)
+        dt = _DTYPE_TO_ONNX.get(array.dtype)
+        if dt is None:  # bf16 params export as f32 (ONNX f32 graphs)
+            array = array.astype(np.float32)
+            dt = P.TensorProto.FLOAT
+        t.data_type = dt
+        t.raw_data = array.tobytes()
+        return name
+
+    def constant(self, array, hint):
+        self._const_id += 1
+        name = "%s_const%d" % (hint, self._const_id)
+        return self.initializer(name, np.asarray(array))
+
+    def value_info(self, vi, name, shape, dtype=np.float32):
+        vi.name = name
+        tt = vi.type.tensor_type
+        tt.elem_type = _DTYPE_TO_ONNX.get(np.dtype(dtype),
+                                          P.TensorProto.FLOAT)
+        for d in shape:
+            dim = tt.shape.dim.add()
+            dim.dim_value = int(d)
+
+
+def _pads(pad, rank=2):
+    p = _tuple(pad, 0) or (0,) * rank
+    return list(p) + list(p)  # symmetric begin+end
+
+
+# --------------------------------------------------------------------------
+# per-op converters: fn(builder, node, in_names, out_names) -> None
+# --------------------------------------------------------------------------
+def _conv(b, node, ins, outs):
+    at = node.attrs
+    kernel = _tuple(at.get("kernel"))
+    b.node("Conv", ins, outs, node.name,
+           kernel_shape=kernel,
+           strides=_tuple(at.get("stride"), len(kernel)),
+           dilations=_tuple(at.get("dilate"), len(kernel)),
+           pads=_pads(at.get("pad"), len(kernel)),
+           group=int(at.get("num_group", 1)))
+
+
+def _fc(b, node, ins, outs):
+    at = node.attrs
+    data = ins[0]
+    if at.get("flatten", True):
+        flat = node.name + "_flat"
+        b.node("Flatten", [data], [flat], flat, axis=1)
+        data = flat
+    b.node("Gemm", [data] + ins[1:], outs, node.name,
+           alpha=1.0, beta=1.0, transA=0, transB=1)
+
+
+def _batchnorm(b, node, ins, outs):
+    at = node.attrs
+    ins = list(ins)
+    if at.get("fix_gamma", True):
+        # reference semantics: fix_gamma forces scale == 1 at runtime
+        # regardless of the stored gamma values — ONNX has no such flag,
+        # so export a ones tensor as the scale input (the reference
+        # exporter does the same, mx2onnx convert_batchnorm)
+        shape = b.param_shape(ins[1])
+        if shape is None:
+            raise MXNetError(
+                "BatchNorm %s has fix_gamma=True but its gamma %r is a "
+                "graph input, not a parameter — cannot export"
+                % (node.name, ins[1]))
+        ins[1] = b.constant(np.ones(shape, np.float32),
+                            node.name + "_fixed_gamma")
+    b.node("BatchNormalization", ins, outs[:1], node.name,
+           epsilon=float(at.get("eps", 1e-5)),
+           momentum=float(at.get("momentum", 0.9)))
+
+
+def _activation(b, node, ins, outs):
+    table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus", "softsign": "Softsign"}
+    act = node.attrs.get("act_type", "relu")
+    if act not in table:
+        raise MXNetError("cannot export Activation(act_type=%r)" % act)
+    b.node(table[act], ins, outs, node.name)
+
+
+def _leaky(b, node, ins, outs):
+    at = node.attrs
+    act = at.get("act_type", "leaky")
+    if act == "leaky":
+        b.node("LeakyRelu", ins, outs, node.name,
+               alpha=float(at.get("slope", 0.25)))
+    elif act == "elu":
+        b.node("Elu", ins, outs, node.name,
+               alpha=float(at.get("slope", 0.25)))
+    else:
+        raise MXNetError("cannot export LeakyReLU(act_type=%r)" % act)
+
+
+def _pooling(b, node, ins, outs):
+    at = node.attrs
+    ptype = at.get("pool_type", "max")
+    if at.get("global_pool", False):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}.get(ptype)
+        if op is None:
+            raise MXNetError("cannot export global %s pooling" % ptype)
+        b.node(op, ins, outs, node.name)
+        return
+    kernel = _tuple(at.get("kernel"))
+    kw = dict(kernel_shape=kernel,
+              strides=_tuple(at.get("stride"), len(kernel)),
+              pads=_pads(at.get("pad"), len(kernel)))
+    if at.get("pooling_convention", "valid") == "full":
+        kw["ceil_mode"] = 1
+    if ptype == "max":
+        b.node("MaxPool", ins, outs, node.name, **kw)
+    elif ptype == "avg":
+        kw["count_include_pad"] = 1 if at.get("count_include_pad",
+                                              True) else 0
+        b.node("AveragePool", ins, outs, node.name, **kw)
+    else:
+        raise MXNetError("cannot export %s pooling" % ptype)
+
+
+def _softmax(b, node, ins, outs):
+    b.node("Softmax", ins[:1], outs, node.name,
+           axis=int(node.attrs.get("axis", -1)))
+
+
+def _softmax_output(b, node, ins, outs):
+    # inference semantics: the loss head exports as plain Softmax over
+    # the data input (ref: mx2onnx softmax_output converter)
+    b.node("Softmax", ins[:1], outs, node.name, axis=-1)
+
+
+def _flatten(b, node, ins, outs):
+    b.node("Flatten", ins, outs, node.name, axis=1)
+
+
+def _reshape(b, node, ins, outs):
+    shape = node.attrs.get("shape")
+    if shape is None:
+        raise MXNetError("Reshape without a static shape can't export")
+    shape = tuple(int(s) for s in shape)
+    if any(s < -1 for s in shape):
+        # MXNet's -2/-3/-4 shape codes have no ONNX equivalent (ONNX
+        # Reshape defines only 0 = copy and -1 = infer, which match)
+        raise MXNetError(
+            "Reshape shape %s uses MXNet special codes (<-1) that ONNX "
+            "cannot express" % (shape,))
+    shp = b.constant(np.asarray(shape, np.int64), node.name)
+    b.node("Reshape", [ins[0], shp], outs, node.name)
+
+
+def _transpose(b, node, ins, outs):
+    axes = node.attrs.get("axes")
+    b.node("Transpose", ins, outs, node.name,
+           perm=_tuple(axes, 0) if axes else None)
+
+
+def _concat(b, node, ins, outs):
+    b.node("Concat", ins, outs, node.name,
+           axis=int(node.attrs.get("dim", 1)))
+
+
+def _dropout(b, node, ins, outs):
+    b.node("Dropout", ins, outs[:1], node.name)
+
+
+def _embedding(b, node, ins, outs):
+    idx = node.name + "_idx"
+    b.node("Cast", [ins[0]], [idx], idx, to=int(P.TensorProto.INT64))
+    b.node("Gather", [ins[1], idx], outs, node.name)
+
+
+def _binop(op_type):
+    def conv(b, node, ins, outs):
+        b.node(op_type, ins, outs, node.name)
+    return conv
+
+
+def _scalar_op(op_type, swap=False):
+    def conv(b, node, ins, outs):
+        scalar = float(node.attrs.get("scalar", 0.0))
+        c = b.constant(np.asarray(scalar, np.float32), node.name)
+        ins2 = [c, ins[0]] if swap else [ins[0], c]
+        b.node(op_type, ins2, outs, node.name)
+    return conv
+
+
+def _unary(op_type):
+    def conv(b, node, ins, outs):
+        b.node(op_type, ins, outs, node.name)
+    return conv
+
+
+CONVERTERS = {
+    "Convolution": _conv,
+    "FullyConnected": _fc,
+    "BatchNorm": _batchnorm,
+    "Activation": _activation,
+    "LeakyReLU": _leaky,
+    "Pooling": _pooling,
+    "softmax": _softmax,
+    "SoftmaxActivation": _softmax,
+    "SoftmaxOutput": _softmax_output,
+    "Flatten": _flatten,
+    "flatten": _flatten,
+    "Reshape": _reshape,
+    "reshape": _reshape,
+    "transpose": _transpose,
+    "Concat": _concat,
+    "concat": _concat,
+    "Dropout": _dropout,
+    "Embedding": _embedding,
+    "elemwise_add": _binop("Add"),
+    "broadcast_add": _binop("Add"),
+    "elemwise_sub": _binop("Sub"),
+    "broadcast_sub": _binop("Sub"),
+    "elemwise_mul": _binop("Mul"),
+    "broadcast_mul": _binop("Mul"),
+    "elemwise_div": _binop("Div"),
+    "broadcast_div": _binop("Div"),
+    "_plus_scalar": _scalar_op("Add"),
+    "_minus_scalar": _scalar_op("Sub"),
+    "_rminus_scalar": _scalar_op("Sub", swap=True),
+    "_mul_scalar": _scalar_op("Mul"),
+    "_div_scalar": _scalar_op("Div"),
+    "relu": _unary("Relu"),
+    "sigmoid": _unary("Sigmoid"),
+    "tanh": _unary("Tanh"),
+    "exp": _unary("Exp"),
+    "log": _unary("Log"),
+    "sqrt": _unary("Sqrt"),
+    "negative": _unary("Neg"),
+    "abs": _unary("Abs"),
+    "identity": _unary("Identity"),
+    "BlockGrad": _unary("Identity"),
+}
+
+
+def _out_names(node):
+    if node.num_outputs == 1:
+        return [node.name]
+    return ["%s_out%d" % (node.name, i) for i in range(node.num_outputs)]
+
+
+def export_model(sym, params, input_shape, input_type=np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export a Symbol + params to an ONNX file
+    (ref: mx2onnx.export_model — same signature contract).
+
+    params: dict of NDArray/ndarray keyed by parameter name (the
+    ``arg:``/``aux:`` prefixes of .params files are stripped).
+    input_shape: one shape tuple, or a list with one shape per graph
+    input (in ``list_inputs`` order of the non-parameter variables).
+    """
+    from ...ndarray.ndarray import NDArray
+
+    params = {
+        (k.split(":", 1)[1] if k.startswith(("arg:", "aux:")) else k):
+        (v.asnumpy() if isinstance(v, NDArray) else np.asarray(v))
+        for k, v in (params or {}).items()
+    }
+    if input_shape and isinstance(input_shape[0], (int, np.integer)):
+        input_shapes = [tuple(input_shape)]
+    else:
+        input_shapes = [tuple(s) for s in input_shape]
+
+    model = P.ModelProto()
+    model.ir_version = 8
+    model.producer_name = "mxnet_tpu"
+    model.producer_version = "0.4"
+    opset = model.opset_import.add()
+    opset.version = 13
+    graph = model.graph
+    graph.name = sym.name or "mxnet_tpu_graph"
+    b = _GraphBuilder(graph)
+
+    nodes = sym._topo_nodes()
+    data_inputs = []
+    for node in nodes:
+        if not node.is_var():
+            continue
+        if node.name in params:
+            b.initializer(node.name, params[node.name])
+        else:
+            data_inputs.append(node.name)
+    if len(data_inputs) != len(input_shapes):
+        raise MXNetError(
+            "model has %d data inputs %s but %d input shapes given"
+            % (len(data_inputs), data_inputs, len(input_shapes)))
+    for name, shape in zip(data_inputs, input_shapes):
+        b.value_info(graph.input.add(), name, shape, input_type)
+
+    for node in nodes:
+        if node.is_var():
+            continue
+        conv = CONVERTERS.get(node.op)
+        if conv is None:
+            raise MXNetError(
+                "op %r has no ONNX converter (supported: %s)"
+                % (node.op, sorted(CONVERTERS)))
+        ins = [_out_names(n)[i] for n, i in node.inputs]
+        conv(b, node, ins, _out_names(node))
+        if verbose:
+            print("exported %s -> %s" % (node.op, node.name))
+
+    for node, idx in sym._outputs:
+        name = _out_names(node)[idx]
+        b.value_info(graph.output.add(), name, ())
+
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.SerializeToString())
+    return onnx_file_path

@@ -1,0 +1,386 @@
+"""ONNX -> Symbol importer (ref: python/mxnet/contrib/onnx/onnx2mx —
+import_model / GraphProto.from_onnx).
+
+Covers the opset the exporter emits plus the common inference graphs:
+Conv, Gemm, BatchNormalization, pooling (incl. global), activations,
+Flatten/Reshape/Transpose/Concat, elementwise arithmetic, Gather,
+Dropout, Cast, Identity, Sum. Returns (sym, arg_params, aux_params)
+exactly like the reference API.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from . import onnx_minimal_pb2 as P
+
+_ONNX_TO_NP = {
+    P.TensorProto.FLOAT: np.float32,
+    P.TensorProto.DOUBLE: np.float64,
+    P.TensorProto.FLOAT16: np.float16,
+    P.TensorProto.INT32: np.int32,
+    P.TensorProto.INT64: np.int64,
+    P.TensorProto.INT8: np.int8,
+    P.TensorProto.UINT8: np.uint8,
+    P.TensorProto.BOOL: np.bool_,
+    P.TensorProto.BFLOAT16: np.float32,  # promoted on import
+}
+
+
+def _tensor_to_np(t):
+    dtype = _ONNX_TO_NP.get(t.data_type)
+    if dtype is None:
+        raise MXNetError("unsupported tensor data_type %d" % t.data_type)
+    shape = tuple(t.dims)
+    if t.raw_data:
+        if t.data_type == P.TensorProto.BFLOAT16:
+            raw = np.frombuffer(t.raw_data, np.uint16).astype(np.uint32)
+            arr = (raw << 16).view(np.float32).astype(np.float32)
+        else:
+            arr = np.frombuffer(
+                t.raw_data,
+                np.dtype(dtype if t.data_type != P.TensorProto.BFLOAT16
+                         else np.uint16))
+        return arr.reshape(shape).copy()
+    for field in ("float_data", "int64_data", "int32_data", "double_data"):
+        data = getattr(t, field)
+        if len(data):
+            return np.asarray(list(data), dtype).reshape(shape)
+    return np.zeros(shape, dtype)
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == P.AttributeProto.FLOAT:
+            out[a.name] = float(a.f)
+        elif a.type == P.AttributeProto.INT:
+            out[a.name] = int(a.i)
+        elif a.type == P.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == P.AttributeProto.FLOATS:
+            out[a.name] = tuple(float(x) for x in a.floats)
+        elif a.type == P.AttributeProto.INTS:
+            out[a.name] = tuple(int(x) for x in a.ints)
+        elif a.type == P.AttributeProto.TENSOR:
+            out[a.name] = _tensor_to_np(a.t)
+        else:
+            raise MXNetError("unsupported attribute type %d for %s"
+                             % (a.type, a.name))
+    return out
+
+
+def _pair(v, default=(1, 1)):
+    if v is None:
+        return default
+    v = tuple(v)
+    return v
+
+
+def _split_pads(pads):
+    if pads is None:
+        return (0, 0)
+    pads = tuple(pads)
+    n = len(pads) // 2
+    begin, end = pads[:n], pads[n:]
+    if begin != end:
+        raise MXNetError("asymmetric pads %s not supported" % (pads,))
+    return begin
+
+
+class _Importer:
+    def __init__(self):
+        from ... import symbol as S
+
+        self.S = S
+        self.tensors = {}   # onnx tensor name -> Symbol
+        self.params = {}    # param name -> np array
+        self.consumed = set()
+
+    def get(self, name):
+        t = self.tensors.get(name)
+        if t is not None:
+            return t
+        if name in self.params:
+            # materialize a parameter variable on first symbolic use
+            var = self.S.Variable(name)
+            self.tensors[name] = var
+            self.consumed.add(name)
+            return var
+        raise MXNetError("tensor %r is not defined yet" % (name,))
+
+    def const(self, name):
+        """A parameter consumed as a STATIC value (Reshape shapes)."""
+        if name in self.params:
+            return self.params[name]
+        raise MXNetError("expected initializer for %r" % (name,))
+
+    # -- per-op handlers ---------------------------------------------------
+    def op_Conv(self, node, at):
+        ins = [self.get(i) for i in node.input]
+        kernel = _pair(at.get("kernel_shape"))
+        return self.S.Convolution(
+            *ins, kernel=kernel,
+            stride=_pair(at.get("strides"), (1,) * len(kernel)),
+            dilate=_pair(at.get("dilations"), (1,) * len(kernel)),
+            pad=_split_pads(at.get("pads")),
+            num_filter=int(self.const(node.input[1]).shape[0]),
+            num_group=int(at.get("group", 1)),
+            no_bias=len(node.input) < 3, name=node.name or None)
+
+    def op_Gemm(self, node, at):
+        # full Gemm semantics: Y = alpha * A @ B' + beta * C
+        # (ONNX defaults: alpha=1, beta=1, transA=0, transB=0)
+        alpha = float(at.get("alpha", 1.0))
+        beta = float(at.get("beta", 1.0))
+        if int(at.get("transA", 0)):
+            raise MXNetError("Gemm(transA=1) is not supported")
+        trans_b = int(at.get("transB", 0))
+        a = self.get(node.input[0])
+        b_name = node.input[1]
+        if b_name in self.params and b_name not in self.tensors:
+            if not trans_b:
+                # FullyConnected wants (num_hidden, in): fold the
+                # transpose into the stored weight once
+                self.params[b_name] = np.ascontiguousarray(
+                    self.params[b_name].T)
+            num_hidden = int(self.params[b_name].shape[0])
+            bias_as_fc = (len(node.input) > 2 and alpha == 1.0
+                          and beta == 1.0
+                          and node.input[2] in self.params
+                          and self.params[node.input[2]].ndim == 1)
+            if bias_as_fc:
+                return self.S.FullyConnected(
+                    a, self.get(b_name), self.get(node.input[2]),
+                    num_hidden=num_hidden, flatten=False,
+                    name=node.name or None)
+            out = self.S.FullyConnected(
+                a, self.get(b_name), num_hidden=num_hidden, no_bias=True,
+                flatten=False, name=node.name or None)
+        else:
+            w = self.get(b_name)
+            if trans_b:
+                w = self.S.transpose(w)
+            out = self.S.dot(a, w)
+        if alpha != 1.0:
+            out = out * alpha
+        if len(node.input) > 2:  # bias_as_fc returned above
+            c = self.get(node.input[2])
+            out = self.S.broadcast_add(out, c * beta if beta != 1.0 else c)
+        return out
+
+    def op_MatMul(self, node, at):
+        a, bsym = (self.get(i) for i in node.input)
+        return self.S.dot(a, bsym, name=node.name or None)
+
+    def op_BatchNormalization(self, node, at):
+        ins = [self.get(i) for i in node.input]
+        return self.S.BatchNorm(
+            *ins, eps=float(at.get("epsilon", 1e-5)),
+            momentum=float(at.get("momentum", 0.9)),
+            fix_gamma=False, name=node.name or None)
+
+    def op_MaxPool(self, node, at, pool_type="max"):
+        kernel = _pair(at.get("kernel_shape"))
+        kw = dict(kernel=kernel, pool_type=pool_type,
+                  stride=_pair(at.get("strides"), (1,) * len(kernel)),
+                  pad=_split_pads(at.get("pads")))
+        if at.get("ceil_mode"):
+            kw["pooling_convention"] = "full"
+        if pool_type == "avg":
+            kw["count_include_pad"] = bool(at.get("count_include_pad", 0))
+        return self.S.Pooling(self.get(node.input[0]),
+                              name=node.name or None, **kw)
+
+    def op_AveragePool(self, node, at):
+        return self.op_MaxPool(node, at, pool_type="avg")
+
+    def op_GlobalMaxPool(self, node, at):
+        return self.S.Pooling(self.get(node.input[0]), global_pool=True,
+                              pool_type="max", name=node.name or None)
+
+    def op_GlobalAveragePool(self, node, at):
+        return self.S.Pooling(self.get(node.input[0]), global_pool=True,
+                              pool_type="avg", name=node.name or None)
+
+    def op_Flatten(self, node, at):
+        if int(at.get("axis", 1)) != 1:
+            raise MXNetError("Flatten(axis!=1) not supported")
+        return self.S.Flatten(self.get(node.input[0]),
+                              name=node.name or None)
+
+    def op_Reshape(self, node, at):
+        shape = tuple(int(x) for x in self.const(node.input[1]))
+        return self.S.Reshape(self.get(node.input[0]), shape=shape,
+                              name=node.name or None)
+
+    def op_Transpose(self, node, at):
+        perm = at.get("perm")
+        return self.S.transpose(self.get(node.input[0]),
+                                axes=perm, name=node.name or None)
+
+    def op_Concat(self, node, at):
+        ins = [self.get(i) for i in node.input]
+        return self.S.Concat(*ins, dim=int(at.get("axis", 1)),
+                             name=node.name or None)
+
+    def op_Softmax(self, node, at):
+        return self.S.softmax(self.get(node.input[0]),
+                              axis=int(at.get("axis", -1)),
+                              name=node.name or None)
+
+    def op_Dropout(self, node, at):
+        return self.S.Dropout(self.get(node.input[0]),
+                              name=node.name or None)
+
+    def op_Cast(self, node, at):
+        to = _ONNX_TO_NP.get(int(at.get("to", P.TensorProto.FLOAT)),
+                             np.float32)
+        return self.S.cast(self.get(node.input[0]),
+                           dtype=np.dtype(to).name,
+                           name=node.name or None)
+
+    def op_Gather(self, node, at):
+        if int(at.get("axis", 0)) != 0:
+            raise MXNetError("Gather(axis!=0) not supported")
+        data, idx = node.input
+        if data in self.params:
+            vocab, dim = self.params[data].shape[:2]
+            return self.S.Embedding(self.get(idx), self.get(data),
+                                    input_dim=int(vocab),
+                                    output_dim=int(dim),
+                                    name=node.name or None)
+        return self.S.take(self.get(data), self.get(idx),
+                           name=node.name or None)
+
+    def op_Identity(self, node, at):
+        return self.S.identity(self.get(node.input[0]),
+                               name=node.name or None)
+
+    def op_Sum(self, node, at):
+        ins = [self.get(i) for i in node.input]
+        total = ins[0]
+        for extra in ins[1:]:
+            total = self.S.broadcast_add(total, extra)
+        return total
+
+    def op_Softplus(self, node, at):
+        return self.S.Activation(self.get(node.input[0]),
+                                 act_type="softrelu",
+                                 name=node.name or None)
+
+    def op_LeakyRelu(self, node, at):
+        return self.S.LeakyReLU(self.get(node.input[0]),
+                                slope=float(at.get("alpha", 0.01)),
+                                name=node.name or None)
+
+    def op_Elu(self, node, at):
+        return self.S.LeakyReLU(self.get(node.input[0]), act_type="elu",
+                                slope=float(at.get("alpha", 1.0)),
+                                name=node.name or None)
+
+    def _simple(mx_name):  # noqa: N805 — converter factory
+        def handler(self, node, at):
+            ins = [self.get(i) for i in node.input]
+            return getattr(self.S, mx_name)(*ins, name=node.name or None)
+        return handler
+
+    op_Relu = _simple("relu")
+    op_Sigmoid = _simple("sigmoid")
+    op_Tanh = _simple("tanh")
+    op_Softsign = _simple("softsign")
+    op_Exp = _simple("exp")
+    op_Log = _simple("log")
+    op_Sqrt = _simple("sqrt")
+    op_Neg = _simple("negative")
+    op_Abs = _simple("abs")
+    op_Add = _simple("broadcast_add")
+    op_Sub = _simple("broadcast_sub")
+    op_Mul = _simple("broadcast_mul")
+    op_Div = _simple("broadcast_div")
+    del _simple
+
+
+def _load_model(model_file):
+    model = P.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    return model
+
+
+def get_model_metadata(model_file):
+    """Input/output descriptions (ref: onnx2mx.get_model_metadata)."""
+    model = _load_model(model_file)
+    graph = model.graph
+    inits = {t.name for t in graph.initializer}
+
+    def info(vi):
+        shape = tuple(
+            d.dim_value if d.dim_value else d.dim_param
+            for d in vi.type.tensor_type.shape.dim)
+        return (vi.name, shape)
+
+    return {
+        "input_tensor_data": [info(v) for v in graph.input
+                              if v.name not in inits],
+        "output_tensor_data": [info(v) for v in graph.output],
+    }
+
+
+def import_model(model_file):
+    """Load an ONNX file into (sym, arg_params, aux_params)
+    (ref: onnx2mx.import_model — same return contract)."""
+    from ...ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+
+    model = _load_model(model_file)
+    graph = model.graph
+    imp = _Importer()
+    for t in graph.initializer:
+        imp.params[t.name] = _tensor_to_np(t)
+    inits = set(imp.params)
+    for vi in graph.input:
+        if vi.name not in inits:
+            imp.tensors[vi.name] = imp.S.Variable(vi.name)
+
+    for node in graph.node:
+        handler = getattr(imp, "op_" + node.op_type, None)
+        if handler is None:
+            raise MXNetError(
+                "ONNX op %r has no importer (file %s)"
+                % (node.op_type, model_file))
+        result = handler(node, _attrs(node))
+        outs = list(node.output)
+        if len(outs) == 1:
+            imp.tensors[outs[0]] = result
+        else:
+            for i, oname in enumerate(outs):
+                imp.tensors[oname] = result[i]
+
+    out_syms = [imp.tensors[v.name] for v in graph.output]
+    sym = out_syms[0] if len(out_syms) == 1 else imp.S.Group(out_syms)
+
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params, aux_params = {}, {}
+    for name in imp.consumed:
+        arr = NDArray(jnp.asarray(imp.params[name]))
+        (aux_params if name in aux_names else arg_params)[name] = arr
+    return sym, arg_params, aux_params
+
+
+def import_to_gluon(model_file, ctx=None):
+    """Load an ONNX file as a Gluon SymbolBlock
+    (ref: onnx2mx.import_to_gluon)."""
+    del ctx
+    from ...gluon.symbol_block import SymbolBlock
+    from ... import symbol as S
+
+    sym, arg_params, aux_params = import_model(model_file)
+    meta = get_model_metadata(model_file)
+    inputs = [S.Variable(n) for n, _ in meta["input_tensor_data"]]
+    net = SymbolBlock(sym, inputs)
+    net_params = net.collect_params()
+    for name, arr in list(arg_params.items()) + list(aux_params.items()):
+        if name in net_params:
+            net_params[name].set_data(arr)
+    return net

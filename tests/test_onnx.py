@@ -1,0 +1,168 @@
+"""contrib.onnx export/import roundtrips (models the reference's
+tests/python-pytest/onnx — forward-equivalence after a save/load through
+the ONNX wire format)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.contrib import onnx as onnx_mxnet
+
+
+def _init_args(s, rng, **input_shapes):
+    arg_shapes, _, aux_shapes = s.infer_shape(**input_shapes)
+    args = {}
+    for name, shape in zip(s.list_arguments(), arg_shapes):
+        if name in input_shapes:
+            continue
+        args[name] = nd.array(rng.uniform(-0.5, 0.5, shape).astype("f4"))
+    aux = {}
+    for name, shape in zip(s.list_auxiliary_states(), aux_shapes):
+        val = rng.uniform(0.5, 1.5, shape) if name.endswith("var") \
+            else rng.uniform(-0.1, 0.1, shape)
+        aux[name] = nd.array(val.astype("f4"))
+    return args, aux
+
+
+def _forward(s, args, aux, **inputs):
+    ex = s.bind(args={**args, **{k: nd.array(v) for k, v in
+                                 inputs.items()}},
+                aux_states=dict(aux) if aux else None, grad_req="null")
+    outs = ex.forward(is_train=False)
+    return outs[0].asnumpy()
+
+
+def _roundtrip(s, input_shapes, tmp_path, atol=1e-5):
+    rng = np.random.RandomState(0)
+    args, aux = _init_args(s, rng, **input_shapes)
+    inputs = {k: rng.uniform(-1, 1, v).astype("f4")
+              for k, v in input_shapes.items()}
+    ref = _forward(s, args, aux, **inputs)
+
+    path = str(tmp_path / "model.onnx")
+    onnx_mxnet.export_model(s, {**args, **aux},
+                            [input_shapes[k] for k in sorted(input_shapes)],
+                            np.float32, path)
+    s2, arg2, aux2 = onnx_mxnet.import_model(path)
+    out = _forward(s2, arg2, aux2, **inputs)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=atol)
+    return s2
+
+
+def test_onnx_mlp_roundtrip(tmp_path):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = sym.softmax(net, name="prob")
+    _roundtrip(net, {"data": (2, 20)}, tmp_path)
+
+
+def test_onnx_lenet_roundtrip(tmp_path):
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(5, 5), num_filter=8, name="c1")
+    net = sym.Activation(net, act_type="tanh", name="t1")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                      name="p1")
+    net = sym.Convolution(net, kernel=(3, 3), num_filter=16, pad=(1, 1),
+                          name="c2")
+    net = sym.Activation(net, act_type="relu", name="r2")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="avg",
+                      name="p2")
+    net = sym.Flatten(net, name="flat")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc")
+    _roundtrip(net, {"data": (2, 1, 28, 28)}, tmp_path)
+
+
+def test_onnx_conv_bn_global_pool_roundtrip(tmp_path):
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                          no_bias=True, name="conv")
+    net = sym.BatchNorm(net, fix_gamma=False, name="bn")
+    net = sym.LeakyReLU(net, slope=0.1, name="lrelu")
+    net = sym.Pooling(net, global_pool=True, pool_type="avg", name="gap")
+    net = sym.Flatten(net, name="fl")
+    _roundtrip(net, {"data": (2, 3, 8, 8)}, tmp_path, atol=1e-4)
+
+
+def test_onnx_elemwise_and_scalar_roundtrip(tmp_path):
+    a = sym.Variable("a")
+    net = sym.broadcast_add(a * 2.0, sym.sqrt(sym.abs(a)) + 1.0)
+    net = sym.tanh(net)
+    _roundtrip(net, {"a": (3, 4)}, tmp_path)
+
+
+def test_onnx_reshape_transpose_concat_roundtrip(tmp_path):
+    a = sym.Variable("a")
+    left = sym.Reshape(a, shape=(2, 12), name="rs")
+    right = sym.Reshape(sym.transpose(a, axes=(0, 2, 1), name="tr"),
+                        shape=(2, 12), name="rs2")
+    net = sym.Concat(left, right, dim=1, name="cat")
+    _roundtrip(net, {"a": (2, 3, 4)}, tmp_path)
+
+
+def test_onnx_embedding_roundtrip(tmp_path):
+    idx = sym.Variable("idx")
+    net = sym.Embedding(idx, input_dim=11, output_dim=6, name="emb")
+    net = sym.FullyConnected(net, num_hidden=4, flatten=True, name="fc")
+    rng = np.random.RandomState(1)
+    s = net
+    args, aux = _init_args(s, rng, idx=(2, 5))
+    x = rng.randint(0, 11, (2, 5)).astype("f4")
+    ref = _forward(s, args, aux, idx=x)
+    path = str(tmp_path / "emb.onnx")
+    onnx_mxnet.export_model(s, args, [(2, 5)], np.float32, path)
+    s2, arg2, aux2 = onnx_mxnet.import_model(path)
+    out = _forward(s2, arg2, aux2, idx=x)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_onnx_model_metadata(tmp_path):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=3, name="fc")
+    rng = np.random.RandomState(0)
+    args, _ = _init_args(net, rng, data=(4, 7))
+    path = str(tmp_path / "meta.onnx")
+    onnx_mxnet.export_model(net, args, [(4, 7)], np.float32, path)
+    meta = onnx_mxnet.get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (4, 7))]
+    assert len(meta["output_tensor_data"]) == 1
+
+
+def test_onnx_import_to_gluon(tmp_path):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=5, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="r")
+    net = sym.FullyConnected(net, num_hidden=3, name="fc2")
+    rng = np.random.RandomState(0)
+    args, _ = _init_args(net, rng, data=(2, 6))
+    x = rng.uniform(-1, 1, (2, 6)).astype("f4")
+    ref = _forward(net, args, {}, data=x)
+    path = str(tmp_path / "g.onnx")
+    onnx_mxnet.export_model(net, args, [(2, 6)], np.float32, path)
+    block = onnx_mxnet.import_to_gluon(path)
+    out = block(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_onnx_unsupported_op_errors(tmp_path):
+    data = sym.Variable("data")
+    net = sym.SequenceReverse(data)
+    with pytest.raises(mx.MXNetError, match="no ONNX converter"):
+        onnx_mxnet.export_model(net, {}, [(2, 3, 4)], np.float32,
+                                str(tmp_path / "x.onnx"))
+
+
+def test_onnx_batchnorm_fix_gamma_roundtrip(tmp_path):
+    # fix_gamma=True (the BatchNorm default) forces scale=1 at runtime;
+    # the exporter must write a ones scale, not the stored gamma values
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                          name="conv")
+    net = sym.BatchNorm(net, name="bn")  # fix_gamma defaults True
+    net = sym.Activation(net, act_type="relu", name="r")
+    net = sym.Flatten(net, name="f")
+    net = sym.FullyConnected(net, num_hidden=3, name="fc")
+    net = sym.softmax(net, name="prob")
+    _roundtrip(net, {"data": (2, 3, 6, 6)}, tmp_path, atol=1e-4)

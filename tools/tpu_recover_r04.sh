@@ -29,6 +29,10 @@ run lstm128 600 env BENCH_CONFIGS=lstm_ptb BENCH_LSTM_BATCH=128 \
 run lstm256 600 env BENCH_CONFIGS=lstm_ptb BENCH_LSTM_BATCH=256 \
     BENCH_BUDGET=500 python bench.py
 
+# 3b) BERT through the canonical Gluon loop (fused donated Trainer.step)
+run bert_gluon 900 env BENCH_CONFIGS=bert BENCH_BERT_PATH=trainer \
+    BENCH_BUDGET=800 python bench.py
+
 # 4) ResNet-50 MFU levers (VERDICT #2): batch 256, remat variants
 run resnet_b256 900 env BENCH_CONFIGS=resnet50 BENCH_BATCH=256 \
     BENCH_BUDGET=800 python bench.py
