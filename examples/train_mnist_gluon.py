@@ -41,11 +41,18 @@ def load_data(args):
         x = x.reshape(-1, 1, 28, 28) / 255.0
         return x, y
     except Exception:
-        print("MNIST files not found — using synthetic data")
+        print("MNIST files not found — using synthetic digits")
+        # LEARNABLE synthetic task (not random labels): 10 smooth
+        # prototypes + noise, so the printed accuracy is a real
+        # convergence signal (mirrors tests/test_tpu_smoke.py's
+        # train-tier bar)
         rng = np.random.RandomState(0)
-        x = rng.rand(4096, 1, 28, 28).astype("f4")
-        y = rng.randint(0, 10, (4096,)).astype("f4")
-        return x, y
+        protos = np.repeat(np.repeat(rng.rand(10, 1, 7, 7), 4, axis=2),
+                           4, axis=3).astype("f4")
+        y = rng.randint(0, 10, (4096,))
+        x = (protos[y] + rng.normal(0, 0.35, (4096, 1, 28, 28))
+             ).astype("f4")
+        return x, y.astype("f4")
 
 
 def main():

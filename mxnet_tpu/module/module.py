@@ -216,6 +216,19 @@ class Module(BaseModule):
             self._optimizer = optimizer
         else:
             opt_params = dict(optimizer_params)
+            # ref: module.py — init_optimizer defaults rescale_grad to
+            # 1/batch_size (the executor's gradients are batch-SUMMED;
+            # without this the effective lr scales with batch size).
+            # Batch size comes from the DataDesc layout's batch axis —
+            # a TNC-layout RNN input has it at axis 1, not 0.
+            if "rescale_grad" not in opt_params and self._data_shapes:
+                from ..io.io import DataDesc
+
+                desc = self._data_shapes[0]
+                axis = DataDesc.get_batch_axis(
+                    getattr(desc, "layout", None))
+                if axis < len(desc.shape) and desc.shape[axis]:
+                    opt_params["rescale_grad"] = 1.0 / desc.shape[axis]
             idx2name = {i: n for i, n in enumerate(self._param_names)}
             self._optimizer = opt_mod.create(
                 optimizer, param_idx2name=idx2name, **opt_params)

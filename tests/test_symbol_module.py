@@ -418,3 +418,31 @@ def test_print_summary_and_plot_network():
     except ImportError:
         with _pytest.raises(ImportError):
             mx.viz.plot_network(net)
+
+
+def test_module_fit_converges():
+    """Module.fit with default optimizer_params must actually learn — the
+    reference defaults rescale_grad to 1/batch_size in init_optimizer
+    (module.py); without it gradients arrive batch-summed and training
+    diverges or stalls at chance accuracy."""
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=32, name="cfc1")
+    h = sym.Activation(h, act_type="relu", name="crelu")
+    h = sym.FullyConnected(h, num_hidden=10, name="cfc2")
+    out = sym.SoftmaxOutput(h, name="softmax")
+
+    rng = np.random.RandomState(0)
+    protos = rng.rand(10, 20).astype("f4")
+    y = rng.randint(0, 10, (512,))
+    x = (protos[y] + rng.normal(0, 0.2, (512, 20))).astype("f4")
+    it = mx.io.NDArrayIter(x, y.astype("f4"), 64, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(it, optimizer="sgd", initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            eval_metric="acc", num_epoch=4)
+    assert mod._optimizer.rescale_grad == pytest.approx(1.0 / 64)
+    it.reset()
+    score = mod.score(it, mx.metric.Accuracy())
+    assert score[0][1] > 0.9, score
