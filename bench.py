@@ -189,6 +189,13 @@ def bench_resnet50(platform, dtype):
     dt = _timed_steps(step, x, y, iters, warmup)
     img_s = batch * iters / dt
 
+    dump = os.environ.get("BENCH_DUMP_HLO")
+    if dump:  # post-run: one AOT compile, shared with the MFU accounting
+        try:
+            step.dump_hlo(x, y, dump)
+        except Exception as e:  # noqa: BLE001 — diagnostics only
+            print("bench: HLO dump failed: %r" % (e,), file=sys.stderr)
+
     flops_per_img = step.flops_per_step(x, y)
     if flops_per_img:
         flops_per_img /= batch

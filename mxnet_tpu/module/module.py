@@ -227,7 +227,7 @@ class Module(BaseModule):
                 desc = self._data_shapes[0]
                 axis = DataDesc.get_batch_axis(
                     getattr(desc, "layout", None))
-                if axis < len(desc.shape) and desc.shape[axis]:
+                if 0 <= axis < len(desc.shape) and desc.shape[axis]:
                     opt_params["rescale_grad"] = 1.0 / desc.shape[axis]
             idx2name = {i: n for i, n in enumerate(self._param_names)}
             self._optimizer = opt_mod.create(

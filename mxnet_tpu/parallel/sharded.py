@@ -252,24 +252,60 @@ class ShardedTrainStep:
         self._batch_cache[id(data)] = (data, out)
         return out
 
-    def flops_per_step(self, x, y):
-        """Total FLOPs of one compiled step per XLA cost analysis, or None
-        if the backend doesn't report it. Used by bench.py for MFU."""
+    def dump_hlo(self, x, y, path, optimized=True):
+        """Write the step's HLO to ``path`` for offline analysis (the
+        round-4 ResNet backward work: finding dgrad/wgrad layout copies
+        needs the post-optimization module). optimized=False dumps the
+        pre-optimization lowering instead. The AOT compile (one per
+        process, shared with flops_per_step's accounting) is separate
+        from the traced-call executable."""
+        if optimized:
+            compiled = self._compile(x, y)
+            try:
+                modules = compiled.runtime_executable().hlo_modules()
+                text = "\n\n".join(m.to_string() for m in modules)
+            except Exception:  # noqa: BLE001 — backend-dependent surface
+                text = compiled.as_text()
+        else:
+            text = self._lower(x, y).as_text()
+        with open(path, "w") as f:
+            f.write(text)
+        return path
+
+    def _gather(self):
+        """The exact (train, states, aux) operands __call__ passes —
+        lowering helpers must stay in lockstep with execution."""
         train_vals = tuple(self._all_params[n].data().data
                            for n in self._train_names)
         aux_vals = tuple(self._all_params[n].data().data
                          for n in self._aux_names)
         states = tuple(self._states[n] for n in self._train_names)
+        return train_vals, states, aux_vals
+
+    def _lower(self, x, y):
+        train_vals, states, aux_vals = self._gather()
+        return self._jit.lower(
+            train_vals, states, aux_vals, self._shard_batch(x),
+            self._shard_batch(y), self._ensure_key(), self._t_dev)
+
+    def _compile(self, x, y):
+        """AOT-compiled step, memoized so flops_per_step + dump_hlo share
+        ONE compile (ResNet-50 compiles are minutes on the tunnel)."""
+        if getattr(self, "_aot_compiled", None) is None:
+            self._aot_compiled = self._lower(x, y).compile()
+        return self._aot_compiled
+
+    def flops_per_step(self, x, y):
+        """Total FLOPs of one compiled step per XLA cost analysis, or None
+        if the backend doesn't report it. Used by bench.py for MFU."""
         try:
-            lowered = self._jit.lower(
-                train_vals, states, aux_vals, self._shard_batch(x),
-                self._shard_batch(y), self._ensure_key(), self._t_dev)
+            lowered = self._lower(x, y)
             try:
                 cost = lowered.cost_analysis()  # no compile needed
             except Exception:  # noqa: BLE001 — older backends
                 cost = None
             if not cost:  # axon returns None from the lowered analysis
-                cost = lowered.compile().cost_analysis()
+                cost = self._compile(x, y).cost_analysis()
             if isinstance(cost, (list, tuple)):
                 cost = cost[0] if cost else {}
             flops = float(cost.get("flops", 0.0)) if cost else 0.0
@@ -283,11 +319,7 @@ class ShardedTrainStep:
         return self._base_key
 
     def __call__(self, x, y):
-        train_vals = tuple(self._all_params[n].data().data
-                           for n in self._train_names)
-        aux_vals = tuple(self._all_params[n].data().data
-                         for n in self._aux_names)
-        states = tuple(self._states[n] for n in self._train_names)
+        train_vals, states, aux_vals = self._gather()
         loss, new_train, new_states, new_aux, self._t_dev = self._jit(
             train_vals, states, aux_vals, self._shard_batch(x),
             self._shard_batch(y), self._ensure_key(), self._t_dev)

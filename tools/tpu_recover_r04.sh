@@ -35,7 +35,8 @@ run bert_gluon 900 env BENCH_CONFIGS=bert BENCH_BERT_PATH=trainer \
 
 # 4) ResNet-50 MFU levers (VERDICT #2): batch 256, remat variants
 run resnet_b256 900 env BENCH_CONFIGS=resnet50 BENCH_BATCH=256 \
-    BENCH_BUDGET=800 python bench.py
+    BENCH_BUDGET=800 BENCH_DUMP_HLO=/tmp/resnet_b256_axon.hlo \
+    python bench.py
 run resnet_remat 900 env BENCH_CONFIGS=resnet50 BENCH_REMAT=full \
     BENCH_BUDGET=800 python bench.py
 run resnet_remat_dots 900 env BENCH_CONFIGS=resnet50 \
