@@ -190,11 +190,17 @@ def bench_resnet50(platform, dtype):
     img_s = batch * iters / dt
 
     dump = os.environ.get("BENCH_DUMP_HLO")
-    if dump:  # post-run: one AOT compile, shared with the MFU accounting
+    # post-run: one AOT compile, shared with the MFU accounting — but a
+    # compile can take minutes, so only start it with real headroom
+    # (being killed mid-compile is the tunnel-wedge mechanism)
+    if dump and _remaining() > 300:
         try:
             step.dump_hlo(x, y, dump)
         except Exception as e:  # noqa: BLE001 — diagnostics only
             print("bench: HLO dump failed: %r" % (e,), file=sys.stderr)
+    elif dump:
+        print("bench: skipping HLO dump — %.0fs budget left" % _remaining(),
+              file=sys.stderr)
 
     flops_per_img = step.flops_per_step(x, y)
     if flops_per_img:

@@ -288,12 +288,22 @@ class ShardedTrainStep:
             train_vals, states, aux_vals, self._shard_batch(x),
             self._shard_batch(y), self._ensure_key(), self._t_dev)
 
-    def _compile(self, x, y):
-        """AOT-compiled step, memoized so flops_per_step + dump_hlo share
-        ONE compile (ResNet-50 compiles are minutes on the tunnel)."""
-        if getattr(self, "_aot_compiled", None) is None:
-            self._aot_compiled = self._lower(x, y).compile()
-        return self._aot_compiled
+    def _compile(self, x, y, lowered=None):
+        """AOT-compiled step, memoized per input signature so
+        flops_per_step + dump_hlo share ONE compile (ResNet-50 compiles
+        are minutes on the tunnel). Pass ``lowered`` to reuse an
+        already-lowered module instead of tracing again."""
+        def sig(a):
+            d = a.data if isinstance(a, NDArray) else a
+            return tuple(d.shape), str(d.dtype)
+
+        key = (sig(x), sig(y))
+        cache = getattr(self, "_aot_compiled", None)
+        if cache is None:
+            cache = self._aot_compiled = {}
+        if key not in cache:
+            cache[key] = (lowered or self._lower(x, y)).compile()
+        return cache[key]
 
     def flops_per_step(self, x, y):
         """Total FLOPs of one compiled step per XLA cost analysis, or None
@@ -305,7 +315,7 @@ class ShardedTrainStep:
             except Exception:  # noqa: BLE001 — older backends
                 cost = None
             if not cost:  # axon returns None from the lowered analysis
-                cost = self._compile(x, y).cost_analysis()
+                cost = self._compile(x, y, lowered=lowered).cost_analysis()
             if isinstance(cost, (list, tuple)):
                 cost = cost[0] if cost else {}
             flops = float(cost.get("flops", 0.0)) if cost else 0.0

@@ -40,3 +40,33 @@ def test_example_runs(script, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)  # scratch data dirs land here
     monkeypatch.setattr(sys, "argv", [script] + list(EXAMPLES[script]))
     runpy.run_path(os.path.join(REPO_ROOT, script), run_name="__main__")
+
+
+def test_example_mnist_gluon_converges(tmp_path, monkeypatch, capsys):
+    """Train-tier bar on the canonical Gluon example (the synthetic
+    fallback is a LEARNABLE prototype task, so accuracy is a real
+    convergence signal — models the reference train-tier, SURVEY §4)."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(sys, "argv", [
+        "examples/train_mnist_gluon.py", "--epochs", "2",
+        "--batch-size", "256"])
+    runpy.run_path(os.path.join(REPO_ROOT,
+                                "examples/train_mnist_gluon.py"),
+                   run_name="__main__")
+    out = capsys.readouterr().out
+    last = [l for l in out.splitlines() if "train acc" in l][-1]
+    acc = float(last.rsplit(" ", 1)[1])
+    assert acc >= 0.9, out
+
+
+def test_example_mnist_module_converges(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(sys, "argv", [
+        "examples/train_mnist_module.py", "--epochs", "2"])
+    runpy.run_path(os.path.join(REPO_ROOT,
+                                "examples/train_mnist_module.py"),
+                   run_name="__main__")
+    out = capsys.readouterr().out
+    last = [l for l in out.splitlines() if "final val" in l][-1]
+    acc = float(last.split("'accuracy', ")[1].rstrip(")]"))
+    assert acc >= 0.9, out
