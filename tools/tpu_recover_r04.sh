@@ -42,4 +42,8 @@ run resnet_remat 900 env BENCH_CONFIGS=resnet50 BENCH_REMAT=full \
 run resnet_remat_dots 900 env BENCH_CONFIGS=resnet50 \
     BENCH_REMAT=dots_saveable BENCH_BUDGET=800 python bench.py
 
+# 5) profiler trace of the ResNet step (PERF.md attachment)
+run profile 900 python tools/profile_resnet.py --batch 64 --steps 8 \
+    --out profiles/resnet50_r04
+
 echo "RECOVERY_DONE" >> "$LOG"
