@@ -147,7 +147,10 @@ def _mfu(samples_per_sec, flops_per_sample, platform):
     return round(samples_per_sec * flops_per_sample / V5E_PEAK_FLOPS, 4)
 
 
-def bench_resnet50(platform, dtype):
+def bench_resnet50(platform, dtype, batch=None, remat="env"):
+    """remat: "env" reads BENCH_REMAT; "none" forces no remat (the
+    variant sweep needs to express 'explicitly off' even when the stage
+    env sets BENCH_REMAT); any other value is a remat policy name."""
     import numpy as np
 
     import mxnet_tpu as mx
@@ -156,7 +159,12 @@ def bench_resnet50(platform, dtype):
     from mxnet_tpu import parallel
 
     small = platform == "cpu"
-    batch = int(os.environ.get("BENCH_BATCH", "8" if small else "64"))
+    if batch is None:
+        batch = int(os.environ.get("BENCH_BATCH", "8" if small else "64"))
+    if remat == "env":
+        remat = os.environ.get("BENCH_REMAT") or None
+    elif remat == "none":
+        remat = None
     iters = int(os.environ.get("BENCH_ITERS", "3" if small else "20"))
     warmup = int(os.environ.get("BENCH_WARMUP", "1" if small else "3"))
     # channels-last is the MXU-native layout (gluon/nn/layout.py); NCHW
@@ -179,7 +187,7 @@ def bench_resnet50(platform, dtype):
     step = parallel.ShardedTrainStep(
         net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
         {"learning_rate": 0.1, "momentum": 0.9},
-        remat=os.environ.get("BENCH_REMAT") or None)
+        remat=remat)
 
     rng = np.random.RandomState(0)
     x = nd.array(rng.uniform(-1, 1, in_shape).astype(np.float32))
@@ -211,7 +219,7 @@ def bench_resnet50(platform, dtype):
     row = {
         "config": "resnet50_v1_train", "chips": 1, "batch_size": batch,
         "dtype": dtype, "layout": layout,
-        "remat": os.environ.get("BENCH_REMAT") or None,
+        "remat": remat,
         "images_or_tokens_per_sec_per_chip": round(img_s, 2),
         "mfu": _mfu(img_s, flops_per_img, platform), "platform": platform,
         "flops_per_sample": flops_per_img,
@@ -539,6 +547,7 @@ def main():
     headline = None
     errors = []
     skipped = []
+    best_resnet = None
     for name in ("resnet50", "bert", "lstm_ptb", "wide_deep", "lenet"):
         if name not in configs:
             continue
@@ -553,6 +562,8 @@ def main():
         metric, unit, fn = metric_info[name]
         try:
             val, row = fn(platform, dtype)
+            if name == "resnet50":
+                best_resnet = (val, row)
             if headline is None:
                 headline = {
                     "metric": metric,
@@ -566,6 +577,31 @@ def main():
                 }
         except Exception as e:  # noqa: BLE001 — diagnostic JSON, not crash
             errors.append("%s: %r" % (name, e))
+
+    # perf-round lever sweep on TRULY leftover budget (after every
+    # standard config had its chance): batch/remat resnet variants, with
+    # the headline updated to the BEST resnet row (VERDICT r3 #2 — the
+    # official number should reflect the best landed configuration)
+    if platform == "axon" and best_resnet is not None:
+        variants = os.environ.get("BENCH_RESNET_VARIANTS", "256:,256:full")
+        for spec in [s for s in variants.split(",") if s]:
+            if _remaining() < 450:  # full resnet cost estimate + margin
+                skipped.append("resnet50@%s" % spec)
+                continue
+            vb, _, vr = spec.partition(":")
+            try:
+                v2, row2 = bench_resnet50(platform, dtype, batch=int(vb),
+                                          remat=vr or "none")
+                if v2 > best_resnet[0]:
+                    best_resnet = (v2, row2)
+            except Exception as e:  # noqa: BLE001
+                errors.append("resnet50@%s: %r" % (spec, e))
+        if headline is not None and \
+                headline["metric"] == "resnet50_train_throughput":
+            val, row = best_resnet
+            headline["value"] = round(val, 2)
+            headline["vs_baseline"] = round(val / BASELINE_IMG_S, 3)
+            headline["mfu"] = row["mfu"]
 
     if headline is None:
         first = next((c for c in ("resnet50", "bert", "lstm_ptb",

@@ -57,10 +57,12 @@ def main():
 
     profiler.set_config(filename=args.out, profile_all=True)
     profiler.start()
-    loss = None
+    # sync EVERY step: an external kill mid-window must never find a deep
+    # un-synced dispatch queue (the tunnel-wedge mechanism, PERF.md §1.4).
+    # Per-step RTT gaps appear in the trace but each step's device
+    # timeline is intact, which is what the backward analysis needs.
     for _ in range(args.steps):
-        loss = step(xb, yb)
-    loss.wait_to_read()  # trace covers the whole chained window
+        step(xb, yb).wait_to_read()
     trace_dir = profiler.dump()
     print("trace:", trace_dir)
 
