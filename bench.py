@@ -584,11 +584,15 @@ def main():
     # official number should reflect the best landed configuration)
     if platform == "axon" and best_resnet is not None:
         variants = os.environ.get("BENCH_RESNET_VARIANTS", "256:,256:full")
+        base_cost = float(os.environ.get("BENCH_COST_RESNET50",
+                                         _CONFIG_COST["resnet50"]))
         for spec in [s for s in variants.split(",") if s]:
-            if _remaining() < 450:  # full resnet cost estimate + margin
+            vb, _, vr = spec.partition(":")
+            # per-step work scales with batch; same iters -> same scaling
+            cost = base_cost * max(1.0, int(vb) / 64.0) + 30
+            if _remaining() < cost:
                 skipped.append("resnet50@%s" % spec)
                 continue
-            vb, _, vr = spec.partition(":")
             try:
                 v2, row2 = bench_resnet50(platform, dtype, batch=int(vb),
                                           remat=vr or "none")

@@ -35,6 +35,9 @@ _BINARY = {
 _BINARY_ALIASES = {
     "broadcast_add": ("broadcast_plus",),
     "broadcast_sub": ("broadcast_minus",),
+    # the reference's elemwise `mod` shares the broadcast kernel on XLA
+    # (`_mod` is separately registered below)
+    "broadcast_mod": ("mod",),
 }
 
 for _name, _jfn in _BINARY.items():
@@ -59,6 +62,21 @@ _COMPARE = {
     "broadcast_logical_xor": jnp.logical_xor,
 }
 
+# the reference exposes both elemwise and broadcast_* spellings of every
+# comparison/logical op (elemwise requires equal shapes — a strict subset
+# of broadcasting, so one XLA kernel serves both)
+_COMPARE_ALIASES = {
+    "broadcast_equal": ("equal", "_equal"),
+    "broadcast_not_equal": ("not_equal", "_not_equal"),
+    "broadcast_greater": ("greater", "_greater"),
+    "broadcast_greater_equal": ("greater_equal", "_greater_equal"),
+    "broadcast_lesser": ("lesser", "_lesser"),
+    "broadcast_lesser_equal": ("lesser_equal", "_lesser_equal"),
+    "broadcast_logical_and": ("logical_and",),
+    "broadcast_logical_or": ("logical_or",),
+    "broadcast_logical_xor": ("logical_xor",),
+}
+
 for _name, _jfn in _COMPARE.items():
 
     def _mkc(jfn):
@@ -67,7 +85,8 @@ for _name, _jfn in _COMPARE.items():
 
         return fn
 
-    register(_name, differentiable=False)(_mkc(_jfn))
+    register(_name, differentiable=False,
+             aliases=_COMPARE_ALIASES.get(_name, ()))(_mkc(_jfn))
 
 
 # elemwise_* (shape-equal) variants share impls with broadcast on XLA
@@ -327,3 +346,31 @@ def amp_multicast(*data, num_outputs=None):
     del num_outputs
     widest = jnp.result_type(*[d.dtype for d in data])
     return tuple(d.astype(widest) for d in data)
+
+
+@register("all_finite", differentiable=False)
+def all_finite(data, init_output=True):
+    """(1,)-shaped 1.0/0.0 flag: every element finite (ref:
+    src/operator/contrib/all_finite.cc — the gradient-overflow check
+    behind dynamic loss scaling). init_output keeps API parity; the
+    functional result is always freshly computed here."""
+    del init_output
+    return jnp.isfinite(data).all().reshape(1).astype(jnp.float32)
+
+
+@register("multi_all_finite", differentiable=False)
+def multi_all_finite(*arrays, num_arrays=None, init_output=True):
+    """all_finite over several arrays at once (ref: all_finite.cc —
+    MultiAllFinite; one fused check for a whole gradient set).
+    num_arrays defaults to the actual count; a mismatch raises — a
+    silently ignored gradient would hide an overflow from the loss
+    scaler."""
+    del init_output
+    if num_arrays is not None and num_arrays != len(arrays):
+        raise ValueError(
+            "multi_all_finite got %d arrays but num_arrays=%d"
+            % (len(arrays), num_arrays))
+    ok = jnp.bool_(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.isfinite(a).all())
+    return ok.reshape(1).astype(jnp.float32)

@@ -97,3 +97,44 @@ def block_grad(data):
 @register("identity", aliases=("_copy",))
 def identity(data):
     return data
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _svm_core(margin, reg_coef, use_linear):
+    def fwd(data, label):
+        return data, (data, label)
+
+    def bwd(res, ct):
+        del ct  # loss head: cotangent ignored, like SoftmaxOutput
+        data, label = res
+        n, k = data.shape
+        y = label.astype(jnp.int32)
+        x_y = jnp.take_along_axis(data, y[:, None], axis=1)  # (n, 1)
+        viol = (x_y - data) < margin  # margin violated per class
+        onehot = jax.nn.one_hot(y, k, dtype=data.dtype)
+        viol = jnp.logical_and(viol, onehot == 0)
+        if use_linear:  # L1-SVM: hinge
+            g = viol.astype(data.dtype) * reg_coef
+        else:  # L2-SVM: squared hinge (the reference default)
+            g = jnp.where(viol, 2.0 * reg_coef * (margin - (x_y - data)),
+                          0.0).astype(data.dtype)
+        g = g - onehot * g.sum(axis=1, keepdims=True)
+        return (g, jnp.zeros_like(label))
+
+    core = jax.custom_vjp(lambda data, label: fwd(data, label)[0])
+    core.defvjp(fwd, bwd)
+    return core
+
+
+@register("SVMOutput")
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """Multiclass SVM loss head (ref: src/operator/svm_output-inl.h):
+    identity forward; backward emits the (squared) hinge gradient —
+    for each class j != y with x_y - x_j < margin, push x_j down and
+    x_y up. use_linear selects L1-SVM; default is L2 (squared hinge)."""
+    return _svm_core(float(margin), float(regularization_coefficient),
+                     bool(use_linear))(data, label)

@@ -689,3 +689,32 @@ def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
     if stride1 > 1:
         out = out[:, :, ::stride1, ::stride1]
     return out
+
+
+@register("Crop", num_outputs=1)
+def crop_op(*args, num_args=1, offset=(0, 0), h_w=(0, 0),
+            center_crop=False):
+    """Spatial crop of NCHW data (ref: src/operator/crop.cc — the
+    FCN-era Crop op; `mx.nd.crop` is a different op, an alias of
+    `slice`). With num_args=2 the second input is a shape reference and
+    the output matches its (H, W); otherwise h_w gives the target size.
+    center_crop centers the window, else `offset` is its top-left
+    corner."""
+    data = args[0]
+    h, w = data.shape[2], data.shape[3]
+    if num_args == 2 or len(args) == 2:
+        th, tw = args[1].shape[2], args[1].shape[3]
+    else:
+        th, tw = h_w
+    if th > h or tw > w:
+        raise ValueError(
+            "crop size (%d, %d) exceeds input (%d, %d)" % (th, tw, h, w))
+    if center_crop:
+        y0, x0 = (h - th) // 2, (w - tw) // 2
+    else:
+        y0, x0 = offset
+        if y0 < 0 or x0 < 0 or y0 + th > h or x0 + tw > w:
+            raise ValueError(
+                "crop offset %s + size (%d, %d) outside input (%d, %d)"
+                % (offset, th, tw, h, w))
+    return data[:, :, y0:y0 + th, x0:x0 + tw]

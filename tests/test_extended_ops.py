@@ -213,3 +213,63 @@ def test_grid_generator_warp_gradient():
         -0.3, 0.3, (1, 2, 3, 4)).astype("f8")
     check_numeric_gradient(
         lambda f: nd.GridGenerator(f, transform_type="warp").sum(), [flow])
+
+
+def test_comparison_and_logical_elemwise_aliases():
+    a = nd.array(np.array([1.0, 2.0, 3.0], "f4"))
+    b = nd.array(np.array([2.0, 2.0, 1.0], "f4"))
+    np.testing.assert_array_equal(nd.equal(a, b).asnumpy(), [0, 1, 0])
+    np.testing.assert_array_equal(nd.not_equal(a, b).asnumpy(), [1, 0, 1])
+    np.testing.assert_array_equal(nd.greater(a, b).asnumpy(), [0, 0, 1])
+    np.testing.assert_array_equal(nd.greater_equal(a, b).asnumpy(),
+                                  [0, 1, 1])
+    np.testing.assert_array_equal(nd.lesser(a, b).asnumpy(), [1, 0, 0])
+    np.testing.assert_array_equal(nd.lesser_equal(a, b).asnumpy(),
+                                  [1, 1, 0])
+    x = nd.array(np.array([0.0, 1.0, 2.0], "f4"))
+    z = nd.array(np.array([0.0, 0.0, 3.0], "f4"))
+    np.testing.assert_array_equal(nd.logical_and(x, z).asnumpy(), [0, 0, 1])
+    np.testing.assert_array_equal(nd.logical_or(x, z).asnumpy(), [0, 1, 1])
+    np.testing.assert_array_equal(nd.logical_xor(x, z).asnumpy(), [0, 1, 0])
+    np.testing.assert_allclose(nd.mod(a, b).asnumpy(), [1, 0, 0])
+
+
+def test_all_finite_ops():
+    good = nd.array(np.ones((3, 3), "f4"))
+    bad = nd.array(np.array([[1.0, np.inf], [0.0, 1.0]], "f4"))
+    nan = nd.array(np.array([np.nan], "f4"))
+    assert nd.all_finite(good).asnumpy().tolist() == [1.0]
+    assert nd.all_finite(bad).asnumpy().tolist() == [0.0]
+    assert nd.all_finite(nan).asnumpy().tolist() == [0.0]
+    assert nd.multi_all_finite(good, good, num_arrays=2
+                               ).asnumpy().tolist() == [1.0]
+    assert nd.multi_all_finite(good, bad, num_arrays=2
+                               ).asnumpy().tolist() == [0.0]
+
+
+def test_crop_op_variants():
+    x = nd.array(np.arange(2 * 1 * 6 * 6, dtype="f4").reshape(2, 1, 6, 6))
+    like = nd.zeros((2, 1, 4, 4))
+    o = nd.Crop(x, like, num_args=2, center_crop=True)
+    np.testing.assert_array_equal(o.asnumpy(), x.asnumpy()[:, :, 1:5, 1:5])
+    o2 = nd.Crop(x, h_w=(3, 3), offset=(2, 1))
+    np.testing.assert_array_equal(o2.asnumpy(), x.asnumpy()[:, :, 2:5, 1:4])
+    with pytest.raises(ValueError):
+        nd.Crop(x, h_w=(7, 7))
+
+
+def test_svm_output_forward_identity_and_training():
+    # forward is identity; gradients push violating classes down
+    from mxnet_tpu import autograd as ag
+
+    x = nd.array(np.array([[2.0, 1.0, 0.0]], "f4"))
+    y = nd.array(np.array([0.0], "f4"))
+    out = nd.SVMOutput(x, y)
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+    xv = nd.array(np.array([[0.5, 1.0, 0.2]], "f4"))
+    xv.attach_grad()
+    with ag.record():
+        o = nd.SVMOutput(xv, y)
+        o.backward(nd.ones(o.shape))
+    g = xv.grad.asnumpy()[0]
+    assert g[1] > 0 and g[0] < 0  # violator pushed down, true class up

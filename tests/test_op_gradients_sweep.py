@@ -318,6 +318,8 @@ SPEC.update({
     # the 6x6 map AND between integer grid lines (bilinear kink-free)
     "BilinearSampler": ([_pos(1, 2, 6, 6), _unit(1, 2, 3, 3) * 0.15],
                         {}, None),
+    # spatial crop is a strided slice — gradient is a zero-padded scatter
+    "Crop": ([_any(1, 2, 5, 5)], dict(h_w=(3, 3), offset=(1, 1)), None),
     # contrib family
     "fft": ([_any(3, 8)], {}, None),
     "ifft": ([_any(3, 16)], {}, None),
@@ -410,6 +412,19 @@ def _implied_softmax(d, lbl):
     return -np.sum(np.log(p[np.arange(d.shape[0]), lbl.astype(int)]))
 
 
+def _implied_svm(d, lbl):
+    # L2-SVM (squared hinge), margin=1, C=1 — the SVMOutput defaults
+    y = lbl.astype(int)
+    total = 0.0
+    for i in range(d.shape[0]):
+        xy = d[i, y[i]]
+        for j in range(d.shape[1]):
+            if j != y[i]:
+                v = max(0.0, 1.0 - (xy - d[i, j]))
+                total += v * v
+    return total
+
+
 LOSS_HEADS = {
     "LinearRegressionOutput": (
         _any(3, 4), _any(3, 4), _implied_linear),
@@ -419,6 +434,8 @@ LOSS_HEADS = {
         _any(3, 4), _pos(3, 4) * 0.4, _implied_logistic),
     "SoftmaxOutput": (
         _any(4, 5), np.array([0.0, 2.0, 1.0, 4.0]), _implied_softmax),
+    "SVMOutput": (
+        _any(4, 5), np.array([0.0, 2.0, 1.0, 4.0]), _implied_svm),
 }
 
 
