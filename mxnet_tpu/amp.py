@@ -123,17 +123,27 @@ class LossScaler:
         self._unskipped = 0
 
     def has_overflow(self, params):
-        """True if any gradient is non-finite."""
-        import jax.numpy as jnp
+        """True if any gradient is non-finite. ONE fused device check +
+        one host read for the whole gradient set (ref: all_finite.cc —
+        MultiAllFinite; a per-parameter loop would pay a launch and a
+        full tunnel round-trip per parameter)."""
+        from .ndarray.ndarray import NDArray
+
+        arrs = []
         for p in params:
             g = p.grad()
             if hasattr(g, "_values"):  # row_sparse
-                arr = g._values
+                arrs.append(NDArray(g._values.data
+                                    if isinstance(g._values, NDArray)
+                                    else g._values))
             else:
-                arr = g.data if hasattr(g, "data") else g
-            if not bool(jnp.all(jnp.isfinite(arr.astype(jnp.float32)))):
-                return True
-        return False
+                arrs.append(g if isinstance(g, NDArray) else NDArray(g))
+        if not arrs:
+            return False
+        from . import nd
+
+        flag = nd.multi_all_finite(*arrs, num_arrays=len(arrs))
+        return float(flag.asnumpy()[0]) == 0.0
 
     def update_scale(self, overflow):
         if overflow:
