@@ -1,0 +1,227 @@
+"""Asynchronous parameter server — the ps-lite/hogwild analog
+(ref: src/kvstore/kvstore_dist_server.h — KVStoreDistServer::DataHandleEx
+async branch: each worker's push is applied to the server-side weight the
+moment it arrives, with NO cross-worker barrier; pulls return whatever
+the weight currently is).
+
+TPU-native placement note: synchronous data parallelism compiles into the
+training step as XLA collectives (parallel/sharded.py) — that path never
+touches this module. True ASYNC semantics cannot ride collectives (they
+are barriers by construction), so dist_async gets what the reference has:
+a parameter-server process. Here it is a thread inside worker 0 speaking
+length-prefixed pickles over TCP; the server address derives from the
+launcher's coordinator (MXT_COORDINATOR host, port + ASYNC_PORT_OFFSET).
+
+Asynchrony is BETWEEN WORKERS: no worker ever waits for another's push
+(the reference's async contract). Application at the server is
+serialized by a store lock, matching ps-lite's per-server customer
+thread, which handles one message at a time — "lock-free" in the
+reference describes the absence of worker-side barriers, not racy
+read-modify-write on the server. A push is fully applied before its
+ack, so each worker's own pushes are totally ordered.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+
+from .base import MXNetError
+
+ASYNC_PORT_OFFSET = 1717
+
+__all__ = ["AsyncParamServer", "AsyncClient", "server_address",
+           "get_server", "ASYNC_PORT_OFFSET"]
+
+_SERVERS = {}  # (host, port) -> AsyncParamServer (one bind per process)
+
+
+def get_server(host, port):
+    """Process-wide server singleton: re-creating a dist_async KVStore
+    must not re-bind the port (EADDRINUSE); a new store generation
+    RESETs the existing server instead."""
+    key = (host, port)
+    if key not in _SERVERS:
+        _SERVERS[key] = AsyncParamServer(host, port)
+    return _SERVERS[key]
+
+
+def server_address():
+    """host:port of the async server, derived from MXT_COORDINATOR."""
+    coord = os.environ.get("MXT_COORDINATOR")
+    if not coord or ":" not in coord:
+        return None
+    host, _, port = coord.rpartition(":")
+    return host, int(port) + ASYNC_PORT_OFFSET
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("!Q", len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("async kvstore peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = struct.unpack("!Q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class AsyncParamServer:
+    """Threaded TCP server holding weights + the server-side optimizer."""
+
+    def __init__(self, host, port):
+        self._store = {}     # key -> np.ndarray (the weight)
+        self._updater = None
+        self._mutate = threading.Lock()  # ps-lite customer-thread analog
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="kv-async-accept")
+        self._accept_thread.start()
+
+    # -- server side -------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # socket closed during shutdown
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True, name="kv-async-conn").start()
+
+    def _serve(self, conn):
+        from .ndarray.ndarray import NDArray
+        import numpy as np
+        import jax.numpy as jnp
+
+        try:
+            while True:
+                op, key, payload = _recv_msg(conn)
+                if isinstance(key, str) and key.isdigit():
+                    # the eager updater keys optimizer state and lr/wd
+                    # multipliers by int for digit keys (kvstore.py push)
+                    key = int(key)
+                if op == "reset":
+                    with self._mutate:
+                        self._store.clear()
+                        self._updater = None
+                    _send_msg(conn, ("ok", None))
+                elif op == "init":
+                    with self._mutate:
+                        # first writer wins (every worker sends its init)
+                        self._store.setdefault(key, np.array(payload))
+                    _send_msg(conn, ("ok", None))
+                elif op == "push":
+                    with self._mutate:
+                        w = self._store.get(key)
+                        if w is None:
+                            # first push initializes, like KVStoreLocal
+                            self._store[key] = np.array(payload)
+                            _send_msg(conn, ("ok", None))
+                            continue
+                        if self._updater is not None:
+                            w_nd = NDArray(jnp.asarray(w))
+                            self._updater(key,
+                                          NDArray(jnp.asarray(payload)),
+                                          w_nd)
+                            self._store[key] = np.asarray(w_nd.data)
+                        else:
+                            # replace semantics, matching the local
+                            # no-updater path (CopyFromTo(merged, &local))
+                            self._store[key] = np.array(payload)
+                    _send_msg(conn, ("ok", None))
+                elif op == "pull":
+                    w = self._store.get(key)
+                    if w is None:
+                        _send_msg(conn, ("err",
+                                         "key %r not initialized" % key))
+                    else:
+                        _send_msg(conn, ("ok", np.array(w)))
+                elif op == "set_optimizer":
+                    from . import optimizer as opt
+
+                    with self._mutate:
+                        self._updater = opt.get_updater(
+                            pickle.loads(payload))
+                    _send_msg(conn, ("ok", None))
+                elif op == "get_states":
+                    with self._mutate:
+                        blob = (self._updater.get_states(payload)
+                                if self._updater is not None else None)
+                    _send_msg(conn, ("ok", blob))
+                elif op == "set_states":
+                    with self._mutate:
+                        if self._updater is None:
+                            _send_msg(conn, ("err",
+                                             "no server-side optimizer"))
+                            continue
+                        self._updater.set_states(payload)
+                    _send_msg(conn, ("ok", None))
+                else:
+                    _send_msg(conn, ("err", "unknown op %r" % op))
+        except (ConnectionError, EOFError):
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class AsyncClient:
+    """One worker's connection to the async server."""
+
+    def __init__(self, host, port, timeout=30.0):
+        import time
+
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=timeout)
+                # connect-only timeout: a push ack can legitimately wait
+                # behind other workers applying serially; a recv timeout
+                # mid-frame would desync the length-prefixed protocol
+                self._sock.settimeout(None)
+                break
+            except OSError as e:  # server thread may not be up yet
+                last = e
+                time.sleep(0.2)
+        else:
+            raise MXNetError(
+                "cannot reach async kvstore server at %s:%d (%r)"
+                % (host, port, last))
+        self._lock = threading.Lock()
+
+    def request(self, op, key=None, payload=None):
+        with self._lock:
+            _send_msg(self._sock, (op, key, payload))
+            status, result = _recv_msg(self._sock)
+        if status != "ok":
+            raise MXNetError("async kvstore server error: %s" % result)
+        return result
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

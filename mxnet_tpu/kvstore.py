@@ -86,6 +86,30 @@ class KVStore:
         self._optimizer = None
         self._compression = None
         self._str_key_dict = {}
+        self._async = None         # AsyncClient when true async is active
+        self._async_server = None  # rank 0 owns the server thread
+        if kv_type == "dist_async":
+            self._maybe_start_async()
+
+    def _maybe_start_async(self):
+        """Engage the real hogwild parameter server (async_server.py) when
+        running multi-process under the launcher; single-process
+        dist_async keeps synchronous local semantics (create() warns)."""
+        from . import async_server
+
+        addr = async_server.server_address()
+        if addr is None or self.num_workers <= 1:
+            return
+        host, port = addr
+        if self.rank == 0:
+            # singleton per process; a fresh KVStore generation resets
+            # the server state (all ranks must create the store at the
+            # same program point, as with any collective construction)
+            self._async_server = async_server.get_server(host, port)
+            reset = async_server.AsyncClient(host, port)
+            reset.request("reset")
+            reset.close()
+        self._async = async_server.AsyncClient(host, port)
 
     # -- identity ----------------------------------------------------------
     @property
@@ -117,6 +141,13 @@ class KVStore:
     # -- core API ----------------------------------------------------------
     def init(self, key, value):
         keys, values = self._flatten(key, value)
+        if self._async is not None:
+            import numpy as np
+
+            for k, v in zip(keys, values):
+                arr = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+                self._async.request("init", k, arr)  # first writer wins
+            return
         for k, v in zip(keys, values):
             if k in self._store:
                 continue
@@ -165,6 +196,15 @@ class KVStore:
     def push(self, key, value, priority=0):
         del priority  # XLA async dispatch owns scheduling
         keys, values = self._flatten(key, value)
+        if self._async is not None:
+            # hogwild: this worker's contribution goes straight to the
+            # server (which applies it immediately) — no collective, no
+            # barrier with other workers (ref: DataHandleEx async branch)
+            for k, v in zip(keys, values):
+                merged = self._merge(v)
+                merged = self._maybe_compress(k, merged)
+                self._async.request("push", k, merged.asnumpy())
+            return
         for k, v in zip(keys, values):
             merged = self._merge(v)
             if self._type.startswith("dist"):
@@ -202,14 +242,22 @@ class KVStore:
 
         keys, outs = self._flatten(key, out)
         for k, o in zip(keys, outs):
-            if k not in self._store:
-                raise MXNetError("key %s has not been initialized" % (k,))
-            src = self._store[k]
             targets = o if isinstance(o, (list, tuple)) else [o]
-            for oo in targets:
+            if ignore_sparse:
+                live = [oo for oo in targets
+                        if not isinstance(oo, BaseSparseNDArray)]
+            else:
+                live = list(targets)
+            if not live:
+                continue  # nothing to write — skip the (network) fetch
+            if self._async is not None:
+                src = NDArray(self._async.request("pull", k))
+            elif k in self._store:
+                src = self._store[k]
+            else:
+                raise MXNetError("key %s has not been initialized" % (k,))
+            for oo in live:
                 if isinstance(oo, BaseSparseNDArray):
-                    if ignore_sparse:
-                        continue
                     cast_storage(src, oo.stype).copyto(oo)
                 else:
                     src.copyto(oo)
@@ -235,9 +283,13 @@ class KVStore:
         from .sparse import retain_rows
 
         for k, o, r in zip(keys, outs, rids):
-            if k not in self._store:
+            if self._async is not None:
+                src = NDArray(self._async.request("pull", k))
+            elif k in self._store:
+                src = self._store[k]
+            else:
                 raise MXNetError("key %s has not been initialized" % (k,))
-            retain_rows(self._store[k], r, out=o)
+            retain_rows(src, r, out=o)
 
     # -- optimizer plumbing ------------------------------------------------
     def set_optimizer(self, optimizer):
@@ -247,6 +299,12 @@ class KVStore:
         # serializable (catches the same bugs the reference would)
         self._optimizer = pickle.loads(pickle.dumps(optimizer))
         self._updater = opt.get_updater(self._optimizer)
+        if self._async is not None and self.rank == 0:
+            # only rank 0 ships it (ref: kvstore_dist.cc — SendCommandTo
+            # servers from worker 0); a later arrival from another rank
+            # would replace the live updater and wipe its state
+            self._async.request("set_optimizer", None,
+                                pickle.dumps(optimizer))
 
     def set_gradient_compression(self, compression_params):
         """2-bit gradient compression with error-feedback residual
@@ -277,14 +335,27 @@ class KVStore:
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("optimizer is not set on this kvstore")
+        if self._async is not None:
+            # the LIVE states are on the server thread, not the local
+            # (never-invoked) updater
+            blob = self._async.request("get_states", None, dump_optimizer)
+            if blob is None:
+                raise MXNetError("async server has no optimizer states")
+        else:
+            blob = self._updater.get_states(dump_optimizer)
         with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+            f.write(blob)
 
     def load_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("optimizer is not set on this kvstore")
         with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            blob = f.read()
+        if self._async is not None:
+            if self.rank == 0:
+                self._async.request("set_states", None, blob)
+        else:
+            self._updater.set_states(blob)
 
     def _barrier(self):
         if self.num_workers > 1:
@@ -308,21 +379,22 @@ def create(name="local"):
     if name == "horovod":
         # horovod's allreduce role is played by the same XLA collectives
         name = "device"
-    if name == "dist_async":
-        # the reference's async mode is lock-free hogwild on the server
-        # (ref: kvstore_dist_server.h — DataHandleEx async branch); XLA
-        # collectives have no pod-native analog, so pushes here are
-        # collectively reduced = synchronous semantics. Loud once, so a
-        # ported async training script knows its staleness model changed.
+    kv = KVStore(name)
+    if name == "dist_async" and kv._async is None:
+        # multi-process dist_async gets the REAL hogwild parameter server
+        # (async_server.py, ref: kvstore_dist_server.h — DataHandleEx
+        # async branch). Without the launcher's coordinator (single
+        # process) pushes reduce synchronously instead — loud once, so a
+        # ported async script knows its staleness model changed.
         global _warned_async
         if not _warned_async:
             import warnings
 
             warnings.warn(
-                "kvstore 'dist_async' runs with SYNCHRONOUS semantics on "
-                "this backend: pushes are collective psum reductions, not "
-                "hogwild server-side updates. Convergence behavior matches "
-                "dist_sync, not the reference's async mode.",
+                "kvstore 'dist_async' without a multi-process launcher "
+                "runs with SYNCHRONOUS semantics: pushes reduce "
+                "collectively, not via hogwild server-side updates. Run "
+                "under tools/launch.py for the reference's async mode.",
                 UserWarning, stacklevel=2)
             _warned_async = True
-    return KVStore(name)
+    return kv

@@ -1,0 +1,102 @@
+"""Worker script for the multi-process dist_async test (models the async
+section of tests/nightly/dist_async_kvstore.py): every worker pushes its
+own gradients with NO barrier; the rank-0 parameter-server thread applies
+each push on arrival (hogwild), and after an explicit cross-worker sync
+every worker's pull observes ALL updates.
+
+Run: python tools/launch.py -n 2 --launcher local \
+         python tests/dist/dist_async_kvstore.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+
+
+def _barrier():
+    """Cross-process rendezvous (the test needs a 'everyone pushed'
+    point; REAL training would not barrier — that is the async point)."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("dist_async_test")
+
+
+def main():
+    mx.parallel.init_distributed()
+    kv = mx.kv.create("dist_async")
+    rank, nw = kv.rank, kv.num_workers
+    assert kv._async is not None, "async server did not engage"
+
+    # 1) server-side SGD: each worker pushes (rank+1) gradients of ones;
+    # with lr=1 the weight ends at -(total pushes) exactly (each push is
+    # applied once, acked before the next — per-worker total order)
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    kv.init("w", nd.zeros((3, 2)))
+    for _ in range(rank + 1):
+        kv.push("w", nd.ones((3, 2)))
+    _barrier()  # test-only: wait until every worker's pushes are acked
+    out = nd.zeros((3, 2))
+    kv.pull("w", out=out)
+    total = sum(r + 1 for r in range(nw))
+    np.testing.assert_allclose(out.asnumpy(), -float(total), rtol=1e-6)
+
+    # 2) NO-barrier staleness: a worker's pull immediately after its own
+    # push must already reflect that push (server applies on arrival)
+    kv.init("v", nd.zeros((2,)))
+    kv.push("v", nd.ones((2,)) * (rank + 1))
+    mine = nd.zeros((2,))
+    kv.pull("v", out=mine)
+    assert float(mine.asnumpy()[0]) <= -(rank + 1) + 1e-6  # mine applied
+    _barrier()
+
+    # 3) accumulate mode (no optimizer on this key's server... same
+    # server; push after set_optimizer applies SGD — verify pulls agree
+    # across workers after the barrier
+    final = nd.zeros((2,))
+    kv.pull("v", out=final)
+    exp = -float(sum(r + 1 for r in range(nw)))
+    np.testing.assert_allclose(final.asnumpy(), exp, rtol=1e-6)
+
+    # 4) optimizer states live on the SERVER; save fetches them there
+    if rank == 0:
+        import tempfile
+
+        f = tempfile.NamedTemporaryFile(delete=False)
+        kv.save_optimizer_states(f.name, dump_optimizer=True)
+        assert os.path.getsize(f.name) > 0
+        kv.load_optimizer_states(f.name)
+        os.unlink(f.name)
+    _barrier()
+
+    # 5) store re-creation: no EADDRINUSE, fresh state after reset
+    kv2 = mx.kv.create("dist_async")
+    _barrier()  # reset (rank 0, inside create) before anyone inits
+    kv2.init("z", nd.ones((2,)))
+    out2 = nd.zeros((2,))
+    kv2.pull("z", out=out2)
+    np.testing.assert_allclose(out2.asnumpy(), 1.0)
+    # no optimizer on the fresh generation: push REPLACES (CopyFromTo)
+    kv2.push("z", nd.full((2,), 5.0 + rank))
+    _barrier()
+    kv2.pull("z", out=out2)
+    assert out2.asnumpy()[0] in [5.0 + r for r in range(nw)]
+    # first push to an uninitialized key initializes it
+    kv2.push("fresh%d" % rank, nd.full((2,), 2.0))
+    got = nd.zeros((2,))
+    kv2.pull("fresh%d" % rank, out=got)
+    np.testing.assert_allclose(got.asnumpy(), 2.0)
+
+    print("ASYNC_PASS rank=%d/%d" % (rank, nw), flush=True)
+
+
+if __name__ == "__main__":
+    main()

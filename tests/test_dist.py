@@ -36,3 +36,18 @@ def test_launcher_cli_errors():
         capture_output=True, text=True, timeout=60)
     assert r.returncode != 0
     assert "no command" in r.stderr
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_dist_async_kvstore_hogwild(n):
+    """dist_async under the launcher engages the REAL parameter-server
+    thread (async_server.py): pushes apply on arrival with no barrier."""
+    env = dict(os.environ)
+    env.pop("MXT_COORDINATOR", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", str(n), "--launcher", "local", sys.executable,
+         os.path.join(ROOT, "tests", "dist", "dist_async_kvstore.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert r.stdout.count("ASYNC_PASS") == n, r.stdout[-2000:]
