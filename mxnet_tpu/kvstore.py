@@ -11,11 +11,16 @@ buffers and the optional server-side updater. Types:
   pushed values per key are summed (the reference reduces across GPUs; here
   a sharded batch already arrives pre-reduced by psum, and list pushes are
   summed with one fused XLA add-n).
-- ``dist_sync`` / ``dist_device_sync`` / ``dist_async``: multi-process via
+- ``dist_sync`` / ``dist_device_sync``: multi-process via
   ``jax.distributed`` (see parallel/). Push triggers a cross-process psum of
   the gradient; semantics of sync mode (all workers see identical weights)
-  hold because the reduction is collective. ``dist_async`` has no pod-native
-  analog (SURVEY §5) — it is accepted and behaves synchronously, documented.
+  hold because the reduction is collective.
+- ``dist_async``: under the launcher this is the reference's REAL async
+  mode — a parameter-server thread on worker 0 (async_server.py) applies
+  each worker's push on arrival with no cross-worker barrier (ref:
+  kvstore_dist_server.h — DataHandleEx async branch). Async cannot ride
+  XLA collectives (collectives ARE barriers), hence the server. Without
+  the launcher it falls back to synchronous semantics with a warning.
 
 ``set_optimizer`` installs an Updater so ``push`` applies updates
 server-side (update_on_kvstore=True path), exactly like
