@@ -95,6 +95,37 @@ def main():
     kv2.pull("fresh%d" % rank, out=got)
     np.testing.assert_allclose(got.asnumpy(), 2.0)
 
+    # 6) the canonical Trainer loop over the async store: each worker
+    # trains at its own pace (update_on_kvstore: push grad, server
+    # applies, pull weight back) — the reference's async training shape
+    _barrier()
+    kv3 = mx.kv.create("dist_async")
+    _barrier()  # reset before anyone registers params
+    from mxnet_tpu import autograd as ag
+    from mxnet_tpu import gluon
+
+    mx.random.seed(11)  # same init everywhere; server keeps rank 0's
+    net = gluon.nn.Dense(2, in_units=3, prefix="anet_")
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore=kv3)
+    rng = np.random.RandomState(300 + rank)
+    for _ in range(3 + rank):  # deliberately different step counts
+        x = nd.array(rng.normal(size=(4, 3)).astype("f4"))
+        with ag.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        trainer.step(4)
+    assert trainer._update_on_kvstore is True
+    _barrier()  # all pushes applied; now every pull must agree
+    w_final = nd.zeros(net.weight.data().shape)
+    kv3.pull(0, out=w_final)
+    from mxnet_tpu.parallel.sharded import allreduce_across_processes
+    mean_w = allreduce_across_processes(
+        nd.array(w_final.asnumpy() / nw)).asnumpy()
+    np.testing.assert_allclose(w_final.asnumpy(), mean_w,
+                               rtol=1e-5, atol=1e-6)
+
     print("ASYNC_PASS rank=%d/%d" % (rank, nw), flush=True)
 
 
