@@ -43,7 +43,7 @@ class _FusedUpdate:
     save_states/load_states round-trip unchanged.
     """
 
-    _SUPPORTED = ("SGD", "NAG", "Adam", "AdamW")
+    _SUPPORTED = ("SGD", "NAG", "Adam", "AdamW", "RMSProp", "AdaGrad")
 
     @staticmethod
     def eligible(trainer):
@@ -129,6 +129,41 @@ class _FusedUpdate:
                 def upd(w, g, s, t, lr, wd, rescale):
                     return fn(w, g, lr=lr * lr_mult, wd=wd * wd_mult,
                               rescale_grad=rescale, clip_gradient=clip), ()
+        elif name == "RMSProp":
+            gamma1, gamma2, eps = o.gamma1, o.gamma2, o.epsilon
+            clip_w = o.clip_weights
+            if o.centered:
+                fn = get_op("rmspropalex_update").fn
+
+                def upd(w, g, s, t, lr, wd, rescale):
+                    w2, n2, g2, d2 = fn(
+                        w, g, s[0], s[1], s[2], lr=lr * lr_mult,
+                        gamma1=gamma1, gamma2=gamma2, epsilon=eps,
+                        wd=wd * wd_mult, rescale_grad=rescale,
+                        clip_gradient=clip, clip_weights=clip_w)
+                    return w2, (n2, g2, d2)
+            else:
+                fn = get_op("rmsprop_update").fn
+
+                def upd(w, g, s, t, lr, wd, rescale):
+                    w2, n2 = fn(w, g, s[0], lr=lr * lr_mult,
+                                gamma1=gamma1, epsilon=eps,
+                                wd=wd * wd_mult, rescale_grad=rescale,
+                                clip_gradient=clip, clip_weights=clip_w)
+                    return w2, (n2,)
+        elif name == "AdaGrad":
+            eps = o.float_stable_eps
+
+            def upd(w, g, s, t, lr, wd, rescale):
+                # mirror the eager python update exactly (optimizer.py —
+                # AdaGrad.update dense branch)
+                g = g * rescale
+                if clip is not None:
+                    g = jnp.clip(g, -clip, clip)
+                g = g + (wd * wd_mult) * w
+                s2 = s[0] + g * g
+                w2 = w - (lr * lr_mult) * g / (jnp.sqrt(s2) + eps)
+                return w2.astype(w.dtype), (s2,)
         else:  # Adam / AdamW — bias correction folded into lr, as eager
             beta1, beta2, eps = o.beta1, o.beta2, o.epsilon
             if name == "Adam":

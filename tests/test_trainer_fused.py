@@ -51,6 +51,9 @@ def _weights(net):
     ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
     ("adam", {"learning_rate": 1e-2}),
     ("adamw", {"learning_rate": 1e-2, "wd": 1e-2}),
+    ("rmsprop", {"learning_rate": 1e-3}),
+    ("rmsprop", {"learning_rate": 1e-3, "centered": True}),
+    ("adagrad", {"learning_rate": 0.05, "wd": 1e-4}),
 ])
 def test_fused_matches_eager(monkeypatch, optimizer, opt_params):
     net_f = _make_net()
@@ -145,8 +148,8 @@ def test_fused_save_load_states_roundtrip(tmp_path):
 
 def test_fused_ineligible_falls_back():
     net = _make_net()
-    # rmsprop has no fused builder — must run eager and still train
-    tr = Trainer(net.collect_params(), "rmsprop", {"learning_rate": 1e-3})
+    # adadelta has no fused builder — must run eager and still train
+    tr = Trainer(net.collect_params(), "adadelta", {"learning_rate": 1.0})
     losses = _train(net, tr)
     assert tr._fused is False
     assert np.isfinite(losses[-1])
