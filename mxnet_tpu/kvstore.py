@@ -108,12 +108,16 @@ class KVStore:
         host, port = addr
         if self.rank == 0:
             # singleton per process; a fresh KVStore generation resets
-            # the server state (all ranks must create the store at the
-            # same program point, as with any collective construction)
+            # the server state
             self._async_server = async_server.get_server(host, port)
             reset = async_server.AsyncClient(host, port)
             reset.request("reset")
             reset.close()
+        # rendezvous (ps-lite init is one too): nobody talks to the
+        # server until rank 0's reset is acked, so a fast worker can't
+        # have its init wiped (and then have a first PUSH take the
+        # first-push-initializes branch with a gradient as the weight)
+        self._barrier()
         self._async = async_server.AsyncClient(host, port)
 
     # -- identity ----------------------------------------------------------
@@ -304,12 +308,18 @@ class KVStore:
         # serializable (catches the same bugs the reference would)
         self._optimizer = pickle.loads(pickle.dumps(optimizer))
         self._updater = opt.get_updater(self._optimizer)
-        if self._async is not None and self.rank == 0:
+        if self._async is not None:
             # only rank 0 ships it (ref: kvstore_dist.cc — SendCommandTo
             # servers from worker 0); a later arrival from another rank
-            # would replace the live updater and wipe its state
-            self._async.request("set_optimizer", None,
-                                pickle.dumps(optimizer))
+            # would replace the live updater and wipe its state. The
+            # barrier makes this collective (like the reference, every
+            # worker calls set_optimizer): no rank can push a gradient
+            # before the server has its optimizer — an optimizer-less
+            # push would REPLACE the weight instead of updating it.
+            if self.rank == 0:
+                self._async.request("set_optimizer", None,
+                                    pickle.dumps(optimizer))
+            self._barrier()
 
     def set_gradient_compression(self, compression_params):
         """2-bit gradient compression with error-feedback residual
@@ -364,10 +374,9 @@ class KVStore:
 
     def _barrier(self):
         if self.num_workers > 1:
-            import jax
+            from jax.experimental import multihost_utils
 
-            jax.experimental.multihost_utils.sync_global_devices(
-                "kvstore_barrier")
+            multihost_utils.sync_global_devices("kvstore_barrier")
 
 
 _KV_TYPES = ("local", "device", "nccl", "dist", "dist_sync", "dist_async",

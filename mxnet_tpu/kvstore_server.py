@@ -1,12 +1,15 @@
 """kvstore server-role entry (ref: python/mxnet/kvstore_server.py — the
 process that blocks in MXKVStoreRunServer under DMLC_ROLE=server).
 
-The TPU build has no parameter-server role by design: gradient exchange
-is compiled into the training step as XLA collectives over ICI/DCN
-(SURVEY §2.4 — the worker/server topology collapses into SPMD), and
-``tools/launch.py`` starts only workers. This module keeps the import
-surface so reference launch scripts fail with an explanation instead of
-an ImportError.
+The TPU build has no SEPARATE parameter-server process role: synchronous
+gradient exchange is compiled into the training step as XLA collectives
+over ICI/DCN (SURVEY §2.4 — the worker/server topology collapses into
+SPMD), and ``tools/launch.py`` starts only workers. The one surface that
+does need a server — ``dist_async`` hogwild — runs as a THREAD inside
+worker 0 (see async_server.py), so there is still nothing to launch on a
+dedicated server node. This module keeps the import surface so
+reference-style launches fail with an explanation instead of an
+ImportError.
 """
 from __future__ import annotations
 
@@ -22,10 +25,11 @@ class KVStoreServer:
 
     def __init__(self, kvstore):
         raise MXNetError(
-            "the TPU build has no parameter-server role: dist training "
-            "uses SPMD collectives compiled into the step (see "
-            "parallel.ShardedTrainStep and tools/launch.py). Launch "
-            "workers only — there is nothing to run on a server node.")
+            "the TPU build has no separate parameter-server process: "
+            "sync dist training uses SPMD collectives compiled into the "
+            "step (parallel.ShardedTrainStep), and dist_async's hogwild "
+            "server runs as a thread inside worker 0 (async_server.py). "
+            "Launch workers only — nothing runs on a server node.")
 
     def run(self):  # pragma: no cover - unreachable (init raises)
         raise NotImplementedError

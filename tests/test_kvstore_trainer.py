@@ -274,7 +274,8 @@ def test_kvstore_server_role_explains_design(monkeypatch):
     from mxnet_tpu import kvstore_server
     from mxnet_tpu.base import MXNetError
 
-    with pytest.raises(MXNetError, match="no parameter-server role"):
+    with pytest.raises(MXNetError,
+                       match="no separate parameter-server process"):
         kvstore_server.KVStoreServer(None)
     monkeypatch.setenv("DMLC_ROLE", "server")
     with pytest.raises(MXNetError, match="workers only"):
