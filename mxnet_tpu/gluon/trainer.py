@@ -33,8 +33,9 @@ class _FusedUpdate:
     static_alloc analog ShardedTrainStep already uses (parallel/sharded.py)
     brought to the canonical path.
 
-    Eligible: optimizer is exactly SGD/NAG/Adam/AdamW, dense gradients, no
-    multi_precision, and no distributed/server-side kvstore. Anything else
+    Eligible: optimizer class in _SUPPORTED (sgd/nag/adam/adamw/rmsprop/
+    adagrad), dense gradients, no multi_precision, and no
+    distributed/server-side kvstore. Anything else
     falls back to the eager per-parameter updater (same numerics, more
     launches). Dynamic scalars (scheduler lr, wd, rescale_grad, step t)
     enter as traced 0-d arguments so no step ever retraces; per-parameter

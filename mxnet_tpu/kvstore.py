@@ -243,6 +243,15 @@ class KVStore:
                 else:
                     merged.copyto(tgt)
 
+    def _fetch(self, k):
+        """Current value of a key: from the async server in hogwild mode,
+        else the local store."""
+        if self._async is not None:
+            return NDArray(self._async.request("pull", k))
+        if k in self._store:
+            return self._store[k]
+        raise MXNetError("key %s has not been initialized" % (k,))
+
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """ref: KVStore::Pull — with ignore_sparse (the default), sparse
         outs are skipped and must use row_sparse_pull instead."""
@@ -259,12 +268,7 @@ class KVStore:
                 live = list(targets)
             if not live:
                 continue  # nothing to write — skip the (network) fetch
-            if self._async is not None:
-                src = NDArray(self._async.request("pull", k))
-            elif k in self._store:
-                src = self._store[k]
-            else:
-                raise MXNetError("key %s has not been initialized" % (k,))
+            src = self._fetch(k)
             for oo in live:
                 if isinstance(oo, BaseSparseNDArray):
                     cast_storage(src, oo.stype).copyto(oo)
@@ -292,13 +296,7 @@ class KVStore:
         from .sparse import retain_rows
 
         for k, o, r in zip(keys, outs, rids):
-            if self._async is not None:
-                src = NDArray(self._async.request("pull", k))
-            elif k in self._store:
-                src = self._store[k]
-            else:
-                raise MXNetError("key %s has not been initialized" % (k,))
-            retain_rows(src, r, out=o)
+            retain_rows(self._fetch(k), r, out=o)
 
     # -- optimizer plumbing ------------------------------------------------
     def set_optimizer(self, optimizer):

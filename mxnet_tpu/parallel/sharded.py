@@ -176,6 +176,7 @@ class ShardedTrainStep:
         # device-resident step counter, carried/donated through the jit
         self._t_dev = jnp.zeros((), jnp.int32)
         self._batch_cache = {}
+        self._aot_compiled = {}  # (x sig, y sig) -> compiled (see _compile)
         self._jit = self._build()
 
     # ------------------------------------------------------------------
@@ -298,12 +299,10 @@ class ShardedTrainStep:
             return tuple(d.shape), str(d.dtype)
 
         key = (sig(x), sig(y))
-        cache = getattr(self, "_aot_compiled", None)
-        if cache is None:
-            cache = self._aot_compiled = {}
-        if key not in cache:
-            cache[key] = (lowered or self._lower(x, y)).compile()
-        return cache[key]
+        if key not in self._aot_compiled:
+            self._aot_compiled[key] = \
+                (lowered or self._lower(x, y)).compile()
+        return self._aot_compiled[key]
 
     def flops_per_step(self, x, y):
         """Total FLOPs of one compiled step per XLA cost analysis, or None
