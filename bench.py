@@ -446,6 +446,8 @@ def bench_lstm_ptb(platform, dtype):
     row = {
         "config": "lstm_ptb_train", "chips": 1, "batch_size": batch,
         "seq_len": seq_len, "dtype": dtype,
+        "wavefront": bool(__import__("mxnet_tpu").config.get(
+            "MXT_RNN_WAVEFRONT")),
         "images_or_tokens_per_sec_per_chip": round(tok_s, 2),
         "mfu": _mfu(tok_s, flops_per_tok, platform), "platform": platform,
         "flops_per_sample": flops_per_tok,

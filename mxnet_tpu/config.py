@@ -61,6 +61,12 @@ _declare("MXT_FUSED_TRAINER", bool, True,
          "grads, no dist kvstore). 0 falls back to eager per-param "
          "updates.")
 
+_declare("MXT_RNN_WAVEFRONT", bool, False,
+         "Run multi-layer unidirectional LSTM as a diagonal wavefront: "
+         "all layers' recurrent gemms batch into one einsum per diagonal "
+         "(serial chain T+L-1 instead of L*T). Off until measured on "
+         "chip; numerics identical to the sequential path.")
+
 _declare("MXT_RNN_UNROLL", int, None,
          "Unroll factor for the fused-RNN recurrent scan (0 disables "
          "unrolling; unset = auto: full unroll up to T=128, else 16). "

@@ -127,6 +127,80 @@ def _run_layer(x, mode, wi, wh, bi, bh, h0, c0, reverse=False):
     return carry, outs
 
 
+def _wavefront_lstm(x, ws, bs, state, state_cell, num_layers):
+    """Multi-layer LSTM as a diagonal WAVEFRONT (MXT_RNN_WAVEFRONT=1).
+
+    The standard path runs layer scans sequentially: the serial chain is
+    num_layers * T small (B, H)@(H, 4H) matmuls, each latency-bound at
+    small batch (PERF.md round-4 LSTM ceiling analysis). At diagonal step
+    d, layer l processes t = d - l, so every active layer's recurrent
+    gemm is INDEPENDENT — they batch into one (A, B, 2H)@(A, 2H, 4H)
+    einsum per diagonal. Chain length drops from L*T to T + L - 1 at the
+    cost of zero-padding layer 0's unused input half (latency-bound
+    segments, so the padded FLOPs are ~free).
+
+    Unidirectional, no inter-layer dropout, T small enough to unroll —
+    the caller gates on that. Numerically equivalent to the sequential
+    path up to FP reduction order (the fused [h,x]@[Wh;Wi] contraction
+    sums over one axis); pinned at rtol 1e-6 by the
+    tests/test_gluon_rnn.py equivalence test."""
+    T, B, _ = x.shape
+    L = num_layers
+    H = ws[0][0][1].shape[1]
+
+    # layer 0's input projection hoists into one big gemm, as before
+    wi0, wh0 = ws[0][0]
+    bi0, bh0 = bs[0][0]
+    gates_x0 = jnp.einsum("tbi,gi->tbg", x, wi0) + bi0 + bh0  # (T, B, 4H)
+
+    # per-layer stacked weights: operand is [h_prev, x_in] (B, 2H) ->
+    # weight [Wh ; Wi] (4H, 2H); layer 0's x half is zero (its x term is
+    # the precomputed gates_x0)
+    wcat, bias = [], []
+    for l in range(L):
+        wi, wh = ws[l][0]
+        bi, bh = bs[l][0]
+        if l == 0:
+            wcat.append(jnp.concatenate(
+                [wh0, jnp.zeros((wh0.shape[0], H), wh0.dtype)], axis=1))
+            bias.append(jnp.zeros_like(bi0))  # biases live in gates_x0
+        else:
+            wcat.append(jnp.concatenate([wh, wi], axis=1))
+            bias.append(bi + bh)
+    wcat = jnp.stack(wcat)          # (L, 4H, 2H)
+    bias = jnp.stack(bias)          # (L, 4H)
+
+    h = [state[l] for l in range(L)]
+    c = [state_cell[l] for l in range(L)]
+    outs = []
+    for d in range(T + L - 1):
+        lo, hi = max(0, d - T + 1), min(L - 1, d)
+        # layer l's input at this diagonal is layer l-1's output from
+        # the PREVIOUS diagonal — which is exactly h[l-1] right now
+        ops = jnp.stack([
+            jnp.concatenate(
+                [h[l], h[l - 1] if l > 0 else jnp.zeros_like(h[0])],
+                axis=-1)
+            for l in range(lo, hi + 1)])             # (A, B, 2H)
+        gates = jnp.einsum("abe,afe->abf", ops, wcat[lo:hi + 1]) \
+            + bias[lo:hi + 1][:, None, :]            # (A, B, 4H)
+        if lo == 0:  # layer 0 active at t = d: add its hoisted x gates
+            gates = gates.at[0].add(gates_x0[d])
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
+                   jax.nn.sigmoid(o))
+        g = jnp.tanh(g)
+        cs = jnp.stack([c[l] for l in range(lo, hi + 1)])
+        c2 = f * cs + i * g
+        h2 = o * jnp.tanh(c2)
+        for a, l in enumerate(range(lo, hi + 1)):
+            h[l], c[l] = h2[a], c2[a]
+        if hi == L - 1:  # final layer produced y_{L-1, d-(L-1)}
+            outs.append(h[L - 1])
+    out = jnp.stack(outs)                            # (T, B, H)
+    return out, jnp.stack(h), jnp.stack(c)
+
+
 @register("RNN", num_outputs=3)
 def rnn_op(data, parameters, state, state_cell=None, mode="lstm",
            state_size=0, num_layers=1, bidirectional=False, p=0.0,
@@ -141,6 +215,13 @@ def rnn_op(data, parameters, state, state_cell=None, mode="lstm",
     h = state_size
     input_size = data.shape[2]
     ws, bs = _unpack(parameters, mode, input_size, h, num_layers, bidirectional)
+
+    from .. import config as _config
+
+    if (mode == "lstm" and d == 1 and num_layers >= 2
+            and data.shape[0] <= 128 and (p == 0 or not train_mode)
+            and _config.get("MXT_RNN_WAVEFRONT")):
+        return _wavefront_lstm(data, ws, bs, state, state_cell, num_layers)
 
     x = data
     h_finals, c_finals = [], []

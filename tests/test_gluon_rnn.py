@@ -303,3 +303,48 @@ def test_rnn_layer_in_training_loop():
         if first is None:
             first = cur
     assert cur < first * 0.5, (first, cur)
+
+
+def test_lstm_wavefront_matches_sequential(monkeypatch):
+    """MXT_RNN_WAVEFRONT=1 runs multi-layer LSTM as a diagonal wavefront
+    (ops/rnn.py _wavefront_lstm); outputs, final states, and the whole
+    training step must match the sequential path bit-for-bit in f32."""
+    import numpy as np
+
+    from mxnet_tpu import autograd as ag
+
+    from mxnet_tpu.ops import rnn as rnn_ops
+
+    calls = []
+    real_wf = rnn_ops._wavefront_lstm
+
+    def spy(*args, **kw):
+        calls.append(1)
+        return real_wf(*args, **kw)
+
+    monkeypatch.setattr(rnn_ops, "_wavefront_lstm", spy)
+
+    def run(env):
+        if env:
+            monkeypatch.setenv("MXT_RNN_WAVEFRONT", "1")
+        else:
+            monkeypatch.delenv("MXT_RNN_WAVEFRONT", raising=False)
+        mx.random.seed(3)
+        net = rnn.LSTM(hidden_size=8, num_layers=3, layout="NTC",
+                       prefix="wf_%d_" % env)
+        net.initialize()
+        x = nd.array(np.random.RandomState(0).uniform(
+            -1, 1, (4, 6, 5)).astype("f4"))
+        x.attach_grad()
+        with ag.record():
+            out = net(x)
+            loss = (out ** 2).sum()
+        loss.backward()
+        return out.asnumpy(), x.grad.asnumpy()
+
+    out_seq, g_seq = run(0)
+    assert not calls  # sequential run must not dispatch the wavefront
+    out_wf, g_wf = run(1)
+    assert calls, "MXT_RNN_WAVEFRONT=1 did not dispatch _wavefront_lstm"
+    np.testing.assert_allclose(out_wf, out_seq, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(g_wf, g_seq, rtol=1e-5, atol=1e-6)

@@ -29,6 +29,12 @@ run lstm128 600 env BENCH_CONFIGS=lstm_ptb BENCH_LSTM_BATCH=128 \
 run lstm256 600 env BENCH_CONFIGS=lstm_ptb BENCH_LSTM_BATCH=256 \
     BENCH_BUDGET=500 python bench.py
 
+# 3a') LSTM wavefront A/B at the parity config (serial-chain lever)
+run lstm_wavefront 600 env BENCH_CONFIGS=lstm_ptb MXT_RNN_WAVEFRONT=1 \
+    BENCH_BUDGET=500 python bench.py
+run lstm_wf128 600 env BENCH_CONFIGS=lstm_ptb MXT_RNN_WAVEFRONT=1 \
+    BENCH_LSTM_BATCH=128 BENCH_BUDGET=500 python bench.py
+
 # 3b) BERT through the canonical Gluon loop (fused donated Trainer.step)
 run bert_gluon 900 env BENCH_CONFIGS=bert BENCH_BERT_PATH=trainer \
     BENCH_BUDGET=800 python bench.py
