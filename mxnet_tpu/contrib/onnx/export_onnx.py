@@ -256,6 +256,88 @@ def _embedding(b, node, ins, outs):
     b.node("Gather", [ins[1], idx], outs, node.name)
 
 
+def _deconv(b, node, ins, outs):
+    at = node.attrs
+    kernel = _tuple(at.get("kernel"))
+    adj = at.get("adj")
+    b.node("ConvTranspose", ins, outs, node.name,
+           kernel_shape=kernel,
+           strides=_tuple(at.get("stride"), len(kernel)),
+           dilations=_tuple(at.get("dilate"), len(kernel)),
+           pads=_pads(at.get("pad"), len(kernel)),
+           output_padding=_tuple(adj, len(kernel)) if adj else None,
+           group=int(at.get("num_group", 1)))
+
+
+def _clip(b, node, ins, outs):
+    # opset-13 Clip takes min/max as INPUTS, not attributes
+    at = node.attrs
+    lo = b.constant(np.float32(at.get("a_min", np.finfo("f4").min)),
+                    node.name + "_min")
+    hi = b.constant(np.float32(at.get("a_max", np.finfo("f4").max)),
+                    node.name + "_max")
+    b.node("Clip", [ins[0], lo, hi], outs, node.name)
+
+
+def _reduce(op_type):
+    def conv(b, node, ins, outs):
+        at = node.attrs
+        if at.get("exclude"):
+            raise MXNetError("%s with exclude=True can't export"
+                             % node.op)
+        axis = at.get("axis")
+        if axis is not None and not isinstance(axis, (tuple, list)):
+            axis = (axis,)
+        # opset-13 ReduceSum takes axes as an input; the other reduces
+        # still use the attribute form
+        kw = dict(keepdims=int(bool(at.get("keepdims", False))))
+        if op_type == "ReduceSum":
+            inputs = list(ins)
+            if axis is not None:
+                inputs.append(b.constant(
+                    np.asarray(axis, np.int64), node.name))
+            b.node(op_type, inputs, outs, node.name, **kw)
+        else:
+            if axis is not None:
+                kw["axes"] = tuple(int(a) for a in axis)
+            b.node(op_type, ins, outs, node.name, **kw)
+    return conv
+
+
+def _cast(b, node, ins, outs):
+    dtype = node.attrs.get("dtype", "float32")
+    try:
+        dt = _DTYPE_TO_ONNX[np.dtype(dtype)]
+    except (KeyError, TypeError):
+        raise MXNetError("Cast to %r has no ONNX mapping" % (dtype,))
+    b.node("Cast", ins, outs, node.name, to=int(dt))
+
+
+def _pad_op(b, node, ins, outs):
+    at = node.attrs
+    mode = at.get("mode", "constant")
+    onnx_mode = {"constant": "constant", "edge": "edge",
+                 "reflect": "reflect"}.get(mode)
+    if onnx_mode is None:
+        raise MXNetError("pad mode %r can't export" % mode)
+    pw = at.get("pad_width", ())
+    n = len(pw) // 2
+    begins = [int(pw[2 * i]) for i in range(n)]
+    ends = [int(pw[2 * i + 1]) for i in range(n)]
+    pads = b.constant(np.asarray(begins + ends, np.int64), node.name)
+    val = b.constant(np.float32(at.get("constant_value", 0.0)),
+                     node.name + "_val")
+    b.node("Pad", [ins[0], pads, val], outs, node.name, mode=onnx_mode)
+
+
+def _l2norm(b, node, ins, outs):
+    if node.attrs.get("mode", "instance") != "channel":
+        raise MXNetError(
+            "L2Normalization exports only mode='channel' "
+            "(ONNX LpNormalization is per-axis)")
+    b.node("LpNormalization", ins, outs, node.name, axis=1, p=2)
+
+
 def _binop(op_type):
     def conv(b, node, ins, outs):
         b.node(op_type, ins, outs, node.name)
@@ -278,6 +360,15 @@ def _unary(op_type):
 
 
 CONVERTERS = {
+    "Deconvolution": _deconv,
+    "clip": _clip,
+    "sum": _reduce("ReduceSum"),
+    "mean": _reduce("ReduceMean"),
+    "max": _reduce("ReduceMax"),
+    "min": _reduce("ReduceMin"),
+    "norm_like_cast": _cast,
+    "pad": _pad_op,
+    "L2Normalization": _l2norm,
     "Convolution": _conv,
     "FullyConnected": _fc,
     "BatchNorm": _batchnorm,

@@ -2,10 +2,12 @@
 import_model / GraphProto.from_onnx).
 
 Covers the opset the exporter emits plus the common inference graphs:
-Conv, Gemm, BatchNormalization, pooling (incl. global), activations,
-Flatten/Reshape/Transpose/Concat, elementwise arithmetic, Gather,
-Dropout, Cast, Identity, Sum. Returns (sym, arg_params, aux_params)
-exactly like the reference API.
+Conv/ConvTranspose, Gemm (alpha/beta/transB; transA rejected), MatMul,
+BatchNormalization, pooling (incl. global), activations, Clip,
+Flatten/Reshape/Transpose/Concat/Pad, Reduce{Sum,Mean,Max,Min},
+LpNormalization, elementwise arithmetic, Gather, Dropout, Cast,
+Identity, Sum. Returns (sym, arg_params, aux_params) exactly like the
+reference API.
 """
 from __future__ import annotations
 
@@ -252,6 +254,82 @@ class _Importer:
                                     name=node.name or None)
         return self.S.take(self.get(data), self.get(idx),
                            name=node.name or None)
+
+    def op_ConvTranspose(self, node, at):
+        if at.get("auto_pad", "NOTSET") not in ("NOTSET", "") or \
+                at.get("output_shape"):
+            raise MXNetError(
+                "ConvTranspose with auto_pad/output_shape is not "
+                "supported — re-export with explicit pads")
+        ins = [self.get(i) for i in node.input]
+        kernel = _pair(at.get("kernel_shape"))
+        w = self.const(node.input[1])  # (in, out/group, kH, kW)
+        return self.S.Deconvolution(
+            *ins, kernel=kernel,
+            stride=_pair(at.get("strides"), (1,) * len(kernel)),
+            dilate=_pair(at.get("dilations"), (1,) * len(kernel)),
+            pad=_split_pads(at.get("pads")),
+            adj=_pair(at.get("output_padding"), (0,) * len(kernel)),
+            num_filter=int(w.shape[1]) * int(at.get("group", 1)),
+            num_group=int(at.get("group", 1)),
+            no_bias=len(node.input) < 3, name=node.name or None)
+
+    def op_Clip(self, node, at):
+        lo = hi = None
+        if len(node.input) > 1 and node.input[1]:
+            lo = float(np.asarray(self.const(node.input[1])).reshape(())[()])
+        if len(node.input) > 2 and node.input[2]:
+            hi = float(np.asarray(self.const(node.input[2])).reshape(())[()])
+        lo = at.get("min", lo)  # opset<11 attribute form
+        hi = at.get("max", hi)
+        return self.S.clip(self.get(node.input[0]), a_min=lo, a_max=hi,
+                           name=node.name or None)
+
+    def _reduce(self, node, at, mx_name):
+        axes = at.get("axes")
+        if mx_name == "sum" and len(node.input) > 1:  # opset-13 input
+            axes = tuple(int(a) for a in self.const(node.input[1]))
+        return getattr(self.S, mx_name)(
+            self.get(node.input[0]),
+            axis=tuple(axes) if axes is not None else None,
+            keepdims=bool(at.get("keepdims", 1)), name=node.name or None)
+
+    def op_ReduceSum(self, node, at):
+        return self._reduce(node, at, "sum")
+
+    def op_ReduceMean(self, node, at):
+        return self._reduce(node, at, "mean")
+
+    def op_ReduceMax(self, node, at):
+        return self._reduce(node, at, "max")
+
+    def op_ReduceMin(self, node, at):
+        return self._reduce(node, at, "min")
+
+    def op_Pad(self, node, at):
+        if len(node.input) > 1:
+            flat = [int(x) for x in self.const(node.input[1])]
+        else:  # opset<11 attribute form (same begins+ends layout)
+            flat = [int(x) for x in at.get("pads", ())]
+        n = len(flat) // 2
+        pw = []
+        for i in range(n):
+            pw += [flat[i], flat[n + i]]
+        val = float(at.get("value", 0.0))
+        if len(node.input) > 2 and node.input[2]:
+            val = float(np.asarray(self.const(node.input[2])
+                                   ).reshape(())[()])
+        return self.S.pad(self.get(node.input[0]),
+                          mode=at.get("mode", "constant"),
+                          pad_width=tuple(pw), constant_value=val,
+                          name=node.name or None)
+
+    def op_LpNormalization(self, node, at):
+        if int(at.get("p", 2)) != 2 or int(at.get("axis", -1)) != 1:
+            raise MXNetError("only LpNormalization(p=2, axis=1) imports")
+        return self.S.L2Normalization(self.get(node.input[0]),
+                                      mode="channel",
+                                      name=node.name or None)
 
     def op_Identity(self, node, at):
         return self.S.identity(self.get(node.input[0]),

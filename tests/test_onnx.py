@@ -166,3 +166,35 @@ def test_onnx_batchnorm_fix_gamma_roundtrip(tmp_path):
     net = sym.FullyConnected(net, num_hidden=3, name="fc")
     net = sym.softmax(net, name="prob")
     _roundtrip(net, {"data": (2, 3, 6, 6)}, tmp_path, atol=1e-4)
+
+
+def test_onnx_deconv_clip_pad_roundtrip(tmp_path):
+    data = sym.Variable("data")
+    net = sym.Deconvolution(data, kernel=(2, 2), stride=(2, 2),
+                            num_filter=4, name="up")
+    net = sym.clip(net, a_min=-0.4, a_max=0.6)
+    net = sym.pad(net, mode="constant", constant_value=0.5,
+                  pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
+    _roundtrip(net, {"data": (2, 3, 5, 5)}, tmp_path, atol=1e-4)
+
+
+def test_onnx_reduce_and_l2norm_roundtrip(tmp_path):
+    a = sym.Variable("a")
+    parts = [
+        sym.sum(a, axis=(1,), keepdims=True),
+        sym.mean(a, axis=(1,), keepdims=True),
+        sym.max(a, axis=(1,), keepdims=True),
+        sym.min(a, axis=(1,), keepdims=True),
+    ]
+    net = sym.Concat(*parts, dim=1, name="cat")
+    _roundtrip(net, {"a": (3, 5)}, tmp_path)
+
+    x = sym.Variable("x")
+    net2 = sym.L2Normalization(x, mode="channel", name="l2")
+    _roundtrip(net2, {"x": (2, 4, 3, 3)}, tmp_path, atol=1e-5)
+
+
+def test_onnx_cast_roundtrip(tmp_path):
+    a = sym.Variable("a")
+    net = sym.cast(sym.cast(a, dtype="float64") * 1.5, dtype="float32")
+    _roundtrip(net, {"a": (2, 3)}, tmp_path)
