@@ -59,3 +59,56 @@ def clip_global_norm(arrays, max_norm, check_isfinite=True):
         for a in arrays:
             a *= scale
     return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """True if the file's sha1 matches (ref: gluon/utils.py —
+    check_sha1; used to validate downloaded model files)."""
+    import hashlib
+
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            sha1.update(chunk)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Fetch a URL to a local file (ref: gluon/utils.py — download).
+    Same signature/return contract; in a no-egress environment the
+    urllib call raises and the error says so plainly."""
+    import os
+    import urllib.request
+
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if not overwrite and os.path.exists(fname) and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    d = os.path.dirname(os.path.abspath(fname))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    last = None
+    for _ in range(max(1, retries)):
+        try:
+            ctx = None
+            if not verify_ssl:
+                import ssl
+
+                ctx = ssl._create_unverified_context()
+            with urllib.request.urlopen(url, context=ctx) as r, \
+                    open(fname, "wb") as f:
+                f.write(r.read())
+            if sha1_hash and not check_sha1(fname, sha1_hash):
+                raise OSError("sha1 mismatch for %s" % fname)
+            return fname
+        except Exception as e:  # noqa: BLE001 — retry loop
+            last = e
+    raise OSError(
+        "download of %s failed after %d tries (no network egress in "
+        "this environment?): %r" % (url, retries, last))
