@@ -1,5 +1,5 @@
 """gluon.contrib (ref: python/mxnet/gluon/contrib/__init__.py)."""
-from . import estimator
+from . import estimator, nn, rnn
 from .estimator import Estimator
 
-__all__ = ["estimator", "Estimator"]
+__all__ = ["estimator", "Estimator", "nn", "rnn"]

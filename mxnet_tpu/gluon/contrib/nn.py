@@ -1,0 +1,128 @@
+"""Experimental Gluon layers (ref: python/mxnet/gluon/contrib/nn/
+basic_layers.py). In the reference SyncBatchNorm lives here; our
+implementation sits in gluon.nn (it is a first-class citizen on a
+sharded backend) and is re-exported for import parity."""
+from __future__ import annotations
+
+from ..block import Block, HybridBlock
+from ..nn.basic_layers import HybridSequential, Sequential, SyncBatchNorm
+from ..nn.conv_layers import _tup
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class Concurrent(Sequential):
+    """Feeds the input to every child and concatenates the outputs along
+    ``axis`` (ref: basic_layers.py — Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x, *args):
+        from ... import ndarray as F
+
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable :class:`Concurrent` (ref: basic_layers.py)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Identity mapping — useful as a :class:`Concurrent` branch
+    (ref: basic_layers.py — Identity)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding with a row_sparse gradient, for sparse training through
+    the KVStore row_sparse path (ref: basic_layers.py — SparseEmbedding).
+    Identical compute to ``nn.Embedding(sparse_grad=True)``; kept as a
+    distinct class for reference API parity. The weight is registered
+    directly (param name ``weight``) so checkpoints match the
+    reference's parameter layout."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim,
+                        "sparse_grad": True}
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim), dtype=dtype,
+                init=weight_initializer, grad_stype="row_sparse")
+
+    def forward(self, x):
+        from ... import ndarray as F
+
+        return F.Embedding(x, self.weight.data(), **self._kwargs)
+
+    def __repr__(self):
+        return "SparseEmbedding({input_dim} -> {output_dim})".format(
+            **self._kwargs)
+
+
+class _PixelShuffle(HybridBlock):
+    """Rearranges channel blocks into spatial dims — sub-pixel conv
+    upsampling (ref: basic_layers.py — PixelShuffle1D/2D/3D; Shi et al.
+    1609.05158). Implemented as one reshape/transpose pair, which XLA
+    lowers to a single copy (no gather) on TPU."""
+
+    def __init__(self, factor, ndim, **kwargs):
+        super().__init__(**kwargs)
+        self._factors = tuple(int(f) for f in _tup(factor, ndim))
+        assert len(self._factors) == ndim, (factor, ndim)
+        self._ndim = ndim
+
+    def hybrid_forward(self, F, x):
+        f = self._factors
+        n = self._ndim
+        b = x.shape[0]
+        c_in = x.shape[1]
+        spatial = x.shape[2:]
+        prod = 1
+        for v in f:
+            prod *= v
+        assert c_in % prod == 0, \
+            "channels %d not divisible by product of factors %s" % (c_in, f)
+        c_out = c_in // prod
+        # (B, C*prod(f), *S) -> (B, C, f1..fn, *S) -> interleave -> merge
+        x = F.reshape(x, (b, c_out) + f + tuple(spatial))
+        perm = [0, 1]
+        for i in range(n):          # ... S_i, f_i pairs
+            perm += [2 + n + i, 2 + i]
+        x = F.transpose(x, axes=tuple(perm))
+        out_spatial = tuple(s * fi for s, fi in zip(spatial, f))
+        return F.reshape(x, (b, c_out) + out_spatial)
+
+    def __repr__(self):
+        return "%s(%s)" % (type(self).__name__, self._factors)
+
+
+class PixelShuffle1D(_PixelShuffle):
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)

@@ -1,0 +1,334 @@
+"""Experimental recurrent cells (ref: python/mxnet/gluon/contrib/rnn/
+{rnn_cell.py,conv_rnn_cell.py}): VariationalDropoutCell, LSTMPCell, and
+the Conv1D/2D/3D RNN/LSTM/GRU cell family.
+
+Conv cells keep the reference's contract: ``input_shape`` is the
+per-step (C, *spatial) shape, h2h convs are same-padded (odd kernels
+required), gate math matches the dense cells. Each step is a pair of
+convs + elementwise gates — XLA fuses the gate arithmetic into the conv
+epilogue, so a cell step is two MXU convolutions."""
+from __future__ import annotations
+
+from ... import autograd
+from ..nn.conv_layers import _tup
+from ..rnn.rnn_cell import HybridRecurrentCell, ModifierCell
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell",
+           "Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Applies Gal & Ghahramani (1512.05287) variational dropout: one
+    mask per sequence, shared across all time steps, separately for
+    inputs / states / outputs (ref: rnn_cell.py —
+    VariationalDropoutCell)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+        super().__init__(base_cell)
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _initialize_masks(self, F, inputs, states):
+        if not autograd.is_training():
+            return
+        if self.drop_inputs and self._input_mask is None:
+            self._input_mask = F.Dropout(F.ones_like(inputs),
+                                         p=self.drop_inputs,
+                                         train_mode=True)
+        if self.drop_states and self._state_mask is None:
+            self._state_mask = F.Dropout(F.ones_like(states[0]),
+                                         p=self.drop_states,
+                                         train_mode=True)
+
+    def hybrid_forward(self, F, inputs, states):
+        cell = self.base_cell
+        self._initialize_masks(F, inputs, states)
+        if self._input_mask is not None:
+            inputs = inputs * self._input_mask
+        if self._state_mask is not None:
+            states = [states[0] * self._state_mask] + list(states[1:])
+        next_output, next_states = cell(inputs, states)
+        if self.drop_outputs:
+            if autograd.is_training():
+                if self._output_mask is None:
+                    self._output_mask = F.Dropout(F.ones_like(next_output),
+                                                  p=self.drop_outputs,
+                                                  train_mode=True)
+                next_output = next_output * self._output_mask
+        return next_output, next_states
+
+class LSTMPCell(HybridRecurrentCell):
+    """LSTM with a projection of the hidden state (LSTMP, Sak et al.
+    1402.1128) — the recurrent/output state is ``projection_size`` wide
+    while the cell state stays ``hidden_size`` (ref: rnn_cell.py —
+    LSTMPCell; gate order [i,f,g,o])."""
+
+    def __init__(self, hidden_size, projection_size,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, projection_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.h2r_weight = self.params.get(
+                "h2r_weight", shape=(projection_size, hidden_size),
+                init=h2r_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstmp"
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight=None,
+                       h2h_weight=None, h2r_weight=None, i2h_bias=None,
+                       h2h_bias=None):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        sg = F.split(gates, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(sg[0])
+        forget_gate = F.sigmoid(sg[1])
+        in_transform = F.tanh(sg[2])
+        out_gate = F.sigmoid(sg[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        hidden = out_gate * F.tanh(next_c)
+        next_r = F.FullyConnected(hidden, h2r_weight, None, no_bias=True,
+                                  num_hidden=self._projection_size)
+        return next_r, [next_r, next_c]
+
+
+class _BaseConvRNNCell(HybridRecurrentCell):
+    """Shared machinery for convolutional recurrent cells
+    (ref: conv_rnn_cell.py — _BaseConvRNNCell)."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, dims, conv_layout, activation,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_channels = hidden_channels
+        self._input_shape = tuple(input_shape)
+        self._conv_layout = conv_layout
+        self._activation = activation
+        self._dims = dims
+        # channel position within the per-step (batchless) shape; the
+        # reference derives it the same way (conv_rnn_cell.py —
+        # conv_layout.find('C'))
+        self._c_axis = conv_layout.find("C") - 1
+        assert 0 <= self._c_axis <= dims, conv_layout
+        assert len(self._input_shape) == dims + 1, \
+            "input_shape must be the per-step (channels+spatial) shape"
+
+        def _ntup(x, name):
+            t = _tup(x, dims)
+            assert len(t) == dims, "%s must have %d elements" % (name, dims)
+            return t
+
+        self._i2h_kernel = _ntup(i2h_kernel, "i2h_kernel")
+        self._h2h_kernel = _ntup(h2h_kernel, "h2h_kernel")
+        assert all(k % 2 == 1 for k in self._h2h_kernel), \
+            "h2h_kernel must be odd (same-padded recurrence): %s" % (
+                self._h2h_kernel,)
+        self._i2h_pad = _ntup(i2h_pad, "i2h_pad")
+        self._i2h_dilate = _ntup(i2h_dilate, "i2h_dilate")
+        self._h2h_dilate = _ntup(h2h_dilate, "h2h_dilate")
+        self._h2h_pad = tuple(d * (k - 1) // 2 for d, k in
+                              zip(self._h2h_dilate, self._h2h_kernel))
+
+        in_channels = self._input_shape[self._c_axis]
+        ng = self._num_gates
+        spatial_out = self._spatial_out()
+        state = list(spatial_out)
+        state.insert(self._c_axis, hidden_channels)
+        self._state_shape = tuple(state)
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight",
+                shape=(ng * hidden_channels, in_channels) + self._i2h_kernel,
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight",
+                shape=(ng * hidden_channels, hidden_channels)
+                + self._h2h_kernel,
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(ng * hidden_channels,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(ng * hidden_channels,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def _spatial_out(self):
+        spatial = [s for i, s in enumerate(self._input_shape)
+                   if i != self._c_axis]
+        out = []
+        for i, s in enumerate(spatial):
+            k = self._i2h_dilate[i] * (self._i2h_kernel[i] - 1) + 1
+            out.append((s + 2 * self._i2h_pad[i] - k) + 1)
+        return tuple(out)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size,) + self._state_shape,
+                 "__layout__": self._conv_layout}
+                for _ in range(self._num_states)]
+
+    def _conv_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                      i2h_bias, h2h_bias):
+        ng = self._num_gates
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            dilate=self._i2h_dilate,
+                            num_filter=ng * self._hidden_channels,
+                            layout=self._conv_layout)
+        h2h = F.Convolution(states[0], h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, pad=self._h2h_pad,
+                            dilate=self._h2h_dilate,
+                            num_filter=ng * self._hidden_channels,
+                            layout=self._conv_layout)
+        return i2h, h2h
+
+    def _act(self, F, x):
+        return F.Activation(x, act_type=self._activation)
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    _num_gates = 1
+    _num_states = 1
+
+    def _alias(self):
+        return "conv_rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight=None,
+                       h2h_weight=None, i2h_bias=None, h2h_bias=None):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        output = self._act(F, i2h + h2h)
+        return output, [output]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    """Shi et al. 1506.04214 (ConvLSTM); gate order [i,f,g,o]."""
+
+    _num_gates = 4
+    _num_states = 2
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight=None,
+                       h2h_weight=None, i2h_bias=None, h2h_bias=None):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        c_axis = self._conv_layout.find("C")
+        sg = F.split(gates, num_outputs=4, axis=c_axis)
+        in_gate = F.sigmoid(sg[0])
+        forget_gate = F.sigmoid(sg[1])
+        in_transform = self._act(F, sg[2])
+        out_gate = F.sigmoid(sg[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * self._act(F, next_c)
+        return next_h, [next_h, next_c]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    _num_gates = 3
+    _num_states = 1
+
+    def _alias(self):
+        return "conv_gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight=None,
+                       h2h_weight=None, i2h_bias=None, h2h_bias=None):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        c_axis = self._conv_layout.find("C")
+        i2h_r, i2h_z, i2h_n = F.split(i2h, num_outputs=3, axis=c_axis)
+        h2h_r, h2h_z, h2h_n = F.split(h2h, num_outputs=3, axis=c_axis)
+        reset_gate = F.sigmoid(i2h_r + h2h_r)
+        update_gate = F.sigmoid(i2h_z + h2h_z)
+        next_h_tmp = self._act(F, i2h_n + reset_gate * h2h_n)
+        next_h = ((1.0 - update_gate) * next_h_tmp
+                  + update_gate * states[0])
+        return next_h, [next_h]
+
+
+def _make_conv_cell(base, dims, default_layout, alias_suffix):
+    class Cell(base):
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                     i2h_weight_initializer=None,
+                     h2h_weight_initializer=None,
+                     i2h_bias_initializer="zeros",
+                     h2h_bias_initializer="zeros",
+                     conv_layout=default_layout, activation="tanh",
+                     prefix=None, params=None):
+            super().__init__(
+                input_shape=input_shape, hidden_channels=hidden_channels,
+                i2h_kernel=i2h_kernel, h2h_kernel=h2h_kernel,
+                i2h_pad=i2h_pad, i2h_dilate=i2h_dilate,
+                h2h_dilate=h2h_dilate,
+                i2h_weight_initializer=i2h_weight_initializer,
+                h2h_weight_initializer=h2h_weight_initializer,
+                i2h_bias_initializer=i2h_bias_initializer,
+                h2h_bias_initializer=h2h_bias_initializer,
+                dims=dims, conv_layout=conv_layout, activation=activation,
+                prefix=prefix, params=params)
+
+    Cell.__name__ = "Conv%dD%sCell" % (dims, alias_suffix)
+    Cell.__qualname__ = Cell.__name__
+    Cell.__doc__ = ("%d-D convolutional %s cell (ref: conv_rnn_cell.py — "
+                    "%s)." % (dims, alias_suffix, Cell.__name__))
+    return Cell
+
+
+Conv1DRNNCell = _make_conv_cell(_ConvRNNCell, 1, "NCW", "RNN")
+Conv2DRNNCell = _make_conv_cell(_ConvRNNCell, 2, "NCHW", "RNN")
+Conv3DRNNCell = _make_conv_cell(_ConvRNNCell, 3, "NCDHW", "RNN")
+Conv1DLSTMCell = _make_conv_cell(_ConvLSTMCell, 1, "NCW", "LSTM")
+Conv2DLSTMCell = _make_conv_cell(_ConvLSTMCell, 2, "NCHW", "LSTM")
+Conv3DLSTMCell = _make_conv_cell(_ConvLSTMCell, 3, "NCDHW", "LSTM")
+Conv1DGRUCell = _make_conv_cell(_ConvGRUCell, 1, "NCW", "GRU")
+Conv2DGRUCell = _make_conv_cell(_ConvGRUCell, 2, "NCHW", "GRU")
+Conv3DGRUCell = _make_conv_cell(_ConvGRUCell, 3, "NCDHW", "GRU")

@@ -31,20 +31,13 @@ def _make_branch(use_pool, *conv_settings):
     return out
 
 
-class _Concurrent(HybridBlock):
-    """Parallel branches, channel-concat outputs (plays the role of
-    gluon.contrib HybridConcurrent used by the reference)."""
+def _Concurrent(prefix=None):
+    """Parallel branches, channel-concat outputs — the reference builds
+    inception blocks from gluon.contrib HybridConcurrent; so do we
+    (axis resolved from the active layout scope at construction)."""
+    from ...contrib.nn import HybridConcurrent
 
-    def __init__(self, axis=None, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
-        self._axis = nn.channel_axis() if axis is None else axis
-
-    def add(self, block):
-        self.register_child(block)
-
-    def hybrid_forward(self, F, x):
-        outs = [child(x) for child in self._children.values()]
-        return F.concat(*outs, dim=self._axis)
+    return HybridConcurrent(axis=nn.channel_axis(), prefix=prefix)
 
 
 def _make_A(pool_features, prefix):

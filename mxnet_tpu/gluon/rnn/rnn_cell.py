@@ -8,6 +8,7 @@ like the reference.
 """
 from __future__ import annotations
 
+from ... import autograd
 from ...base import MXNetError
 from ...ndarray.ndarray import NDArray
 from ..block import Block, HybridBlock
@@ -437,7 +438,8 @@ class DropoutCell(HybridRecurrentCell):
 
     def hybrid_forward(self, F, inputs, states):
         if self._rate > 0:
-            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes)
+            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes,
+                               train_mode=autograd.is_training())
         return inputs, states
 
 
@@ -487,15 +489,14 @@ class ZoneoutCell(ModifierCell):
         self._prev_output = None
 
     def hybrid_forward(self, F, inputs, states):
-        import mxnet_tpu.autograd as ag
-
         cell = self.base_cell
         next_output, next_states = cell(inputs, states)
-        if not ag.is_training():
+        if not autograd.is_training():
             return next_output, next_states
 
         def mask(p, like):
-            return F.Dropout(F.ones_like(like), p=p)
+            # reached only under autograd.is_training() (guard above)
+            return F.Dropout(F.ones_like(like), p=p, train_mode=True)
 
         prev_output = self._prev_output
         if prev_output is None:

@@ -1,0 +1,139 @@
+"""gluon.contrib.nn / gluon.contrib.rnn block zoo
+(ref: tests/python/unittest/test_gluon_contrib.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon.contrib import nn as cnn
+from mxnet_tpu.gluon.contrib import rnn as crnn
+from mxnet_tpu.test_utils import with_seed
+
+
+def test_concurrent():
+    net = cnn.HybridConcurrent(axis=1)
+    net.add(gluon.nn.Dense(4), cnn.Identity())
+    net.initialize()
+    x = mx.nd.ones((2, 3))
+    out = net(x)
+    assert out.shape == (2, 7)
+    # Identity branch passes x through untouched
+    assert np.array_equal(out.asnumpy()[:, 4:], x.asnumpy())
+    dyn = cnn.Concurrent(axis=-1)
+    dyn.add(cnn.Identity(), cnn.Identity())
+    dyn.initialize()
+    assert dyn(x).shape == (2, 6)
+
+
+def test_pixelshuffle2d_values():
+    ps = cnn.PixelShuffle2D(2)
+    a = np.arange(1 * 4 * 2 * 2, dtype=np.float32).reshape(1, 4, 2, 2)
+    got = ps(mx.nd.array(a)).asnumpy()
+    ref = a.reshape(1, 1, 2, 2, 2, 2).transpose(0, 1, 4, 2, 5, 3) \
+        .reshape(1, 1, 4, 4)
+    assert np.array_equal(got, ref)
+
+
+@pytest.mark.parametrize("cls,shape,factor,out_shape", [
+    (cnn.PixelShuffle1D, (1, 6, 4), 3, (1, 2, 12)),
+    (cnn.PixelShuffle2D, (1, 8, 3, 3), 2, (1, 2, 6, 6)),
+    (cnn.PixelShuffle2D, (1, 6, 3, 3), (3, 2), (1, 1, 9, 6)),
+    (cnn.PixelShuffle3D, (1, 8, 2, 2, 2), 2, (1, 1, 4, 4, 4)),
+])
+def test_pixelshuffle_shapes(cls, shape, factor, out_shape):
+    assert cls(factor)(mx.nd.ones(shape)).shape == out_shape
+
+
+def test_sparse_embedding():
+    se = cnn.SparseEmbedding(10, 4)
+    se.initialize()
+    out = se(mx.nd.array([[1, 2]]))
+    assert out.shape == (1, 2, 4)
+    assert se.weight._grad_stype == "row_sparse"
+
+
+def test_lstmp_cell():
+    c = crnn.LSTMPCell(8, 3)
+    c.initialize()
+    out, states = c(mx.nd.ones((2, 5)), c.begin_state(2))
+    assert out.shape == (2, 3)
+    assert states[0].shape == (2, 3) and states[1].shape == (2, 8)
+    outs, _ = c.unroll(4, mx.nd.ones((2, 4, 5)), merge_outputs=True)
+    assert outs.shape == (2, 4, 3)
+
+
+@with_seed()
+def test_variational_dropout_mask_fixed_over_time():
+    base = gluon.rnn.LSTMCell(6)
+    vd = crnn.VariationalDropoutCell(base, drop_inputs=0.5, drop_outputs=0.5)
+    vd.initialize()
+    with autograd.record():
+        o, _ = vd.unroll(3, mx.nd.ones((2, 3, 5)), merge_outputs=False)
+    m0 = o[0].asnumpy() == 0
+    m1 = o[1].asnumpy() == 0
+    assert np.any(m0), "dropout must actually fire during training"
+    assert np.array_equal(m0, m1), "output mask must be shared across time"
+    # inference mode: no dropout at all
+    vd.reset()
+    o, _ = vd.unroll(2, mx.nd.ones((2, 2, 5)), merge_outputs=False)
+    assert not np.any(o[0].asnumpy() == 0)
+
+
+def test_conv_lstm_cell():
+    cc = crnn.Conv2DLSTMCell(input_shape=(3, 8, 8), hidden_channels=4,
+                             i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cc.initialize()
+    o, s = cc(mx.nd.ones((2, 3, 8, 8)), cc.begin_state(2))
+    assert o.shape == (2, 4, 8, 8)
+    assert s[1].shape == (2, 4, 8, 8)
+    o2, _ = cc.unroll(3, mx.nd.ones((2, 3, 3, 8, 8)), merge_outputs=True)
+    assert o2.shape == (2, 3, 4, 8, 8)
+
+
+@pytest.mark.parametrize("cls,gates", [
+    (crnn.Conv1DRNNCell, 1),
+    (crnn.Conv1DLSTMCell, 4),
+    (crnn.Conv1DGRUCell, 3),
+])
+def test_conv_cells_1d(cls, gates):
+    c = cls(input_shape=(2, 10), hidden_channels=3, i2h_kernel=3,
+            h2h_kernel=3, i2h_pad=1)
+    c.initialize()
+    o, _ = c(mx.nd.ones((2, 2, 10)), c.begin_state(2))
+    assert o.shape == (2, 3, 10)
+    assert c.i2h_weight.shape[0] == gates * 3
+
+
+def test_conv_lstm_channels_last():
+    """TPU-preferred NHWC layout: state/weight shapes follow the C axis."""
+    cc = crnn.Conv2DLSTMCell(input_shape=(8, 8, 3), hidden_channels=4,
+                             i2h_kernel=3, h2h_kernel=3, i2h_pad=1,
+                             conv_layout="NHWC")
+    cc.initialize()
+    o, s = cc(mx.nd.ones((2, 8, 8, 3)), cc.begin_state(2))
+    assert o.shape == (2, 8, 8, 4)
+    assert s[1].shape == (2, 8, 8, 4)
+    # value parity with the NCHW cell under transposed inputs + same params
+    ref = crnn.Conv2DLSTMCell(input_shape=(3, 8, 8), hidden_channels=4,
+                              i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    ref.initialize()
+    for name in ("i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias"):
+        getattr(ref, name).set_data(getattr(cc, name).data())
+    x = mx.nd.random.uniform(shape=(2, 3, 8, 8))
+    o_ref, _ = ref(x, ref.begin_state(2))
+    o_nhwc, _ = cc(x.transpose((0, 2, 3, 1)), cc.begin_state(2))
+    np.testing.assert_allclose(o_nhwc.asnumpy().transpose(0, 3, 1, 2),
+                               o_ref.asnumpy(), rtol=2e-5, atol=2e-5)
+
+
+def test_conv_rnn_grad_flows():
+    c = crnn.Conv2DRNNCell(input_shape=(1, 4, 4), hidden_channels=2,
+                           i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    c.initialize()
+    x = mx.nd.ones((1, 3, 1, 4, 4))
+    with autograd.record():
+        o, _ = c.unroll(3, x, merge_outputs=True)
+        loss = o.sum()
+    loss.backward()
+    g = c.i2h_weight.grad().asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0

@@ -240,6 +240,11 @@ def test_zoneout_cell_smoke():
     with ag.record(train_mode=True):
         outs, _ = cell.unroll(3, seq, layout="NTC", merge_outputs=True)
     assert outs.shape == (2, 3, 4)
+    # zoneout must actually fire in training: zoned-out outputs at t=0
+    # take the previous output, which starts at zeros — exact zeros that
+    # a tanh RNN output essentially never produces on its own
+    assert np.any(outs.asnumpy()[:, 0, :] == 0), \
+        "zoneout produced no zoned elements under record()"
 
 
 @with_seed()
