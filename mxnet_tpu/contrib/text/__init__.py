@@ -1,0 +1,5 @@
+"""contrib.text (ref: python/mxnet/contrib/text/__init__.py)."""
+from . import embedding, utils, vocab
+from .vocab import Vocabulary
+
+__all__ = ["embedding", "utils", "vocab", "Vocabulary"]

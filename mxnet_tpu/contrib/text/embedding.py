@@ -1,0 +1,261 @@
+"""Pretrained token embeddings (ref: python/mxnet/contrib/text/
+embedding.py). The file-format layer (token<sep>vec lines) and the
+lookup/update API are fully functional; the GloVe/FastText classes keep
+the reference's registry + pretrained-file inventory but their fetch
+goes through gluon.utils.download, which raises loudly in this no-egress
+environment unless the file is already cached on disk."""
+from __future__ import annotations
+
+import io
+import logging
+import os
+
+import numpy as np
+
+from ... import ndarray as nd
+from ...gluon.utils import download
+from .vocab import Vocabulary
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "GloVe", "FastText", "CustomEmbedding",
+           "CompositeEmbedding"]
+
+_REGISTRY = {}
+
+
+def register(cls):
+    """Registers a TokenEmbedding subclass under its lowercase name
+    (ref: embedding.py — register)."""
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(embedding_name, **kwargs):
+    """Creates a registered embedding, e.g. ``create('glove',
+    pretrained_file_name=...)`` (ref: embedding.py — create)."""
+    name = embedding_name.lower()
+    if name not in _REGISTRY:
+        raise KeyError("embedding %r not registered; have %s"
+                       % (embedding_name, sorted(_REGISTRY)))
+    return _REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Inventory of known pretrained files per embedding
+    (ref: embedding.py — get_pretrained_file_names)."""
+    if embedding_name is not None:
+        return list(_REGISTRY[embedding_name.lower()]
+                    .pretrained_file_name_sha1)
+    return {name: list(cls.pretrained_file_name_sha1)
+            for name, cls in _REGISTRY.items()
+            if cls.pretrained_file_name_sha1}
+
+
+class TokenEmbedding:
+    """Base: token -> vector table with unknown handling
+    (ref: embedding.py — _TokenEmbedding)."""
+
+    pretrained_file_name_sha1 = {}  # non-pretrained subclasses stay empty
+
+    def __init__(self, unknown_token="<unk>",
+                 init_unknown_vec=nd.zeros):
+        self._unknown_token = unknown_token
+        self._init_unknown_vec = init_unknown_vec
+        self._idx_to_token = [unknown_token]
+        self._token_to_idx = {unknown_token: 0}
+        self._idx_to_vec = None
+        self._vec_len = 0
+
+    # -- loading ------------------------------------------------------
+    def _load_embedding(self, path, elem_delim=" ", encoding="utf8"):
+        """Parses token<elem_delim>v1...vN lines; malformed lines are
+        skipped with a warning, first seen token wins (ref:
+        embedding.py — _load_embedding)."""
+        vecs = []
+        with io.open(path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                elems = line.rstrip().split(elem_delim)
+                if len(elems) <= 2:
+                    logging.warning("line %d in %s: unexpected format, "
+                                    "skipped", line_num, path)
+                    continue
+                token, vec = elems[0], elems[1:]
+                if self._vec_len == 0:
+                    self._vec_len = len(vec)
+                elif len(vec) != self._vec_len:
+                    logging.warning("line %d in %s: inconsistent vector "
+                                    "length, skipped", line_num, path)
+                    continue
+                if token in self._token_to_idx:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                vecs.append(np.asarray(vec, dtype=np.float32))
+        table = np.zeros((len(self._idx_to_token), self._vec_len),
+                         dtype=np.float32)
+        if vecs:
+            table[1:] = np.stack(vecs)
+        unk = self._init_unknown_vec(shape=(self._vec_len,))
+        table[0] = (unk.asnumpy() if isinstance(unk, nd.NDArray)
+                    else np.asarray(unk))
+        self._idx_to_vec = nd.array(table)
+
+    # -- API ----------------------------------------------------------
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vectors for token(s); unknown tokens get the unknown vector
+        (ref: embedding.py — get_vecs_by_tokens)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+
+        def idx(t):
+            if t in self._token_to_idx:
+                return self._token_to_idx[t]
+            if lower_case_backup and t.lower() in self._token_to_idx:
+                return self._token_to_idx[t.lower()]
+            return 0
+        rows = self._idx_to_vec[nd.array([idx(t) for t in toks],
+                                         dtype="int32")]
+        return rows[0] if single else rows
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrites vectors for existing tokens
+        (ref: embedding.py — update_token_vectors)."""
+        if self._idx_to_vec is None:
+            raise RuntimeError("no vectors loaded")
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        vals = (new_vectors.asnumpy()
+                if isinstance(new_vectors, nd.NDArray)
+                else np.asarray(new_vectors, dtype=np.float32))
+        if vals.ndim == 1:
+            vals = vals.reshape(1, -1)
+        if len(vals) != len(toks):
+            raise ValueError("got %d tokens but %d vectors"
+                             % (len(toks), len(vals)))
+        table = np.array(self._idx_to_vec.asnumpy())  # asnumpy is read-only
+        for t, v in zip(toks, vals):
+            if t not in self._token_to_idx:
+                raise ValueError("token %r not in the embedding" % (t,))
+            table[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd.array(table)
+
+
+class _PretrainedEmbedding(TokenEmbedding):
+    """Shared ctor for registry embeddings whose file ships from a URL
+    inventory (loud download failure without egress)."""
+
+    url_prefix = ""
+    pretrained_file_name_sha1 = {}
+
+    def __init__(self, pretrained_file_name=None, embedding_root=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if pretrained_file_name is None:
+            pretrained_file_name = next(iter(
+                self.pretrained_file_name_sha1))
+        if pretrained_file_name not in self.pretrained_file_name_sha1:
+            raise KeyError(
+                "unknown pretrained file %r for %s; known: %s"
+                % (pretrained_file_name, type(self).__name__,
+                   sorted(self.pretrained_file_name_sha1)))
+        root = embedding_root or os.path.join(
+            os.path.expanduser("~"), ".mxnet_tpu", "embeddings")
+        sha1 = self.pretrained_file_name_sha1[pretrained_file_name]
+        if sha1 is None:
+            # the reference pins SHA1s so torn caches re-fetch; those
+            # values aren't available offline, so be loud about it
+            logging.warning(
+                "%s: no SHA1 pinned for %s — a cached file is used "
+                "without integrity verification; delete %s to re-fetch",
+                type(self).__name__, pretrained_file_name, root)
+        path = download(
+            self.url_prefix + pretrained_file_name,
+            path=os.path.join(root, pretrained_file_name),
+            sha1_hash=sha1)
+        self._load_embedding(path)
+
+
+@register
+class GloVe(_PretrainedEmbedding):
+    """GloVe vectors (ref: embedding.py — GloVe; files from
+    nlp.stanford.edu). File inventory mirrors the reference's list."""
+
+    url_prefix = "https://nlp.stanford.edu/data/"
+    pretrained_file_name_sha1 = {
+        "glove.6B.50d.txt": None, "glove.6B.100d.txt": None,
+        "glove.6B.200d.txt": None, "glove.6B.300d.txt": None,
+        "glove.42B.300d.txt": None, "glove.840B.300d.txt": None,
+        "glove.twitter.27B.25d.txt": None,
+        "glove.twitter.27B.50d.txt": None,
+        "glove.twitter.27B.100d.txt": None,
+        "glove.twitter.27B.200d.txt": None,
+    }
+
+
+@register
+class FastText(_PretrainedEmbedding):
+    """fastText vectors (ref: embedding.py — FastText)."""
+
+    url_prefix = "https://dl.fbaipublicfiles.com/fasttext/vectors-wiki/"
+    pretrained_file_name_sha1 = {
+        "wiki.simple.vec": None, "wiki.en.vec": None,
+    }
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Embedding loaded from a user file of token<elem_delim>vector
+    lines (ref: embedding.py — CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim=elem_delim,
+                             encoding=encoding)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenates several embeddings' vectors over one vocabulary
+    (ref: embedding.py — CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(vocabulary, Vocabulary):
+            raise TypeError("vocabulary must be a Vocabulary")
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        unk = vocabulary.unknown_token
+        super().__init__(unknown_token=unk)
+        self._vocabulary = vocabulary
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = [emb.get_vecs_by_tokens(self._idx_to_token)
+                 for emb in token_embeddings]
+        self._idx_to_vec = nd.concat(*parts, dim=1)
+        self._vec_len = self._idx_to_vec.shape[1]
+
+    @property
+    def vocabulary(self):
+        return self._vocabulary

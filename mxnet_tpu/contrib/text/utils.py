@@ -1,0 +1,22 @@
+"""Text utilities (ref: python/mxnet/contrib/text/utils.py)."""
+from __future__ import annotations
+
+import collections
+import re
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Counts tokens in ``source_str`` split on ``token_delim`` and
+    ``seq_delim`` (ref: utils.py — count_tokens_from_str). Returns (or
+    updates in place) a ``collections.Counter``."""
+    source_str = re.split(token_delim + "|" + seq_delim, source_str)
+    tokens = [t for t in source_str if t]
+    if to_lower:
+        tokens = [t.lower() for t in tokens]
+    if counter_to_update is None:
+        return collections.Counter(tokens)
+    counter_to_update.update(tokens)
+    return counter_to_update

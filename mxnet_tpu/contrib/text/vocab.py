@@ -1,0 +1,95 @@
+"""Text-token indexing (ref: python/mxnet/contrib/text/vocab.py)."""
+from __future__ import annotations
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Maps tokens <-> integer indices (ref: vocab.py — Vocabulary).
+
+    Index 0 is the unknown token when ``unknown_token`` is set, followed
+    by ``reserved_tokens``, then counter tokens sorted by descending
+    frequency (ties broken alphabetically, like the reference).
+    """
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            reserved_set = set(reserved_tokens)
+            if len(reserved_set) != len(reserved_tokens):
+                raise ValueError("reserved_tokens must not be duplicated")
+            if unknown_token in reserved_set:
+                raise ValueError(
+                    "unknown_token must not appear in reserved_tokens")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = (list(reserved_tokens)
+                                 if reserved_tokens else None)
+        self._idx_to_token = ([unknown_token]
+                              if unknown_token is not None else [])
+        if reserved_tokens:
+            self._idx_to_token += list(reserved_tokens)
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        kept = 0
+        for token, freq in pairs:
+            if freq < min_freq:
+                break
+            if most_freq_count is not None and kept >= most_freq_count:
+                break
+            if token in self._token_to_idx:
+                continue
+            self._token_to_idx[token] = len(self._idx_to_token)
+            self._idx_to_token.append(token)
+            kept += 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token (or list of tokens) -> index (or list). Unknown tokens
+        map to the unknown index (0) — raises if no unknown_token."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = []
+        for t in toks:
+            if t in self._token_to_idx:
+                out.append(self._token_to_idx[t])
+            elif self._unknown_token is not None:
+                out.append(self._token_to_idx[self._unknown_token])
+            else:
+                raise KeyError(
+                    "token %r unknown and no unknown_token is set" % (t,))
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        out = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError("index %d out of range [0, %d)" %
+                                 (i, len(self._idx_to_token)))
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out

@@ -79,35 +79,15 @@ class SparseEmbedding(Block):
 class _PixelShuffle(HybridBlock):
     """Rearranges channel blocks into spatial dims — sub-pixel conv
     upsampling (ref: basic_layers.py — PixelShuffle1D/2D/3D; Shi et al.
-    1609.05158). Implemented as one reshape/transpose pair, which XLA
-    lowers to a single copy (no gather) on TPU."""
+    1609.05158). Written with the reference's shape-free reshape codes
+    (0 keep / -3 merge / -4 split) so the blocks trace symbolically
+    (export/SymbolBlock) as well as eagerly; XLA lowers the
+    reshape/transpose chain to a single copy on TPU."""
 
     def __init__(self, factor, ndim, **kwargs):
         super().__init__(**kwargs)
         self._factors = tuple(int(f) for f in _tup(factor, ndim))
         assert len(self._factors) == ndim, (factor, ndim)
-        self._ndim = ndim
-
-    def hybrid_forward(self, F, x):
-        f = self._factors
-        n = self._ndim
-        b = x.shape[0]
-        c_in = x.shape[1]
-        spatial = x.shape[2:]
-        prod = 1
-        for v in f:
-            prod *= v
-        assert c_in % prod == 0, \
-            "channels %d not divisible by product of factors %s" % (c_in, f)
-        c_out = c_in // prod
-        # (B, C*prod(f), *S) -> (B, C, f1..fn, *S) -> interleave -> merge
-        x = F.reshape(x, (b, c_out) + f + tuple(spatial))
-        perm = [0, 1]
-        for i in range(n):          # ... S_i, f_i pairs
-            perm += [2 + n + i, 2 + i]
-        x = F.transpose(x, axes=tuple(perm))
-        out_spatial = tuple(s * fi for s, fi in zip(spatial, f))
-        return F.reshape(x, (b, c_out) + out_spatial)
 
     def __repr__(self):
         return "%s(%s)" % (type(self).__name__, self._factors)
@@ -117,12 +97,39 @@ class PixelShuffle1D(_PixelShuffle):
     def __init__(self, factor, **kwargs):
         super().__init__(factor, 1, **kwargs)
 
+    def hybrid_forward(self, F, x):
+        f, = self._factors                       # (N, C*f, W)
+        x = F.reshape(x, shape=(0, -4, -1, f, 0))      # (N, C, f, W)
+        x = F.transpose(x, axes=(0, 1, 3, 2))    # (N, C, W, f)
+        return F.reshape(x, shape=(0, 0, -3))          # (N, C, W*f)
+
 
 class PixelShuffle2D(_PixelShuffle):
     def __init__(self, factor, **kwargs):
         super().__init__(factor, 2, **kwargs)
 
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors                          # (N, C*f1*f2, H, W)
+        x = F.reshape(x, shape=(0, -4, -1, f1 * f2, 0, 0))    # (N, C, f1*f2, H, W)
+        x = F.reshape(x, shape=(0, 0, -4, f1, f2, 0, 0))      # (N, C, f1, f2, H, W)
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))     # (N, C, H, f1, W, f2)
+        return F.reshape(x, shape=(0, 0, -3, -3))             # (N, C, H*f1, W*f2)
+
 
 class PixelShuffle3D(_PixelShuffle):
     def __init__(self, factor, **kwargs):
         super().__init__(factor, 3, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        f1, f2, f3 = self._factors               # (N, C*f1*f2*f3, D, H, W)
+        # split the factor block off C, then interleave each factor with
+        # its spatial dim, merging as we go
+        x = F.reshape(x, shape=(0, -4, -1, f1 * f2 * f3, 0, 0, 0))
+        x = F.swapaxes(x, dim1=2, dim2=3)                  # (N, C, D, f1*f2*f3, H, W)
+        x = F.reshape(x, shape=(0, 0, 0, -4, f1, f2 * f3, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -3, 0, 0, 0))    # (N, C, D*f1, f2*f3, H, W)
+        x = F.swapaxes(x, dim1=3, dim2=4)                  # (N, C, D*f1, H, f2*f3, W)
+        x = F.reshape(x, shape=(0, 0, 0, 0, -4, f2, f3, 0))
+        x = F.reshape(x, shape=(0, 0, 0, -3, 0, 0))    # (N, C, D*f1, H*f2, f3, W)
+        x = F.swapaxes(x, dim1=4, dim2=5)                  # (N, C, D*f1, H*f2, W, f3)
+        return F.reshape(x, shape=(0, 0, 0, 0, -3))    # (N, C, D*f1, H*f2, W*f3)

@@ -1,0 +1,129 @@
+"""contrib.text vocab/embedding/utils
+(ref: tests/python/unittest/test_contrib_text.py)."""
+import collections
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+
+
+def test_count_tokens_from_str():
+    c = text.utils.count_tokens_from_str("a b c\nb c c")
+    assert c == collections.Counter(
+        {"c": 3, "b": 2, "a": 1})
+    c2 = text.utils.count_tokens_from_str("A a", to_lower=True)
+    assert c2 == collections.Counter({"a": 2})
+    base = collections.Counter({"a": 1})
+    got = text.utils.count_tokens_from_str("a", counter_to_update=base)
+    assert got is base and base["a"] == 2
+
+
+def test_vocabulary_order_and_lookup():
+    counter = collections.Counter(
+        {"the": 5, "a": 5, "cat": 3, "dog": 1})
+    v = text.Vocabulary(counter, min_freq=2, reserved_tokens=["<pad>"])
+    # unk, reserved, then freq-desc (alphabetical ties)
+    assert v.idx_to_token == ["<unk>", "<pad>", "a", "the", "cat"]
+    assert v.to_indices("cat") == 4
+    assert v.to_indices(["zzz", "a"]) == [0, 2]
+    assert v.to_tokens([0, 2]) == ["<unk>", "a"]
+    assert len(v) == 5
+    with pytest.raises(ValueError):
+        v.to_tokens(99)
+
+
+def test_vocabulary_most_freq_count():
+    counter = collections.Counter({"a": 3, "b": 2, "c": 1})
+    v = text.Vocabulary(counter, most_freq_count=2)
+    assert v.idx_to_token == ["<unk>", "a", "b"]
+
+
+def test_vocabulary_validation():
+    with pytest.raises(ValueError):
+        text.Vocabulary(min_freq=0)
+    with pytest.raises(ValueError):
+        text.Vocabulary(reserved_tokens=["<unk>"])
+    with pytest.raises(ValueError):
+        text.Vocabulary(reserved_tokens=["x", "x"])
+
+
+@pytest.fixture
+def vec_file(tmp_path):
+    p = tmp_path / "custom.vec"
+    p.write_text("hello 0.1 0.2 0.3\nworld 1.0 2.0 3.0\n"
+                 "badline 0.5\n"          # malformed: skipped
+                 "hello 9.9 9.9 9.9\n")   # duplicate: first wins
+    return str(p)
+
+
+def test_custom_embedding(vec_file):
+    emb = text.embedding.CustomEmbedding(vec_file)
+    assert emb.vec_len == 3
+    assert len(emb) == 3  # unk + hello + world
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [0.1, 0.2, 0.3],
+        rtol=1e-6)
+    out = emb.get_vecs_by_tokens(["world", "nope"])
+    np.testing.assert_allclose(out.asnumpy()[0], [1.0, 2.0, 3.0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(out.asnumpy()[1], [0, 0, 0])
+    # lower_case_backup
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("HELLO", lower_case_backup=True).asnumpy(),
+        [0.1, 0.2, 0.3], rtol=1e-6)
+
+
+def test_update_token_vectors(vec_file):
+    emb = text.embedding.CustomEmbedding(vec_file)
+    emb.update_token_vectors("hello", mx.nd.array([7.0, 8.0, 9.0]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [7.0, 8.0, 9.0],
+        rtol=1e-6)
+    # plain-list vector for a single token must land element-wise
+    emb.update_token_vectors("world", [9.0, 8.0, 7.0])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [9.0, 8.0, 7.0],
+        rtol=1e-6)
+    with pytest.raises(ValueError):
+        emb.update_token_vectors("absent", mx.nd.array([1.0, 2.0, 3.0]))
+    with pytest.raises(ValueError):  # tokens/vectors length mismatch
+        emb.update_token_vectors(["hello", "world"],
+                                 mx.nd.array([[1.0, 2.0, 3.0]]))
+
+
+def test_composite_embedding(vec_file):
+    emb = text.embedding.CustomEmbedding(vec_file)
+    vocab = text.Vocabulary(collections.Counter({"hello": 2, "new": 1}))
+    comp = text.embedding.CompositeEmbedding(vocab, [emb, emb])
+    assert comp.vec_len == 6
+    got = comp.get_vecs_by_tokens("hello").asnumpy()
+    np.testing.assert_allclose(got, [0.1, 0.2, 0.3, 0.1, 0.2, 0.3],
+                               rtol=1e-6)
+    # token in vocab but not in the source embedding -> unknown vector
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("new").asnumpy(), np.zeros(6))
+
+
+def test_registry_create_and_inventory(vec_file):
+    names = text.embedding.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+    assert "glove.6B.50d.txt" in names["glove"]
+    emb = text.embedding.create("customembedding",
+                                pretrained_file_path=vec_file)
+    assert emb.vec_len == 3
+    with pytest.raises(KeyError):
+        text.embedding.create("nosuch")
+
+
+def test_pretrained_fetch_fails_loudly(tmp_path, monkeypatch):
+    """No egress: GloVe construction must raise, not hang or silently
+    return an empty table (matches gluon.utils.download posture)."""
+    monkeypatch.setenv("HOME", str(tmp_path))
+    import mxnet_tpu.gluon.utils as gutils
+    with pytest.raises(Exception):
+        text.embedding.create(
+            "glove", pretrained_file_name="glove.6B.50d.txt",
+            embedding_root=str(tmp_path))

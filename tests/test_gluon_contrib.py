@@ -44,6 +44,21 @@ def test_pixelshuffle_shapes(cls, shape, factor, out_shape):
     assert cls(factor)(mx.nd.ones(shape)).shape == out_shape
 
 
+def test_pixelshuffle_symbolic():
+    """Shape-free formulation must trace through the Symbol path
+    (export / SymbolBlock)."""
+    import mxnet_tpu.symbol as sym
+
+    for ps, shape in [(cnn.PixelShuffle1D(2), (1, 4, 5)),
+                      (cnn.PixelShuffle2D(2), (1, 8, 3, 3)),
+                      (cnn.PixelShuffle3D(2), (1, 8, 2, 2, 2))]:
+        out = ps(sym.var("data"))
+        eager = ps(mx.nd.ones(shape))
+        bound = out.bind(mx.cpu(), {"data": mx.nd.ones(shape)})
+        np.testing.assert_allclose(bound.forward()[0].asnumpy(),
+                                   eager.asnumpy(), rtol=1e-6)
+
+
 def test_sparse_embedding():
     se = cnn.SparseEmbedding(10, 4)
     se.initialize()
