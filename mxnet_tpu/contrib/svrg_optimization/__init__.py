@@ -1,0 +1,5 @@
+"""SVRG optimization (ref: python/mxnet/contrib/svrg_optimization)."""
+from .svrg_module import SVRGModule
+from .svrg_optimizer import _SVRGOptimizer
+
+__all__ = ["SVRGModule", "_SVRGOptimizer"]

@@ -1,0 +1,237 @@
+"""SVRGModule — Stochastic Variance Reduced Gradient training
+(ref: python/mxnet/contrib/svrg_optimization/svrg_module.py; Johnson &
+Zhang 2013).
+
+Design: the reference keeps a second executor group at the snapshot
+weights and special kvstore keys for the full gradients. Here the
+auxiliary Module shares the same single-program executor machinery, and
+the variance-reduced gradient ``g_i(w) - g_i(w_snap) + mu`` is one fused
+XLA elementwise expression per parameter — no kvstore round-trips."""
+from __future__ import annotations
+
+import time
+
+from ... import initializer as init_mod
+from ... import metric as metric_mod
+from ... import ndarray as nd
+from ... import optimizer as opt_mod
+from ...model import BatchEndParam
+from ...module.base_module import _as_list
+from ...module.module import Module
+from .svrg_optimizer import _SVRGOptimizer
+
+__all__ = ["SVRGModule"]
+
+
+def _as_metric(metric):
+    return metric if isinstance(metric, metric_mod.EvalMetric) \
+        else metric_mod.create(metric)
+
+
+class SVRGModule(Module):
+    """Module with SVRG updates: every ``update_freq`` epochs a full
+    gradient is evaluated at a weight snapshot, and each batch update
+    uses ``g_i(w) - g_i(w_snapshot) + full_grad``
+    (ref: svrg_module.py — SVRGModule)."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=None, context=None,
+                 work_load_list=None, fixed_param_names=None,
+                 state_names=None, update_freq=2, **kwargs):
+        import logging
+
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names,
+                         logger=logger or logging, context=context,
+                         work_load_list=work_load_list,
+                         fixed_param_names=fixed_param_names,
+                         state_names=state_names, **kwargs)
+        if not isinstance(update_freq, int) or update_freq < 1:
+            raise ValueError("update_freq must be a positive int, got %r"
+                             % (update_freq,))
+        self.update_freq = update_freq
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names,
+                               context=context,
+                               fixed_param_names=fixed_param_names)
+        self._param_dict = None  # name -> full gradient at the snapshot
+
+    # -- lifecycle -----------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module,
+                     grad_req)
+        if for_training:
+            self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                               inputs_need_grad, force_rebind,
+                               shared_module, grad_req)
+
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if initializer is None:
+            initializer = init_mod.Uniform(0.01)
+        super().init_params(initializer=initializer, arg_params=arg_params,
+                            aux_params=aux_params,
+                            allow_missing=allow_missing,
+                            force_init=force_init, allow_extra=allow_extra)
+        if self._mod_aux.binded:
+            arg, aux = self.get_params()
+            self._mod_aux.init_params(
+                initializer=initializer, arg_params=arg, aux_params=aux,
+                allow_missing=allow_missing, force_init=force_init,
+                allow_extra=allow_extra)
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        # the reference swaps in _SVRGOptimizer(default_optimizer=...)
+        # with offset keys for the full-grad slots; same seam here
+        if self.optimizer_initialized and not force_init:
+            return
+        super().init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                               optimizer_params=optimizer_params,
+                               force_init=force_init)
+        idx2name = {i: n for i, n in enumerate(self._param_names)}
+        idx2name.update({i + len(self._param_names): n + "_full"
+                         for i, n in enumerate(self._param_names)})
+        self._optimizer = _SVRGOptimizer(
+            default_optimizer=self._optimizer, param_idx2name=idx2name)
+        self._updater = opt_mod.get_updater(self._optimizer)
+
+    # -- SVRG machinery ------------------------------------------------
+    def update_full_grads(self, train_data):
+        """Takes a weight snapshot and accumulates the mean gradient of
+        the whole ``train_data`` at it (ref: svrg_module.py —
+        update_full_grads)."""
+        assert self.binded and self.params_initialized
+        arg, aux = self.get_params()
+        self._mod_aux.set_params(arg_params=arg, aux_params=aux)
+        train_data.reset()
+        nbatch = 0
+        accum = {name: None for name in self._param_names}
+        for batch in train_data:
+            self._mod_aux.forward_backward(batch)
+            for name in self._param_names:
+                g = self._mod_aux._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                accum[name] = g.copy() if accum[name] is None \
+                    else accum[name] + g
+            nbatch += 1
+        assert nbatch > 0, "train_data yielded no batches"
+        # the mean full grads land in their slots through the offset
+        # keys + _AssignmentOptimizer, the reference's kvstore seam
+        self._param_dict = self._param_dict or {}
+        for i, name in enumerate(self._param_names):
+            if accum[name] is None:
+                continue
+            mean = accum[name] / nbatch
+            slot = self._param_dict.get(name)
+            if slot is None:
+                slot = nd.zeros(mean.shape, dtype=mean.dtype)
+                self._param_dict[name] = slot
+            if self.optimizer_initialized:
+                self._updater(i + len(self._param_names), mean, slot)
+            else:
+                slot[:] = mean
+        train_data.reset()
+
+    def forward_backward(self, data_batch):
+        """Forward+backward on BOTH the live weights and the snapshot
+        weights (ref: svrg_module.py — forward_backward)."""
+        super().forward_backward(data_batch)
+        if self._param_dict is not None:
+            self._mod_aux.forward(data_batch, is_train=True)
+            self._mod_aux.backward()
+
+    def update(self):
+        """Applies the variance-reduced gradient through the updater
+        (ref: svrg_module.py — update + _update_svrg_gradients)."""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            if self._param_dict is not None and name in self._param_dict:
+                g_snap = self._mod_aux._exec.grad_dict[name]
+                grad = grad - g_snap + self._param_dict[name]
+            self._updater(i, grad, self._exec.arg_dict[name])
+
+    # -- training loop -------------------------------------------------
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None, sparse_row_id_fn=None):
+        """BaseModule.fit plus the full-gradient snapshot every
+        ``update_freq`` epochs (ref: svrg_module.py — fit)."""
+        del sparse_row_id_fn
+        assert num_epoch is not None, "please specify number of epochs"
+        if initializer is None:
+            initializer = init_mod.Uniform(0.01)
+
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        if monitor is not None:
+            self.install_monitor(monitor)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+
+        if validation_metric is None:
+            validation_metric = eval_metric
+        eval_metric = _as_metric(eval_metric)
+
+        for epoch in range(begin_epoch, num_epoch):
+            tic = time.time()
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            nbatch = 0
+            for data_batch in train_data:
+                if monitor is not None:
+                    monitor.tic()
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                if monitor is not None:
+                    monitor.toc_print()
+                if batch_end_callback is not None:
+                    params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                           eval_metric=eval_metric,
+                                           locals=locals())
+                    for cb in _as_list(batch_end_callback):
+                        cb(params)
+                nbatch += 1
+
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - tic)
+
+            arg_p, aux_p = self.get_params()
+            self.set_params(arg_p, aux_p)
+            if epoch_end_callback is not None:
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_p, aux_p)
+
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric,
+                                 score_end_callback=eval_end_callback,
+                                 batch_end_callback=eval_batch_end_callback,
+                                 epoch=epoch)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
+                                     name, val)
+            train_data.reset()

@@ -1,0 +1,60 @@
+"""SVRG optimizer internals (ref: python/mxnet/contrib/
+svrg_optimization/svrg_optimizer.py).
+
+The reference routes three key families through one kvstore optimizer:
+parameter keys (default optimizer), full-gradient keys (assignment), and
+the special-key arithmetic lives server-side. Our single-program design
+does the variance-reduction arithmetic in SVRGModule.update (one fused
+XLA expression per param); these classes keep the reference's optimizer
+seam so the update path is still routed through an Optimizer object."""
+from __future__ import annotations
+
+from ... import optimizer as opt_mod
+
+__all__ = ["_AssignmentOptimizer", "_SVRGOptimizer"]
+
+
+@opt_mod.register
+class _AssignmentOptimizer(opt_mod.Optimizer):
+    """update = plain assignment; used for the full-gradient slots
+    (ref: svrg_optimizer.py — _AssignmentOptimizer)."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        weight[:] = grad
+
+
+@opt_mod.register
+class _SVRGOptimizer(opt_mod.Optimizer):
+    """Dispatches full-gradient keys to assignment and parameter keys to
+    the wrapped default optimizer (ref: svrg_optimizer.py —
+    _SVRGOptimizer). Full-gradient keys are index-offset by the param
+    count and name-suffixed "_full", matching the reference's key
+    mangling."""
+
+    def __init__(self, default_optimizer="sgd", param_idx2name=None,
+                 **kwargs):
+        super().__init__(param_idx2name=param_idx2name or {}, **kwargs)
+        if isinstance(default_optimizer, str):
+            self.default_opt = opt_mod.create(
+                default_optimizer, param_idx2name=param_idx2name, **kwargs)
+        else:
+            self.default_opt = default_optimizer
+        self.aux_opt = _AssignmentOptimizer()
+
+    def _is_full_grad_key(self, index):
+        name = self.idx2name.get(index, "")
+        return name.endswith("_full")
+
+    def create_state(self, index, weight):
+        if self._is_full_grad_key(index):
+            return self.aux_opt.create_state(index, weight)
+        return self.default_opt.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        if self._is_full_grad_key(index):
+            self.aux_opt.update(index, weight, grad, state)
+        else:
+            self.default_opt.update(index, weight, grad, state)

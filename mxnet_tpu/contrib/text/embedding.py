@@ -72,6 +72,7 @@ class TokenEmbedding:
         skipped with a warning, first seen token wins (ref:
         embedding.py — _load_embedding)."""
         vecs = []
+        loaded_unknown_vec = None
         with io.open(path, "r", encoding=encoding) as f:
             for line_num, line in enumerate(f):
                 elems = line.rstrip().split(elem_delim)
@@ -86,6 +87,11 @@ class TokenEmbedding:
                     logging.warning("line %d in %s: inconsistent vector "
                                     "length, skipped", line_num, path)
                     continue
+                if token == self._unknown_token:
+                    # file supplies the unknown vector — use it (ref:
+                    # embedding.py loaded_unknown_vec)
+                    loaded_unknown_vec = np.asarray(vec, dtype=np.float32)
+                    continue
                 if token in self._token_to_idx:
                     continue
                 self._token_to_idx[token] = len(self._idx_to_token)
@@ -95,9 +101,12 @@ class TokenEmbedding:
                          dtype=np.float32)
         if vecs:
             table[1:] = np.stack(vecs)
-        unk = self._init_unknown_vec(shape=(self._vec_len,))
-        table[0] = (unk.asnumpy() if isinstance(unk, nd.NDArray)
-                    else np.asarray(unk))
+        if loaded_unknown_vec is not None:
+            table[0] = loaded_unknown_vec
+        else:
+            unk = self._init_unknown_vec(shape=(self._vec_len,))
+            table[0] = (unk.asnumpy() if isinstance(unk, nd.NDArray)
+                        else np.asarray(unk))
         self._idx_to_vec = nd.array(table)
 
     # -- API ----------------------------------------------------------
@@ -135,7 +144,10 @@ class TokenEmbedding:
                 return self._token_to_idx[t]
             if lower_case_backup and t.lower() in self._token_to_idx:
                 return self._token_to_idx[t.lower()]
-            return 0
+            if self._unknown_token is None:
+                raise KeyError("token %r unknown and no unknown_token "
+                               "is set" % (t,))
+            return self._token_to_idx[self._unknown_token]
         rows = self._idx_to_vec[nd.array([idx(t) for t in toks],
                                          dtype="int32")]
         return rows[0] if single else rows
@@ -169,6 +181,7 @@ class _PretrainedEmbedding(TokenEmbedding):
 
     url_prefix = ""
     pretrained_file_name_sha1 = {}
+    pretrained_archive_name = {}  # file -> containing zip (GloVe)
 
     def __init__(self, pretrained_file_name=None, embedding_root=None,
                  **kwargs):
@@ -191,10 +204,21 @@ class _PretrainedEmbedding(TokenEmbedding):
                 "%s: no SHA1 pinned for %s — a cached file is used "
                 "without integrity verification; delete %s to re-fetch",
                 type(self).__name__, pretrained_file_name, root)
-        path = download(
-            self.url_prefix + pretrained_file_name,
-            path=os.path.join(root, pretrained_file_name),
-            sha1_hash=sha1)
+        path = os.path.join(root, pretrained_file_name)
+        archive = self.pretrained_archive_name.get(pretrained_file_name)
+        if os.path.isfile(path) or archive is None:
+            # direct file (cached, or served as-is like fastText .vec)
+            path = download(self.url_prefix + pretrained_file_name,
+                            path=path, sha1_hash=sha1)
+        else:
+            # served inside a zip archive (GloVe): fetch + extract the
+            # member, like the reference's _get_pretrained_file
+            import zipfile
+
+            zpath = download(self.url_prefix + archive,
+                             path=os.path.join(root, archive))
+            with zipfile.ZipFile(zpath) as zf:
+                zf.extract(pretrained_file_name, root)
         self._load_embedding(path)
 
 
@@ -212,6 +236,18 @@ class GloVe(_PretrainedEmbedding):
         "glove.twitter.27B.50d.txt": None,
         "glove.twitter.27B.100d.txt": None,
         "glove.twitter.27B.200d.txt": None,
+    }
+    pretrained_archive_name = {
+        "glove.6B.50d.txt": "glove.6B.zip",
+        "glove.6B.100d.txt": "glove.6B.zip",
+        "glove.6B.200d.txt": "glove.6B.zip",
+        "glove.6B.300d.txt": "glove.6B.zip",
+        "glove.42B.300d.txt": "glove.42B.300d.zip",
+        "glove.840B.300d.txt": "glove.840B.300d.zip",
+        "glove.twitter.27B.25d.txt": "glove.twitter.27B.zip",
+        "glove.twitter.27B.50d.txt": "glove.twitter.27B.zip",
+        "glove.twitter.27B.100d.txt": "glove.twitter.27B.zip",
+        "glove.twitter.27B.200d.txt": "glove.twitter.27B.zip",
     }
 
 

@@ -89,6 +89,20 @@ class _PixelShuffle(HybridBlock):
         self._factors = tuple(int(f) for f in _tup(factor, ndim))
         assert len(self._factors) == ndim, (factor, ndim)
 
+    def __call__(self, x, *args):
+        # eager path: fail with a clear message instead of an opaque
+        # backend reshape error (Symbols have no shape; checked at bind)
+        shape = getattr(x, "shape", None)
+        if shape is not None and len(shape) >= 2:
+            prod = 1
+            for f in self._factors:
+                prod *= f
+            if shape[1] % prod != 0:
+                raise ValueError(
+                    "channels %d not divisible by product of factors %s"
+                    % (shape[1], self._factors))
+        return super().__call__(x, *args)
+
     def __repr__(self):
         return "%s(%s)" % (type(self).__name__, self._factors)
 

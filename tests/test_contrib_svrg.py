@@ -1,0 +1,121 @@
+"""contrib.svrg_optimization (ref: tests/python/unittest/
+test_contrib_svrg_module.py, test_contrib_svrg_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.svrg_optimization import SVRGModule, _SVRGOptimizer
+from mxnet_tpu.test_utils import with_seed
+
+
+def _linreg_symbol():
+    data = mx.sym.var("data")
+    label = mx.sym.var("lin_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+    return mx.sym.LinearRegressionOutput(fc, label, name="lro")
+
+
+def _make_iter(n=64, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-1, 1, (n, 3)).astype(np.float32)
+    w = np.array([[2.0, -3.0, 0.5]], dtype=np.float32)
+    y = x @ w.T + 0.01 * rng.randn(n, 1).astype(np.float32)
+    return mx.io.NDArrayIter(x, y, batch_size=batch, label_name="lin_label")
+
+
+def _new_module(update_freq=2):
+    return SVRGModule(_linreg_symbol(), data_names=("data",),
+                      label_names=("lin_label",), update_freq=update_freq)
+
+
+def test_update_freq_validation():
+    with pytest.raises(ValueError):
+        _new_module(update_freq=0)
+
+
+@with_seed()
+def test_bind_and_aux_module():
+    mod = _new_module()
+    it = _make_iter()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    assert mod.binded and mod._mod_aux.binded
+    mod.init_params()
+    arg, _ = mod.get_params()
+    arg_aux, _ = mod._mod_aux.get_params()
+    for k in arg:
+        np.testing.assert_array_equal(arg[k].asnumpy(),
+                                      arg_aux[k].asnumpy())
+
+
+@with_seed()
+def test_update_full_grads_is_dataset_mean():
+    mod = _new_module()
+    it = _make_iter(n=32, batch=8)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),))
+    mod.update_full_grads(it)
+    assert set(mod._param_dict) == {"fc_weight", "fc_bias"}
+    # manual mean of per-batch gradients at the same (snapshot) weights
+    it.reset()
+    sums, nb = {}, 0
+    for batch in it:
+        mod._mod_aux.forward_backward(batch)
+        for name in ("fc_weight", "fc_bias"):
+            g = mod._mod_aux._exec.grad_dict[name].asnumpy()
+            sums[name] = sums.get(name, 0) + g
+        nb += 1
+    for name in sums:
+        np.testing.assert_allclose(mod._param_dict[name].asnumpy(),
+                                   sums[name] / nb, rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_svrg_grad_at_snapshot_equals_full_grad():
+    """The defining identity: with w == w_snapshot, the variance-reduced
+    gradient g_i(w) - g_i(w_snap) + mu collapses to mu for every batch."""
+    mod = _new_module()
+    it = _make_iter(n=32, batch=8)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(
+        optimizer="sgd",
+        optimizer_params=(("learning_rate", 0.0),))  # freeze weights
+    mod.update_full_grads(it)
+    it.reset()
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    for name in ("fc_weight", "fc_bias"):
+        g = mod._exec.grad_dict[name]
+        g_snap = mod._mod_aux._exec.grad_dict[name]
+        combined = (g - g_snap + mod._param_dict[name]).asnumpy()
+        np.testing.assert_allclose(combined,
+                                   mod._param_dict[name].asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_svrg_fit_converges():
+    mod = _new_module(update_freq=2)
+    it = _make_iter(n=64, batch=8)
+    mod.fit(it, num_epoch=30, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.05),),
+            eval_metric="mse")
+    w = mod.get_params()[0]["fc_weight"].asnumpy()
+    np.testing.assert_allclose(w, [[2.0, -3.0, 0.5]], atol=0.15)
+
+
+@with_seed()
+def test_svrg_optimizer_dispatch():
+    opt = _SVRGOptimizer(default_optimizer="sgd", learning_rate=0.5,
+                         param_idx2name={0: "w", 1: "w_full"})
+    w = mx.nd.ones((2,))
+    g = mx.nd.ones((2,)) * 4.0
+    # param key: sgd step w -= lr * g
+    opt.update(0, w, g, opt.create_state(0, w))
+    np.testing.assert_allclose(w.asnumpy(), [-1.0, -1.0], rtol=1e-6)
+    # full-grad key: assignment
+    slot = mx.nd.zeros((2,))
+    opt.update(1, slot, g, opt.create_state(1, slot))
+    np.testing.assert_allclose(slot.asnumpy(), [4.0, 4.0], rtol=1e-6)

@@ -94,6 +94,37 @@ def test_update_token_vectors(vec_file):
                                  mx.nd.array([[1.0, 2.0, 3.0]]))
 
 
+def test_unknown_vector_from_file(tmp_path):
+    """A '<unk>' line in the source file supplies the unknown vector."""
+    p = tmp_path / "with_unk.vec"
+    p.write_text("<unk> 0.5 0.5 0.5\nhello 0.1 0.2 0.3\n")
+    emb = text.embedding.CustomEmbedding(str(p))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("never-seen").asnumpy(), [0.5, 0.5, 0.5],
+        rtol=1e-6)
+    assert len(emb) == 2  # unk + hello, no duplicate unk row
+
+
+def test_no_unknown_token_raises(vec_file):
+    emb = text.embedding.CustomEmbedding(vec_file)
+    vocab = text.Vocabulary(collections.Counter({"hello": 1}),
+                            unknown_token=None)
+    comp = text.embedding.CompositeEmbedding(vocab, emb)
+    with pytest.raises(KeyError):
+        comp.get_vecs_by_tokens("missing")
+
+
+def test_glove_archive_inventory():
+    """Every GloVe file maps to its hosting zip (the reference downloads
+    archives, not bare .txt)."""
+    gl = text.embedding.GloVe
+    assert set(gl.pretrained_archive_name) == set(
+        gl.pretrained_file_name_sha1)
+    assert gl.pretrained_archive_name["glove.6B.50d.txt"] == "glove.6B.zip"
+    assert gl.pretrained_archive_name[
+        "glove.twitter.27B.25d.txt"] == "glove.twitter.27B.zip"
+
+
 def test_composite_embedding(vec_file):
     emb = text.embedding.CustomEmbedding(vec_file)
     vocab = text.Vocabulary(collections.Counter({"hello": 2, "new": 1}))

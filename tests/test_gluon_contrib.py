@@ -44,6 +44,11 @@ def test_pixelshuffle_shapes(cls, shape, factor, out_shape):
     assert cls(factor)(mx.nd.ones(shape)).shape == out_shape
 
 
+def test_pixelshuffle_bad_channels_message():
+    with pytest.raises(ValueError, match="not divisible"):
+        cnn.PixelShuffle2D(2)(mx.nd.ones((1, 6, 3, 3)))
+
+
 def test_pixelshuffle_symbolic():
     """Shape-free formulation must trace through the Symbol path
     (export / SymbolBlock)."""
