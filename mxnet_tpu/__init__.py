@@ -43,6 +43,7 @@ from .symbol import Symbol
 from . import gluon
 from . import module
 from . import module as mod
+from . import rnn
 from .module import Module, BucketingModule, SequentialModule
 from . import model
 from .model import save_checkpoint, load_checkpoint
@@ -66,5 +67,5 @@ __all__ = [
     "sym", "Symbol", "module", "mod", "Module", "BucketingModule", "model",
     "save_checkpoint", "load_checkpoint", "profiler", "monitor",
     "operator", "image", "config", "amp", "contrib",
-    "SequentialModule", "visualization", "viz", "runtime", "util",
+    "SequentialModule", "visualization", "viz", "runtime", "util", "rnn",
 ]

@@ -301,6 +301,23 @@ def cast(a, dtype="float32"):
     return a.astype(get_dtype(dtype))
 
 
+@register("_zeros", aliases=("zeros_op",), differentiable=False)
+def _zeros(shape=(), dtype="float32"):
+    """Nullary creation op (ref: src/operator/tensor/init_op.cc — _zeros);
+    the symbolic form backs mx.sym.zeros / rnn begin_state."""
+    from ..base import get_dtype
+
+    return jnp.zeros(shape, dtype=get_dtype(dtype))
+
+
+@register("_ones", aliases=("ones_op",), differentiable=False)
+def _ones(shape=(), dtype="float32"):
+    """ref: init_op.cc — _ones."""
+    from ..base import get_dtype
+
+    return jnp.ones(shape, dtype=get_dtype(dtype))
+
+
 @register("zeros_like")
 def zeros_like(a):
     return jnp.zeros_like(a)

@@ -1,0 +1,14 @@
+"""mx.rnn — legacy symbolic RNN API (ref: python/mxnet/rnn/__init__.py)."""
+from .io import BucketSentenceIter
+from .rnn import (do_rnn_checkpoint, load_rnn_checkpoint,
+                  save_rnn_checkpoint)
+from .rnn_cell import (BaseRNNCell, BidirectionalRNNCell, DropoutCell,
+                       FusedRNNCell, GRUCell, LSTMCell, ModifierCell,
+                       ResidualCell, RNNCell, RNNParams,
+                       SequentialRNNCell, ZoneoutCell)
+
+__all__ = ["BucketSentenceIter", "do_rnn_checkpoint",
+           "load_rnn_checkpoint", "save_rnn_checkpoint", "BaseRNNCell",
+           "BidirectionalRNNCell", "DropoutCell", "FusedRNNCell",
+           "GRUCell", "LSTMCell", "ModifierCell", "ResidualCell",
+           "RNNCell", "RNNParams", "SequentialRNNCell", "ZoneoutCell"]

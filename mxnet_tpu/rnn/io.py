@@ -1,0 +1,119 @@
+"""Bucketing data iterator for variable-length sequences
+(ref: python/mxnet/rnn/io.py — BucketSentenceIter)."""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from ..io.io import DataBatch, DataDesc, DataIter
+
+__all__ = ["BucketSentenceIter"]
+
+
+class BucketSentenceIter(DataIter):
+    """Pads encoded sentences into length buckets and yields fixed-shape
+    batches with a ``bucket_key`` for BucketingModule
+    (ref: io.py — BucketSentenceIter). Buckets ARE the TPU story here:
+    each bucket is one static shape, so XLA compiles once per bucket
+    instead of once per sentence length."""
+
+    def __init__(self, sentences, batch_size, buckets=None,
+                 invalid_label=-1, data_name="data",
+                 label_name="softmax_label", dtype="float32", layout="NT"):
+        super().__init__(batch_size=batch_size)
+        if not buckets:
+            counts = np.bincount(
+                [len(s) for s in sentences if len(s) > 0])
+            buckets = [i for i, n in enumerate(counts)
+                       if n >= batch_size]
+            if not buckets:
+                buckets = [max(len(s) for s in sentences)]
+        buckets = sorted(buckets)
+
+        ndiscard = 0
+        self.data = [[] for _ in buckets]
+        for sentence in sentences:
+            if len(sentence) == 0:
+                continue
+            buck = np.searchsorted(buckets, len(sentence))
+            if buck == len(buckets):
+                ndiscard += 1
+                continue
+            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
+            buff[:len(sentence)] = sentence
+            self.data[buck].append(buff)
+        self.data = [np.asarray(x, dtype=dtype) for x in self.data]
+        if ndiscard:
+            import logging
+
+            logging.warning(
+                "discarded %d sentences longer than the largest bucket",
+                ndiscard)
+
+        self.batch_size = batch_size
+        self.buckets = buckets
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self.invalid_label = invalid_label
+        self.layout = layout
+        self.major_axis = layout.find("N")
+        self.default_bucket_key = max(buckets)
+
+        shape = ((batch_size, self.default_bucket_key)
+                 if self.major_axis == 0
+                 else (self.default_bucket_key, batch_size))
+        self.provide_data = [DataDesc(data_name, shape, layout=layout)]
+        self.provide_label = [DataDesc(label_name, shape, layout=layout)]
+
+        self.idx = []
+        for i, buck in enumerate(self.data):
+            self.idx.extend((i, j) for j in
+                            range(0, len(buck) - batch_size + 1,
+                                  batch_size))
+        self.curr_idx = 0
+        self.nddata = []
+        self.ndlabel = []
+        self.reset()
+
+    def reset(self):
+        from .. import ndarray as nd
+
+        self.curr_idx = 0
+        random.shuffle(self.idx)
+        for buck in self.data:
+            np.random.shuffle(buck)
+        self.nddata = []
+        self.ndlabel = []
+        for buck in self.data:
+            if len(buck) == 0:
+                self.nddata.append(None)
+                self.ndlabel.append(None)
+                continue
+            # next-token labels: shift left, pad with invalid_label
+            label = np.empty_like(buck)
+            label[:, :-1] = buck[:, 1:]
+            label[:, -1] = self.invalid_label
+            self.nddata.append(nd.array(buck, dtype=self.dtype))
+            self.ndlabel.append(nd.array(label, dtype=self.dtype))
+
+    def next(self):
+        if self.curr_idx == len(self.idx):
+            raise StopIteration
+        i, j = self.idx[self.curr_idx]
+        self.curr_idx += 1
+
+        if self.major_axis == 1:
+            data = self.nddata[i][j:j + self.batch_size].T
+            label = self.ndlabel[i][j:j + self.batch_size].T
+        else:
+            data = self.nddata[i][j:j + self.batch_size]
+            label = self.ndlabel[i][j:j + self.batch_size]
+
+        return DataBatch(
+            [data], [label], pad=0, bucket_key=self.buckets[i],
+            provide_data=[DataDesc(self.data_name, data.shape,
+                                   layout=self.layout)],
+            provide_label=[DataDesc(self.label_name, label.shape,
+                                    layout=self.layout)])

@@ -110,4 +110,18 @@ for _alias, _target in list(_registry._ALIASES.items()):
     if not hasattr(_mod, _alias):
         setattr(_mod, _alias, getattr(_mod, _target))
 
+def zeros(shape=(), dtype="float32", name=None, **kwargs):
+    """Constant-zeros symbol (ref: symbol creation API — mx.sym.zeros).
+    ``shape`` must be fully known; rnn cells' default unroll state uses a
+    shape-free zeros-from-inputs construction instead."""
+    return _apply_sym_op("_zeros", shape=tuple(shape), dtype=dtype,
+                         name=name, **kwargs)
+
+
+def ones(shape=(), dtype="float32", name=None, **kwargs):
+    """Constant-ones symbol (ref: mx.sym.ones)."""
+    return _apply_sym_op("_ones", shape=tuple(shape), dtype=dtype,
+                         name=name, **kwargs)
+
+
 from .executor import Executor  # noqa: E402,F401

@@ -1,0 +1,274 @@
+"""Legacy mx.rnn symbolic API
+(ref: tests/python/unittest/test_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import with_seed
+
+
+def _bind_forward(outputs, shapes, seed=0):
+    rng = np.random.RandomState(seed)
+    args = {}
+    sym = outputs if isinstance(outputs, mx.sym.Symbol) \
+        else mx.sym.Group(outputs)
+    for name in sym.list_arguments():
+        if name in shapes:
+            args[name] = mx.nd.array(
+                rng.uniform(-0.5, 0.5, shapes[name]).astype(np.float32))
+    missing = [n for n in sym.list_arguments() if n not in args]
+    assert not missing, "unshaped args: %s" % missing
+    exe = sym.bind(mx.cpu(), args)
+    return exe, args
+
+
+def _param_shapes(cell_prefix, in_dim, hidden, gates):
+    g = gates
+    return {
+        "%si2h_weight" % cell_prefix: (g * hidden, in_dim),
+        "%si2h_bias" % cell_prefix: (g * hidden,),
+        "%sh2h_weight" % cell_prefix: (g * hidden, hidden),
+        "%sh2h_bias" % cell_prefix: (g * hidden,),
+    }
+
+
+@with_seed()
+@pytest.mark.parametrize("cls,gates", [(mx.rnn.RNNCell, 1),
+                                       (mx.rnn.LSTMCell, 4),
+                                       (mx.rnn.GRUCell, 3)])
+def test_cell_unroll_shapes(cls, gates):
+    cell = cls(8, prefix="c_")
+    outputs, states = cell.unroll(3, mx.sym.var("data"),
+                                  merge_outputs=True)
+    shapes = {"data": (2, 3, 5)}
+    shapes.update(_param_shapes("c_", 5, 8, gates))
+    exe, _ = _bind_forward(outputs, shapes)
+    out = exe.forward()[0]
+    assert out.shape == (2, 3, 8)
+
+
+@with_seed()
+def test_lstm_matches_gluon_cell():
+    """Same weights -> same outputs as the gluon LSTMCell."""
+    cell = mx.rnn.LSTMCell(6, prefix="l_", forget_bias=0.0)
+    outputs, _ = cell.unroll(4, mx.sym.var("data"), merge_outputs=True)
+    shapes = {"data": (3, 4, 5)}
+    shapes.update(_param_shapes("l_", 5, 6, 4))
+    exe, args = _bind_forward(outputs, shapes, seed=3)
+    sym_out = exe.forward()[0].asnumpy()
+
+    gcell = mx.gluon.rnn.LSTMCell(6, input_size=5)
+    gcell.initialize()
+    gcell.i2h_weight.set_data(args["l_i2h_weight"])
+    gcell.i2h_bias.set_data(args["l_i2h_bias"])
+    gcell.h2h_weight.set_data(args["l_h2h_weight"])
+    gcell.h2h_bias.set_data(args["l_h2h_bias"])
+    gout, _ = gcell.unroll(4, mx.nd.array(args["data"].asnumpy()),
+                           merge_outputs=True)
+    np.testing.assert_allclose(sym_out, gout.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+@with_seed()
+def test_fused_matches_unfused():
+    T, B, I, H, L = 3, 2, 4, 5, 2
+    fused = mx.rnn.FusedRNNCell(H, num_layers=L, mode="lstm",
+                                prefix="f_", get_next_state=True)
+    f_out, f_states = fused.unroll(T, mx.sym.var("data"),
+                                   merge_outputs=True)
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    psize = rnn_param_size("lstm", I, H, num_layers=L)
+    exe, args = _bind_forward(f_out, {"data": (B, T, I),
+                                      "f_parameters": (psize,)}, seed=5)
+    fused_out = exe.forward()[0].asnumpy()
+
+    # unfuse, load the unpacked weights, compare
+    stack = fused.unfuse()
+    u_out, _ = stack.unroll(T, mx.sym.var("data"), merge_outputs=True)
+    unpacked = fused.unpack_weights({"f_parameters": args["f_parameters"],
+                                     "data": args["data"]})
+    u_args = {k: v for k, v in unpacked.items()}
+    u_sym = u_out
+    exe2 = u_sym.bind(mx.cpu(), {n: u_args[n]
+                                 for n in u_sym.list_arguments()})
+    unfused_out = exe2.forward()[0].asnumpy()
+    np.testing.assert_allclose(fused_out, unfused_out, rtol=1e-5,
+                               atol=1e-5)
+
+
+@with_seed()
+def test_fused_begin_state_batch_size():
+    """begin_state(batch_size=...) must produce (L*D, B, H) states."""
+    fused = mx.rnn.FusedRNNCell(5, num_layers=2, mode="lstm", prefix="f_")
+    states = fused.begin_state(batch_size=3)
+    assert len(states) == 2
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    psize = rnn_param_size("lstm", 4, 5, num_layers=2)
+    out, _ = fused.unroll(3, mx.sym.var("data"), begin_state=states,
+                          merge_outputs=True)
+    exe, _ = _bind_forward(out, {"data": (3, 3, 4),
+                                 "f_parameters": (psize,)})
+    assert exe.forward()[0].shape == (3, 3, 5)
+
+
+@with_seed()
+def test_fused_nested_in_sequential():
+    """FusedRNNCell stacked under SequentialRNNCell with default states."""
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.FusedRNNCell(4, num_layers=1, mode="gru",
+                                  prefix="fg_"))
+    stack.add(mx.rnn.LSTMCell(4, prefix="top_"))
+    out, states = stack.unroll(3, mx.sym.var("data"), merge_outputs=True)
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    shapes = {"data": (2, 3, 6),
+              "fg_parameters": (rnn_param_size("gru", 6, 4),)}
+    shapes.update(_param_shapes("top_", 4, 4, 4))
+    exe, _ = _bind_forward(out, shapes)
+    assert exe.forward()[0].shape == (2, 3, 4)
+
+
+@with_seed()
+def test_pack_unpack_roundtrip():
+    fused = mx.rnn.FusedRNNCell(5, num_layers=2, mode="gru", prefix="g_")
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    psize = rnn_param_size("gru", 4, 5, num_layers=2)
+    params = mx.nd.array(np.random.RandomState(0)
+                         .uniform(-1, 1, (psize,)).astype(np.float32))
+    unpacked = fused.unpack_weights({"g_parameters": params})
+    assert "g_parameters" not in unpacked
+    assert "g_l0_i2h_weight" in unpacked
+    assert unpacked["g_l0_i2h_weight"].shape == (15, 4)
+    repacked = fused.pack_weights(unpacked)
+    np.testing.assert_allclose(repacked["g_parameters"].asnumpy(),
+                               params.asnumpy(), rtol=1e-6)
+
+
+@with_seed()
+def test_bidirectional_unroll():
+    cell = mx.rnn.BidirectionalRNNCell(
+        mx.rnn.LSTMCell(4, prefix="fw_"),
+        mx.rnn.LSTMCell(4, prefix="bw_"))
+    outputs, states = cell.unroll(3, mx.sym.var("data"),
+                                  merge_outputs=True)
+    shapes = {"data": (2, 3, 5)}
+    shapes.update(_param_shapes("fw_", 5, 4, 4))
+    shapes.update(_param_shapes("bw_", 5, 4, 4))
+    exe, _ = _bind_forward(outputs, shapes)
+    assert exe.forward()[0].shape == (2, 3, 8)
+
+
+@with_seed()
+def test_sequential_and_residual():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(4, prefix="s0_"))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.LSTMCell(4, prefix="s1_")))
+    outputs, states = stack.unroll(3, mx.sym.var("data"),
+                                   merge_outputs=True)
+    shapes = {"data": (2, 3, 4)}
+    shapes.update(_param_shapes("s0_", 4, 4, 4))
+    shapes.update(_param_shapes("s1_", 4, 4, 4))
+    exe, _ = _bind_forward(outputs, shapes)
+    assert exe.forward()[0].shape == (2, 3, 4)
+    assert len(states) == 4
+
+
+def test_bucket_sentence_iter():
+    sentences = [[1, 2, 3], [4, 5], [6, 7, 8, 9, 10, 11, 12],
+                 [1, 3, 5], [2, 4], [9, 9, 9]] * 4
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4,
+                                   buckets=[3, 5], invalid_label=-1)
+    assert it.default_bucket_key == 5
+    seen = 0
+    for batch in it:
+        assert batch.bucket_key in (3, 5)
+        assert batch.data[0].shape == (4, batch.bucket_key)
+        d = batch.data[0].asnumpy()
+        lbl = batch.label[0].asnumpy()
+        # label is next-token shift of data
+        np.testing.assert_array_equal(lbl[:, :-1], d[:, 1:])
+        assert (lbl[:, -1] == -1).all()
+        seen += 1
+    assert seen >= 2
+    it.reset()
+    assert sum(1 for _ in it) == seen
+
+
+@with_seed()
+def test_bucketing_module_with_rnn_cells():
+    """The canonical bucketing flow: variable-length first-token-recall
+    task trained with BucketingModule over mx.rnn cells."""
+    rng = np.random.RandomState(0)
+    sentences = []
+    for _ in range(160):
+        ln = rng.choice([3, 5])
+        sentences.append(rng.randint(0, 2, ln).tolist())
+
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=2, output_dim=8,
+                                 name="embed")
+        cell = mx.rnn.LSTMCell(16, prefix="lstm_")
+        outputs, _ = cell.unroll(seq_len, embed, merge_outputs=False)
+        pred = mx.sym.FullyConnected(outputs[-1], num_hidden=2, name="fc")
+        return (mx.sym.SoftmaxOutput(pred, label, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    buckets = [3, 5]
+    data = [[] for _ in buckets]
+    label = [[] for _ in buckets]
+    for s in sentences:
+        b = buckets.index(len(s))
+        data[b].append(s)
+        label[b].append([s[0]])  # recall the first token across time
+
+    class _Iter(mx.io.DataIter):
+        def __init__(self):
+            super().__init__(batch_size=8)
+            from mxnet_tpu.io.io import DataDesc
+
+            self.provide_data = [DataDesc("data", (8, 5))]
+            self.provide_label = [DataDesc("softmax_label", (8,))]
+            self.default_bucket_key = 5
+            self._order = []
+            for bi, rows in enumerate(data):
+                for start in range(0, len(rows) - 7, 8):
+                    self._order.append((bi, start))
+            self._i = 0
+
+        def reset(self):
+            self._i = 0
+
+        def next(self):
+            from mxnet_tpu.io.io import DataBatch, DataDesc
+
+            if self._i >= len(self._order):
+                raise StopIteration
+            bi, start = self._order[self._i]
+            self._i += 1
+            d = mx.nd.array(np.asarray(data[bi][start:start + 8],
+                                       dtype=np.float32))
+            lbl = mx.nd.array(np.asarray(
+                label[bi][start:start + 8], dtype=np.float32).ravel())
+            return DataBatch(
+                [d], [lbl], bucket_key=buckets[bi],
+                provide_data=[DataDesc("data", d.shape)],
+                provide_label=[DataDesc("softmax_label", lbl.shape)])
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=5)
+    it = _Iter()
+    mod.fit(it, num_epoch=15, optimizer="adam",
+            optimizer_params=(("learning_rate", 0.01),))
+    it.reset()
+    correct = total = 0
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy()
+        lbl = batch.label[0].asnumpy()
+        correct += (pred.argmax(axis=1) == lbl).sum()
+        total += len(lbl)
+    assert correct / total > 0.9, (correct, total)
