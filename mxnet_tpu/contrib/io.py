@@ -1,0 +1,60 @@
+"""contrib.io (ref: python/mxnet/contrib/io.py): DataLoaderIter wraps a
+gluon DataLoader as a classic DataIter so Module.fit can drive
+gluon-style datasets."""
+from __future__ import annotations
+
+from ..io.io import DataBatch, DataDesc, DataIter
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """ref: contrib/io.py — DataLoaderIter. Infers provide_data /
+    provide_label from the first batch; the loader must yield
+    (data, label) pairs of NDArrays (or lists of them)."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label",
+                 dtype="float32"):
+        super().__init__()
+        self._loader = loader
+        self._iter = iter(loader)
+        self._dtype = dtype
+        self._data_name = data_name
+        self._label_name = label_name
+
+        first = next(self._iter)
+        data, label = self._as_pair(first)
+        self.batch_size = data[0].shape[0]
+
+        def _descs(arrays, name):
+            # multi-array loaders need distinct names (Module binds by
+            # name); single-array keeps the plain name like NDArrayIter
+            if len(arrays) == 1:
+                return [DataDesc(name, arrays[0].shape, dtype)]
+            return [DataDesc("_%d_%s" % (i, name), a.shape, dtype)
+                    for i, a in enumerate(arrays)]
+
+        self.provide_data = _descs(data, data_name)
+        self.provide_label = _descs(label, label_name)
+        self._pending = first
+
+    @staticmethod
+    def _as_pair(batch):
+        data, label = batch
+        if not isinstance(data, (list, tuple)):
+            data = [data]
+        if not isinstance(label, (list, tuple)):
+            label = [label]
+        return data, label
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._pending = None
+
+    def next(self):
+        if self._pending is not None:
+            batch, self._pending = self._pending, None
+        else:
+            batch = next(self._iter)
+        data, label = self._as_pair(batch)
+        return DataBatch(list(data), list(label), pad=0)

@@ -1,5 +1,5 @@
 """gluon.contrib (ref: python/mxnet/gluon/contrib/__init__.py)."""
-from . import estimator, nn, rnn
+from . import data, estimator, nn, rnn
 from .estimator import Estimator
 
-__all__ = ["estimator", "Estimator", "nn", "rnn"]
+__all__ = ["data", "estimator", "Estimator", "nn", "rnn"]

@@ -1,0 +1,7 @@
+"""gluon.contrib.data (ref: python/mxnet/gluon/contrib/data)."""
+from . import sampler, text
+from .sampler import IntervalSampler
+from .text import WikiText2, WikiText103
+
+__all__ = ["sampler", "text", "IntervalSampler", "WikiText2",
+           "WikiText103"]

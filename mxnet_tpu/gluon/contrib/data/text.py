@@ -1,0 +1,89 @@
+"""Experimental text datasets (ref: python/mxnet/gluon/contrib/data/
+text.py — WikiText2/WikiText103). The parsing/vocabulary/sequence logic
+is fully functional over a local copy of the corpus; the fetch goes
+through gluon.utils.download which raises loudly without egress unless
+the archive is already cached."""
+from __future__ import annotations
+
+import io
+import os
+import zipfile
+
+import numpy as np
+
+from ....contrib.text.utils import count_tokens_from_str
+from ....contrib.text.vocab import Vocabulary
+from ...data.dataset import Dataset
+from ...utils import download
+
+__all__ = ["WikiText2", "WikiText103"]
+
+
+class _WikiText(Dataset):
+    """Token-id sequences of fixed length ``seq_len`` over the corpus
+    (ref: text.py — _WikiText; layout matches the reference: flatten the
+    whole split, chop into (seq_len+1)-grams: data=x[:-1], label=x[1:])."""
+
+    archive = ""
+    url_root = "https://s3.amazonaws.com/research.metamind.io/wikitext/"
+    namespace = ""
+
+    def __init__(self, root, segment, vocab, seq_len):
+        self._root = os.path.expanduser(root)
+        self._segment = segment
+        self._seq_len = seq_len
+        os.makedirs(self._root, exist_ok=True)
+        raw = self._read_segment()
+        counter = count_tokens_from_str(raw)
+        self.vocabulary = vocab if vocab is not None else Vocabulary(
+            counter, unknown_token="<unk>", reserved_tokens=["<eos>"])
+        ids = np.asarray(
+            self.vocabulary.to_indices(
+                raw.replace("\n", " <eos> ").split()),
+            dtype=np.int32)
+        n = (len(ids) - 1) // seq_len
+        self._data = ids[:n * seq_len].reshape(n, seq_len)
+        self._label = ids[1:n * seq_len + 1].reshape(n, seq_len)
+
+    def _read_segment(self):
+        fname = "wiki.%s.tokens" % self._segment
+        member = "%s/%s" % (self.namespace, fname)
+        path = os.path.join(self._root, fname)
+        if not os.path.isfile(path):
+            zpath = download(self.url_root + self.archive,
+                             path=os.path.join(self._root, self.archive))
+            with zipfile.ZipFile(zpath) as zf:
+                with zf.open(member) as src, open(path, "wb") as dst:
+                    dst.write(src.read())
+        with io.open(path, "r", encoding="utf8") as f:
+            return f.read()
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        from .... import ndarray as nd
+
+        return nd.array(self._data[idx]), nd.array(self._label[idx])
+
+
+class WikiText2(_WikiText):
+    """ref: text.py — WikiText2 (segments: train/val/test)."""
+
+    archive = "wikitext-2-v1.zip"
+    namespace = "wikitext-2"
+
+    def __init__(self, root="~/.mxnet_tpu/datasets/wikitext-2",
+                 segment="train", vocab=None, seq_len=35):
+        super().__init__(root, segment, vocab, seq_len)
+
+
+class WikiText103(_WikiText):
+    """ref: text.py — WikiText103."""
+
+    archive = "wikitext-103-v1.zip"
+    namespace = "wikitext-103"
+
+    def __init__(self, root="~/.mxnet_tpu/datasets/wikitext-103",
+                 segment="train", vocab=None, seq_len=35):
+        super().__init__(root, segment, vocab, seq_len)

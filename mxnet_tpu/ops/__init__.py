@@ -13,6 +13,7 @@ from . import loss_output
 from . import attention
 from . import linalg
 from . import contrib_ops
+from . import ctc
 
 from .registry import apply_op, get_op, list_ops, register, Op
 

@@ -1,0 +1,112 @@
+"""CTC loss (ref: src/operator/nn/ctc_loss.cc — the reference wraps
+warp-ctc/cuDNN; here the standard log-semiring alpha recursion runs as a
+`lax.scan` over time — one fused XLA program, static shapes (padded
+label path, masked lengths), gradients via autodiff of the scan, which
+XLA rematerializes efficiently on TPU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = ["ctc_loss"]
+
+_NEG_INF = -1e30
+
+
+def _ctc_alpha_scan(log_probs, ext_labels, ext_mask, data_mask):
+    """log-alpha recursion. log_probs: (T, N, C); ext_labels: (N, S) with
+    blanks interleaved; ext_mask: (N, S) valid-slot mask; data_mask:
+    (T, N). Returns final alpha (N, S)."""
+    N, S = ext_labels.shape
+
+    lp_ext_all = jnp.take_along_axis(
+        log_probs,
+        jnp.broadcast_to(ext_labels[None], (log_probs.shape[0], N, S)),
+        axis=2)                                        # (T, N, S)
+
+    # skip-connection allowed where label differs from two slots back
+    # (and the slot is a non-blank, i.e. odd position)
+    same_as_two_back = jnp.concatenate(
+        [jnp.ones((N, 2), dtype=bool),
+         ext_labels[:, 2:] == ext_labels[:, :-2]], axis=1)
+    can_skip = (~same_as_two_back) & (jnp.arange(S)[None, :] % 2 == 1)
+
+    alpha0 = jnp.full((N, S), _NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(lp_ext_all[0, :, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(ext_mask[:, 1], lp_ext_all[0, :, 1], _NEG_INF))
+
+    def shift(a, k):
+        return jnp.concatenate(
+            [jnp.full((N, k), _NEG_INF), a[:, :-k]], axis=1)
+
+    def step(alpha, inputs):
+        lp_t, m_t = inputs                      # (N, S), (N,)
+        stay = alpha
+        diag = shift(alpha, 1)
+        skip = jnp.where(can_skip, shift(alpha, 2), _NEG_INF)
+        new = jnp.logaddexp(jnp.logaddexp(stay, diag), skip) + lp_t
+        new = jnp.where(ext_mask, new, _NEG_INF)
+        # past the sample's length the alpha is carried through unchanged
+        new = jnp.where(m_t[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0,
+                            (lp_ext_all[1:], data_mask[1:]))
+    return alpha
+
+
+@register("CTCLoss", aliases=("ctc_loss", "_contrib_CTCLoss",
+                              "_contrib_ctc_loss"), wrt=(0,))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first"):
+    """ref: ctc_loss.cc — CTCLossOp. data: (T, N, C) unnormalized
+    activations (softmax applied internally, like the reference); label:
+    (N, L) padded class indices. Without explicit label_lengths, padding
+    uses 0 for blank_label='first' (classes are 1-based) and -1
+    otherwise. Returns per-sample negative log likelihood (N,)."""
+    T, N, C = data.shape
+    L = label.shape[1]
+    log_probs = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+
+    label = label.astype(jnp.int32)
+    if blank_label == "first":
+        blank = 0
+        pad = 0
+    else:
+        blank = C - 1
+        pad = -1
+
+    if use_label_lengths and label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        lab_len = jnp.sum((label != pad).astype(jnp.int32), axis=1)
+    if use_data_lengths and data_lengths is not None:
+        dat_len = data_lengths.astype(jnp.int32)
+    else:
+        dat_len = jnp.full((N,), T, dtype=jnp.int32)
+
+    # interleave blanks: S = 2L+1 slots [b, l1, b, l2, ..., b]
+    S = 2 * L + 1
+    pos = jnp.arange(S)
+    lab_idx = jnp.clip((pos - 1) // 2, 0, L - 1)
+    gathered = jnp.take_along_axis(
+        label, jnp.broadcast_to(lab_idx, (N, S)), axis=1)
+    ext_labels = jnp.where(pos[None, :] % 2 == 1, gathered, blank)
+    ext_labels = jnp.clip(ext_labels, 0, C - 1)
+    ext_mask = pos[None, :] < (2 * lab_len[:, None] + 1)
+
+    data_mask = jnp.arange(T)[:, None] < dat_len[None, :]  # (T, N)
+
+    alpha = _ctc_alpha_scan(log_probs, ext_labels, ext_mask, data_mask)
+
+    last = 2 * lab_len            # blank after the last label
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(
+        alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(lab_len > 0, a_prev, _NEG_INF)
+    loss = -jnp.logaddexp(a_last, a_prev)
+    return loss.astype(data.dtype)

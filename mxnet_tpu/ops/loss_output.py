@@ -21,8 +21,13 @@ def _regression_core(transform, grad_fn):
 
     def bwd(grad_scale, res, ct):
         data, label = res
+        # the reference reshapes a same-size label onto data
+        # (regression_output-inl.h) — a (B,) label against (B,1) data
+        # must NOT broadcast to (B,B)
+        lab = label.reshape(data.shape) if label.size == data.size \
+            else label
         num_output = max(1, int(jnp.size(data)) // max(1, data.shape[0]))
-        g = grad_fn(transform(data), label) * (grad_scale / num_output)
+        g = grad_fn(transform(data), lab) * (grad_scale / num_output)
         return (g.astype(data.dtype), jnp.zeros_like(label))
 
     core = jax.custom_vjp(

@@ -1,0 +1,175 @@
+"""CTC loss (vs torch ground truth), contrib.io.DataLoaderIter,
+gluon.contrib.data samplers/datasets
+(ref: tests/python/unittest/{test_loss.py,test_contrib_data}.py)."""
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.test_utils import with_seed
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as tF  # noqa: E402
+
+
+@with_seed()
+def test_ctc_op_matches_torch():
+    rng = np.random.RandomState(0)
+    T, N, C = 12, 3, 6
+    logits = rng.randn(T, N, C).astype(np.float32)
+    labels = np.array([[1, 2, 3, 2], [2, 2, 0, 0], [4, 1, 5, 3]])
+    lab_lens = np.array([4, 2, 4])
+    dat_lens = np.array([12, 9, 12])
+
+    ours = mx.nd.CTCLoss(
+        mx.nd.array(logits), mx.nd.array(labels.astype(np.float32)),
+        mx.nd.array(dat_lens.astype(np.float32)),
+        mx.nd.array(lab_lens.astype(np.float32)),
+        use_data_lengths=True, use_label_lengths=True,
+        blank_label="first")
+    ref = tF.ctc_loss(
+        torch.from_numpy(logits).log_softmax(-1),
+        torch.from_numpy(labels), torch.from_numpy(dat_lens),
+        torch.from_numpy(lab_lens), blank=0, reduction="none")
+    np.testing.assert_allclose(ours.asnumpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+@with_seed()
+def test_ctc_grad_matches_torch():
+    rng = np.random.RandomState(2)
+    T, N, C = 10, 2, 5
+    logits = rng.randn(T, N, C).astype(np.float32)
+    labels = np.array([[1, 2, 3], [2, 1, 0]])
+
+    x = mx.nd.array(logits)
+    x.attach_grad()
+    with autograd.record():
+        loss = mx.nd.CTCLoss(x, mx.nd.array(labels.astype(np.float32)),
+                             blank_label="first")
+    loss.backward()
+    tx = torch.from_numpy(logits).requires_grad_()
+    tl = tF.ctc_loss(tx.log_softmax(-1), torch.from_numpy(labels),
+                     torch.tensor([T, T]), torch.tensor([3, 2]),
+                     blank=0, reduction="sum")
+    tl.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), tx.grad.numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+
+@with_seed()
+def test_gluon_ctc_loss_blank_last():
+    rng = np.random.RandomState(1)
+    N, T, C = 2, 10, 5
+    pred = rng.randn(N, T, C).astype(np.float32)
+    label = np.array([[1, 2, 3], [0, 2, -1]], dtype=np.float32)
+    loss = gluon.loss.CTCLoss()(mx.nd.array(pred), mx.nd.array(label))
+    ref = tF.ctc_loss(
+        torch.from_numpy(pred.transpose(1, 0, 2)).log_softmax(-1),
+        torch.from_numpy(np.array([[1, 2, 3], [0, 2, 0]])),
+        torch.tensor([T, T]), torch.tensor([3, 2]),
+        blank=C - 1, reduction="none")
+    np.testing.assert_allclose(loss.asnumpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_ctc_trains():
+    """CTC decreases when training toward a target sequence."""
+    mx.random.seed(0)
+    np.random.seed(0)
+    N, T, C = 4, 12, 4
+    x = mx.nd.random.uniform(shape=(N, T, 8))
+    label = mx.nd.array(np.tile([0, 1, 2], (N, 1)).astype(np.float32))
+    net = gluon.nn.Dense(C, flatten=False)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.05})
+    ctc = gluon.loss.CTCLoss()
+    first = None
+    for _ in range(60):
+        with autograd.record():
+            loss = ctc(net(x), label)
+        loss.backward()
+        tr.step(N)
+        if first is None:
+            first = float(loss.mean().asnumpy())
+    final = float(loss.mean().asnumpy())
+    assert final < 0.6 * first, (first, final)
+
+
+@with_seed()
+def test_regression_output_flat_label():
+    """(B,) label vs (B,1) prediction must reshape, not broadcast
+    (ref: regression_output-inl.h label reshape)."""
+    x = mx.nd.array(np.random.randn(4, 3).astype(np.float32))
+    w = mx.nd.array(np.random.randn(1, 3).astype(np.float32))
+    y = mx.nd.array(np.random.randn(4).astype(np.float32))  # flat label
+    w.attach_grad()
+    with autograd.record():
+        pred = mx.nd.FullyConnected(x, w, None, no_bias=True, num_hidden=1)
+        out = mx.nd.LinearRegressionOutput(pred, y)
+    out.backward()
+    g = w.grad.asnumpy()
+    manual = ((pred.asnumpy().ravel() - y.asnumpy())[:, None]
+              * x.asnumpy()).sum(0, keepdims=True)
+    np.testing.assert_allclose(g, manual, rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_ce_alias():
+    assert gluon.loss.SoftmaxCELoss is gluon.loss.SoftmaxCrossEntropyLoss
+
+
+def test_dataloader_iter():
+    from mxnet_tpu.contrib.io import DataLoaderIter
+
+    x = np.arange(40, dtype=np.float32).reshape(20, 2)
+    y = np.arange(20, dtype=np.float32)
+    ds = gluon.data.ArrayDataset(mx.nd.array(x), mx.nd.array(y))
+    loader = gluon.data.DataLoader(ds, batch_size=5)
+    it = DataLoaderIter(loader)
+    assert it.provide_data[0].shape == (5, 2)
+    batches = list(it)
+    assert len(batches) == 4
+    it.reset()
+    first = next(iter(it))
+    np.testing.assert_array_equal(first.data[0].asnumpy(), x[:5])
+
+
+def test_interval_sampler():
+    from mxnet_tpu.gluon.contrib.data import IntervalSampler
+
+    s = list(IntervalSampler(10, 3))
+    assert s == [0, 3, 6, 9, 1, 4, 7, 2, 5, 8]
+    assert len(IntervalSampler(10, 3)) == 10
+    s2 = list(IntervalSampler(10, 3, rollover=False))
+    assert s2 == [0, 3, 6, 9]
+
+
+def test_wikitext_parsing(tmp_path, monkeypatch):
+    """Dataset parses a locally-cached corpus (no egress needed)."""
+    from mxnet_tpu.gluon.contrib.data import WikiText2
+
+    root = tmp_path / "wt2"
+    root.mkdir()
+    text = "the cat sat\nthe dog sat\nthe cat ran\n" * 20
+    (root / "wiki.train.tokens").write_text(text)
+    ds = WikiText2(root=str(root), segment="train", seq_len=5)
+    assert len(ds) > 10
+    d, l = ds[0]
+    assert d.shape == (5,) and l.shape == (5,)
+    # label is the next-token shift of data across the flat stream
+    d2, _ = ds[1]
+    flat = np.concatenate([d.asnumpy(), d2.asnumpy()])
+    np.testing.assert_array_equal(l.asnumpy(), flat[1:6])
+    # vocabulary covers the corpus
+    assert set(ds.vocabulary.to_indices(["the", "cat", "<eos>"]))
+
+
+def test_wikitext_fails_loudly_without_cache(tmp_path):
+    from mxnet_tpu.gluon.contrib.data import WikiText2
+
+    with pytest.raises(Exception):
+        WikiText2(root=str(tmp_path / "empty"), segment="train")
