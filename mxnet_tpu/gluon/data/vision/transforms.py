@@ -17,7 +17,8 @@ from ...nn import Sequential, HybridSequential
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
            "RandomBrightness", "RandomContrast", "RandomSaturation",
-           "RandomColorJitter", "RandomLighting"]
+           "RandomHue", "RandomColorJitter", "RandomLighting",
+           "CropResize", "Rotate", "RandomRotation"]
 
 
 def _to_np(x):
@@ -213,10 +214,38 @@ class RandomSaturation(_RandomScale):
         return _nd.array(np.clip(arr * f + gray * (1 - f), 0, 255))
 
 
+class RandomHue(Block):
+    """Hue jitter with a factor from [max(0, 1-hue), 1+hue]
+    (ref: transforms.py — RandomHue; backend image_random-inl.h uses the
+    same YIQ chroma-rotation formulation, vectorized here in numpy)."""
+
+    _t_yiq = np.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], dtype=np.float32)
+    # exact inverse (the textbook t_rgb is truncated to 3 decimals,
+    # which breaks the hue=0 == identity contract at uint8 scale)
+    _t_rgb = np.linalg.inv(_t_yiq)
+
+    def __init__(self, hue):
+        super().__init__()
+        self._hue = hue
+
+    def forward(self, x):
+        arr = _to_np(x).astype(np.float32)
+        f = np.random.uniform(max(0.0, 1 - self._hue), 1 + self._hue)
+        theta = (f - 1.0) * np.pi
+        u, w = np.cos(theta), np.sin(theta)
+        # RGB -> YIQ, rotate the IQ (chroma) plane by theta, -> RGB
+        rot = np.array([[1.0, 0.0, 0.0],
+                        [0.0, u, -w],
+                        [0.0, w, u]], dtype=np.float32)
+        m = self._t_rgb @ rot @ self._t_yiq
+        return _nd.array(np.clip(arr @ m.T, 0, 255))
+
+
 class RandomColorJitter(Block):
     def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
         super().__init__()
-        del hue  # HSV hue jitter needs colorsys per-pixel; omitted (rare)
         self._ts = []
         if brightness:
             self._ts.append(RandomBrightness(brightness))
@@ -224,6 +253,8 @@ class RandomColorJitter(Block):
             self._ts.append(RandomContrast(contrast))
         if saturation:
             self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
 
     def forward(self, x):
         order = np.random.permutation(len(self._ts))
@@ -249,3 +280,108 @@ class RandomLighting(Block):
         alpha = np.random.normal(0, self._alpha_std, 3).astype(np.float32)
         rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
         return _nd.array(np.clip(arr + rgb, 0, 255))
+
+
+class CropResize(Block):
+    """Fixed crop at (x, y, width, height), optionally resized to
+    ``size`` (ref: transforms.py — CropResize)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=None):
+        super().__init__()
+        self._x, self._y = x, y
+        self._w, self._h = width, height
+        self._size = (size, size) if isinstance(size, int) else size
+        self._interpolation = interpolation
+
+    def forward(self, data):
+        arr = _to_np(data)
+        h, w = arr.shape[:2]
+        if (self._x < 0 or self._y < 0
+                or self._y + self._h > h or self._x + self._w > w):
+            raise MXNetError(
+                "crop (%d,%d,%d,%d) exceeds image %dx%d"
+                % (self._x, self._y, self._w, self._h, w, h))
+        out = arr[self._y:self._y + self._h, self._x:self._x + self._w]
+        if self._size is not None:
+            from PIL import Image
+
+            interp = Image.NEAREST if self._interpolation == 0 \
+                else Image.BILINEAR
+            out = _pil_resize(out, self._size, interp)
+        return _nd.array(out)
+
+
+def _rotate_np(arr, deg, zoom_in=False, zoom_out=False):
+    """Rotation on the host image (ref: transforms.py — Rotate; the
+    reference's backend op rotates the tensor; augmentation stays
+    host-side here, like the rest of this module). zoom_in crops so no
+    padding shows; zoom_out shrinks so the whole rotated frame fits.
+    Mid-pipeline float images (color jitter outputs) are handled by the
+    uint8 cast inside _pil_resize."""
+    from PIL import Image
+
+    img = Image.fromarray(arr.astype(np.uint8))
+    rot = img.rotate(deg, resample=Image.BILINEAR,
+                     expand=bool(zoom_out))
+    out = np.asarray(rot, dtype=arr.dtype)
+    h, w = arr.shape[:2]
+    if zoom_out:
+        out = _pil_resize(out, (w, h), Image.BILINEAR).astype(arr.dtype)
+    elif zoom_in:
+        # largest axis-aligned rectangle with the original aspect ratio
+        # inside the rotated frame (theta clamped to [0, 90deg], so the
+        # sin+cos denominators are >= 1)
+        theta = abs(deg) % 180
+        theta = min(theta, 180 - theta) * np.pi / 180.0
+        s, c = abs(np.sin(theta)), abs(np.cos(theta))
+        scale = min(h / (w * s + h * c), w / (h * s + w * c))
+        ch, cw = max(1, int(h * scale)), max(1, int(w * scale))
+        y0, x0 = (h - ch) // 2, (w - cw) // 2
+        out = _pil_resize(out[y0:y0 + ch, x0:x0 + cw], (w, h),
+                          Image.BILINEAR).astype(arr.dtype)
+    return out
+
+
+class Rotate(Block):
+    """Rotates by a fixed angle in degrees (ref: transforms.py —
+    Rotate)."""
+
+    def __init__(self, rotation_degrees, zoom_in=False, zoom_out=False):
+        super().__init__()
+        if zoom_in and zoom_out:
+            raise MXNetError("zoom_in and zoom_out are exclusive")
+        self._deg = rotation_degrees
+        self._zoom_in = zoom_in
+        self._zoom_out = zoom_out
+
+    def forward(self, x):
+        return _nd.array(_rotate_np(_to_np(x), self._deg,
+                                    self._zoom_in, self._zoom_out))
+
+
+class RandomRotation(Block):
+    """Rotates by an angle drawn from ``angle_limits``
+    (ref: transforms.py — RandomRotation)."""
+
+    def __init__(self, angle_limits, zoom_in=False, zoom_out=False,
+                 rotate_with_proba=1.0):
+        super().__init__()
+        lo, hi = angle_limits
+        if lo >= hi:
+            raise MXNetError("angle_limits must be (low, high) with "
+                             "low < high")
+        if not 0 <= rotate_with_proba <= 1:
+            raise MXNetError("rotate_with_proba must be in [0, 1]")
+        if zoom_in and zoom_out:
+            raise MXNetError("zoom_in and zoom_out are exclusive")
+        self._limits = (lo, hi)
+        self._proba = rotate_with_proba
+        self._zoom_in = zoom_in
+        self._zoom_out = zoom_out
+
+    def forward(self, x):
+        if np.random.random() > self._proba:
+            return x if isinstance(x, NDArray) else _nd.array(_to_np(x))
+        deg = np.random.uniform(*self._limits)
+        return _nd.array(_rotate_np(_to_np(x), deg,
+                                    self._zoom_in, self._zoom_out))

@@ -256,6 +256,55 @@ def test_transforms():
     assert rl.shape == img.shape
 
 
+def test_transforms_hue_crop_rotate():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    img = nd.array(np.random.randint(0, 255, (20, 16, 3)).astype(np.uint8))
+
+    h = T.RandomHue(0.3)(img)
+    assert h.shape == img.shape
+    # hue=0 factor range collapses to 1.0 -> identity (up to clip/float)
+    h0 = T.RandomHue(0.0)(img)
+    np.testing.assert_allclose(h0.asnumpy(), img.asnumpy().astype(np.float32),
+                               atol=1e-2)
+    # jitter with hue enabled routes through RandomHue
+    cj = T.RandomColorJitter(hue=0.2)(img)
+    assert cj.shape == img.shape
+
+    cr = T.CropResize(2, 4, 10, 12)(img)
+    assert cr.shape == (12, 10, 3)
+    cr2 = T.CropResize(2, 4, 10, 12, size=(6, 8))(img)
+    assert cr2.shape == (8, 6, 3)
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        T.CropResize(10, 10, 10, 12)(img)
+
+    # 4x90-degree rotations of a square image compose to identity
+    sq = nd.array(np.random.randint(0, 255, (16, 16, 3)).astype(np.uint8))
+    r = sq
+    for _ in range(4):
+        r = T.Rotate(90)(r)
+    np.testing.assert_allclose(r.asnumpy(), sq.asnumpy(), atol=1.0)
+    assert T.Rotate(37, zoom_in=True)(sq).shape == (16, 16, 3)
+    assert T.Rotate(37, zoom_out=True)(sq).shape == (16, 16, 3)
+    # float images (mid-pipeline, after color jitter) must work too
+    fsq = T.RandomBrightness(0.3)(sq)
+    assert T.Rotate(20, zoom_in=True)(fsq).shape == (16, 16, 3)
+    assert T.Rotate(20, zoom_out=True)(fsq).shape == (16, 16, 3)
+    with _pytest.raises(Exception):  # negative origin must raise
+        T.CropResize(-5, 0, 4, 4)(img)
+
+    rr = T.RandomRotation((-30, 30))(sq)
+    assert rr.shape == (16, 16, 3)
+    # proba=0 -> identity
+    rr0 = T.RandomRotation((-30, 30), rotate_with_proba=0.0)(sq)
+    np.testing.assert_array_equal(rr0.asnumpy(), sq.asnumpy())
+    with _pytest.raises(Exception):
+        T.RandomRotation((30, -30))
+    with _pytest.raises(Exception):
+        T.Rotate(10, zoom_in=True, zoom_out=True)
+
+
 def test_dataloader_with_transform_pipeline():
     from mxnet_tpu.gluon.data.vision import transforms as T
 
