@@ -1,8 +1,10 @@
 """PTB-style LSTM language model with BucketingModule (ref:
-example/rnn/bucketing/lstm_bucketing.py). Variable-length sentences are
-bucketed; each bucket gets its own bound executor sharing one parameter
-set — each executor is one compiled XLA program (the fused RNN unrolls
-its recurrent scan on TPU). Synthetic corpus keeps it runnable anywhere.
+example/rnn/bucketing/lstm_bucketing.py). The reference flow exactly:
+sentences -> mx.rnn.BucketSentenceIter (pad into length buckets) ->
+sym_gen unrolling an mx.rnn cell per bucket -> BucketingModule.fit.
+Each bucket binds one executor sharing one parameter set — one compiled
+XLA program per bucket (the fused RNN unrolls its recurrent scan on
+TPU). Synthetic corpus keeps it runnable anywhere.
 
 Run:  python examples/lstm_ptb_bucketing.py --epochs 1
 """
@@ -17,71 +19,76 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import numpy as np
 
 import mxnet_tpu as mx
-from mxnet_tpu import nd
-from mxnet_tpu.io import DataBatch, DataDesc
 
 
-def sym_gen_factory(vocab, hidden, layers):
+def sym_gen_factory(vocab, hidden, layers, fused=True):
     def sym_gen(seq_len):
         data = mx.sym.Variable("data")
         label = mx.sym.Variable("softmax_label")
         emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=hidden,
                                name="embed")
-        rnn = mx.sym.RNN(mx.sym.transpose(emb, axes=(1, 0, 2)),
-                         mode="lstm", state_size=hidden,
-                         num_layers=layers, name="lstm")
-        out = mx.sym.transpose(rnn[0], axes=(1, 0, 2))  # [0]: sequence
+        if fused:
+            cell = mx.rnn.FusedRNNCell(hidden, num_layers=layers,
+                                       mode="lstm", prefix="lstm_")
+        else:
+            cell = mx.rnn.SequentialRNNCell()
+            for i in range(layers):
+                cell.add(mx.rnn.LSTMCell(hidden, prefix="lstm_l%d_" % i))
+        outputs, _ = cell.unroll(seq_len, inputs=emb, layout="NTC",
+                                 merge_outputs=True)
         pred = mx.sym.FullyConnected(
-            mx.sym.reshape(out, shape=(-1, hidden)),
+            mx.sym.reshape(outputs, shape=(-1, hidden)),
             num_hidden=vocab, name="pred")
         lbl = mx.sym.reshape(label, shape=(-1,))
         sm = mx.sym.SoftmaxOutput(pred, lbl, name="softmax",
-                                  normalization="batch")
+                                  use_ignore=True, ignore_label=-1,
+                                  normalization="valid")
         return sm, ("data",), ("softmax_label",)
     return sym_gen
+
+
+def synthetic_corpus(rng, vocab, n_sentences):
+    """Markov-ish sentences so perplexity has signal to minimize."""
+    sentences = []
+    for _ in range(n_sentences):
+        ln = int(rng.choice([6, 8, 14, 16, 28, 30]))
+        start = int(rng.randint(1, vocab))
+        step = int(rng.randint(1, 5))
+        sentences.append([(start + t * step) % (vocab - 1) + 1
+                          for t in range(ln)])
+    return sentences
 
 
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--epochs", type=int, default=1)
-    p.add_argument("--batches", type=int, default=12)
+    p.add_argument("--sentences", type=int, default=256)
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--hidden", type=int, default=128)
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--vocab", type=int, default=1000)
+    p.add_argument("--unfused", action="store_true",
+                   help="stacked LSTMCells instead of the fused cell")
     args = p.parse_args()
 
-    buckets = (8, 16, 32)
+    buckets = [8, 16, 32]
     rng = np.random.RandomState(0)
-    b = args.batch_size
+    sentences = synthetic_corpus(rng, args.vocab, args.sentences)
+    data_train = mx.rnn.BucketSentenceIter(
+        sentences, args.batch_size, buckets=buckets, invalid_label=-1)
 
     mod = mx.mod.BucketingModule(
-        sym_gen_factory(args.vocab, args.hidden, args.layers),
-        default_bucket_key=max(buckets))
-    mod.bind(data_shapes=[DataDesc("data", (b, max(buckets)))],
-             label_shapes=[DataDesc("softmax_label", (b, max(buckets)))])
-    # fused-RNN packed params are 1-D; Uniform handles any rank
-    mod.init_params(initializer=mx.init.Uniform(0.08))
-    mod.init_optimizer(optimizer="sgd",
-                       optimizer_params={"learning_rate": 0.5})
-
-    per = mx.metric.Perplexity(ignore_label=None)
-    for epoch in range(args.epochs):
-        per.reset()
-        for i in range(args.batches):
-            blen = buckets[rng.randint(len(buckets))]
-            x = rng.randint(1, args.vocab, (b, blen)).astype("f4")
-            y = np.roll(x, -1, axis=1)
-            batch = DataBatch(
-                data=[nd.array(x)], label=[nd.array(y)],
-                bucket_key=blen,
-                provide_data=[DataDesc("data", (b, blen))],
-                provide_label=[DataDesc("softmax_label", (b, blen))])
-            mod.forward(batch, is_train=True)
-            mod.backward()
-            mod.update()
-            per.update([nd.array(y)], [mod.get_outputs()[0]])
-        print("epoch %d: %s = %.2f" % (epoch, *per.get()))
+        sym_gen_factory(args.vocab, args.hidden, args.layers,
+                        fused=not args.unfused),
+        default_bucket_key=data_train.default_bucket_key)
+    mod.fit(data_train,
+            eval_metric=mx.metric.Perplexity(ignore_label=-1),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            initializer=mx.init.Uniform(0.08),
+            num_epoch=args.epochs,
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, frequent=8))
 
 
 if __name__ == "__main__":

@@ -296,7 +296,7 @@ class CropResize(Block):
     def forward(self, data):
         arr = _to_np(data)
         h, w = arr.shape[:2]
-        if (self._x < 0 or self._y < 0
+        if (self._x < 0 or self._y < 0 or self._w <= 0 or self._h <= 0
                 or self._y + self._h > h or self._x + self._w > w):
             raise MXNetError(
                 "crop (%d,%d,%d,%d) exceeds image %dx%d"
@@ -326,7 +326,17 @@ def _rotate_np(arr, deg, zoom_in=False, zoom_out=False):
     out = np.asarray(rot, dtype=arr.dtype)
     h, w = arr.shape[:2]
     if zoom_out:
-        out = _pil_resize(out, (w, h), Image.BILINEAR).astype(arr.dtype)
+        # uniform scale so the whole rotated frame fits, then center-pad
+        # back to (h, w) — resizing straight to (w, h) would stretch
+        # non-square images
+        rh, rw = out.shape[:2]
+        s = min(h / rh, w / rw)
+        sh, sw = max(1, int(rh * s)), max(1, int(rw * s))
+        scaled = _pil_resize(out, (sw, sh), Image.BILINEAR)
+        canvas = np.zeros((h, w) + arr.shape[2:], dtype=arr.dtype)
+        y0, x0 = (h - sh) // 2, (w - sw) // 2
+        canvas[y0:y0 + sh, x0:x0 + sw] = scaled
+        out = canvas
     elif zoom_in:
         # largest axis-aligned rectangle with the original aspect ratio
         # inside the rotated frame (theta clamped to [0, 90deg], so the

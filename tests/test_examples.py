@@ -18,8 +18,8 @@ EXAMPLES = {
         "--synthetic", "--iters", "2", "--batch-size", "8",
         "--image-shape", "3,32,32", "--dtype", "float32"],
     "examples/lstm_ptb_bucketing.py": [
-        "--epochs", "1", "--batches", "4", "--batch-size", "4",
-        "--hidden", "16", "--vocab", "50"],
+        "--epochs", "1", "--sentences", "32", "--batch-size", "4",
+        "--hidden", "16", "--vocab", "50", "--layers", "1"],
     "examples/bert_mlm_pretrain.py": [
         "--iters", "2", "--batch-size", "8", "--seq-len", "16"],
     "examples/wide_deep_ctr.py": [

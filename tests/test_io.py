@@ -293,6 +293,16 @@ def test_transforms_hue_crop_rotate():
     assert T.Rotate(20, zoom_out=True)(fsq).shape == (16, 16, 3)
     with _pytest.raises(Exception):  # negative origin must raise
         T.CropResize(-5, 0, 4, 4)(img)
+    with _pytest.raises(Exception):  # non-positive dims must raise
+        T.CropResize(0, 0, 0, 10)(img)
+    # zoom_out on a non-square image: content scales uniformly (a square
+    # marker stays square), no stretch
+    rect = np.zeros((10, 30, 3), dtype=np.uint8)
+    rect[3:7, 13:17] = 255  # 4x4 marker
+    rot = T.Rotate(90, zoom_out=True)(nd.array(rect)).asnumpy()
+    ys, xs = np.where(rot[:, :, 0] > 128)
+    hspan, wspan = ys.max() - ys.min() + 1, xs.max() - xs.min() + 1
+    assert abs(hspan - wspan) <= 1, (hspan, wspan)
 
     rr = T.RandomRotation((-30, 30))(sq)
     assert rr.shape == (16, 16, 3)
