@@ -40,6 +40,10 @@ from . import io
 from . import symbol
 from . import symbol as sym
 from .symbol import Symbol
+from . import attribute
+from .attribute import AttrScope
+from . import name
+from . import engine
 from . import gluon
 from . import module
 from . import module as mod
@@ -68,4 +72,5 @@ __all__ = [
     "save_checkpoint", "load_checkpoint", "profiler", "monitor",
     "operator", "image", "config", "amp", "contrib",
     "SequentialModule", "visualization", "viz", "runtime", "util", "rnn",
+    "attribute", "AttrScope", "name", "engine",
 ]

@@ -1,0 +1,56 @@
+"""Attribute scoping for symbol construction (ref: python/mxnet/
+attribute.py — AttrScope). Attributes set here land on every symbol
+created inside the scope — the reference's `group2ctx` model-parallel
+placement rides this (`with mx.AttrScope(ctx_group='dev1')`); in this
+framework placement is sharding, but the attrs still flow into the
+graph for tooling/serialization parity."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+
+class AttrScope:
+    """with-scope attaching string attributes to created symbols
+    (ref: attribute.py — AttrScope)."""
+
+    _state = threading.local()
+
+    def __init__(self, **kwargs):
+        for value in kwargs.values():
+            if not isinstance(value, str):
+                raise ValueError(
+                    "AttrScope values must be strings, got %r" % (value,))
+        self._attr = kwargs
+        self._old_scope = None
+
+    def get(self, attr=None):
+        """Merge scope attrs under explicit ``attr`` (explicit wins)."""
+        if not self._attr:
+            return dict(attr) if attr else {}
+        ret = self._attr.copy()
+        if attr:
+            ret.update(attr)
+        return ret
+
+    def __enter__(self):
+        if not hasattr(AttrScope._state, "current"):
+            AttrScope._state.current = AttrScope()
+        self._old_scope = AttrScope._state.current
+        attr = AttrScope._state.current._attr.copy()
+        attr.update(self._attr)
+        self._attr = attr
+        AttrScope._state.current = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_scope is not None
+        AttrScope._state.current = self._old_scope
+
+
+def current():
+    """The innermost active scope (a fresh empty one per thread)."""
+    if not hasattr(AttrScope._state, "current"):
+        AttrScope._state.current = AttrScope()
+    return AttrScope._state.current

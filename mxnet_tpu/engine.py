@@ -1,0 +1,33 @@
+"""Engine controls (ref: python/mxnet/engine.py — bulk/set_bulk_size).
+
+The reference's engine bulks consecutive async ops into one scheduling
+unit to cut per-op dispatch cost. Here XLA compiles whole programs and
+fuses internally, so bulking is structural, not a runtime switch —
+these shims keep the API importable and record the requested size."""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["bulk", "set_bulk_size"]
+
+_BULK_SIZE = 15  # reference default (MXNET_ENGINE_BULK_SIZE)
+
+
+def set_bulk_size(size):
+    """Returns the previous size (ref: engine.py — set_bulk_size).
+    No-op on execution: under jit every traced program is already one
+    'bulk'."""
+    global _BULK_SIZE
+    prev, _BULK_SIZE = _BULK_SIZE, int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """with-scope analog of the reference's engine bulking
+    (ref: engine.py — bulk)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 from collections import namedtuple
 
+from .base import MXNetError
 from .ndarray import ndarray as _nd
 
 __all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
@@ -41,3 +42,164 @@ def load_checkpoint(prefix, epoch):
         elif tp == "aux":
             aux_params[name] = v
     return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Legacy pre-Module trainer (ref: python/mxnet/model.py —
+    FeedForward; deprecated upstream in favor of Module, kept for API
+    parity). Thin adapter over Module: fit/predict/score/save/load with
+    the classic constructor surface."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from . import initializer as init_mod
+
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer or init_mod.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    def _label_names(self):
+        candidates = [n for n in self.symbol.list_arguments()
+                      if n.endswith("label")]
+        return tuple(candidates) or ("softmax_label",)
+
+    def _make_module(self):
+        from .module.module import Module
+
+        return Module(self.symbol, data_names=("data",),
+                      label_names=self._label_names(), context=self.ctx)
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None,
+            monitor=None, eval_end_callback=None,
+            eval_batch_end_callback=None):
+        del logger, work_load_list
+        assert self.num_epoch is not None, "num_epoch must be set"
+        data = self._as_iter(X, y)
+        self._module = self._make_module()
+        self._module.fit(
+            data, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=self.optimizer, optimizer_params=self.kwargs,
+            initializer=self.initializer, arg_params=self.arg_params,
+            aux_params=self.aux_params, begin_epoch=self.begin_epoch,
+            num_epoch=self.num_epoch, monitor=monitor,
+            eval_end_callback=eval_end_callback,
+            eval_batch_end_callback=eval_batch_end_callback)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    def _as_iter(self, X, y=None):
+        from .io.io import DataIter, NDArrayIter
+
+        if isinstance(X, DataIter):
+            return X
+        label_name = self._label_names()[0]
+        # ref: model.py — _init_iter clamps batch_size to the data size
+        bsz = min(self.numpy_batch_size, len(X))
+        return NDArrayIter(X, y, batch_size=bsz, label_name=label_name)
+
+    def _bind_for_inference(self, data):
+        """Lazy module construction for predict/score (ref: model.py —
+        _init_predictor)."""
+        if self._module is not None:
+            return
+        if self.arg_params is None:
+            raise MXNetError(
+                "FeedForward has no parameters — call fit() first or "
+                "construct with arg_params/load()")
+        self._module = self._make_module()
+        self._module.bind(data_shapes=data.provide_data,
+                          label_shapes=data.provide_label,
+                          for_training=False)
+        self._module.set_params(self.arg_params, self.aux_params or {})
+
+    def predict(self, X, num_batch=None, return_data=False,
+                reset=True):
+        del return_data
+        import numpy as _np
+
+        data = self._as_iter(X)
+        self._bind_for_inference(data)
+        if reset:
+            data.reset()
+        outs = []
+        for i, batch in enumerate(data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self._module.forward(batch, is_train=False)
+            out = self._module.get_outputs()[0].asnumpy()
+            pad = batch.pad or 0
+            if pad:  # last batch wraps around — trim the duplicates
+                out = out[:out.shape[0] - pad]
+            outs.append(out)
+        return _np.concatenate(outs, axis=0)
+
+    def score(self, X, eval_metric="acc", num_batch=None,
+              batch_end_callback=None, reset=True):
+        del batch_end_callback
+        from . import metric as metric_mod
+
+        data = self._as_iter(X)
+        if reset:
+            data.reset()
+        metric = eval_metric if isinstance(
+            eval_metric, metric_mod.EvalMetric) \
+            else metric_mod.create(eval_metric)
+        self._bind_for_inference(data)
+        metric.reset()
+        for i, batch in enumerate(data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self._module.forward(batch, is_train=False)
+            self._module.update_metric(metric, batch.label)
+        return metric.get()[1]
+
+    def save(self, prefix, epoch=None):
+        """ref: model.py — FeedForward.save (checkpoint format shared
+        with Module)."""
+        if epoch is None:
+            epoch = self.num_epoch or 0
+        save_checkpoint(prefix, epoch, self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None,
+               epoch_size=None, optimizer="sgd", initializer=None,
+               eval_data=None, eval_metric="acc",
+               epoch_end_callback=None, batch_end_callback=None,
+               kvstore="local", logger=None, work_load_list=None,
+               eval_end_callback=None, eval_batch_end_callback=None,
+               **kwargs):
+        """ref: model.py — FeedForward.create (construct + fit)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        return model.fit(
+            X, y, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            logger=logger, work_load_list=work_load_list,
+            eval_end_callback=eval_end_callback,
+            eval_batch_end_callback=eval_batch_end_callback)

@@ -1,0 +1,55 @@
+"""Automatic symbol naming (ref: python/mxnet/name.py — NameManager /
+Prefix). The default manager numbers by op hint ("convolution0", ...);
+Prefix prepends a string to every name it resolves."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix"]
+
+
+class NameManager:
+    """with-scope resolving (name, hint) -> node name
+    (ref: name.py — NameManager)."""
+
+    _state = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old_manager = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        n = self._counter.get(hint, 0)
+        self._counter[hint] = n + 1
+        return "%s%d" % (hint, n)
+
+    def __enter__(self):
+        if not hasattr(NameManager._state, "current"):
+            NameManager._state.current = NameManager()
+        self._old_manager = NameManager._state.current
+        NameManager._state.current = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_manager is not None
+        NameManager._state.current = self._old_manager
+
+
+class Prefix(NameManager):
+    """Prepends ``prefix`` to every resolved name
+    (ref: name.py — Prefix)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)
+
+
+def current():
+    if not hasattr(NameManager._state, "current"):
+        NameManager._state.current = NameManager()
+    return NameManager._state.current

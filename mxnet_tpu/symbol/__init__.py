@@ -9,10 +9,12 @@ from __future__ import annotations
 
 import sys as _sys
 
+from .. import attribute as _attribute
+from .. import name as _naming
 from ..base import MXNetError
 from ..ops import registry as _registry
 from .symbol import (
-    Symbol, Variable, var, Group, load, load_json, _Node, _name_manager,
+    Symbol, Variable, var, Group, load, load_json, _Node,
     OP_INPUTS, VISIBLE_OUTPUTS, num_outputs_for,
 )
 
@@ -39,8 +41,9 @@ def _apply_sym_op(op_name, *args, name=None, attr=None, **kwargs):
                 "op %s: positional inputs must be Symbols, got %r"
                 % (op.name, type(a)))
 
-    node_name = name if name is not None else _name_manager.get(
-        op.name.lower().lstrip("_"))
+    # the active NameManager resolves (name, hint) — a Prefix manager
+    # prefixes both generated and explicit names (ref: name.py)
+    node_name = _naming.current().get(name, op.name.lower().lstrip("_"))
 
     info = OP_INPUTS.get(op.name)
     if info is not None:
@@ -78,7 +81,7 @@ def _apply_sym_op(op_name, *args, name=None, attr=None, **kwargs):
                 "op %s: non-trailing None input not allowed (no "
                 "auto-variable table entry)" % op.name)
 
-    attrs = dict(attr or {})
+    attrs = _attribute.current().get(attr)  # scope attrs, explicit win
     for k, v in kwargs.items():
         if isinstance(v, list):
             v = tuple(v)

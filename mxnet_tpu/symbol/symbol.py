@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import ast
 import json
-import threading
 
 import numpy as np
 
@@ -20,18 +19,7 @@ from ..base import MXNetError
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
 
 
-class _SymNameManager(threading.local):
-    def __init__(self):
-        super().__init__()
-        self.counters = {}
-
-    def get(self, hint):
-        n = self.counters.get(hint, 0)
-        self.counters[hint] = n + 1
-        return "%s%d" % (hint, n)
-
-
-_name_manager = _SymNameManager()
+# node naming lives in mxnet_tpu/name.py (NameManager/Prefix scopes)
 
 
 class _Node:
@@ -391,7 +379,9 @@ def var(name, attr=None, shape=None, dtype=None, init=None, stype=None,
         **kwargs):
     """Create a variable symbol (ref: symbol.py — var/Variable)."""
     del stype
-    attrs = dict(attr or {})
+    from .. import attribute as _attribute
+
+    attrs = _attribute.current().get(attr)  # active AttrScope attrs
     attrs.update(kwargs)
     if shape is not None:
         attrs["__shape__"] = tuple(shape)

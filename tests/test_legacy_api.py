@@ -1,0 +1,132 @@
+"""AttrScope / NameManager / engine shims / FeedForward
+(ref: tests/python/unittest/{test_attr.py,test_symbol.py,
+test_model*.py})."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import with_seed
+
+
+def test_attr_scope_basic():
+    with mx.AttrScope(group="4", data="great"):
+        x = mx.sym.var("data", attr={"dtype": "data", "group": "1"})
+        y = mx.sym.var("lhs")
+    assert x.attr("group") == "1"      # explicit wins
+    assert x.attr("dtype") == "data"
+    assert y.attr("group") == "4"
+    assert y.attr("data") == "great"
+    z = mx.sym.var("after")
+    assert z.attr("group") is None     # scope exited
+
+
+def test_attr_scope_nesting_and_ops():
+    with mx.AttrScope(ctx_group="stage1"):
+        a = mx.sym.var("a")
+        with mx.AttrScope(ctx_group="stage2", lr_mult="0.5"):
+            fc = mx.sym.FullyConnected(a, num_hidden=3, name="fc_in")
+        b = mx.sym.FullyConnected(a, num_hidden=3, name="fc_out")
+    assert fc.attr("ctx_group") == "stage2"
+    assert fc.attr("lr_mult") == "0.5"
+    assert b.attr("ctx_group") == "stage1"
+    assert b.attr("lr_mult") is None
+
+
+def test_attr_scope_rejects_non_string():
+    with pytest.raises(ValueError):
+        mx.AttrScope(group=4)
+
+
+def test_name_prefix():
+    with mx.name.Prefix("mynet_"):
+        a = mx.sym.var("x")
+        fc = mx.sym.FullyConnected(a, num_hidden=2)
+    assert fc.name.startswith("mynet_fullyconnected")
+    fc2 = mx.sym.FullyConnected(a, num_hidden=2)
+    assert not fc2.name.startswith("mynet_")
+
+
+def test_name_manager_counts():
+    with mx.name.NameManager():
+        a = mx.sym.var("x")
+        f1 = mx.sym.FullyConnected(a, num_hidden=2)
+        f2 = mx.sym.FullyConnected(a, num_hidden=2)
+    # fresh manager numbers from 0 within its scope
+    base = f1.name.rstrip("0123456789")
+    assert f1.name == base + "0" and f2.name == base + "1"
+
+
+def test_engine_bulk_shim():
+    prev = mx.engine.set_bulk_size(8)
+    assert mx.engine.set_bulk_size(prev) == 8
+    with mx.engine.bulk(32):
+        x = mx.nd.ones((2, 2)) + 1
+    assert float(x.sum().asscalar()) == 8.0
+
+
+@with_seed()
+def test_feedforward_fit_predict_save_load(tmp_path):
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (120, 4)).astype(np.float32)
+    w = np.array([[1.0, -1.5, 2.0, 0.3]], dtype=np.float32)
+    y = x @ w.T
+    data = mx.sym.var("data")
+    label = mx.sym.var("lin_label")
+    net = mx.sym.LinearRegressionOutput(
+        mx.sym.FullyConnected(data, num_hidden=1, name="fc"),
+        label, name="lro")
+
+    model = mx.model.FeedForward(net, num_epoch=40, optimizer="sgd",
+                                 numpy_batch_size=12, learning_rate=0.1)
+    model.fit(x, y, eval_metric="mse")
+    pred = model.predict(x)
+    np.testing.assert_allclose(pred, y, atol=0.05)
+
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, 1)
+    loaded = mx.model.FeedForward.load(prefix, 1)
+    pred2 = loaded.predict(x)
+    np.testing.assert_allclose(pred2, pred, rtol=1e-5, atol=1e-6)
+
+    mse = loaded.score(
+        mx.io.NDArrayIter(x, y, batch_size=12, label_name="lin_label"),
+        eval_metric="mse")
+    assert mse < 0.01
+
+
+@with_seed()
+def test_feedforward_predict_trims_pad():
+    """100 samples / batch 12: predict must return exactly 100 rows (the
+    wrapped pad batch is trimmed, ref: model.py real_size)."""
+    rng = np.random.RandomState(3)
+    x = rng.uniform(-1, 1, (100, 4)).astype(np.float32)
+    y = x.sum(axis=1, keepdims=True)
+    net = mx.sym.LinearRegressionOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=1,
+                              name="fc"),
+        mx.sym.var("lin_label"), name="lro")
+    model = mx.model.FeedForward(net, num_epoch=2, optimizer="sgd",
+                                 numpy_batch_size=12, learning_rate=0.01)
+    model.fit(x, y, eval_metric="mse")
+    pred = model.predict(x)
+    assert pred.shape[0] == 100, pred.shape
+    # unfitted model must raise loudly, not crash opaquely
+    fresh = mx.model.FeedForward(net, numpy_batch_size=12)
+    with pytest.raises(Exception, match="no parameters"):
+        fresh.predict(x)
+
+
+@with_seed()
+def test_feedforward_create():
+    rng = np.random.RandomState(1)
+    x = rng.uniform(-1, 1, (60, 3)).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) > 0).astype(np.float32).ravel()
+    data = mx.sym.var("data")
+    sm = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=2, name="fc"),
+        mx.sym.var("softmax_label"), name="softmax")
+    model = mx.model.FeedForward.create(
+        sm, x, y, num_epoch=20, optimizer="sgd", numpy_batch_size=10,
+        learning_rate=0.5)
+    acc = model.score(mx.io.NDArrayIter(x, y, batch_size=10))
+    assert acc > 0.8, acc
