@@ -75,11 +75,22 @@ class FeedForward:
                       if n.endswith("label")]
         return tuple(candidates) or ("softmax_label",)
 
-    def _make_module(self):
+    def _make_module(self, data=None):
+        """ref: model.py — input names come from the iterator's
+        provide_data/provide_label when available, not a hard-coded
+        'data'."""
         from .module.module import Module
 
-        return Module(self.symbol, data_names=("data",),
-                      label_names=self._label_names(), context=self.ctx)
+        if data is not None and getattr(data, "provide_data", None):
+            data_names = tuple(d.name for d in data.provide_data)
+        else:
+            data_names = ("data",)
+        if data is not None and getattr(data, "provide_label", None):
+            label_names = tuple(d.name for d in data.provide_label)
+        else:
+            label_names = self._label_names()
+        return Module(self.symbol, data_names=data_names,
+                      label_names=label_names, context=self.ctx)
 
     def fit(self, X, y=None, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None,
@@ -89,7 +100,7 @@ class FeedForward:
         del logger, work_load_list
         assert self.num_epoch is not None, "num_epoch must be set"
         data = self._as_iter(X, y)
-        self._module = self._make_module()
+        self._module = self._make_module(data)
         self._module.fit(
             data, eval_data=eval_data, eval_metric=eval_metric,
             epoch_end_callback=epoch_end_callback,
@@ -122,7 +133,7 @@ class FeedForward:
             raise MXNetError(
                 "FeedForward has no parameters — call fit() first or "
                 "construct with arg_params/load()")
-        self._module = self._make_module()
+        self._module = self._make_module(data)
         self._module.bind(data_shapes=data.provide_data,
                           label_shapes=data.provide_label,
                           for_training=False)
@@ -137,17 +148,26 @@ class FeedForward:
         self._bind_for_inference(data)
         if reset:
             data.reset()
-        outs = []
+        outs = None
         for i, batch in enumerate(data):
             if num_batch is not None and i >= num_batch:
                 break
             self._module.forward(batch, is_train=False)
-            out = self._module.get_outputs()[0].asnumpy()
+            batch_outs = [o.asnumpy() for o in self._module.get_outputs()]
             pad = batch.pad or 0
             if pad:  # last batch wraps around — trim the duplicates
-                out = out[:out.shape[0] - pad]
-            outs.append(out)
-        return _np.concatenate(outs, axis=0)
+                batch_outs = [o[:o.shape[0] - pad] for o in batch_outs]
+            if outs is None:
+                outs = [[] for _ in batch_outs]
+            for acc, o in zip(outs, batch_outs):
+                acc.append(o)
+        if outs is None:
+            raise MXNetError(
+                "predict() saw no batches (exhausted iterator or "
+                "num_batch=0)")
+        merged = [_np.concatenate(acc, axis=0) for acc in outs]
+        # ref: model.py — a single-output net returns the array itself
+        return merged[0] if len(merged) == 1 else merged
 
     def score(self, X, eval_metric="acc", num_batch=None,
               batch_end_callback=None, reset=True):

@@ -44,6 +44,10 @@ def _apply_sym_op(op_name, *args, name=None, attr=None, **kwargs):
     # the active NameManager resolves (name, hint) — a Prefix manager
     # prefixes both generated and explicit names (ref: name.py)
     node_name = _naming.current().get(name, op.name.lower().lstrip("_"))
+    # scope/attr= entries are pure annotations, resolved up front so the
+    # auto-created variable inputs below inherit them too (the reference
+    # attaches AttrScope attrs to every symbol created in scope)
+    annotations = _attribute.current().get(attr)
 
     info = OP_INPUTS.get(op.name)
     if info is not None:
@@ -66,7 +70,8 @@ def _apply_sym_op(op_name, *args, name=None, attr=None, **kwargs):
         for i in range(len(inputs)):
             if inputs[i] is None:
                 vname = "%s_%s" % (node_name, in_names[i])
-                inputs[i] = _Node(None, vname, {}, []), 0
+                inputs[i] = _Node(None, vname, {}, [],
+                                  annotations=dict(annotations)), 0
     else:
         # Symbol kwargs not in a table op: treat as named extra inputs is
         # unsupported — require positional
@@ -81,14 +86,17 @@ def _apply_sym_op(op_name, *args, name=None, attr=None, **kwargs):
                 "op %s: non-trailing None input not allowed (no "
                 "auto-variable table entry)" % op.name)
 
-    attrs = _attribute.current().get(attr)  # scope attrs, explicit win
+    # op kwargs are execution params — kept apart from annotations so an
+    # annotation named like a fn param (e.g. AttrScope(p=...) around
+    # Dropout) can't leak into execution
+    attrs = {}
     for k, v in kwargs.items():
         if isinstance(v, list):
             v = tuple(v)
         attrs[k] = v
     n_out = num_outputs_for(op, kwargs)
     node = _Node(op.name, node_name, attrs, list(inputs),
-                 num_outputs=n_out)
+                 num_outputs=n_out, annotations=annotations)
     n_vis = VISIBLE_OUTPUTS.get(op.name, n_out)
     return Symbol([(node, i) for i in range(n_vis)])
 

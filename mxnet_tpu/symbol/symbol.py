@@ -25,14 +25,20 @@ __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
 class _Node:
     """One graph node: a variable (op=None) or an op application."""
 
-    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs")
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs",
+                 "annotations")
 
-    def __init__(self, op, name, attrs, inputs, num_outputs=1):
+    def __init__(self, op, name, attrs, inputs, num_outputs=1,
+                 annotations=None):
         self.op = op  # None for variables, else registry op name (str)
         self.name = name
         self.attrs = attrs  # static params (python values)
         self.inputs = inputs  # list[(that _Node, int output_index)]
         self.num_outputs = num_outputs
+        # user/AttrScope annotations (ctx_group, lr_mult, ...) — kept
+        # OUT of attrs so they can never be mistaken for op parameters
+        # at execution (the reference separates these the same way)
+        self.annotations = annotations or {}
 
     def is_var(self):
         return self.op is None
@@ -206,15 +212,19 @@ class Symbol:
     def attr_dict(self):
         out = {}
         for node in self._topo_nodes():
-            if node.attrs:
-                out[node.name] = {
-                    k: str(v) for k, v in node.attrs.items()
-                    if not k.startswith("__")}
+            merged = {k: str(v) for k, v in node.attrs.items()
+                      if not k.startswith("__")}
+            merged.update(
+                {k: str(v) for k, v in node.annotations.items()})
+            if merged:
+                out[node.name] = merged
         return out
 
     def attr(self, key):
         node = self._outputs[0][0]
-        v = node.attrs.get(key)
+        v = node.annotations.get(key)
+        if v is None:
+            v = node.attrs.get(key)
         return str(v) if v is not None else None
 
     # -- shape / dtype inference --------------------------------------
@@ -264,10 +274,17 @@ class Symbol:
         for i, n in enumerate(nodes):
             if n.is_var():
                 arg_nodes.append(i)
+            jattrs = {k: str(v) for k, v in n.attrs.items()}
+            for k, v in n.annotations.items():
+                # an annotation colliding with a param key must not
+                # clobber the execution value — park it under a
+                # reversible private key instead
+                key = k if k not in jattrs else "__ann_%s__" % k
+                jattrs[key] = str(v)
             jnodes.append({
                 "op": "null" if n.is_var() else n.op,
                 "name": n.name,
-                "attrs": {k: str(v) for k, v in n.attrs.items()},
+                "attrs": jattrs,
                 "inputs": [[nid[id(inp)], oi, 0] for inp, oi in n.inputs],
             })
         heads = [[nid[id(n)], oi, 0] for n, oi in self._outputs]
@@ -381,15 +398,17 @@ def var(name, attr=None, shape=None, dtype=None, init=None, stype=None,
     del stype
     from .. import attribute as _attribute
 
-    attrs = _attribute.current().get(attr)  # active AttrScope attrs
-    attrs.update(kwargs)
+    annotations = _attribute.current().get(attr)  # active AttrScope
+    annotations.update(kwargs)
+    attrs = {}
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:
         attrs["__dtype__"] = str(np.dtype(dtype))
     if init is not None:
         attrs["__init__"] = str(init)
-    return Symbol([(_Node(None, name, attrs, []), 0)])
+    return Symbol([(_Node(None, name, attrs, [],
+                          annotations=annotations), 0)])
 
 
 Variable = var
@@ -416,14 +435,40 @@ def load_json(json_str):
     nodes = []
     for jn in data["nodes"]:
         attrs = {}
+        parked = {}  # __ann_<k>__ keys: annotations parked on collision
         for k, v in (jn.get("attrs") or jn.get("param") or {}).items():
-            attrs[k] = _parse_attr(v)
+            if k.startswith("__ann_") and k.endswith("__"):
+                parked[k[len("__ann_"):-2]] = str(v)
+            else:
+                attrs[k] = _parse_attr(v)
         if jn["op"] == "null":
-            node = _Node(None, jn["name"], attrs, [])
+            # variables: only the __special__ keys are structural; the
+            # rest are user annotations
+            ann = {k: v for k, v in attrs.items()
+                   if not k.startswith("__")}
+            ann.update(parked)
+            attrs = {k: v for k, v in attrs.items()
+                     if k.startswith("__")}
+            node = _Node(None, jn["name"], attrs, [], annotations=ann)
         else:
             op = get_op(jn["op"])  # raises if unknown
+            # split params from annotations by the op fn's signature
+            # (the serialized format stores them in one dict, like the
+            # reference's JSON)
+            from .executor import _fn_params
+
+            accepted = _fn_params(op.fn)
+            if accepted is not None:
+                ann = {k: v for k, v in attrs.items()
+                       if k not in accepted and not k.startswith("__")}
+                attrs = {k: v for k, v in attrs.items()
+                         if k in accepted or k.startswith("__")}
+            else:
+                ann = {}
+            ann.update(parked)
             node = _Node(op.name, jn["name"], attrs, [],
-                         num_outputs=num_outputs_for(op, attrs))
+                         num_outputs=num_outputs_for(op, attrs),
+                         annotations=ann)
         nodes.append(node)
     for node, jn in zip(nodes, data["nodes"]):
         node.inputs = [(nodes[i[0]], i[1]) for i in jn["inputs"]]

@@ -32,6 +32,49 @@ def test_attr_scope_nesting_and_ops():
     assert b.attr("lr_mult") is None
 
 
+def test_attr_scope_never_leaks_into_op_params():
+    """An annotation named like an op parameter (Dropout's 'p') must not
+    change execution."""
+    d = mx.sym.var("data")
+    with mx.AttrScope(p="stage1", mode="whatever"):
+        out = mx.sym.Dropout(d, p=0.0)
+    assert out.attr("p") == "stage1"  # annotation visible as attr
+    x = mx.nd.ones((2, 3))
+    res = out.bind(mx.cpu(), {"data": x}).forward()[0]
+    np.testing.assert_array_equal(res.asnumpy(), x.asnumpy())
+
+
+def test_annotations_roundtrip_json():
+    with mx.AttrScope(ctx_group="dev1", lr_mult="0.5"):
+        fc = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=3,
+                                   name="fc")
+    # auto-created weight/bias variables inherit the scope attrs
+    assert fc.attr_dict["fc_weight"]["ctx_group"] == "dev1"
+    s2 = mx.sym.load_json(fc.tojson())
+    assert s2.attr("ctx_group") == "dev1"
+    assert s2.attr("lr_mult") == "0.5"
+    assert s2.attr("num_hidden") == "3"  # params still visible as attrs
+    # and the loaded graph still executes with the right params
+    out = s2.bind(mx.cpu(), {
+        "data": mx.nd.ones((2, 4)),
+        "fc_weight": mx.nd.ones((3, 4)),
+        "fc_bias": mx.nd.zeros((3,))}).forward()[0]
+    assert out.shape == (2, 3)
+
+
+def test_colliding_annotation_roundtrips_without_clobber():
+    """An annotation named like a param (Dropout's 'p') must survive
+    save/load without corrupting the execution value."""
+    with mx.AttrScope(p="stage1"):
+        out = mx.sym.Dropout(mx.sym.var("data"), p=0.25)
+    s2 = mx.sym.load_json(out.tojson())
+    assert s2.attr("p") == "stage1"       # annotation preserved
+    x = mx.nd.ones((2, 3))
+    res = s2.bind(mx.cpu(), {"data": x}).forward()[0]
+    np.testing.assert_array_equal(res.asnumpy(), x.asnumpy())  # p=0.25,
+    # inference mode -> identity; a str p would TypeError here
+
+
 def test_attr_scope_rejects_non_string():
     with pytest.raises(ValueError):
         mx.AttrScope(group=4)
@@ -114,6 +157,28 @@ def test_feedforward_predict_trims_pad():
     fresh = mx.model.FeedForward(net, numpy_batch_size=12)
     with pytest.raises(Exception, match="no parameters"):
         fresh.predict(x)
+    # empty prediction window raises a clear error
+    with pytest.raises(Exception, match="no batches"):
+        model.predict(x, num_batch=0)
+
+
+@with_seed()
+def test_feedforward_custom_input_name():
+    """Input names come from the iterator, not hard-coded 'data'."""
+    rng = np.random.RandomState(5)
+    x = rng.uniform(-1, 1, (40, 3)).astype(np.float32)
+    y = x.sum(axis=1, keepdims=True)
+    net = mx.sym.LinearRegressionOutput(
+        mx.sym.FullyConnected(mx.sym.var("img"), num_hidden=1,
+                              name="fc"),
+        mx.sym.var("lin_label"), name="lro")
+    it = mx.io.NDArrayIter(
+        {"img": x}, {"lin_label": y}, batch_size=8)
+    model = mx.model.FeedForward(net, num_epoch=30, optimizer="sgd",
+                                 learning_rate=0.1)
+    model.fit(it, eval_metric="mse")
+    pred = model.predict(mx.io.NDArrayIter({"img": x}, batch_size=8))
+    np.testing.assert_allclose(pred, y, atol=0.05)
 
 
 @with_seed()

@@ -392,3 +392,66 @@ def test_mnist_convergence_hardware():
         if acc >= 0.97:
             break
     assert acc >= 0.95, "val accuracy %.4f below the train-tier bar" % acc
+
+
+# ---------------------------------------------------------------------------
+# round-4 additions: CTC scan kernel + wavefront LSTM parity on hardware
+# ---------------------------------------------------------------------------
+def test_ctc_loss_hardware():
+    """The lax.scan alpha recursion compiles and matches the CPU-verified
+    torch-parity values on chip (scan + take_along_axis + masked
+    logaddexp is exactly the op mix Mosaic has rejected before)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+
+    rng = np.random.RandomState(0)
+    T, N, C = 12, 3, 6
+    logits = rng.randn(T, N, C).astype(np.float32)
+    labels = np.array([[1, 2, 3, 2], [2, 2, 0, 0], [4, 1, 5, 3]],
+                      dtype=np.float32)
+    x = mx.nd.array(logits)
+    x.attach_grad()
+    with autograd.record():
+        loss = mx.nd.CTCLoss(x, mx.nd.array(labels), blank_label="first")
+    loss.backward()
+    vals = loss.asnumpy()
+    # CPU-verified torch ground truth for this exact seed/config
+    np.testing.assert_allclose(
+        vals, [10.896658, 19.76711, 11.33562], rtol=1e-3)
+    g = x.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_wavefront_lstm_parity_hardware():
+    """MXT_RNN_WAVEFRONT batches all layers' recurrent gemms per
+    diagonal; outputs must match the sequential path on chip."""
+    import os
+
+    from mxnet_tpu.ops.rnn import rnn_op, rnn_param_size
+
+    T, B, I, H, L = 16, 8, 32, 32, 3
+    key = jax.random.PRNGKey(1)
+    k1, k2, k3 = jax.random.split(key, 3)
+    data = jax.random.normal(k1, (T, B, I), jnp.float32)
+    params = jax.random.normal(
+        k2, (rnn_param_size("lstm", I, H, num_layers=L),),
+        jnp.float32) * 0.1
+    state = jnp.zeros((L, B, H), jnp.float32)
+    cell = jnp.zeros((L, B, H), jnp.float32)
+
+    old = os.environ.get("MXT_RNN_WAVEFRONT")
+    try:
+        os.environ["MXT_RNN_WAVEFRONT"] = "0"
+        seq = rnn_op(data, params, state, cell, mode="lstm",
+                     state_size=H, num_layers=L)
+        os.environ["MXT_RNN_WAVEFRONT"] = "1"
+        wave = rnn_op(data, params, state, cell, mode="lstm",
+                      state_size=H, num_layers=L)
+    finally:
+        if old is None:
+            os.environ.pop("MXT_RNN_WAVEFRONT", None)
+        else:
+            os.environ["MXT_RNN_WAVEFRONT"] = old
+    assert _maxerr(jnp.asarray(seq[0]), jnp.asarray(wave[0])) < 1e-4
+    assert _maxerr(jnp.asarray(seq[1]), jnp.asarray(wave[1])) < 1e-4
+    assert _maxerr(jnp.asarray(seq[2]), jnp.asarray(wave[2])) < 1e-4
