@@ -13,6 +13,7 @@ dispatch the same registered ops). Here each op is a *pure JAX function*
 from __future__ import annotations
 
 import functools
+import inspect
 
 import jax
 
@@ -73,6 +74,22 @@ def _normalize_kwargs(kwargs):
     return out
 
 
+@functools.lru_cache(maxsize=None)
+def fn_params(fn):
+    """Accepted parameter names of an op fn (None if uninspectable).
+    Keyed on the fn object so re-registering an op name can't serve
+    stale signatures."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return None
+    return frozenset(sig.parameters)
+
+
+def _accepts_train_mode(op):
+    return "train_mode" in (fn_params(op.fn) or ())
+
+
 def apply_op(op, *inputs, out=None, **kwargs):
     """Invoke a registered op on NDArrays (imperative path).
 
@@ -86,6 +103,11 @@ def apply_op(op, *inputs, out=None, **kwargs):
     if isinstance(op, str):
         op = get_op(op)
     kwargs = _normalize_kwargs(kwargs)
+    # ops that behave differently in training (Dropout/BatchNorm/RNN
+    # dropout) read the imperative context like the reference's
+    # ctx.is_train (imperative.cc) unless the caller pins train_mode
+    if "train_mode" not in kwargs and _accepts_train_mode(op):
+        kwargs["train_mode"] = ag.is_training()
     raw = [x.data if isinstance(x, NDArray) else x for x in inputs]
     fn = functools.partial(op.fn, **kwargs) if kwargs else op.fn
 

@@ -30,13 +30,7 @@ __all__ = ["Executor"]
 AUX_UPDATES = {"BatchNorm": {3: 1, 4: 2}}
 
 
-@functools.lru_cache(maxsize=None)
-def _fn_params(fn):
-    try:
-        sig = inspect.signature(fn)
-    except (TypeError, ValueError):
-        return None
-    return frozenset(sig.parameters)
+from ..ops.registry import fn_params as _fn_params  # noqa: E402 — canonical home
 
 
 def _call_op_with_attrs(op, attrs, train, arrays):

@@ -275,12 +275,19 @@ class Symbol:
             if n.is_var():
                 arg_nodes.append(i)
             jattrs = {k: str(v) for k, v in n.attrs.items()}
-            for k, v in n.annotations.items():
-                # an annotation colliding with a param key must not
-                # clobber the execution value — park it under a
-                # reversible private key instead
-                key = k if k not in jattrs else "__ann_%s__" % k
-                jattrs[key] = str(v)
+            if n.annotations:
+                if n.is_var():
+                    accepted = frozenset()
+                else:
+                    from ..ops.registry import fn_params, get_op
+
+                    accepted = fn_params(get_op(n.op).fn) or frozenset()
+                for k, v in n.annotations.items():
+                    # an annotation matching ANY op parameter (passed or
+                    # defaulted) must not deserialize as the execution
+                    # value — park it under a reversible private key
+                    key = k if k not in accepted else "__ann_%s__" % k
+                    jattrs[key] = str(v)
             jnodes.append({
                 "op": "null" if n.is_var() else n.op,
                 "name": n.name,

@@ -75,6 +75,20 @@ def test_colliding_annotation_roundtrips_without_clobber():
     # inference mode -> identity; a str p would TypeError here
 
 
+def test_unpassed_param_annotation_roundtrips_inert():
+    """An annotation matching an UNPASSED op param ('mode') must not
+    become the execution value after save/load."""
+    with mx.AttrScope(mode="always"):
+        out = mx.sym.Dropout(mx.sym.var("data"), p=0.5)
+    s2 = mx.sym.load_json(out.tojson())
+    assert s2.attr("mode") == "always"    # annotation preserved
+    x = mx.nd.ones((2, 100))
+    res = s2.bind(mx.cpu(), {"data": x}).forward()[0]
+    # inference: identity. If 'mode' leaked as the execution param,
+    # mode='always' would drop half the elements here.
+    np.testing.assert_array_equal(res.asnumpy(), x.asnumpy())
+
+
 def test_attr_scope_rejects_non_string():
     with pytest.raises(ValueError):
         mx.AttrScope(group=4)

@@ -353,6 +353,10 @@ EXEMPT = {
     "norm_like_cast": "dtype cast; gradient is the identity cast",
     "ones_like": "constant output, zero gradient by definition",
     "zeros_like": "constant output, zero gradient by definition",
+    "CTCLoss": "integer labels break the sweep's perturb-everything "
+               "harness; values AND input grads are pinned against "
+               "torch.nn.functional.ctc_loss in "
+               "tests/test_ctc_and_contrib_data.py",
 }
 
 

@@ -1,0 +1,213 @@
+"""Value-level parity of core NN ops against torch (CPU oracle).
+
+The numeric-gradient sweep checks our backward against our forward;
+these tests check the FORWARD semantics themselves against an
+independent implementation of the same reference ops (torch implements
+the identical conv/pool/norm contracts the reference's mshadow/cuDNN
+kernels do). Gradients for conv/FC are cross-checked too.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as tF  # noqa: E402
+
+
+def _np32(*shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed)
+            .uniform(-1, 1, shape).astype(np.float32) * scale)
+
+
+@pytest.mark.parametrize("stride,pad,dilate,groups", [
+    (1, 0, 1, 1), (2, 1, 1, 1), (1, 2, 2, 1), (1, 1, 1, 2)])
+def test_conv2d_forward_backward(stride, pad, dilate, groups):
+    x_np = _np32(2, 4, 10, 10, seed=1)
+    w_np = _np32(6, 4 // groups, 3, 3, seed=2)
+    b_np = _np32(6, seed=3)
+
+    x = mx.nd.array(x_np)
+    w = mx.nd.array(w_np)
+    b = mx.nd.array(b_np)
+    for a in (x, w, b):
+        a.attach_grad()
+    with autograd.record():
+        out = mx.nd.Convolution(x, w, b, kernel=(3, 3),
+                                stride=(stride, stride),
+                                pad=(pad, pad), dilate=(dilate, dilate),
+                                num_filter=6, num_group=groups)
+        loss = (out * out).sum()
+    loss.backward()
+
+    tx = torch.from_numpy(x_np).requires_grad_()
+    tw = torch.from_numpy(w_np).requires_grad_()
+    tb = torch.from_numpy(b_np).requires_grad_()
+    tout = tF.conv2d(tx, tw, tb, stride=stride, padding=pad,
+                     dilation=dilate, groups=groups)
+    (tout * tout).sum().backward()
+
+    np.testing.assert_allclose(out.asnumpy(), tout.detach().numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(x.grad.asnumpy(), tx.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(w.grad.asnumpy(), tw.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(b.grad.asnumpy(), tb.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_deconv2d_forward():
+    x_np = _np32(2, 3, 5, 5, seed=4)
+    w_np = _np32(3, 4, 3, 3, seed=5)  # (in, out, kH, kW) — both contracts
+    out = mx.nd.Deconvolution(mx.nd.array(x_np), mx.nd.array(w_np),
+                              kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                              adj=(1, 1), num_filter=4, no_bias=True)
+    tout = tF.conv_transpose2d(torch.from_numpy(x_np),
+                               torch.from_numpy(w_np), stride=2,
+                               padding=1, output_padding=1)
+    np.testing.assert_allclose(out.asnumpy(), tout.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+@pytest.mark.parametrize("pool_type,torch_fn", [
+    ("max", tF.max_pool2d), ("avg", tF.avg_pool2d)])
+def test_pooling(pool_type, torch_fn):
+    x_np = _np32(2, 3, 8, 8, seed=6)
+    out = mx.nd.Pooling(mx.nd.array(x_np), kernel=(2, 2), stride=(2, 2),
+                        pool_type=pool_type)
+    tout = torch_fn(torch.from_numpy(x_np), 2, 2)
+    np.testing.assert_allclose(out.asnumpy(), tout.numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_global_pooling():
+    x_np = _np32(2, 3, 7, 5, seed=7)
+    out = mx.nd.Pooling(mx.nd.array(x_np), kernel=(1, 1),
+                        pool_type="avg", global_pool=True)
+    ref = x_np.mean(axis=(2, 3), keepdims=True)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_batchnorm_training_stats():
+    x_np = _np32(4, 3, 6, 6, seed=8)
+    gamma = _np32(3, seed=9) + 1.5
+    beta = _np32(3, seed=10)
+    x = mx.nd.array(x_np)
+    mean0 = mx.nd.zeros((3,))
+    var0 = mx.nd.ones((3,))
+    with autograd.record():  # training mode -> batch stats
+        out = mx.nd.BatchNorm(x, mx.nd.array(gamma), mx.nd.array(beta),
+                              mean0, var0, fix_gamma=False, eps=1e-5,
+                              momentum=0.9)
+    tout = tF.batch_norm(torch.from_numpy(x_np), None, None,
+                         torch.from_numpy(gamma),
+                         torch.from_numpy(beta), training=True,
+                         eps=1e-5)
+    y = out[0] if isinstance(out, tuple) else out
+    np.testing.assert_allclose(y.asnumpy(), tout.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_layernorm_parity():
+    x_np = _np32(4, 10, seed=11)
+    g = _np32(10, seed=12) + 1.0
+    b = _np32(10, seed=13)
+    out = mx.nd.LayerNorm(mx.nd.array(x_np), mx.nd.array(g),
+                          mx.nd.array(b), eps=1e-5)
+    tout = tF.layer_norm(torch.from_numpy(x_np), (10,),
+                         torch.from_numpy(g), torch.from_numpy(b),
+                         eps=1e-5)
+    np.testing.assert_allclose(out.asnumpy(), tout.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+@pytest.mark.parametrize("act,tfn", [
+    ("relu", tF.relu), ("sigmoid", torch.sigmoid), ("tanh", torch.tanh),
+    ("softrelu", tF.softplus)])
+def test_activations(act, tfn):
+    x_np = _np32(3, 7, seed=14, scale=3.0)
+    out = mx.nd.Activation(mx.nd.array(x_np), act_type=act)
+    np.testing.assert_allclose(out.asnumpy(),
+                               tfn(torch.from_numpy(x_np)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_log_softmax_pick():
+    x_np = _np32(4, 9, seed=15, scale=4.0)
+    np.testing.assert_allclose(
+        mx.nd.softmax(mx.nd.array(x_np)).asnumpy(),
+        tF.softmax(torch.from_numpy(x_np), dim=-1).numpy(),
+        rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        mx.nd.log_softmax(mx.nd.array(x_np)).asnumpy(),
+        tF.log_softmax(torch.from_numpy(x_np), dim=-1).numpy(),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_fully_connected_grads():
+    x_np = _np32(5, 7, seed=16)
+    w_np = _np32(4, 7, seed=17)
+    b_np = _np32(4, seed=18)
+    x, w, b = (mx.nd.array(a) for a in (x_np, w_np, b_np))
+    for a in (x, w, b):
+        a.attach_grad()
+    with autograd.record():
+        out = mx.nd.FullyConnected(x, w, b, num_hidden=4)
+        ((out * out).sum()).backward()
+    tx = torch.from_numpy(x_np).requires_grad_()
+    tw = torch.from_numpy(w_np).requires_grad_()
+    tb = torch.from_numpy(b_np).requires_grad_()
+    tout = tF.linear(tx, tw, tb)
+    (tout * tout).sum().backward()
+    np.testing.assert_allclose(out.asnumpy(), tout.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    for ours, theirs in ((x, tx), (w, tw), (b, tb)):
+        np.testing.assert_allclose(ours.grad.asnumpy(),
+                                   theirs.grad.numpy(), rtol=1e-3,
+                                   atol=1e-4)
+
+
+def test_embedding_take_gather():
+    table = _np32(11, 5, seed=19)
+    idx = np.array([[1, 4, 7], [0, 10, 3]], dtype=np.float32)
+    out = mx.nd.Embedding(mx.nd.array(idx), mx.nd.array(table),
+                          input_dim=11, output_dim=5)
+    ref = tF.embedding(torch.from_numpy(idx.astype(np.int64)),
+                       torch.from_numpy(table))
+    np.testing.assert_allclose(out.asnumpy(), ref.numpy(), rtol=1e-6)
+
+
+def test_rnn_fused_lstm_vs_torch():
+    """The packed-parameter fused LSTM against torch.nn.LSTM with the
+    same weights."""
+    T, B, I, H = 6, 3, 4, 5
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    rng = np.random.RandomState(20)
+    x_np = rng.uniform(-1, 1, (T, B, I)).astype(np.float32)
+
+    lstm = torch.nn.LSTM(I, H, num_layers=1)
+    with torch.no_grad():
+        for p in lstm.parameters():
+            p.uniform_(-0.5, 0.5)
+    # pack into ops/rnn.py layout: wi, wh (all layers), then bi, bh
+    wi = lstm.weight_ih_l0.detach().numpy()   # (4H, I) gate order i,f,g,o
+    wh = lstm.weight_hh_l0.detach().numpy()
+    bi = lstm.bias_ih_l0.detach().numpy()
+    bh = lstm.bias_hh_l0.detach().numpy()
+    packed = np.concatenate([wi.ravel(), wh.ravel(), bi, bh])
+    assert packed.shape[0] == rnn_param_size("lstm", I, H)
+
+    out = mx.nd.RNN(mx.nd.array(x_np), mx.nd.array(packed),
+                    mx.nd.zeros((1, B, H)), mx.nd.zeros((1, B, H)),
+                    mode="lstm", state_size=H, num_layers=1,
+                    state_outputs=True)
+    tout, (th, tc) = lstm(torch.from_numpy(x_np))
+    np.testing.assert_allclose(out[0].asnumpy(), tout.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(out[1].asnumpy(), th.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(out[2].asnumpy(), tc.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
