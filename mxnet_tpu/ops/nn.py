@@ -255,8 +255,10 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
 
 @register("Dropout")
 def dropout(data, p=0.5, mode="training", axes=(), train_mode=False):
-    """ref: src/operator/nn/dropout.cc. ``train_mode`` is threaded by the
-    caller (gluon layer reads autograd.is_training())."""
+    """ref: src/operator/nn/dropout.cc. ``train_mode`` comes from the
+    caller (gluon layers) or is injected from the autograd context by
+    the eager/executor dispatch (registry.apply_op — the reference's
+    ctx.is_train)."""
     if p <= 0 or (not train_mode and mode != "always"):
         return data
     shape = list(data.shape)
