@@ -17,8 +17,9 @@ from .sharded import (
     ShardedTrainStep, shard_params, sharding_rule, allreduce_across_processes,
 )
 from .sequence import ring_attention, ulysses_attention
+from .pipeline import pipeline_apply, stack_stage_params
 
 __all__ = ["make_mesh", "data_parallel_mesh", "init_distributed",
            "local_device_count", "ShardedTrainStep", "shard_params",
            "sharding_rule", "allreduce_across_processes", "ring_attention",
-           "ulysses_attention"]
+           "ulysses_attention", "pipeline_apply", "stack_stage_params"]
