@@ -18,8 +18,11 @@ from .sharded import (
 )
 from .sequence import ring_attention, ulysses_attention
 from .pipeline import pipeline_apply, stack_stage_params
+from .moe import moe_apply, stack_expert_params, switch_load_balance_loss
 
 __all__ = ["make_mesh", "data_parallel_mesh", "init_distributed",
            "local_device_count", "ShardedTrainStep", "shard_params",
            "sharding_rule", "allreduce_across_processes", "ring_attention",
-           "ulysses_attention", "pipeline_apply", "stack_stage_params"]
+           "ulysses_attention", "pipeline_apply", "stack_stage_params",
+           "moe_apply", "stack_expert_params",
+           "switch_load_balance_loss"]
