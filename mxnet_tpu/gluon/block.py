@@ -361,6 +361,14 @@ class HybridBlock(Block):
             # keyword inputs fall back to eager (rare; matches CachedOp's
             # positional-only calling convention)
             return super().__call__(*args, **kwargs)
+        from ..parallel.sequence import current_sequence_scope
+
+        if current_sequence_scope() is not None:
+            # a single-device whole-block jit cannot host the scope's
+            # multi-device shard_map; run op-by-op eager instead — the
+            # ring attention itself is still one compiled program, and
+            # a stale non-ring trace is never reused inside the scope
+            return super().__call__(*args, **kwargs)
         self._ensure_initialized(*args)
         params = [
             (name, p) for name, p in sorted(self.collect_params().items())

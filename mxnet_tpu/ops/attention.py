@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..base import MXNetError
 from .registry import register
 
 DEFAULT_BLOCK_Q = 128
@@ -405,9 +406,38 @@ def flash_attention(query, key, value, bias=None, causal=False,
                     sm_scale=None):
     """Fused scaled-dot-product attention. query/key/value: (B, H, T, D);
     bias: optional additive (B, H|1, 1, Tk) mask (use large negatives to
-    mask). Returns (B, H, Tq, D)."""
+    mask). Returns (B, H, Tq, D).
+
+    Inside ``parallel.sequence_scope(mesh, axis)`` this dispatches to the
+    ring-attention schedule (T sharded over the mesh axis) — the hook
+    that makes every attention user sequence-parallel without model
+    changes."""
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(query.shape[-1]))
+    from ..parallel.sequence import current_sequence_scope, ring_attention
+
+    scope = current_sequence_scope()
+    if scope is not None and query.shape[2] == key.shape[2]:
+        # the ring schedule covers sequence-sharded SELF-attention;
+        # rectangular attention (cross-attention, Tq=1 decode steps)
+        # falls through to the flash kernel untouched
+        mesh, seq_axis = scope
+        if jax.process_count() > 1:
+            raise MXNetError(
+                "sequence_scope's eager dispatch is single-process; on "
+                "multi-host meshes call parallel.ring_attention inside "
+                "your pjit/shard_map program instead")
+        out = ring_attention(query, key, value, bias=bias, mesh=mesh,
+                             seq_axis=seq_axis, causal=bool(causal),
+                             sm_scale=float(sm_scale))
+        # bring the mesh-sharded result back to a single device so it
+        # composes with unsharded surrounding ops on the eager path
+        # (device_put is traceable; under full-program jit it's just a
+        # sharding constraint XLA folds away)
+        out = jax.device_put(
+            out, jax.sharding.SingleDeviceSharding(
+                mesh.devices.flat[0]))
+        return out
     return _flash_core(query, key, value, bias, bool(causal),
                        float(sm_scale))
 

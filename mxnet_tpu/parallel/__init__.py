@@ -16,7 +16,8 @@ from .mesh import (
 from .sharded import (
     ShardedTrainStep, shard_params, sharding_rule, allreduce_across_processes,
 )
-from .sequence import ring_attention, ulysses_attention
+from .sequence import (current_sequence_scope, ring_attention,
+                       sequence_scope, ulysses_attention)
 from .pipeline import pipeline_apply, stack_stage_params
 from .moe import moe_apply, stack_expert_params, switch_load_balance_loss
 
@@ -25,4 +26,5 @@ __all__ = ["make_mesh", "data_parallel_mesh", "init_distributed",
            "sharding_rule", "allreduce_across_processes", "ring_attention",
            "ulysses_attention", "pipeline_apply", "stack_stage_params",
            "moe_apply", "stack_expert_params",
-           "switch_load_balance_loss"]
+           "switch_load_balance_loss", "sequence_scope",
+           "current_sequence_scope"]

@@ -25,7 +25,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import _attention_reference, _NEG_INF
 
-__all__ = ["ring_attention", "ulysses_attention"]
+__all__ = ["ring_attention", "ulysses_attention", "sequence_scope",
+           "current_sequence_scope"]
 
 
 def _ring_hop_scores(qf, k_cur, b_cur, idx, src, Tl, causal, sm_scale):
@@ -138,9 +139,14 @@ def _ring_core_bwd(axis_name, causal, sm_scale, n_shards, res, do):
                              k_cur.astype(jnp.float32)) * sm_scale
         dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * sm_scale
         if b_cur is not None:
-            db = jnp.sum(ds, axis=(1, 2))[:, None, None, :]
-            if bias_loc.shape[0] == 1:  # batch-broadcast bias
-                db = jnp.sum(db, axis=0, keepdims=True)
+            # reduce ds (B, H, Tq, Tk) onto the bias's own shape: sum
+            # exactly the axes the bias broadcasts over (H=1 shared
+            # biases sum heads; per-head (B, H, 1, Tk) biases — ALiBi —
+            # keep their head axis)
+            db = ds
+            for ax in range(db.ndim):
+                if bias_loc.shape[ax] == 1 and db.shape[ax] != 1:
+                    db = jnp.sum(db, axis=ax, keepdims=True)
             db_acc = db_acc + db
         # rotate the block with its accumulators; after n hops each dk/dv
         # (and db) lands back on the chip that owns its K/V block
@@ -182,7 +188,19 @@ def ring_attention(q, k, v, bias=None, mesh=None, seq_axis="data",
 
     qkv_spec = P(None, None, seq_axis, None)
     scale = float(sm_scale)
+    # inputs may live on one device while the mesh spans several (the
+    # sequence_scope hook called from an eager gluon forward, or its
+    # vjp trace) — commit them to the mesh first; under jit this lowers
+    # to a sharding constraint
+    from jax.sharding import NamedSharding
+
+    qkv_sh = NamedSharding(mesh, qkv_spec)
+    q = jax.device_put(q, qkv_sh)
+    k = jax.device_put(k, qkv_sh)
+    v = jax.device_put(v, qkv_sh)
     if bias is not None:
+        bias = jax.device_put(
+            bias, NamedSharding(mesh, P(None, None, None, seq_axis)))
         sm = shard_map(
             lambda q_, k_, v_, b_: _ring_core(q_, k_, v_, b_, seq_axis,
                                               causal, scale, n_shards),
@@ -239,3 +257,33 @@ def ulysses_attention(q, k, v, mesh=None, seq_axis="data", causal=False,
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )
     return sm(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# sequence-parallel scope: any flash_attention op called inside it (eager
+# or traced — model zoo, gluon blocks, symbols) dispatches to the ring
+# schedule with zero model changes
+# ---------------------------------------------------------------------------
+import contextlib as _contextlib
+import threading as _threading
+
+_SP_STATE = _threading.local()
+
+
+@_contextlib.contextmanager
+def sequence_scope(mesh, seq_axis="sp"):
+    """Route every flash_attention inside the scope through
+    ring_attention over ``mesh[seq_axis]`` (the op reads this scope at
+    trace time — ops/attention.py flash_attention). The model code does
+    not change; the sequence axis of q/k/v must divide by the axis
+    size."""
+    prev = getattr(_SP_STATE, "scope", None)
+    _SP_STATE.scope = (mesh, seq_axis)
+    try:
+        yield
+    finally:
+        _SP_STATE.scope = prev
+
+
+def current_sequence_scope():
+    return getattr(_SP_STATE, "scope", None)
