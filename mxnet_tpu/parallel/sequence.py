@@ -174,8 +174,6 @@ def ring_attention(q, k, v, bias=None, mesh=None, seq_axis="data",
     optional additive (B, 1, 1, T) key bias (sharded on its T too).
     Returns (B, H, T, D) sharded like q.
     """
-    shard_map = jax.shard_map
-
     if mesh is None:
         raise ValueError("ring_attention requires mesh= (a jax Mesh with "
                          "a %r axis)" % (seq_axis,))
@@ -201,7 +199,21 @@ def ring_attention(q, k, v, bias=None, mesh=None, seq_axis="data",
     if bias is not None:
         bias = jax.device_put(
             bias, NamedSharding(mesh, P(None, None, None, seq_axis)))
-        sm = shard_map(
+        sm = _ring_callable(mesh, seq_axis, causal, scale, n_shards,
+                            True)
+        return sm(q, k, v, bias)
+    sm = _ring_callable(mesh, seq_axis, causal, scale, n_shards, False)
+    return sm(q, k, v)
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_callable(mesh, seq_axis, causal, scale, n_shards, has_bias):
+    """Jitted shard_map program, cached by configuration — a fresh
+    lambda per call would force a recompile per attention call (63 s/fwd
+    for a 4-layer GPT before this cache; one compile per shape after)."""
+    qkv_spec = P(None, None, seq_axis, None)
+    if has_bias:
+        sm = jax.shard_map(
             lambda q_, k_, v_, b_: _ring_core(q_, k_, v_, b_, seq_axis,
                                               causal, scale, n_shards),
             mesh=mesh,
@@ -209,15 +221,15 @@ def ring_attention(q, k, v, bias=None, mesh=None, seq_axis="data",
                       P(None, None, None, seq_axis)),
             out_specs=qkv_spec,
         )
-        return sm(q, k, v, bias)
-    sm = shard_map(
-        lambda q_, k_, v_: _ring_core(q_, k_, v_, None, seq_axis,
-                                      causal, scale, n_shards),
-        mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec),
-        out_specs=qkv_spec,
-    )
-    return sm(q, k, v)
+    else:
+        sm = jax.shard_map(
+            lambda q_, k_, v_: _ring_core(q_, k_, v_, None, seq_axis,
+                                          causal, scale, n_shards),
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec,
+        )
+    return jax.jit(sm)
 
 
 def _ulysses_local(q_loc, k_loc, v_loc, *, axis_name, causal, sm_scale):
