@@ -408,28 +408,38 @@ def flash_attention(query, key, value, bias=None, causal=False,
     bias: optional additive (B, H|1, 1, Tk) mask (use large negatives to
     mask). Returns (B, H, Tq, D).
 
-    Inside ``parallel.sequence_scope(mesh, axis)`` this dispatches to the
-    ring-attention schedule (T sharded over the mesh axis) — the hook
-    that makes every attention user sequence-parallel without model
-    changes."""
+    Inside ``parallel.sequence_scope(mesh, axis, schedule)`` this
+    dispatches to a sequence-parallel schedule (ring KV rotation, or
+    Ulysses head all-to-all when heads divide and there is no bias) —
+    the hook that makes every attention user sequence-parallel without
+    model changes."""
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(query.shape[-1]))
     from ..parallel.sequence import current_sequence_scope, ring_attention
 
     scope = current_sequence_scope()
     if scope is not None and query.shape[2] == key.shape[2]:
-        # the ring schedule covers sequence-sharded SELF-attention;
+        # the scope covers sequence-sharded SELF-attention;
         # rectangular attention (cross-attention, Tq=1 decode steps)
         # falls through to the flash kernel untouched
-        mesh, seq_axis = scope
+        mesh, seq_axis, schedule = scope
         if jax.process_count() > 1:
             raise MXNetError(
                 "sequence_scope's eager dispatch is single-process; on "
                 "multi-host meshes call parallel.ring_attention inside "
                 "your pjit/shard_map program instead")
-        out = ring_attention(query, key, value, bias=bias, mesh=mesh,
-                             seq_axis=seq_axis, causal=bool(causal),
-                             sm_scale=float(sm_scale))
+        from ..parallel.sequence import ulysses_attention
+
+        if (schedule == "ulysses" and bias is None
+                and query.shape[1] % mesh.shape[seq_axis] == 0):
+            out = ulysses_attention(query, key, value, mesh=mesh,
+                                    seq_axis=seq_axis,
+                                    causal=bool(causal),
+                                    sm_scale=float(sm_scale))
+        else:  # ring handles biases and any head count
+            out = ring_attention(query, key, value, bias=bias, mesh=mesh,
+                                 seq_axis=seq_axis, causal=bool(causal),
+                                 sm_scale=float(sm_scale))
         # bring the mesh-sharded result back to a single device so it
         # composes with unsharded surrounding ops on the eager path
         # (device_put is traceable; under full-program jit it's just a

@@ -186,24 +186,25 @@ def ring_attention(q, k, v, bias=None, mesh=None, seq_axis="data",
 
     qkv_spec = P(None, None, seq_axis, None)
     scale = float(sm_scale)
-    # inputs may live on one device while the mesh spans several (the
-    # sequence_scope hook called from an eager gluon forward, or its
-    # vjp trace) — commit them to the mesh first; under jit this lowers
-    # to a sharding constraint
-    from jax.sharding import NamedSharding
-
-    qkv_sh = NamedSharding(mesh, qkv_spec)
-    q = jax.device_put(q, qkv_sh)
-    k = jax.device_put(k, qkv_sh)
-    v = jax.device_put(v, qkv_sh)
+    q, k, v = _commit_to_mesh(mesh, qkv_spec, q, k, v)
     if bias is not None:
-        bias = jax.device_put(
-            bias, NamedSharding(mesh, P(None, None, None, seq_axis)))
+        bias, = _commit_to_mesh(mesh, P(None, None, None, seq_axis),
+                                bias)
         sm = _ring_callable(mesh, seq_axis, causal, scale, n_shards,
                             True)
         return sm(q, k, v, bias)
     sm = _ring_callable(mesh, seq_axis, causal, scale, n_shards, False)
     return sm(q, k, v)
+
+
+def _commit_to_mesh(mesh, spec, *arrays):
+    """device_put arrays onto the mesh sharding — inputs may live on one
+    device while the mesh spans several (eager scope dispatch, or its
+    vjp trace); under jit this lowers to a sharding constraint."""
+    from jax.sharding import NamedSharding
+
+    sh = NamedSharding(mesh, spec)
+    return tuple(jax.device_put(a, sh) for a in arrays)
 
 
 @functools.lru_cache(maxsize=64)
@@ -248,8 +249,6 @@ def ulysses_attention(q, k, v, mesh=None, seq_axis="data", causal=False,
                       sm_scale=None):
     """All-to-all (DeepSpeed-Ulysses style) sequence parallelism. Heads must
     be divisible by the mesh axis size."""
-    shard_map = jax.shard_map
-
     if mesh is None:
         raise ValueError("ulysses_attention requires mesh= (a jax Mesh "
                          "with a %r axis)" % (seq_axis,))
@@ -263,18 +262,29 @@ def ulysses_attention(q, k, v, mesh=None, seq_axis="data", causal=False,
         raise ValueError("sequence length %d not divisible by %d shards"
                          % (q.shape[2], n_shards))
     spec = P(None, None, seq_axis, None)
-    sm = shard_map(
+    q, k, v = _commit_to_mesh(mesh, spec, q, k, v)
+    sm = _ulysses_callable(mesh, seq_axis, causal, float(sm_scale))
+    return sm(q, k, v)
+
+
+@functools.lru_cache(maxsize=64)
+def _ulysses_callable(mesh, seq_axis, causal, sm_scale):
+    """Jitted shard_map program, cached by configuration (same
+    recompile-per-call hazard _ring_callable fixes for the ring)."""
+    spec = P(None, None, seq_axis, None)
+    sm = jax.shard_map(
         functools.partial(_ulysses_local, axis_name=seq_axis,
-                          causal=causal, sm_scale=float(sm_scale)),
+                          causal=causal, sm_scale=sm_scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )
-    return sm(q, k, v)
+    return jax.jit(sm)
 
 
 # ---------------------------------------------------------------------------
 # sequence-parallel scope: any flash_attention op called inside it (eager
-# or traced — model zoo, gluon blocks, symbols) dispatches to the ring
-# schedule with zero model changes
+# or traced — model zoo, gluon blocks, symbols) dispatches to a
+# sequence-parallel schedule (ring, or Ulysses when eligible) with zero
+# model changes
 # ---------------------------------------------------------------------------
 import contextlib as _contextlib
 import threading as _threading
@@ -283,14 +293,22 @@ _SP_STATE = _threading.local()
 
 
 @_contextlib.contextmanager
-def sequence_scope(mesh, seq_axis="sp"):
-    """Route every flash_attention inside the scope through
-    ring_attention over ``mesh[seq_axis]`` (the op reads this scope at
-    trace time — ops/attention.py flash_attention). The model code does
-    not change; the sequence axis of q/k/v must divide by the axis
-    size."""
+def sequence_scope(mesh, seq_axis="sp", schedule="ring"):
+    """Route every flash_attention inside the scope through a
+    sequence-parallel schedule over ``mesh[seq_axis]`` (the op reads
+    this scope at call time — ops/attention.py flash_attention). The
+    model code does not change; the sequence axis of q/k/v must divide
+    by the axis size.
+
+    schedule: "ring" (KV rotation; works with biases and any head
+    count) or "ulysses" (head all-to-all; needs heads divisible by the
+    axis size and no bias — falls back to ring when those don't hold).
+    """
+    if schedule not in ("ring", "ulysses"):
+        raise ValueError("schedule must be 'ring' or 'ulysses', got %r"
+                         % (schedule,))
     prev = getattr(_SP_STATE, "scope", None)
-    _SP_STATE.scope = (mesh, seq_axis)
+    _SP_STATE.scope = (mesh, seq_axis, schedule)
     try:
         yield
     finally:

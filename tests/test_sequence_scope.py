@@ -51,6 +51,50 @@ def test_gpt_forward_and_grads_under_scope():
                                    rtol=5e-3, atol=1e-5)
 
 
+def test_ulysses_schedule_dispatch():
+    """schedule='ulysses' routes through the head all-to-all when heads
+    divide; falls back to ring for per-head-indivisible or biased
+    calls."""
+    q = mx.nd.random.uniform(shape=(2, 4, 16, 8))  # H=4 divides 4
+    base = mx.nd.flash_attention(q, q, q, causal=True).asnumpy()
+    with parallel.sequence_scope(_mesh(4), "sp", schedule="ulysses"):
+        out = mx.nd.flash_attention(q, q, q, causal=True).asnumpy()
+    np.testing.assert_allclose(out, base, rtol=2e-4, atol=2e-5)
+    # H=2 doesn't divide 4 shards -> ring fallback, still correct
+    q2 = mx.nd.random.uniform(shape=(2, 2, 16, 8))
+    base2 = mx.nd.flash_attention(q2, q2, q2).asnumpy()
+    with parallel.sequence_scope(_mesh(4), "sp", schedule="ulysses"):
+        out2 = mx.nd.flash_attention(q2, q2, q2).asnumpy()
+    np.testing.assert_allclose(out2, base2, rtol=2e-4, atol=2e-5)
+    with pytest.raises(ValueError, match="schedule"):
+        with parallel.sequence_scope(_mesh(2), "sp", schedule="nope"):
+            pass
+
+
+def test_ulysses_grads_match_flash():
+    """Gradients through the ulysses all-to-all path (plain autodiff,
+    not ring's custom VJP) must match the flash kernel's."""
+    B, H, T, D = 2, 4, 16, 8
+    rng = np.random.RandomState(7)
+    qn = rng.randn(B, H, T, D).astype(np.float32)
+
+    def run(scoped):
+        q = mx.nd.array(qn)
+        q.attach_grad()
+        with autograd.record():
+            if scoped:
+                with parallel.sequence_scope(_mesh(4), "sp",
+                                             schedule="ulysses"):
+                    out = mx.nd.flash_attention(q, q, q, causal=True)
+            else:
+                out = mx.nd.flash_attention(q, q, q, causal=True)
+            (out * out).sum().backward()
+        return q.grad.asnumpy()
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-3,
+                               atol=2e-4)
+
+
 def test_per_head_bias_grads_match_flash():
     """ALiBi-style (B, H, 1, Tk) bias: ring backward must keep per-head
     bias gradients, not sum heads."""
