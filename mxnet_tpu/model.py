@@ -17,15 +17,38 @@ BatchEndParam = namedtuple("BatchEndParam",
                            ["epoch", "nbatch", "eval_metric", "locals"])
 
 
+def pack_param_dict(arg_params, aux_params):
+    """arg:/aux:-prefixed flat dict — THE on-disk param layout
+    (shared by checkpoints and Module.save_params)."""
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    return save_dict
+
+
+def unpack_param_dict(save_dict, strict=False):
+    """Inverse of pack_param_dict. strict raises on unprefixed keys;
+    otherwise they are skipped (checkpoint-reader leniency)."""
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        elif strict:
+            raise MXNetError(
+                "invalid param dict: key %r has no arg:/aux: prefix"
+                % (k,))
+    return arg_params, aux_params
+
+
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
     del remove_amp_cast
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
-    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    _nd.save(param_name, save_dict)
+    _nd.save(param_name, pack_param_dict(arg_params, aux_params))
 
 
 def load_checkpoint(prefix, epoch):
@@ -33,14 +56,7 @@ def load_checkpoint(prefix, epoch):
 
     symbol = sym_mod.load("%s-symbol.json" % prefix)
     save_dict = _nd.load("%s-%04d.params" % (prefix, epoch))
-    arg_params = {}
-    aux_params = {}
-    for k, v in save_dict.items():
-        tp, _, name = k.partition(":")
-        if tp == "arg":
-            arg_params[name] = v
-        elif tp == "aux":
-            aux_params[name] = v
+    arg_params, aux_params = unpack_param_dict(save_dict)
     return symbol, arg_params, aux_params
 
 

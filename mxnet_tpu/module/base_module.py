@@ -18,6 +18,7 @@ from .. import metric as metric_mod
 from .. import initializer as init_mod
 from ..io.io import DataBatch
 from ..model import BatchEndParam
+from ..ndarray import ndarray as _nd
 
 __all__ = ["BaseModule"]
 
@@ -69,6 +70,36 @@ class BaseModule:
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False, allow_extra=False):
         raise NotImplementedError
+
+    def save_params(self, fname):
+        """Saves parameters only, ``arg:``/``aux:``-prefixed like the
+        checkpoint format (ref: base_module.py — save_params)."""
+        from ..model import pack_param_dict
+
+        arg_params, aux_params = self.get_params()
+        _nd.save(fname, pack_param_dict(arg_params, aux_params))
+
+    def load_params(self, fname):
+        """Loads parameters saved by save_params
+        (ref: base_module.py — load_params)."""
+        from ..model import unpack_param_dict
+
+        arg_params, aux_params = unpack_param_dict(_nd.load(fname),
+                                                   strict=True)
+        self.set_params(arg_params, aux_params)
+
+    def iter_predict(self, eval_data, num_batch=None, reset=True):
+        """Yields (outputs, batch_index, batch) per batch
+        (ref: base_module.py — iter_predict)."""
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        for nbatch, eval_batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(eval_batch, is_train=False)
+            outputs = self.get_outputs()
+            yield outputs, nbatch, eval_batch
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
@@ -139,8 +170,6 @@ class BaseModule:
         """Forward over a dataset, concatenating outputs
         (ref: base_module.py — predict)."""
         del sparse_row_id_fn
-        from ..ndarray import ndarray as _nd
-
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()

@@ -59,3 +59,28 @@ lesser = getattr(_mod, "broadcast_lesser")
 # sparse storage namespace (ref: python/mxnet/ndarray/sparse.py is exposed
 # as mx.nd.sparse); imported late to avoid a cycle with ndarray.ndarray
 from .. import sparse  # noqa: E402,F401
+
+
+# ``mx.nd.contrib`` sub-namespace (ref: register.py generates op modules
+# per prefix: _contrib_X -> nd.contrib.X); both the _contrib_-prefixed
+# registry names and their unprefixed aliases resolve here
+class _ContribNamespace:
+    """Attribute view over the registry's contrib ops."""
+
+    def __init__(self, mod):
+        self._mod = mod
+
+    def __getattr__(self, name):
+        mod = object.__getattribute__(self, "_mod")
+        fn = getattr(mod, "_contrib_%s" % name, None)
+        if fn is not None and callable(fn):
+            return fn
+        raise AttributeError("contrib op %r is not registered" % (name,))
+
+    def __dir__(self):
+        mod = object.__getattribute__(self, "_mod")
+        return sorted({n[len("_contrib_"):] for n in dir(mod)
+                       if n.startswith("_contrib_")})
+
+
+contrib = _ContribNamespace(_mod)

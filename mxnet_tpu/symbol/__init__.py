@@ -136,3 +136,10 @@ def ones(shape=(), dtype="float32", name=None, **kwargs):
 
 
 from .executor import Executor  # noqa: E402,F401
+
+
+# ``mx.sym.contrib`` (ref: symbol/register.py — same prefix convention
+# as the nd namespace)
+from ..ndarray import _ContribNamespace as _CN  # noqa: E402
+
+contrib = _CN(_mod)

@@ -195,6 +195,59 @@ def test_feedforward_custom_input_name():
     np.testing.assert_allclose(pred, y, atol=0.05)
 
 
+def test_contrib_namespaces():
+    """mx.nd.contrib.X / mx.sym.contrib.X resolve the _contrib_-prefixed
+    registry ops (ref: register.py prefix-module convention)."""
+    x = mx.nd.array(np.random.RandomState(0)
+                    .randn(2, 8).astype(np.float32))
+    re_im = mx.nd.contrib.fft(x)
+    assert re_im.shape[-1] == 16  # interleaved complex like the ref op
+    # symbolic form composes too
+    s = mx.sym.contrib.fft(mx.sym.var("data"))
+    out = s.bind(mx.cpu(), {"data": x}).forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), re_im.asnumpy(), rtol=1e-5)
+    assert "fft" in dir(mx.nd.contrib)
+    with pytest.raises(AttributeError):
+        mx.nd.contrib.no_such_op
+
+
+@with_seed()
+def test_module_save_load_params_iter_predict(tmp_path):
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (40, 3)).astype(np.float32)
+    y = (x @ np.array([[1.0, -1.0, 2.0]], dtype=np.float32).T)
+    it = mx.io.NDArrayIter(x, y, batch_size=8, label_name="lin_label")
+    net = mx.sym.LinearRegressionOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=1,
+                              name="fc"),
+        mx.sym.var("lin_label"), name="lro")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("lin_label",))
+    mod.fit(it, num_epoch=20, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),),
+            eval_metric="mse")
+    f = str(tmp_path / "m.params")
+    mod.save_params(f)
+
+    mod2 = mx.mod.Module(net, data_names=("data",),
+                         label_names=("lin_label",))
+    mod2.bind(data_shapes=it.provide_data,
+              label_shapes=it.provide_label, for_training=False)
+    mod2.init_params()
+    mod2.load_params(f)
+    np.testing.assert_allclose(
+        mod2.get_params()[0]["fc_weight"].asnumpy(),
+        mod.get_params()[0]["fc_weight"].asnumpy(), rtol=1e-6)
+
+    # iter_predict walks batches with indices
+    seen = 0
+    for outputs, i, batch in mod2.iter_predict(it):
+        assert i == seen
+        assert outputs[0].shape[0] == 8
+        seen += 1
+    assert seen == 5
+
+
 @with_seed()
 def test_feedforward_create():
     rng = np.random.RandomState(1)
