@@ -1,9 +1,11 @@
 """Data iterators (ref: python/mxnet/io/__init__.py)."""
 from .io import (
     DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
-    CSVIter, MNISTIter, ImageRecordIter, LibSVMIter,
+    CSVIter, MNISTIter, ImageRecordIter, ImageDetRecordIter,
+    LibSVMIter,
 )
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "ImageDetRecordIter",
            "LibSVMIter"]

@@ -703,6 +703,8 @@ class ImageRecordIter(DataIter):
         rng_seeds = self._rng.randint(0, 2 ** 31 - 1,
                                       size=self.preprocess_threads)
 
+        errors = []
+
         def worker(tid):
             # one file handle per thread (neither the Python reader nor the
             # native FILE* is safe to share across seeking threads)
@@ -724,13 +726,18 @@ class ImageRecordIter(DataIter):
                     reader.handle.seek(self._offsets[i])
                     return reader.read()
             rng = np.random.RandomState(rng_seeds[tid])
-            for j in range(tid, len(idxs), self.preprocess_threads):
-                raw = raws[j] if raws[j] is not None else fetch(idxs[j])
-                results[j] = self._decode_one(raw, rng)
-            if nat is not None:
-                nat.close()
-            if reader is not None:
-                reader.close()
+            try:
+                for j in range(tid, len(idxs), self.preprocess_threads):
+                    raw = raws[j] if raws[j] is not None \
+                        else fetch(idxs[j])
+                    results[j] = self._decode_one(raw, rng)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+            finally:
+                if nat is not None:
+                    nat.close()
+                if reader is not None:
+                    reader.close()
 
         threads = [threading.Thread(target=worker, args=(t,))
                    for t in range(self.preprocess_threads)]
@@ -738,6 +745,10 @@ class ImageRecordIter(DataIter):
             t.start()
         for t in threads:
             t.join()
+        if errors:
+            # surface the decode error on the caller's thread — a dead
+            # worker otherwise shows up as an opaque None in np.stack
+            raise errors[0]
 
         data = np.stack([r[0] for r in results])
         label = np.asarray([r[1] for r in results], dtype=np.float32)
@@ -777,3 +788,90 @@ def _crop(img, th, tw, rand=False, rng=None):
         y = (h - th) // 2
         x = (w - tw) // 2
     return img[y:y + th, x:x + tw, :]
+
+
+class ImageDetRecordIter(ImageRecordIter):
+    """Detection RecordIO iterator (ref: src/io/iter_image_det_recordio.cc
+    — ImageDetRecordIter). Records carry im2rec --pack-label detection
+    labels: a flat [header_width, object_width, extra..., then
+    object_width floats per box (id, xmin, ymin, xmax, ymax)] vector in
+    normalized coordinates.
+
+    Emits label (batch, label_pad_width) padded with ``label_pad_value``
+    (the reference's contract — MultiBoxTarget consumers reshape to
+    (B, N, object_width) after stripping the header). Box-invariant
+    augmentations only on this path: resize (normalized coords) and
+    mirror WITH x-coordinate flip; the richer det augmenter zoo lives in
+    mx.image.ImageDetIter/CreateDetAugmenter.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 label_pad_width=0, label_pad_value=-1.0, **kwargs):
+        kwargs.setdefault("label_width", -1)
+        if kwargs.pop("rand_crop", False):
+            raise MXNetError(
+                "ImageDetRecordIter does not support rand_crop (a crop "
+                "would shift normalized box coords); use "
+                "mx.image.ImageDetIter's detection-aware croppers")
+        super().__init__(path_imgrec, data_shape, batch_size, **kwargs)
+        self.label_pad_width = int(label_pad_width)
+        self.label_pad_value = float(label_pad_value)
+        if not self.label_pad_width:
+            from ..recordio import MXRecordIO, unpack
+
+            # derive from the largest record label: header-only parse
+            # (unpack skips the image payload — no decode)
+            widest = 0
+            rec = MXRecordIO(path_imgrec, "r")
+            try:
+                while True:
+                    raw = rec.read()
+                    if raw is None:
+                        break
+                    header, _ = unpack(raw)
+                    lab = np.atleast_1d(np.asarray(header.label))
+                    widest = max(widest, lab.size)
+            finally:
+                rec.close()
+            self.label_pad_width = widest
+
+    @property
+    def provide_label(self):
+        return [DataDesc("label",
+                         (self.batch_size, self.label_pad_width))]
+
+    def _decode_one(self, raw, rng):
+        from PIL import Image
+
+        header, img = self._unpack_img(raw)
+        c, h, w = self.data_shape
+        # warp-resize straight to (w, h): the ONLY reshaping that keeps
+        # normalized box coords valid (any crop would shift them)
+        img = np.asarray(
+            Image.fromarray(img.astype(np.uint8)).resize(
+                (w, h), Image.BILINEAR), dtype=np.float32)
+        lab = np.array(np.atleast_1d(np.asarray(header.label)),
+                       dtype=np.float32)
+        if self.rand_mirror and rng.rand() < 0.5:
+            img = img[:, ::-1, :]
+            # flip normalized x coords: object rows follow the
+            # [hdr_w, obj_w, ...extra] header
+            hdr_w = int(lab[0]) if lab.size >= 2 else 2
+            obj_w = int(lab[1]) if lab.size >= 2 else 5
+            body = lab[hdr_w:]
+            n_obj = body.size // obj_w if obj_w else 0
+            for i in range(n_obj):
+                base = hdr_w + i * obj_w
+                xmin, xmax = lab[base + 1], lab[base + 3]
+                lab[base + 1], lab[base + 3] = 1.0 - xmax, 1.0 - xmin
+        img = (img - self.mean) / self.std
+        img = np.transpose(img, (2, 0, 1))
+        if lab.size < self.label_pad_width:
+            lab = np.concatenate([
+                lab, np.full(self.label_pad_width - lab.size,
+                             self.label_pad_value, np.float32)])
+        elif lab.size > self.label_pad_width:
+            raise MXNetError(
+                "record label width %d exceeds label_pad_width %d"
+                % (lab.size, self.label_pad_width))
+        return img, lab

@@ -77,3 +77,17 @@ def new_key():
         _state.key = jax.random.key(_DEFAULT_SEED)
     _state.key, sub = jax.random.split(_state.key)
     return sub
+
+
+def __getattr__(name):  # PEP 562
+    """Functional sampling API (ref: python/mxnet/random.py re-exports
+    the ndarray.random samplers as mx.random.uniform/normal/...)."""
+    _samplers = ("uniform", "normal", "randn", "randint", "gamma",
+                 "exponential", "poisson", "negative_binomial",
+                 "multinomial", "shuffle", "bernoulli")
+    if name in _samplers:
+        from .ndarray import random as _ndr
+
+        return getattr(_ndr, name)
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

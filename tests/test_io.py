@@ -155,6 +155,67 @@ def test_image_record_iter(tmp_path):
     assert len(list(it)) == 3
 
 
+def test_image_det_record_iter(tmp_path):
+    from mxnet_tpu.io import ImageDetRecordIter
+
+    frec = str(tmp_path / "det.rec")
+    fidx = str(tmp_path / "det.idx")
+    writer = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    rng = np.random.RandomState(0)
+    widths = []
+    for i in range(8):
+        img = rng.randint(0, 255, (20, 18, 3)).astype(np.uint8)
+        n_obj = 1 + i % 3
+        label = [2.0, 5.0]  # header_width, object_width
+        for j in range(n_obj):
+            label += [float(j % 4), 0.1 + 0.05 * j, 0.2, 0.6, 0.8]
+        widths.append(len(label))
+        writer.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, np.array(label, dtype=np.float32), i, 0),
+            img, img_fmt=".png"))
+    writer.close()
+
+    it = ImageDetRecordIter(path_imgrec=frec, path_imgidx=fidx,
+                            data_shape=(3, 16, 16), batch_size=4,
+                            preprocess_threads=2)
+    assert it.label_pad_width == max(widths)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (4, 3, 16, 16)
+    assert batches[0].label[0].shape == (4, max(widths))
+    lab = batches[0].label[0].asnumpy()
+    np.testing.assert_allclose(lab[:, 0], 2.0)  # header width preserved
+    np.testing.assert_allclose(lab[:, 1], 5.0)
+    # single-object rows are padded with -1 past their boxes
+    one_obj = lab[lab[:, 7] == -1.0]
+    if len(one_obj):
+        assert (one_obj[:, 7:] == -1.0).all()
+
+    # mirror flips normalized x coords, boxes stay ordered/in-range
+    it_m = ImageDetRecordIter(path_imgrec=frec, path_imgidx=fidx,
+                              data_shape=(3, 16, 16), batch_size=8,
+                              rand_mirror=True, seed=3,
+                              preprocess_threads=1)
+    b = next(iter(it_m))
+    la = b.label[0].asnumpy()
+    xmin, xmax = la[:, 3], la[:, 5]
+    valid = la[:, 2] >= 0
+    assert (xmin[valid] < xmax[valid]).all()
+    assert (xmin[valid] >= 0).all() and (xmax[valid] <= 1.0).all()
+
+    # rand_crop would shift boxes -> rejected loudly
+    with pytest.raises(Exception, match="rand_crop"):
+        ImageDetRecordIter(path_imgrec=frec, path_imgidx=fidx,
+                           data_shape=(3, 16, 16), batch_size=4,
+                           rand_crop=True)
+    # too-narrow pad width surfaces the real error, not a thread crash
+    it_bad = ImageDetRecordIter(path_imgrec=frec, path_imgidx=fidx,
+                                data_shape=(3, 16, 16), batch_size=4,
+                                label_pad_width=3)
+    with pytest.raises(Exception, match="label_pad_width"):
+        next(iter(it_bad))
+
+
 def test_image_record_iter_sharded(tmp_path):
     frec, fidx = _make_rec(tmp_path)
     it0 = ImageRecordIter(path_imgrec=frec, path_imgidx=fidx,
