@@ -1,0 +1,260 @@
+"""Reference binary NDArray serialization (ref: src/ndarray/ndarray.cc —
+NDArray::Save/Load; c_api.cc — MXNDArraySave/MXNDArrayLoad).
+
+This is the byte format every MXNet 1.x ``.params`` / ``nd.save`` file uses,
+re-implemented in pure Python (struct + numpy) so checkpoints cross the
+reference boundary in both directions:
+
+  file  := uint64 0x112 (kMXAPINDArrayListMagic)
+           uint64 0     (reserved)
+           uint64 N
+           N * ndarray_record
+           uint64 M                       (number of names; 0 for list saves)
+           M * (uint64 len, len bytes)    (dmlc::Stream string serialization)
+
+  ndarray_record (V2/V3, what 1.x writes) :=
+           uint32 magic (0xF993FAC9 V2 | 0xF993FACA V3-np-shape)
+           int32  stype (0 dense, 1 row_sparse, 2 csr)
+           [stype!=dense] storage_shape           (shape of the value blob)
+           shape                                  (uint32 ndim, int64 * ndim)
+           int32 dev_type, int32 dev_id           (Context::Save; cpu=1)
+           int32 type_flag                        (mshadow dtype enum)
+           [stype!=dense] nad * (int32 aux_type, aux_shape)
+           raw value bytes (little-endian, C order; size from shape)
+           [stype!=dense] nad * raw aux bytes
+
+Aux-array order matches the reference enums: row_sparse → (indices,);
+csr → (indptr, indices)  (ref: include/mxnet/ndarray.h — rowsparse::kIdx,
+csr::kIndPtr/kIdx).  Older records are also readable: V1 magic
+(0xF993FAC8, int64 shape, no stype field) and legacy (first uint32 is
+ndim, uint32 dims).
+
+bfloat16 has no slot in the 1.x enum table; we write it as type_flag 12
+(the value oneDNN-era builds used) and read 12 back as bfloat16 — a file
+containing bf16 therefore only round-trips through this implementation.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from ..base import MXNetError
+
+NDLIST_MAGIC = 0x112
+_V1 = 0xF993FAC8
+_V2 = 0xF993FAC9
+_V3 = 0xF993FACA
+
+# mshadow type_flag enum (ref: 3rdparty/mshadow/mshadow/base.h)
+_FLAG_TO_DTYPE = {
+    0: np.dtype("float32"),
+    1: np.dtype("float64"),
+    2: np.dtype("float16"),
+    3: np.dtype("uint8"),
+    4: np.dtype("int32"),
+    5: np.dtype("int8"),
+    6: np.dtype("int64"),
+    7: np.dtype("bool"),
+}
+_DTYPE_TO_FLAG = {v.name: k for k, v in _FLAG_TO_DTYPE.items()}
+_BF16_FLAG = 12  # kBfloat16 in oneDNN-era builds; our extension slot
+
+_STYPE_DENSE, _STYPE_ROW_SPARSE, _STYPE_CSR = 0, 1, 2
+
+
+def _np_of(x):
+    """Host numpy view of an NDArray-like (handles bf16 → uint16 bits)."""
+    # NB: not ascontiguousarray — it silently promotes 0-d to 1-d;
+    # tobytes() below C-orders regardless of memory layout.
+    return np.asarray(x.asnumpy() if hasattr(x, "asnumpy") else x)
+
+
+def _write_shape(out, shape):
+    out.append(struct.pack("<I", len(shape)))
+    if shape:
+        out.append(struct.pack("<%dq" % len(shape), *shape))
+
+
+def _dtype_flag(dt):
+    name = np.dtype(dt).name
+    if name == "bfloat16":
+        return _BF16_FLAG
+    if name not in _DTYPE_TO_FLAG:
+        raise MXNetError("cannot serialize dtype %s to the reference "
+                         "binary format" % name)
+    return _DTYPE_TO_FLAG[name]
+
+
+def _blob_bytes(arr):
+    """Raw little-endian bytes of a numpy (or bf16 jax-backed) array."""
+    if arr.dtype.name == "bfloat16":  # ml_dtypes bfloat16: 2-byte items
+        arr = arr.view(np.uint16)
+    if arr.dtype.byteorder == ">":
+        arr = arr.astype(arr.dtype.newbyteorder("<"))
+    return arr.tobytes(order="C")
+
+
+def _save_dense(out, arr):
+    np_a = _np_of(arr)
+    # V2 for ndim>=1 (what 1.x writes); V3 (np-shape semantics) for true
+    # scalars, where ndim 0 means "scalar", not "uninitialized".
+    out.append(struct.pack("<I", _V2 if np_a.ndim else _V3))
+    out.append(struct.pack("<i", _STYPE_DENSE))
+    _write_shape(out, np_a.shape)
+    out.append(struct.pack("<ii", 1, 0))  # Context: cpu(1), dev_id 0
+    out.append(struct.pack("<i", _dtype_flag(np_a.dtype)))
+    out.append(_blob_bytes(np_a))
+
+
+def _save_sparse(out, arr):
+    from ..sparse import RowSparseNDArray
+    values = np.ascontiguousarray(np.asarray(arr.data.asnumpy()))
+    if isinstance(arr, RowSparseNDArray):
+        stype, aux = _STYPE_ROW_SPARSE, [np.asarray(arr.indices.asnumpy())]
+    else:  # CSR: aux order is (indptr, indices) — ref csr::kIndPtr, kIdx
+        stype = _STYPE_CSR
+        aux = [np.asarray(arr.indptr.asnumpy()),
+               np.asarray(arr.indices.asnumpy())]
+    out.append(struct.pack("<I", _V2))
+    out.append(struct.pack("<i", stype))
+    _write_shape(out, values.shape)          # storage_shape
+    _write_shape(out, arr.shape)             # dense shape
+    out.append(struct.pack("<ii", 1, 0))
+    out.append(struct.pack("<i", _dtype_flag(values.dtype)))
+    for a in aux:
+        out.append(struct.pack("<i", _dtype_flag(a.dtype)))
+        _write_shape(out, a.shape)
+    out.append(_blob_bytes(values))
+    for a in aux:
+        out.append(_blob_bytes(np.ascontiguousarray(a)))
+
+
+def dumps(arrays, names):
+    """Serialize a list of (sparse) NDArrays + parallel name list (possibly
+    empty) to reference-format bytes."""
+    from ..sparse import BaseSparseNDArray
+    out = [struct.pack("<QQQ", NDLIST_MAGIC, 0, len(arrays))]
+    for a in arrays:
+        if isinstance(a, BaseSparseNDArray):
+            _save_sparse(out, a)
+        else:
+            _save_dense(out, a)
+    out.append(struct.pack("<Q", len(names)))
+    for n in names:
+        b = n.encode("utf-8")
+        out.append(struct.pack("<Q", len(b)))
+        out.append(b)
+    return b"".join(out)
+
+
+class _Reader:
+    def __init__(self, buf):
+        self.buf, self.pos = buf, 0
+
+    def read(self, n):
+        b = self.buf[self.pos:self.pos + n]
+        if len(b) != n:
+            raise MXNetError("truncated NDArray file (wanted %d bytes at "
+                             "offset %d)" % (n, self.pos))
+        self.pos += n
+        return b
+
+    def u32(self):
+        return struct.unpack("<I", self.read(4))[0]
+
+    def i32(self):
+        return struct.unpack("<i", self.read(4))[0]
+
+    def u64(self):
+        return struct.unpack("<Q", self.read(8))[0]
+
+    def shape64(self):
+        ndim = self.u32()
+        if ndim == 0xFFFFFFFF:  # np-shape "unknown" → none
+            return None
+        return struct.unpack("<%dq" % ndim, self.read(8 * ndim)) \
+            if ndim else ()
+
+    def shape32(self):
+        ndim = self.u32()
+        return struct.unpack("<%dI" % ndim, self.read(4 * ndim)) \
+            if ndim else ()
+
+
+def _read_blob(r, shape, flag):
+    if flag == _BF16_FLAG:
+        import ml_dtypes
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        raw = np.frombuffer(r.read(2 * n), dtype=np.uint16)
+        return raw.view(ml_dtypes.bfloat16).reshape(shape)
+    if flag not in _FLAG_TO_DTYPE:
+        raise MXNetError("unknown type_flag %d in NDArray file" % flag)
+    dt = _FLAG_TO_DTYPE[flag]
+    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    return np.frombuffer(r.read(dt.itemsize * n),
+                         dtype=dt.newbyteorder("<")).astype(
+                             dt, copy=False).reshape(shape)
+
+
+def _load_one(r):
+    """One ndarray_record → NDArray / RowSparseNDArray / CSRNDArray."""
+    from ..ndarray.ndarray import NDArray
+    from ..sparse import RowSparseNDArray, CSRNDArray
+    magic = r.u32()
+    if magic in (_V2, _V3):
+        stype = r.i32()
+        storage_shape = None
+        if stype != _STYPE_DENSE:
+            storage_shape = r.shape64()
+        shape = r.shape64()
+        if shape is None or (magic == _V2 and shape == ()
+                             and stype == _STYPE_DENSE):
+            return NDArray(np.zeros((0,), np.float32))  # uninitialized slot
+        r.i32(); r.i32()  # Context dev_type/dev_id — device is ours to pick
+        flag = r.i32()
+        if stype == _STYPE_DENSE:
+            return NDArray(_read_blob(r, shape, flag))
+        nad = 1 if stype == _STYPE_ROW_SPARSE else 2
+        aux_meta = [(r.i32(), r.shape64()) for _ in range(nad)]
+        values = _read_blob(r, storage_shape, flag)
+        aux = [_read_blob(r, s, f) for f, s in aux_meta]
+        if stype == _STYPE_ROW_SPARSE:
+            return RowSparseNDArray(values, aux[0], shape)
+        return CSRNDArray(values, aux[1], aux[0], shape)
+    if magic == _V1:
+        shape = r.shape64()
+        if not shape:  # uninitialized slot: no context/dtype/blob follow
+            return NDArray(np.zeros((0,), np.float32))
+    else:  # legacy: `magic` itself was ndim, dims are uint32
+        ndim = magic
+        shape = struct.unpack("<%dI" % ndim, r.read(4 * ndim)) \
+            if ndim else ()
+        if not shape:
+            return NDArray(np.zeros((0,), np.float32))
+    r.i32(); r.i32()
+    flag = r.i32()
+    return NDArray(_read_blob(r, shape, flag))
+
+
+def loads(buf):
+    """Parse reference-format bytes → (list_of_arrays, list_of_names)."""
+    r = _Reader(buf)
+    if r.u64() != NDLIST_MAGIC:
+        raise MXNetError("not a reference NDArray file (bad magic)")
+    r.u64()  # reserved
+    arrays = [_load_one(r) for _ in range(r.u64())]
+    names = []
+    if r.pos < len(buf):
+        for _ in range(r.u64()):
+            names.append(r.read(r.u64()).decode("utf-8"))
+    if names and len(names) != len(arrays):
+        raise MXNetError("name count %d != array count %d"
+                         % (len(names), len(arrays)))
+    return arrays, names
+
+
+def is_mx_binary(head8):
+    """True if the first 8 bytes are the reference list magic."""
+    return len(head8) >= 8 and \
+        struct.unpack("<Q", head8[:8])[0] == NDLIST_MAGIC

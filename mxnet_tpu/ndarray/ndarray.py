@@ -560,30 +560,39 @@ def waitall():
 
 # --------------------------------------------------------------------------
 # save / load (ref: src/ndarray/ndarray.cc — NDArray::Save/Load; C API
-# MXNDArraySave). Same dict-or-list API; the byte format is our own
-# (npz-based) since the reference tree was unreadable for byte-level parity.
+# MXNDArraySave/MXNDArrayLoad). Writes the reference's magic-tagged binary
+# list format (mx_binary.py) so ``.params`` files cross the boundary in
+# both directions; ``load`` additionally still reads the npz files earlier
+# rounds of this framework wrote (format detected from the first bytes).
 # --------------------------------------------------------------------------
-_SAVE_LIST_KEY = "__mxt_list_%d"
-
-
 def save(fname, data):
+    from . import mx_binary
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, (list, tuple)):
-        payload = {_SAVE_LIST_KEY % i: a.asnumpy() for i, a in enumerate(data)}
+        arrays, names = list(data), []
     elif isinstance(data, dict):
-        payload = {k: v.asnumpy() for k, v in data.items()}
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
     else:
         raise TypeError("save expects NDArray, list, or dict")
-    np.savez(_ensure_npz(fname), **payload)
-
-
-def _ensure_npz(fname):
-    # np.savez appends .npz if missing; write exactly to fname via file object
-    return open(fname, "wb")
+    for a in arrays:
+        if not isinstance(a, NDArray):
+            raise TypeError("save expects NDArray values, got %r" % (a,))
+    with open(fname, "wb") as f:
+        f.write(mx_binary.dumps(arrays, names))
 
 
 def load(fname):
+    from . import mx_binary
+    with open(fname, "rb") as f:
+        head = f.read(8)
+        if mx_binary.is_mx_binary(head):
+            arrays, names = mx_binary.loads(head + f.read())
+            if names:
+                return dict(zip(names, arrays))
+            return arrays
+    # npz fallback (this framework's pre-r5 byte format)
     with np.load(fname, allow_pickle=False) as zf:
         keys = list(zf.keys())
         if keys and all(k.startswith("__mxt_list_") for k in keys):

@@ -1,0 +1,251 @@
+"""Reference binary checkpoint format (ref: src/ndarray/ndarray.cc —
+NDArray::Save/Load; c_api.cc — MXNDArraySave).  Round-trips, a
+hand-synthesized golden-bytes fixture in the exact reference layout, and
+the Module/Gluon checkpoint surfaces on top of it."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import sparse
+from mxnet_tpu.ndarray import mx_binary
+
+
+# ---------------------------------------------------------------- helpers
+def synth_dense_record(arr, magic=0xF993FAC9):
+    """Reference V2 dense record, built independently of mx_binary's
+    writer (golden bytes — byte-layout oracle)."""
+    out = [struct.pack("<I", magic), struct.pack("<i", 0)]
+    out.append(struct.pack("<I", arr.ndim))
+    out.append(struct.pack("<%dq" % arr.ndim, *arr.shape))
+    out.append(struct.pack("<ii", 1, 0))
+    flag = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+            "int32": 4, "int8": 5, "int64": 6}[arr.dtype.name]
+    out.append(struct.pack("<i", flag))
+    out.append(np.ascontiguousarray(arr).tobytes())
+    return b"".join(out)
+
+
+def synth_file(records, names):
+    out = [struct.pack("<QQQ", 0x112, 0, len(records))]
+    out.extend(records)
+    out.append(struct.pack("<Q", len(names)))
+    for n in names:
+        b = n.encode()
+        out.append(struct.pack("<Q", len(b)) + b)
+    return b"".join(out)
+
+
+# ---------------------------------------------------------------- golden
+def test_golden_reference_file_loads(tmp_path):
+    """A file in the reference byte layout (synthesized by an independent
+    writer above) parses through mx.nd.load."""
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b = np.array([1.5, -2.0], dtype=np.float32)
+    path = tmp_path / "golden.params"
+    path.write_bytes(synth_file(
+        [synth_dense_record(w), synth_dense_record(b)],
+        ["arg:fc_weight", "arg:fc_bias"]))
+    loaded = nd.load(str(path))
+    assert set(loaded) == {"arg:fc_weight", "arg:fc_bias"}
+    np.testing.assert_array_equal(loaded["arg:fc_weight"].asnumpy(), w)
+    np.testing.assert_array_equal(loaded["arg:fc_bias"].asnumpy(), b)
+
+
+def test_golden_bytes_writer_matches_layout(tmp_path):
+    """Our writer's bytes == the independent synthesizer's bytes."""
+    w = np.arange(6, dtype=np.float32).reshape(2, 3)
+    ours = mx_binary.dumps([nd.array(w)], ["arg:w"])
+    theirs = synth_file([synth_dense_record(w)], ["arg:w"])
+    assert ours == theirs
+
+
+def test_golden_list_no_names(tmp_path):
+    a = np.array([7], dtype=np.int64)
+    path = tmp_path / "list.nd"
+    path.write_bytes(synth_file([synth_dense_record(a)], []))
+    loaded = nd.load(str(path))
+    assert isinstance(loaded, list) and len(loaded) == 1
+    np.testing.assert_array_equal(loaded[0].asnumpy(), a)
+
+
+def test_v1_and_legacy_records_load(tmp_path):
+    """Pre-V2 records: V1 (int64 shape, no stype) and legacy (uint32
+    ndim-first)."""
+    a = np.arange(4, dtype=np.float32)
+    v1 = (struct.pack("<I", 0xF993FAC8) + struct.pack("<I", 1) +
+          struct.pack("<q", 4) + struct.pack("<ii", 1, 0) +
+          struct.pack("<i", 0) + a.tobytes())
+    legacy = (struct.pack("<I", 1) + struct.pack("<I", 4) +
+              struct.pack("<ii", 1, 0) + struct.pack("<i", 0) +
+              a.tobytes())
+    path = tmp_path / "old.nd"
+    path.write_bytes(synth_file([v1, legacy], []))
+    loaded = nd.load(str(path))
+    for item in loaded:
+        np.testing.assert_array_equal(item.asnumpy(), a)
+
+
+# ------------------------------------------------------------ round-trips
+@pytest.mark.parametrize("dtype", ["float32", "float64", "float16",
+                                   "uint8", "int32", "int8", "int64"])
+def test_roundtrip_dtypes(tmp_path, dtype):
+    a = (np.random.RandomState(0).uniform(0, 50, (3, 5))).astype(dtype)
+    p = str(tmp_path / "a.nd")
+    nd.save(p, {"x": nd.array(a)})
+    back = nd.load(p)["x"]
+    assert back.asnumpy().dtype == np.dtype(dtype)
+    np.testing.assert_array_equal(back.asnumpy(), a)
+
+
+def test_roundtrip_bf16(tmp_path):
+    x = nd.array(np.linspace(-3, 3, 16).reshape(4, 4)).astype("bfloat16")
+    p = str(tmp_path / "bf16.nd")
+    nd.save(p, [x])
+    back = nd.load(p)[0]
+    assert "bfloat16" in str(back.asnumpy().dtype)
+    np.testing.assert_array_equal(
+        back.asnumpy().astype(np.float32), x.asnumpy().astype(np.float32))
+
+
+def test_roundtrip_scalar_and_empty_name_unicode(tmp_path):
+    p = str(tmp_path / "s.nd")
+    nd.save(p, {"héllo/λ": nd.array(np.float32(3.25).reshape(()))})
+    back = nd.load(p)
+    assert list(back) == ["héllo/λ"]
+    assert back["héllo/λ"].asnumpy().shape == ()
+
+
+def test_roundtrip_row_sparse(tmp_path):
+    vals = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    idx = np.array([0, 2, 5], dtype=np.int64)
+    rs = sparse.row_sparse_array((vals, idx), shape=(8, 4))
+    p = str(tmp_path / "rs.nd")
+    nd.save(p, {"emb": rs})
+    back = nd.load(p)["emb"]
+    assert isinstance(back, sparse.RowSparseNDArray)
+    assert back.shape == (8, 4)
+    np.testing.assert_array_equal(back.data.asnumpy(), vals)
+    np.testing.assert_array_equal(back.indices.asnumpy(), idx)
+
+
+def test_roundtrip_csr(tmp_path):
+    data = np.array([1., 2., 3.], dtype=np.float32)
+    indices = np.array([1, 0, 2], dtype=np.int64)
+    indptr = np.array([0, 1, 1, 3], dtype=np.int64)
+    cs = sparse.csr_matrix((data, indices, indptr), shape=(3, 4))
+    p = str(tmp_path / "csr.nd")
+    nd.save(p, [cs])
+    back = nd.load(p)[0]
+    assert isinstance(back, sparse.CSRNDArray)
+    np.testing.assert_array_equal(back.todense().asnumpy(),
+                                  cs.todense().asnumpy())
+
+
+def test_npz_fallback_still_loads(tmp_path):
+    """Files written by pre-r5 rounds (npz) keep loading."""
+    p = str(tmp_path / "old.npz")
+    np.savez(open(p, "wb"), **{"w": np.ones((2, 2), np.float32)})
+    back = nd.load(p)
+    np.testing.assert_array_equal(back["w"].asnumpy(), np.ones((2, 2)))
+
+
+def test_truncated_file_raises(tmp_path):
+    w = np.ones((4, 4), np.float32)
+    full = mx_binary.dumps([nd.array(w)], ["w"])
+    p = tmp_path / "trunc.nd"
+    p.write_bytes(full[:len(full) // 2])
+    with pytest.raises(mx.base.MXNetError):
+        nd.load(str(p))
+
+
+# ---------------------------------------------------- checkpoint surfaces
+def test_module_checkpoint_via_binary_format(tmp_path):
+    """Module.save_checkpoint emits reference-layout files; a synthesized
+    reference .params + -symbol.json pair loads through
+    Module.load_checkpoint."""
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.module import Module
+
+    x = sym.Variable("data")
+    net = sym.FullyConnected(x, num_hidden=3, name="fc1")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = Module(net, data_names=["data"], label_names=["softmax_label"])
+    mod.bind(data_shapes=[("data", (2, 5))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 3)
+
+    params_file = prefix + "-0003.params"
+    head = open(params_file, "rb").read(8)
+    assert mx_binary.is_mx_binary(head), \
+        "checkpoint is not in the reference binary format"
+
+    # synthesize the same .params independently and load it back
+    arg, aux = mod.get_params()
+    records, names = [], []
+    for k, v in arg.items():
+        records.append(synth_dense_record(
+            v.asnumpy().astype(np.float32)))
+        names.append("arg:" + k)
+    synth = tmp_path / "synth-0001.params"
+    synth.write_bytes(synth_file(records, names))
+    import shutil
+    shutil.copy(prefix + "-symbol.json", str(tmp_path / "synth-symbol.json"))
+    sym2, arg2, aux2 = mx.model.load_checkpoint(str(tmp_path / "synth"), 1)
+    assert set(arg2) == set(arg)
+    for k in arg:
+        np.testing.assert_allclose(arg2[k].asnumpy(), arg[k].asnumpy(),
+                                   rtol=1e-6)
+
+
+def test_symbolblock_loads_reference_params(tmp_path):
+    """SymbolBlock.imports over a reference-format pair (gluon surface)."""
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.gluon import SymbolBlock
+
+    x = sym.Variable("data")
+    net = sym.FullyConnected(x, num_hidden=4, name="fc0")
+    net.save(str(tmp_path / "m-symbol.json"))
+    w = np.random.RandomState(2).randn(4, 6).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    (tmp_path / "m-0000.params").write_bytes(synth_file(
+        [synth_dense_record(w), synth_dense_record(b)],
+        ["arg:fc0_weight", "arg:fc0_bias"]))
+    blk = SymbolBlock.imports(str(tmp_path / "m-symbol.json"), ["data"],
+                              str(tmp_path / "m-0000.params"))
+    out = blk(mx.nd.array(np.ones((2, 6), np.float32)))
+    np.testing.assert_allclose(out.asnumpy(), np.ones((2, 6)) @ w.T + b,
+                               rtol=1e-5)
+
+
+def test_gluon_save_load_parameters_binary(tmp_path):
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    p = str(tmp_path / "dense.params")
+    net.save_parameters(p)
+    assert mx_binary.is_mx_binary(open(p, "rb").read(8))
+    net2 = nn.Dense(3, in_units=4)
+    net2.load_parameters(p)
+    np.testing.assert_array_equal(net.weight.data().asnumpy(),
+                                  net2.weight.data().asnumpy())
+
+
+def test_v1_uninitialized_slot_then_valid_record(tmp_path):
+    """A V1 ndim-0 (uninitialized) record carries no context/dtype/blob;
+    the parser must not consume the following record's bytes."""
+    a = np.arange(4, dtype=np.float32)
+    v1_none = struct.pack("<I", 0xF993FAC8) + struct.pack("<I", 0)
+    v1_ok = (struct.pack("<I", 0xF993FAC8) + struct.pack("<I", 1) +
+             struct.pack("<q", 4) + struct.pack("<ii", 1, 0) +
+             struct.pack("<i", 0) + a.tobytes())
+    path = tmp_path / "v1none.nd"
+    path.write_bytes(synth_file([v1_none, v1_ok], []))
+    loaded = nd.load(str(path))
+    assert loaded[0].shape == (0,)
+    np.testing.assert_array_equal(loaded[1].asnumpy(), a)
