@@ -19,9 +19,36 @@ thread, which handles one message at a time — "lock-free" in the
 reference describes the absence of worker-side barriers, not racy
 read-modify-write on the server. A push is fully applied before its
 ack, so each worker's own pushes are totally ordered.
+
+Trust boundary / threat model
+-----------------------------
+Frames are pickled Python objects: deserializing one executes arbitrary
+code chosen by the sender, so the wire protocol authenticates WHO may
+speak, not what they say (same posture as ps-lite's ``Van``, which had a
+membership protocol but no payload sandbox — any admitted node is fully
+trusted). Enforcement:
+
+- Without a shared secret the server refuses to bind anything but
+  loopback — single-host rigs work out of the box, and nothing pickled
+  ever arrives off-box.
+- For multi-host (``MXT_COORDINATOR`` set), set ``MXT_KVSTORE_SECRET``
+  on every node (the launcher forwards it): each frame then carries an
+  HMAC-SHA256 over (connection nonce ‖ direction ‖ sequence ‖ payload),
+  verified BEFORE unpickling. The per-connection server nonce plus a
+  per-direction sequence counter defeats cross-connection replay and
+  reflection; a missing or wrong MAC drops the connection. The secret
+  gates membership — anyone holding it has remote-execution-equivalent
+  trust, exactly like a reference cluster's network perimeter.
+- Every accepted connection starts with a server banner announcing
+  whether auth is required, so a secret-presence mismatch between peers
+  is a clean error, not a protocol desync.
+- TLS/on-wire privacy is out of scope (the reference has none either);
+  run on a trusted network segment.
 """
 from __future__ import annotations
 
+import hmac
+import hashlib
 import os
 import pickle
 import socket
@@ -57,9 +84,72 @@ def server_address():
     return host, int(port) + ASYNC_PORT_OFFSET
 
 
-def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack("!Q", len(payload)) + payload)
+_MAC_LEN = hashlib.sha256().digest_size
+_BANNER_MAGIC = b"MXKV"
+_NONCE_LEN = 16
+
+
+def _shared_secret():
+    """Frame-auth key from the environment (launcher forwards it to every
+    node). None → unauthenticated frames, loopback-only enforcement."""
+    s = os.environ.get("MXT_KVSTORE_SECRET")
+    return s.encode("utf-8") if s else None
+
+
+def _is_loopback(host):
+    # NB: "" binds INADDR_ANY — it is NOT loopback
+    return host in ("127.0.0.1", "::1", "localhost")
+
+
+class _Channel:
+    """One authenticated (or plain) connection endpoint.
+
+    The server opens each accepted connection with a banner
+    ``MXKV | flags | nonce?`` (flags bit0: auth required) so both sides
+    agree on framing before any frame flows. With auth, each direction
+    MACs ``nonce ‖ dir ‖ seq ‖ payload`` with its own monotone sequence
+    counter — a frame cannot be replayed on another connection (different
+    nonce), re-ordered/re-sent within one (seq), or reflected back (dir).
+    """
+
+    def __init__(self, sock, secret, nonce, direction):
+        self._sock = sock
+        self._secret = secret
+        self._nonce = nonce
+        self._send_dir = direction
+        self._recv_dir = b"S" if direction == b"C" else b"C"
+        self._send_seq = 0
+        self._recv_seq = 0
+
+    def _mac(self, direction, seq, payload):
+        msg = self._nonce + direction + struct.pack("!Q", seq) + payload
+        return hmac.new(self._secret, msg, hashlib.sha256).digest()
+
+    def send(self, obj):
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        if self._secret is not None:
+            mac = self._mac(self._send_dir, self._send_seq, payload)
+            self._send_seq += 1
+            self._sock.sendall(struct.pack("!Q", len(payload)) + mac +
+                               payload)
+        else:
+            self._sock.sendall(struct.pack("!Q", len(payload)) + payload)
+
+    def recv(self):
+        (n,) = struct.unpack("!Q", _recv_exact(self._sock, 8))
+        if self._secret is not None:
+            mac = _recv_exact(self._sock, _MAC_LEN)
+            payload = _recv_exact(self._sock, n)
+            want = self._mac(self._recv_dir, self._recv_seq, payload)
+            if not hmac.compare_digest(mac, want):
+                # authenticate BEFORE deserializing — a tampered, replayed
+                # or mis-keyed frame must never reach pickle.loads
+                raise MXNetError(
+                    "async kvstore frame failed HMAC verification "
+                    "(tampered/replayed, or MXT_KVSTORE_SECRET mismatch)")
+            self._recv_seq += 1
+            return pickle.loads(payload)
+        return pickle.loads(_recv_exact(self._sock, n))
 
 
 def _recv_exact(sock, n):
@@ -72,15 +162,17 @@ def _recv_exact(sock, n):
     return buf
 
 
-def _recv_msg(sock):
-    (n,) = struct.unpack("!Q", _recv_exact(sock, 8))
-    return pickle.loads(_recv_exact(sock, n))
-
-
 class AsyncParamServer:
     """Threaded TCP server holding weights + the server-side optimizer."""
 
     def __init__(self, host, port):
+        if not _is_loopback(host) and _shared_secret() is None:
+            raise MXNetError(
+                "refusing to bind the async kvstore server to %r without "
+                "frame authentication: frames are pickles (deserializing "
+                "one is code execution). Set MXT_KVSTORE_SECRET on every "
+                "node for multi-host, or bind loopback." % host)
+        self._secret = _shared_secret()  # auth mode fixed at bind time
         self._store = {}     # key -> np.ndarray (the weight)
         self._updater = None
         self._mutate = threading.Lock()  # ps-lite customer-thread analog
@@ -108,9 +200,31 @@ class AsyncParamServer:
         import numpy as np
         import jax.numpy as jnp
 
+        # banner: announce auth mode (+ per-connection nonce when on) so
+        # a secret-presence mismatch fails loudly instead of desyncing
+        secret = self._secret
+        flags = 1 if secret is not None else 0
+        nonce = os.urandom(_NONCE_LEN) if secret is not None else b""
+        try:
+            conn.sendall(_BANNER_MAGIC + bytes([flags]) + nonce)
+        except OSError:
+            conn.close()
+            return
+        ch = _Channel(conn, secret, nonce, b"S")
+
+        def _recv_frame():
+            return ch.recv()
+
+        _send_msg = ch.send
         try:
             while True:
-                op, key, payload = _recv_msg(conn)
+                try:
+                    op, key, payload = _recv_frame()
+                except MXNetError:
+                    # auth failure: drop without answering (an
+                    # unauthenticated peer learns nothing); errors AFTER
+                    # auth go back as ("err", ...) frames below
+                    return
                 if isinstance(key, str) and key.isdigit():
                     # the eager updater keys optimizer state and lr/wd
                     # multipliers by int for digit keys (kvstore.py push)
@@ -119,19 +233,19 @@ class AsyncParamServer:
                     with self._mutate:
                         self._store.clear()
                         self._updater = None
-                    _send_msg(conn, ("ok", None))
+                    _send_msg(("ok", None))
                 elif op == "init":
                     with self._mutate:
                         # first writer wins (every worker sends its init)
                         self._store.setdefault(key, np.array(payload))
-                    _send_msg(conn, ("ok", None))
+                    _send_msg(("ok", None))
                 elif op == "push":
                     with self._mutate:
                         w = self._store.get(key)
                         if w is None:
                             # first push initializes, like KVStoreLocal
                             self._store[key] = np.array(payload)
-                            _send_msg(conn, ("ok", None))
+                            _send_msg(("ok", None))
                             continue
                         if self._updater is not None:
                             w_nd = NDArray(jnp.asarray(w))
@@ -143,38 +257,46 @@ class AsyncParamServer:
                             # replace semantics, matching the local
                             # no-updater path (CopyFromTo(merged, &local))
                             self._store[key] = np.array(payload)
-                    _send_msg(conn, ("ok", None))
+                    _send_msg(("ok", None))
                 elif op == "pull":
                     w = self._store.get(key)
                     if w is None:
-                        _send_msg(conn, ("err",
+                        _send_msg(("err",
                                          "key %r not initialized" % key))
                     else:
-                        _send_msg(conn, ("ok", np.array(w)))
+                        _send_msg(("ok", np.array(w)))
                 elif op == "set_optimizer":
                     from . import optimizer as opt
 
                     with self._mutate:
                         self._updater = opt.get_updater(
                             pickle.loads(payload))
-                    _send_msg(conn, ("ok", None))
+                    _send_msg(("ok", None))
                 elif op == "get_states":
                     with self._mutate:
                         blob = (self._updater.get_states(payload)
                                 if self._updater is not None else None)
-                    _send_msg(conn, ("ok", blob))
+                    _send_msg(("ok", blob))
                 elif op == "set_states":
                     with self._mutate:
                         if self._updater is None:
-                            _send_msg(conn, ("err",
+                            _send_msg(("err",
                                              "no server-side optimizer"))
                             continue
                         self._updater.set_states(payload)
-                    _send_msg(conn, ("ok", None))
+                    _send_msg(("ok", None))
                 else:
-                    _send_msg(conn, ("err", "unknown op %r" % op))
+                    _send_msg(("err", "unknown op %r" % op))
         except (ConnectionError, EOFError):
             pass
+        except MXNetError as e:
+            # post-auth handler failure (bad optimizer config, shape
+            # mismatch in an update): report it to the worker instead of
+            # a bare EOF. (Auth failures return early above, unanswered.)
+            try:
+                _send_msg(("err", "server error: %s" % e))
+            except OSError:
+                pass
         finally:
             conn.close()
 
@@ -210,12 +332,31 @@ class AsyncClient:
             raise MXNetError(
                 "cannot reach async kvstore server at %s:%d (%r)"
                 % (host, port, last))
+        # server banner: agree on the auth mode before any frame flows
+        head = _recv_exact(self._sock, len(_BANNER_MAGIC) + 1)
+        if head[:len(_BANNER_MAGIC)] != _BANNER_MAGIC:
+            raise MXNetError(
+                "peer at %s:%d did not send an async kvstore banner "
+                "(not an async server, or a pre-r5 build)" % (host, port))
+        server_auth = bool(head[len(_BANNER_MAGIC)] & 1)
+        secret = _shared_secret()
+        if server_auth and secret is None:
+            raise MXNetError(
+                "async kvstore server requires frame authentication but "
+                "MXT_KVSTORE_SECRET is not set on this worker")
+        if not server_auth and secret is not None:
+            raise MXNetError(
+                "MXT_KVSTORE_SECRET is set on this worker but the server "
+                "does not authenticate frames — refusing the downgrade")
+        nonce = _recv_exact(self._sock, _NONCE_LEN) if server_auth else b""
+        self._ch = _Channel(self._sock, secret if server_auth else None,
+                            nonce, b"C")
         self._lock = threading.Lock()
 
     def request(self, op, key=None, payload=None):
         with self._lock:
-            _send_msg(self._sock, (op, key, payload))
-            status, result = _recv_msg(self._sock)
+            self._ch.send((op, key, payload))
+            status, result = self._ch.recv()
         if status != "ok":
             raise MXNetError("async kvstore server error: %s" % result)
         return result

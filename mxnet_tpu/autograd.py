@@ -109,15 +109,23 @@ class AGNode:
 
     parents[i] is (AGNode, out_index) for tracked inputs, else None.
     out_avals: (shape, dtype) per output, for synthesizing zero cotangents.
+    fwd_fn/in_vals: the pure forward and its primal inputs, kept so
+    ``grad(create_graph=True)`` can replay the subgraph functionally
+    (higher-order grads need d(residuals)/d(inputs), which a stored vjp
+    closure alone cannot provide).
     """
 
-    __slots__ = ("vjp_fn", "parents", "out_avals", "name", "_ct", "_seen_out")
+    __slots__ = ("vjp_fn", "parents", "out_avals", "name", "_ct",
+                 "_seen_out", "fwd_fn", "in_vals")
 
-    def __init__(self, vjp_fn, parents, out_avals, name=""):
+    def __init__(self, vjp_fn, parents, out_avals, name="",
+                 fwd_fn=None, in_vals=None):
         self.vjp_fn = vjp_fn
         self.parents = parents
         self.out_avals = out_avals
         self.name = name
+        self.fwd_fn = fwd_fn
+        self.in_vals = in_vals
         self._ct = None  # per-output cotangent accumulation during backward
         self._seen_out = None
 
@@ -248,6 +256,8 @@ def _run_backward(heads, head_grads, retain_graph=False, collect=None):
             parent[0].add_ct(parent[1], ct)
         if not retain_graph:
             node.vjp_fn = None
+            node.fwd_fn = None
+            node.in_vals = None
         node._ct = None
 
     if collect is not None:
@@ -283,13 +293,15 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
     """Return gradients of heads w.r.t. variables without touching ``.grad``
-    (ref: python/mxnet/autograd.py — grad). ``create_graph`` (higher-order)
-    is not supported yet — matches the reference's own '[partial]' support."""
+    (ref: python/mxnet/autograd.py — grad). With ``create_graph=True`` the
+    returned gradients are themselves recorded on the tape (differentiable
+    to arbitrary order — gradient penalties, MAML); see
+    ``_grad_create_graph`` for the replay design."""
     del train_mode
     from .ndarray.ndarray import NDArray
 
     if create_graph:
-        raise NotImplementedError("create_graph=True not supported yet")
+        return _grad_create_graph(heads, variables, head_grads)
     if isinstance(variables, NDArray):
         variables = [variables]
         single = True
@@ -311,6 +323,145 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         else:
             outs.append(NDArray(ct.astype(v.dtype)))
     return outs[0] if single else outs
+
+
+def _grad_create_graph(heads, variables, head_grads):
+    """Higher-order ``grad`` (ref: python/mxnet/autograd.py —
+    grad(create_graph=True); the reference's support was itself partial).
+
+    Design: the tape stores each node's pure forward (``fwd_fn``) and
+    primal inputs, so the subgraph from ``variables`` to ``heads`` can be
+    replayed as one pure function F(var_vals) -> head_vals. The returned
+    gradients are G(var_vals, seed_vals) = vjp(F)(seeds), dispatched
+    through ``apply_op`` like any other op — so they land on the tape as a
+    normal node whose vjp JAX derives, and differentiating them (to any
+    order) needs no further machinery. Ops that drew PRNG keys replay the
+    recorded keys (random.capture_keys), keeping stochastic forwards
+    (dropout) bit-identical under replay.
+
+    Values of non-variable inputs are taken from the recorded primals, so
+    later in-place mutation of other leaves does not skew the replay;
+    custom ``Function`` nodes carry no pure forward and raise.
+    """
+    from .ndarray.ndarray import NDArray
+    from .ops.registry import apply_op, Op
+
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+    if len(head_grads) != len(heads):
+        raise ValueError("head_grads length %d != heads length %d"
+                         % (len(head_grads), len(heads)))
+
+    for v in variables:
+        entry = getattr(v, "_ag_node", None)
+        if entry is None or not isinstance(entry[0], AGLeaf):
+            raise ValueError(
+                "variables passed to grad() must have attach_grad() called "
+                "before the recorded computation")
+
+    head_entries = []
+    for h in heads:
+        entry = getattr(h, "_ag_node", None)
+        if entry is None:
+            raise ValueError(
+                "cannot differentiate a head that was not computed inside "
+                "autograd.record()")
+        head_entries.append(entry)
+
+    order = _toposort([e[0] for e in head_entries])
+
+    # The returned gradients must be differentiable w.r.t. EVERY tracked
+    # leaf in the subgraph — not only `variables` (a WGAN-GP penalty
+    # differentiates d y/d x, then backprops THAT into the weights W), so
+    # all leaves become traced inputs of the replay.
+    leaf_nodes, leaf_pos = [], {}
+    for node in order:
+        if isinstance(node, AGLeaf) and id(node) not in leaf_pos:
+            leaf_pos[id(node)] = len(leaf_nodes)
+            leaf_nodes.append(node)
+    for v in variables:  # variables outside the head graph → zero grads
+        node = v._ag_node[0]
+        if id(node) not in leaf_pos:
+            leaf_pos[id(node)] = len(leaf_nodes)
+            leaf_nodes.append(node)
+    var_idx = [leaf_pos[id(v._ag_node[0])] for v in variables]
+
+    depends = {}
+    for node in order:  # parents-before-children
+        if isinstance(node, AGLeaf):
+            depends[id(node)] = True
+            continue
+        dep = any(p is not None and depends.get(id(p[0]), False)
+                  for p in node.parents)
+        depends[id(node)] = dep
+        if dep and node.fwd_fn is None:
+            raise NotImplementedError(
+                "create_graph=True needs node %r's pure forward to "
+                "replay, and none was recorded — either the op is a "
+                "custom autograd.Function (a user-defined backward has "
+                "no pure forward), or MXT_AG_LEAN_TAPE=1 disabled replay "
+                "state" % node.name)
+
+    replay_order = [n for n in order if depends[id(n)]
+                    and not isinstance(n, AGLeaf)]
+    dep_heads = [i for i, e in enumerate(head_entries)
+                 if depends[id(e[0])]]
+
+    def replay_heads(leaf_vals):
+        env = {}
+        for node in replay_order:
+            ins = []
+            for p, v in zip(node.parents, node.in_vals):
+                if p is not None and depends[id(p[0])]:
+                    src = p[0]
+                    if isinstance(src, AGLeaf):
+                        ins.append(leaf_vals[leaf_pos[id(src)]])
+                    else:
+                        ins.append(env[id(src)][p[1]])
+                else:
+                    ins.append(v)  # recorded primal (untracked constant)
+            out = node.fwd_fn(*ins)
+            env[id(node)] = list(out) if isinstance(out, tuple) else [out]
+        vals = []
+        for i in dep_heads:
+            node, idx = head_entries[i]
+            if isinstance(node, AGLeaf):  # head IS a leaf
+                vals.append(leaf_vals[leaf_pos[id(node)]])
+            else:
+                vals.append(env[id(node)][idx])
+        return tuple(vals)
+
+    n_l = len(leaf_nodes)
+
+    def grad_fn(*flat):
+        leaf_vals, seed_vals = flat[:n_l], flat[n_l:]
+        _, vjp = jax.vjp(lambda *lv: replay_heads(lv), *leaf_vals)
+        all_grads = vjp(tuple(seed_vals))
+        return tuple(all_grads[i] for i in var_idx)
+
+    seed_nds = []
+    for i in dep_heads:
+        hg, h = head_grads[i], heads[i]
+        if hg is None:
+            seed_nds.append(NDArray(jnp.ones(h.shape, h.dtype)))
+        else:
+            seed_nds.append(hg.astype(h.dtype) if hg.dtype != h.dtype
+                            else hg)
+
+    leaf_inputs = [n.array_ref for n in leaf_nodes]
+    op = Op("grad_of_%d_heads" % len(heads), grad_fn, differentiable=True)
+    with _RecordingStateScope(True, None):
+        outs = apply_op(op, *(leaf_inputs + seed_nds))
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    return outs[0] if single else list(outs)
 
 
 class Function:

@@ -72,6 +72,18 @@ _declare("MXT_RNN_UNROLL", int, None,
          "unrolling; unset = auto: full unroll up to T=128, else 16). "
          "Unrolling amortizes per-iteration loop overhead on the TPU.")
 
+_declare("MXT_KVSTORE_SECRET", str, None,
+         "Shared secret authenticating dist_async parameter-server "
+         "frames (HMAC-SHA256 over nonce|dir|seq|payload). Required for "
+         "any non-loopback server bind; see async_server.py threat "
+         "model.")
+
+_declare("MXT_AG_LEAN_TAPE", bool, False,
+         "Skip storing per-node replay state (forward fn + primal "
+         "inputs) on the autograd tape. Saves peak memory on very long "
+         "eager recordings whose ops' vjp residuals don't already retain "
+         "their inputs, at the cost of grad(create_graph=True) raising.")
+
 _overrides = {}
 
 

@@ -4,16 +4,21 @@ The reference forks worker processes that return CPUShared-storage
 NDArrays. TPU-native redesign: workers are *threads* by default —
 batchification is numpy (releases the GIL in C loops) and the expensive
 device transfer happens once on the main thread via a single device_put,
-overlapping with compute thanks to XLA async dispatch. num_workers>0 uses a
-thread pool; a multiprocessing path is intentionally not the default (the
-reference needed it for Python-speed augmentation; PIL/numpy release the
-GIL).
+overlapping with compute thanks to XLA async dispatch.
+
+``thread_pool=False`` (with ``num_workers>0``) restores the reference's
+process-worker escape hatch for GIL-heavy pure-Python transform chains
+(ref: dataloader.py — _MultiWorkerIter + worker_loop): forked workers run
+``dataset[i]`` + a numpy-only batchify and ship pickled numpy back; the
+parent does the single device_put. Worker code must stay numpy/PIL —
+JAX is fork-unsafe once its backend is initialized, so the child path
+never touches jax (the reference had the same split: cheap CPUShared
+numpy in workers, device copy in the consumer).
 """
 from __future__ import annotations
 
 import concurrent.futures
-import queue
-import threading
+import multiprocessing
 
 import numpy as np
 
@@ -33,6 +38,45 @@ def default_batchify_fn(data):
         return [default_batchify_fn(i) for i in data]
     out = np.asarray(data)
     return _nd.array(out, dtype=out.dtype)
+
+
+def _np_batchify(data):
+    """Numpy-only batchify for process workers (no jax in a forked
+    child). Mirrors default_batchify_fn's structure handling."""
+    if isinstance(data[0], tuple):
+        return tuple(_np_batchify(i) for i in zip(*data))
+    if isinstance(data[0], NDArray):
+        # reading a device array would re-enter JAX inside a fork()ed
+        # child — likely deadlock. Fail loudly with the fix.
+        raise TypeError(
+            "dataset returned NDArray samples under thread_pool=False; "
+            "process workers must stay numpy/PIL (JAX is fork-unsafe). "
+            "Return numpy from __getitem__, or use thread workers.")
+    return np.asarray(data)
+
+
+def _np_to_nd(batch):
+    if isinstance(batch, tuple):
+        return [_np_to_nd(b) for b in batch]
+    return _nd.array(batch, dtype=batch.dtype)
+
+
+# fork-inherited dataset handle (one per worker process)
+_worker_dataset = None
+
+
+def _worker_init(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_load(indices):
+    samples = [_worker_dataset[i] for i in indices]
+    return _np_batchify(samples)
+
+
+def _worker_samples(indices):
+    return [_worker_dataset[i] for i in indices]
 
 
 class DataLoader:
@@ -68,6 +112,8 @@ class DataLoader:
         self._batch_sampler = batch_sampler
         self._num_workers = max(0, num_workers)
         self._batchify_fn = batchify_fn or default_batchify_fn
+        self._custom_batchify = batchify_fn is not None
+        self._thread_pool = thread_pool
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
 
@@ -82,7 +128,12 @@ class DataLoader:
             for indices in self._batch_sampler:
                 yield self._load_batch(indices)
             return
+        if self._thread_pool:
+            yield from self._iter_threads()
+        else:
+            yield from self._iter_processes()
 
+    def _iter_threads(self):
         with concurrent.futures.ThreadPoolExecutor(
                 max_workers=self._num_workers) as pool:
             pending = []
@@ -101,3 +152,35 @@ class DataLoader:
                     except StopIteration:
                         it = None
                 yield batch
+
+    def _iter_processes(self):
+        """Reference-style fork workers. dataset[i] + numpy batchify run
+        in the child; device placement (and any custom batchify_fn, which
+        may build NDArrays) runs in the parent. Child exceptions re-raise
+        at .result(); an abruptly dead worker (OOM-kill) surfaces as
+        BrokenProcessPool rather than hanging the loader (which a plain
+        multiprocessing.Pool would)."""
+        ctx = multiprocessing.get_context("fork")
+        job = _worker_samples if self._custom_batchify else _worker_load
+        with concurrent.futures.ProcessPoolExecutor(
+                max_workers=self._num_workers, mp_context=ctx,
+                initializer=_worker_init,
+                initargs=(self._dataset,)) as pool:
+            pending = []
+            it = iter(self._batch_sampler)
+            try:
+                for _ in range(max(1, self._prefetch)):
+                    pending.append(pool.submit(job, next(it)))
+            except StopIteration:
+                it = None
+            while pending:
+                raw = pending.pop(0).result()
+                if it is not None:
+                    try:
+                        pending.append(pool.submit(job, next(it)))
+                    except StopIteration:
+                        it = None
+                if self._custom_batchify:
+                    yield self._batchify_fn(raw)
+                else:
+                    yield _np_to_nd(raw)

@@ -17,6 +17,16 @@ import inspect
 
 import jax
 
+
+def _random_mod():
+    from .. import random as _random
+    return _random
+
+
+def _config():
+    from .. import config
+    return config
+
 __all__ = ["Op", "register", "get_op", "list_ops", "apply_op"]
 
 _OPS: dict[str, "Op"] = {}
@@ -121,7 +131,23 @@ def apply_op(op, *inputs, out=None, **kwargs):
             parents = None
 
     if parents is not None:
-        out_raw, vjp_fn = jax.vjp(fn, *raw)
+        # capture PRNG keys drawn during the forward so a create_graph
+        # replay (autograd._grad_create_graph) reproduces stochastic ops
+        # (dropout) bit-for-bit
+        drawn_keys = []
+        with _random_mod().capture_keys(drawn_keys):
+            out_raw, vjp_fn = jax.vjp(fn, *raw)
+        if _config().get("MXT_AG_LEAN_TAPE"):
+            replay_fn = None  # create_graph raises; peak memory shrinks
+            raw_kept = None
+        elif drawn_keys:
+            def replay_fn(*r, _fn=fn, _keys=drawn_keys):
+                with _random_mod().replay_keys(_keys):
+                    return _fn(*r)
+            raw_kept = raw
+        else:
+            replay_fn = fn
+            raw_kept = raw
     else:
         out_raw = fn(*raw)
 
@@ -138,7 +164,7 @@ def apply_op(op, *inputs, out=None, **kwargs):
 
         node = ag.AGNode(
             wrapped_vjp, parents, [(o.shape, o.dtype) for o in outs_raw],
-            name=op.name,
+            name=op.name, fwd_fn=replay_fn, in_vals=raw_kept,
         )
 
     results = []

@@ -24,6 +24,12 @@ class _RandState(threading.local):
         self.seed_ = None
         # stack of (traced_key, counter_list) installed by tracing scopes
         self.scopes = []
+        # autograd replay plumbing (higher-order grad): capture_keys
+        # records every key handed out inside a recorded op; replay_keys
+        # re-serves the recorded keys so a tape replay reproduces the
+        # original stochastic forward bit-for-bit
+        self.captures = []
+        self.replays = []
 
 
 _state = _RandState()
@@ -66,17 +72,56 @@ def in_key_scope() -> bool:
     return bool(_state.scopes)
 
 
+class capture_keys:
+    """Record every key new_key() hands out in this scope (autograd's
+    record path uses this so create_graph replays are deterministic)."""
+
+    def __init__(self, store):
+        self._store = store
+
+    def __enter__(self):
+        _state.captures.append(self._store)
+        return self._store
+
+    def __exit__(self, *args):
+        _state.captures.pop()
+
+
+class replay_keys:
+    """Serve pre-recorded keys from new_key() (tape replay)."""
+
+    def __init__(self, keys):
+        self._keys = keys
+
+    def __enter__(self):
+        _state.replays.append([self._keys, 0])
+        return self
+
+    def __exit__(self, *args):
+        _state.replays.pop()
+
+
 def new_key():
     """Produce a fresh PRNG key for one random op call."""
+    if _state.replays:
+        entry = _state.replays[-1]
+        keys, i = entry
+        if i >= len(keys):
+            raise RuntimeError(
+                "tape replay drew more PRNG keys than the recorded forward")
+        entry[1] += 1
+        return keys[i]
     if _state.scopes:
         scope = _state.scopes[-1]
         k = jax.random.fold_in(scope[0], scope[1])
         scope[1] += 1
-        return k
-    if _state.key is None:
-        _state.key = jax.random.key(_DEFAULT_SEED)
-    _state.key, sub = jax.random.split(_state.key)
-    return sub
+    else:
+        if _state.key is None:
+            _state.key = jax.random.key(_DEFAULT_SEED)
+        _state.key, k = jax.random.split(_state.key)
+    if _state.captures:
+        _state.captures[-1].append(k)
+    return k
 
 
 def __getattr__(name):  # PEP 562

@@ -1,0 +1,147 @@
+"""Async parameter-server trust boundary (threat model in
+async_server.py docstring; ref: ps-lite ``Van`` membership — the
+reference's only admission control was the network perimeter)."""
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import async_server
+from mxnet_tpu.base import MXNetError
+
+
+@pytest.fixture
+def secret_env(monkeypatch):
+    monkeypatch.setenv("MXT_KVSTORE_SECRET", "test-secret-r5")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_nonloopback_bind_refused_without_secret(monkeypatch):
+    monkeypatch.delenv("MXT_KVSTORE_SECRET", raising=False)
+    for host in ("0.0.0.0", ""):  # "" binds INADDR_ANY too
+        with pytest.raises(MXNetError, match="MXT_KVSTORE_SECRET"):
+            async_server.AsyncParamServer(host, _free_port())
+
+
+def test_nonloopback_bind_allowed_with_secret(secret_env):
+    srv = async_server.AsyncParamServer("0.0.0.0", _free_port())
+    srv.close()
+
+
+def test_authenticated_roundtrip(secret_env):
+    port = _free_port()
+    srv = async_server.AsyncParamServer("127.0.0.1", port)
+    try:
+        cli = async_server.AsyncClient("127.0.0.1", port)
+        cli.request("init", "w", np.ones((2, 2), np.float32))
+        out = cli.request("pull", "w")
+        np.testing.assert_array_equal(out, np.ones((2, 2)))
+        cli.close()
+    finally:
+        srv.close()
+
+
+def test_tampered_frame_rejected(secret_env):
+    """Flip one payload byte after the MAC is computed: the server must
+    drop the connection without answering (and without unpickling)."""
+    port = _free_port()
+    srv = async_server.AsyncParamServer("127.0.0.1", port)
+    try:
+        cli = async_server.AsyncClient("127.0.0.1", port)
+        import pickle
+        payload = pickle.dumps(("pull", "w", None))
+        mac = cli._ch._mac(b"C", 0, payload)  # valid MAC for this payload
+        bad = bytearray(payload)
+        bad[-1] ^= 0xFF
+        cli._sock.sendall(struct.pack("!Q", len(bad)) + mac + bytes(bad))
+        # server drops the connection: the next read hits EOF
+        cli._sock.settimeout(5.0)
+        assert cli._sock.recv(1) == b""
+        cli.close()
+    finally:
+        srv.close()
+
+
+def test_replayed_frame_rejected(secret_env):
+    """A frame captured from one connection fails on another (nonce) and
+    a re-sent frame fails within a connection (sequence)."""
+    port = _free_port()
+    srv = async_server.AsyncParamServer("127.0.0.1", port)
+    try:
+        cli = async_server.AsyncClient("127.0.0.1", port)
+        cli.request("init", "w", np.zeros((1,), np.float32))
+        # re-send the exact bytes of the last frame (seq now stale)
+        import pickle
+        payload = pickle.dumps(("init", "w", np.zeros((1,), np.float32)),
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        mac = cli._ch._mac(b"C", 0, payload)  # seq 0 already consumed
+        cli._sock.sendall(struct.pack("!Q", len(payload)) + mac + payload)
+        cli._sock.settimeout(5.0)
+        assert cli._sock.recv(1) == b""  # dropped
+        cli.close()
+    finally:
+        srv.close()
+
+
+def test_wrong_secret_rejected(monkeypatch):
+    port = _free_port()
+    monkeypatch.setenv("MXT_KVSTORE_SECRET", "test-secret-r5")
+    srv = async_server.AsyncParamServer("127.0.0.1", port)
+    try:
+        monkeypatch.setenv("MXT_KVSTORE_SECRET", "attacker-guess")
+        cli = async_server.AsyncClient("127.0.0.1", port)
+        with pytest.raises((MXNetError, ConnectionError)):
+            cli.request("pull", "w")
+        cli.close()
+    finally:
+        monkeypatch.setenv("MXT_KVSTORE_SECRET", "test-secret-r5")
+        srv.close()
+
+
+def test_secret_presence_mismatch_is_clean_error(monkeypatch):
+    """Server-with-secret + client-without (and vice versa) must error at
+    connect, not hang in a desynced frame protocol."""
+    port = _free_port()
+    monkeypatch.setenv("MXT_KVSTORE_SECRET", "test-secret-r5")
+    srv = async_server.AsyncParamServer("127.0.0.1", port)
+    try:
+        monkeypatch.delenv("MXT_KVSTORE_SECRET", raising=False)
+        with pytest.raises(MXNetError, match="requires frame auth"):
+            async_server.AsyncClient("127.0.0.1", port)
+    finally:
+        monkeypatch.setenv("MXT_KVSTORE_SECRET", "test-secret-r5")
+        srv.close()
+
+    port2 = _free_port()
+    monkeypatch.delenv("MXT_KVSTORE_SECRET", raising=False)
+    srv2 = async_server.AsyncParamServer("127.0.0.1", port2)
+    try:
+        monkeypatch.setenv("MXT_KVSTORE_SECRET", "test-secret-r5")
+        with pytest.raises(MXNetError, match="downgrade"):
+            async_server.AsyncClient("127.0.0.1", port2)
+    finally:
+        monkeypatch.delenv("MXT_KVSTORE_SECRET", raising=False)
+        srv2.close()
+
+
+def test_unauthenticated_localhost_still_works(monkeypatch):
+    """Single-host rigs (no secret) keep working on loopback."""
+    monkeypatch.delenv("MXT_KVSTORE_SECRET", raising=False)
+    port = _free_port()
+    srv = async_server.AsyncParamServer("127.0.0.1", port)
+    try:
+        cli = async_server.AsyncClient("127.0.0.1", port)
+        cli.request("init", 3, np.full((2,), 7.0, np.float32))
+        np.testing.assert_array_equal(cli.request("pull", 3),
+                                      np.full((2,), 7.0))
+        cli.close()
+    finally:
+        srv.close()

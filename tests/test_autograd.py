@@ -221,3 +221,164 @@ def test_list_heads_with_grads():
             y = x * 2
             z = x * 3
         ag.backward([y, z], nd.ones((2,)))
+
+
+# ---------------------------------------------------------------------------
+# higher-order gradients — grad(create_graph=True)
+# (ref: python/mxnet/autograd.py — grad(create_graph); replay design in
+# autograd._grad_create_graph)
+# ---------------------------------------------------------------------------
+import pytest  # noqa: E402
+
+from mxnet_tpu import autograd  # noqa: E402
+
+
+def test_create_graph_second_order_polynomial():
+    # y = x^3  →  dy/dx = 3x^2, d2y/dx2 = 6x
+    x = mx.nd.array(np.array([1.0, 2.0, -3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x ** 3
+        (gx,) = [autograd.grad(y, x, create_graph=True)]
+        z = (gx * gx).sum()
+    z.backward()
+    # dz/dx = 2 * (3x^2) * 6x = 36 x^3
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               36 * x.asnumpy() ** 3, rtol=1e-5)
+
+
+def test_create_graph_grad_penalty_vs_torch():
+    """Gradient-penalty double-backward against the torch oracle."""
+    import torch
+    rs = np.random.RandomState(3)
+    Wn = rs.randn(4, 5).astype(np.float32)
+    xn = rs.randn(2, 5).astype(np.float32)
+
+    # torch oracle
+    tw = torch.tensor(Wn, requires_grad=True)
+    tx = torch.tensor(xn)
+    ty = torch.tanh(tx @ tw.t()).sum()
+    (tg,) = torch.autograd.grad(ty, tw, create_graph=True)
+    tp = (tg ** 2).sum()
+    tp.backward()
+    oracle = tw.grad.numpy()
+
+    W = mx.nd.array(Wn)
+    W.attach_grad()
+    x = mx.nd.array(xn)
+    with autograd.record():
+        y = mx.nd.tanh(mx.nd.dot(x, W.T)).sum()
+        g = autograd.grad(y, W, create_graph=True)
+        penalty = (g ** 2).sum()
+    penalty.backward()
+    np.testing.assert_allclose(W.grad.asnumpy(), oracle, rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_create_graph_third_order():
+    # y = x^4: y' = 4x^3, y'' = 12x^2, y''' = 24x
+    x = mx.nd.array(np.array([1.5], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x ** 4
+        g1 = autograd.grad(y, x, create_graph=True)
+        g2 = autograd.grad(g1, x, create_graph=True)
+        g3 = autograd.grad(g2, x, create_graph=True)
+    np.testing.assert_allclose(g3.asnumpy(), [24 * 1.5], rtol=1e-5)
+
+
+def test_create_graph_multi_variable_and_heads():
+    a = mx.nd.array(np.array([2.0], np.float32)); a.attach_grad()
+    b = mx.nd.array(np.array([3.0], np.float32)); b.attach_grad()
+    with autograd.record():
+        h1 = a * a * b          # d/da = 2ab, d/db = a^2
+        h2 = a + b
+        ga, gb = autograd.grad([h1, h2], [a, b], create_graph=True)
+        s = (ga * gb).sum()     # (2ab+1)(a^2+1)
+    s.backward()
+    # ds/da = 2b(a^2+1) + 2a(2ab+1); ds/db = 2a(a^2+1)
+    np.testing.assert_allclose(a.grad.asnumpy(),
+                               [2*3*(4+1) + 2*2*(2*2*3+1)], rtol=1e-5)
+    np.testing.assert_allclose(b.grad.asnumpy(), [2*2*(4+1)], rtol=1e-5)
+
+
+def test_create_graph_through_dropout_replay_deterministic():
+    """The replay must reuse the forward's PRNG keys: grad-of-grad through
+    dropout is consistent with the sampled mask."""
+    mx.random.seed(7)
+    x = mx.nd.array(np.full((64,), 2.0, np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Dropout(x, p=0.5, mode="always")  # y = mask*x/keep
+        s = (y * y).sum()
+        g = autograd.grad(s, x, create_graph=True)  # 2*(mask/keep)^2*x
+        z = g.sum()
+    z.backward()
+    # d z/dx = 2*(mask/keep)^2 — recover mask from y and compare
+    mask_scaled = (y.asnumpy() / 2.0)  # mask/keep
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * mask_scaled ** 2,
+                               rtol=1e-5)
+
+
+def test_create_graph_unused_variable_zero_grad():
+    a = mx.nd.array(np.array([1.0], np.float32)); a.attach_grad()
+    b = mx.nd.array(np.array([5.0], np.float32)); b.attach_grad()
+    with autograd.record():
+        y = a * a
+        ga, gb = autograd.grad(y, [a, b], create_graph=True)
+    np.testing.assert_allclose(ga.asnumpy(), [2.0], rtol=1e-6)
+    np.testing.assert_allclose(gb.asnumpy(), [0.0])
+
+
+def test_create_graph_custom_function_raises():
+    class Sq(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+    x = mx.nd.array(np.array([2.0], np.float32)); x.attach_grad()
+    with autograd.record():
+        y = Sq()(x)
+        with pytest.raises(NotImplementedError):
+            autograd.grad(y, x, create_graph=True)
+
+
+def test_first_order_grad_unchanged_after_create_graph():
+    """create_graph leaves the tape intact: a later backward on the same
+    head still works (implied retain)."""
+    x = mx.nd.array(np.array([3.0], np.float32)); x.attach_grad()
+    with autograd.record():
+        y = x * x
+        g = autograd.grad(y, x, create_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0], rtol=1e-6)
+    np.testing.assert_allclose(g.asnumpy(), [6.0], rtol=1e-6)
+
+
+def test_create_graph_cross_leaf_wgan_gp_vs_torch():
+    """grad(y, x, create_graph=True) must stay differentiable w.r.t. the
+    OTHER tracked leaves (W), not just x — the WGAN-GP pattern."""
+    import torch
+    rs = np.random.RandomState(11)
+    Wn = rs.randn(3, 5).astype(np.float32)
+    xn = rs.randn(4, 5).astype(np.float32)
+
+    tW = torch.tensor(Wn, requires_grad=True)
+    tx = torch.tensor(xn, requires_grad=True)
+    ty = (tx @ tW.t()).tanh().sum()
+    (tgx,) = torch.autograd.grad(ty, tx, create_graph=True)
+    tp = ((tgx.norm(dim=1) - 1.0) ** 2).mean()
+    tp.backward()
+    oracle_W = tW.grad.numpy()
+
+    W = mx.nd.array(Wn); W.attach_grad()
+    x = mx.nd.array(xn); x.attach_grad()
+    with autograd.record():
+        y = mx.nd.tanh(mx.nd.dot(x, W.T)).sum()
+        gx = autograd.grad(y, x, create_graph=True)
+        p = ((mx.nd.sqrt((gx * gx).sum(axis=1)) - 1.0) ** 2).mean()
+    p.backward()
+    np.testing.assert_allclose(W.grad.asnumpy(), oracle_W,
+                               rtol=1e-4, atol=1e-6)
