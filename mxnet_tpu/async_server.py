@@ -332,23 +332,39 @@ class AsyncClient:
             raise MXNetError(
                 "cannot reach async kvstore server at %s:%d (%r)"
                 % (host, port, last))
-        # server banner: agree on the auth mode before any frame flows
-        head = _recv_exact(self._sock, len(_BANNER_MAGIC) + 1)
-        if head[:len(_BANNER_MAGIC)] != _BANNER_MAGIC:
-            raise MXNetError(
-                "peer at %s:%d did not send an async kvstore banner "
-                "(not an async server, or a pre-r5 build)" % (host, port))
-        server_auth = bool(head[len(_BANNER_MAGIC)] & 1)
-        secret = _shared_secret()
-        if server_auth and secret is None:
-            raise MXNetError(
-                "async kvstore server requires frame authentication but "
-                "MXT_KVSTORE_SECRET is not set on this worker")
-        if not server_auth and secret is not None:
-            raise MXNetError(
-                "MXT_KVSTORE_SECRET is set on this worker but the server "
-                "does not authenticate frames — refusing the downgrade")
-        nonce = _recv_exact(self._sock, _NONCE_LEN) if server_auth else b""
+        # server banner: agree on the auth mode before any frame flows.
+        # Time-bounded (a bannerless pre-r5 peer sends nothing and would
+        # hang us) and the socket is closed on any handshake failure.
+        try:
+            self._sock.settimeout(timeout)
+            head = _recv_exact(self._sock, len(_BANNER_MAGIC) + 1)
+            if head[:len(_BANNER_MAGIC)] != _BANNER_MAGIC:
+                raise MXNetError(
+                    "peer at %s:%d did not send an async kvstore banner "
+                    "(not an async server, or a pre-r5 build)"
+                    % (host, port))
+            server_auth = bool(head[len(_BANNER_MAGIC)] & 1)
+            secret = _shared_secret()
+            if server_auth and secret is None:
+                raise MXNetError(
+                    "async kvstore server requires frame authentication "
+                    "but MXT_KVSTORE_SECRET is not set on this worker")
+            if not server_auth and secret is not None:
+                raise MXNetError(
+                    "MXT_KVSTORE_SECRET is set on this worker but the "
+                    "server does not authenticate frames — refusing the "
+                    "downgrade")
+            nonce = _recv_exact(self._sock, _NONCE_LEN) if server_auth \
+                else b""
+            self._sock.settimeout(None)
+        except (OSError, MXNetError, ConnectionError) as e:
+            self._sock.close()
+            if isinstance(e, socket.timeout):
+                raise MXNetError(
+                    "timed out waiting for the async kvstore banner from "
+                    "%s:%d (not an async server, or a pre-r5 build)"
+                    % (host, port)) from e
+            raise
         self._ch = _Channel(self._sock, secret if server_auth else None,
                             nonce, b"C")
         self._lock = threading.Lock()

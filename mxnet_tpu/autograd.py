@@ -339,9 +339,11 @@ def _grad_create_graph(heads, variables, head_grads):
     recorded keys (random.capture_keys), keeping stochastic forwards
     (dropout) bit-identical under replay.
 
-    Values of non-variable inputs are taken from the recorded primals, so
-    later in-place mutation of other leaves does not skew the replay;
-    custom ``Function`` nodes carry no pure forward and raise.
+    Untracked inputs replay from their recorded primals. Tracked leaves
+    replay from their live buffers — mutating a tracked leaf in place
+    between the forward and ``grad()`` therefore skews the replay (the
+    same saved-tensor caveat torch versions away); custom ``Function``
+    nodes carry no pure forward and raise.
     """
     from .ndarray.ndarray import NDArray
     from .ops.registry import apply_op, Op
@@ -382,16 +384,29 @@ def _grad_create_graph(heads, variables, head_grads):
     # differentiates d y/d x, then backprops THAT into the weights W), so
     # all leaves become traced inputs of the replay.
     leaf_nodes, leaf_pos = [], {}
+    by_array = {}  # id(array_ref) -> leaf position (re-attach tolerance)
     for node in order:
         if isinstance(node, AGLeaf) and id(node) not in leaf_pos:
             leaf_pos[id(node)] = len(leaf_nodes)
+            by_array.setdefault(id(node.array_ref), len(leaf_nodes))
             leaf_nodes.append(node)
-    for v in variables:  # variables outside the head graph → zero grads
+
+    def leaf_index(v):
+        # match like the first-order path does (leaf_cts keys by
+        # id(array_ref)): attach_grad() called again after the forward
+        # makes a fresh AGLeaf, but the recorded graph still references
+        # the old one for the same array
         node = v._ag_node[0]
-        if id(node) not in leaf_pos:
-            leaf_pos[id(node)] = len(leaf_nodes)
-            leaf_nodes.append(node)
-    var_idx = [leaf_pos[id(v._ag_node[0])] for v in variables]
+        if id(node) in leaf_pos:
+            return leaf_pos[id(node)]
+        if id(v) in by_array:
+            return by_array[id(v)]
+        # variable not in the head graph at all → appended, zero grads
+        leaf_pos[id(node)] = len(leaf_nodes)
+        leaf_nodes.append(node)
+        return leaf_pos[id(node)]
+
+    var_idx = [leaf_index(v) for v in variables]
 
     depends = {}
     for node in order:  # parents-before-children

@@ -6,7 +6,8 @@ from .. import amp  # noqa: F401
 
 
 def __getattr__(name):  # PEP 562: lazy — onnx pulls in protobuf
-    if name in ("onnx", "text", "svrg_optimization", "io"):
+    if name in ("onnx", "text", "svrg_optimization", "io",
+                "quantization"):
         return importlib.import_module("." + name, __name__)
     raise AttributeError("module %r has no attribute %r"
                          % (__name__, name))

@@ -1,0 +1,373 @@
+"""Post-training int8 quantization (ref: python/mxnet/contrib/
+quantization.py — quantize_model / quantize_graph;
+src/operator/quantization/quantize_graph_pass.cc).
+
+Flow, mirroring the reference:
+
+1. **Calibrate** (``calib_mode='naive'``): bind a Group symbol over every
+   tensor that will cross a float↔int8 boundary and stream
+   ``calib_data`` through it, tracking per-tensor min/max
+   (``calib_mode='entropy'`` refines the range by KL-divergence threshold
+   search over a histogram, ref: _LayerHistogramCollector/
+   _get_optimal_threshold).
+2. **Quantize weights offline**: each target layer's weight becomes an
+   int8 param ``<name>_quantize`` plus ``<name>_quantize_min/max`` range
+   params (ref: quantize_params).
+3. **Rewrite the graph**: Convolution/FullyConnected become
+   quantized_conv / quantized_fully_connected (s8×s8→s32 on the MXU)
+   bracketed by quantize_v2 / requantize / dequantize; Pooling and
+   Flatten between quantized layers ride the int8 triple directly
+   (quantize_graph_pass's passthrough list), and adjacent
+   dequantize→quantize_v2 pairs never materialize because each rewritten
+   tensor keeps its int8 triple alongside its f32 value.
+
+Accuracy contract (ref test: test_quantization.py): a calibrated int8
+LeNet/ResNet stays within ~1pt of its fp32 accuracy.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..symbol.symbol import Symbol, _Node, Group
+
+__all__ = ["quantize_model", "quantize_graph"]
+
+_QUANTIZABLE = ("Convolution", "FullyConnected")
+_PASSTHROUGH = ("Pooling", "Flatten", "flatten")
+
+
+def _collect_stats(sym, arg_params, aux_params, tensors, calib_data,
+                   num_calib_examples, ctx, calib_mode):
+    """Run calibration batches; return {(node_id, out_idx): (min, max)}.
+
+    ``tensors`` is a list of (node, out_idx) pairs from the ORIGINAL
+    graph — a Group symbol over them shares those nodes, so stats key
+    cleanly by node identity.
+    """
+    from .. import context as _ctx
+
+    group = Group([Symbol([(n, i)]) for (n, i) in tensors])
+    data_names = [d[0] for d in calib_data.provide_data]
+    shapes = dict(calib_data.provide_data)
+    args = {}
+    for name in group.list_arguments():
+        if name in arg_params:
+            args[name] = arg_params[name]
+        elif name in shapes:
+            from .. import nd
+            args[name] = nd.zeros(tuple(shapes[name]))
+        else:
+            raise MXNetError(
+                "calibration: argument %r has no value (not in arg_params "
+                "or calib_data.provide_data)" % name)
+    aux = {k: v for k, v in aux_params.items()
+           if k in group.list_auxiliary_states()}
+    exe = group.bind(ctx or _ctx.cpu(), args=args, aux_states=aux,
+                     grad_req="null")
+
+    if calib_mode == "entropy":
+        collectors = [_HistogramCollector() for _ in tensors]
+    else:
+        collectors = [_MinMaxCollector() for _ in tensors]
+    seen = 0
+    calib_data.reset()
+    for batch in calib_data:
+        feed = dict(zip(data_names, batch.data))
+        outs = exe.forward(is_train=False, **feed)
+        for c, o in zip(collectors, outs):
+            c.update(o.asnumpy())
+        seen += batch.data[0].shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    if seen == 0:
+        raise MXNetError("calibration data iterator yielded no batches")
+    return {(id(n), i): c.range()
+            for (n, i), c in zip(tensors, collectors)}, seen
+
+
+class _MinMaxCollector:
+    def __init__(self):
+        self.mn, self.mx = np.inf, -np.inf
+
+    def update(self, arr):
+        self.mn = min(self.mn, float(arr.min()))
+        self.mx = max(self.mx, float(arr.max()))
+
+    def range(self):
+        return self.mn, self.mx
+
+
+class _HistogramCollector:
+    """KL calibration (ref: _LayerHistogramCollector +
+    _get_optimal_threshold): accumulate |x| into a fixed histogram, then
+    pick the threshold whose clipped/quantized distribution has minimal
+    KL divergence from the original."""
+
+    BINS = 2048
+
+    def __init__(self):
+        self.hist = None
+        self.amax = 0.0
+
+    def update(self, arr):
+        a = np.abs(arr.astype(np.float64)).ravel()
+        amax = float(a.max()) if a.size else 0.0
+        if self.hist is None:
+            # range fixed from the first batch (headroom ×1.5); later
+            # overflow lands in the edge bin — exactly the outlier mass
+            # KL clipping discounts anyway
+            self.amax = max(amax * 1.5, 1e-12)
+            self.hist = np.zeros(self.BINS)
+        h, _ = np.histogram(np.minimum(a, self.amax), bins=self.BINS,
+                            range=(0.0, self.amax))
+        self.hist += h
+
+    def range(self):
+        t = _kl_threshold(self.hist, self.amax, nbits=8)
+        return -t, t
+
+
+def _kl_threshold(hist, amax, nbits=8):
+    """Smallest-KL clipping threshold (ref: _get_optimal_threshold,
+    after TensorRT's entropy calibration)."""
+    nbins = len(hist)
+    nquant = 2 ** (nbits - 1) - 1  # 127 levels for symmetric int8
+    start = max(nquant, nbins // 8)
+    best_kl, best_i = np.inf, nbins
+    total = hist.sum()
+    if total == 0:
+        return amax
+    for i in range(start, nbins + 1, max(1, (nbins - start) // 64)):
+        ref = hist[:i].copy()
+        ref[i - 1] += hist[i:].sum()  # clip outliers into the edge bin
+        p = ref / ref.sum()
+        # quantize the first i bins down to nquant levels
+        chunks = np.array_split(hist[:i], nquant)
+        q = np.concatenate([
+            np.full(len(c), (c.sum() / max((c > 0).sum(), 1)) if c.sum()
+                    else 0.0) * (c > 0) for c in chunks])
+        if q.sum() == 0:
+            continue
+        q = q / q.sum()
+        mask = p > 0
+        kl = float(np.sum(p[mask] * np.log(p[mask] /
+                                           np.maximum(q[mask], 1e-12))))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return amax * best_i / nbins
+
+
+def _weight_amax(w):
+    return float(np.max(np.abs(w.asnumpy()))) or 1e-6
+
+
+def quantize_graph(sym, arg_params, aux_params, excluded_sym_names=(),
+                   excluded_op_names=(), stats=None,
+                   quantized_dtype="int8"):
+    """Graph-surgery core (ref: quantize_graph_pass.cc). ``stats`` maps
+    ``(id(node), out_idx) -> (min, max)`` for calibrated boundaries; when
+    absent, quantize_v2/requantize fall back to dynamic ranges."""
+    if quantized_dtype != "int8":
+        raise MXNetError("TPU build quantizes to signed int8 only")
+    stats = stats or {}
+    excluded_sym_names = set(excluded_sym_names)
+    excluded_op_names = set(excluded_op_names)
+
+    qarg_params = dict(arg_params)
+    new_of = {}      # id(old node) -> new node
+    triple_of = {}   # id(old node), only idx 0 -> (node, i_q, i_min, i_max)
+    hinted_vars = {}  # name -> shape-hinted replacement var node
+
+    def hinted_var(old_var):
+        """Copy of a param var with its concrete shape baked in — the
+        quantized ops around it have no PARAM_SHAPE_RULES, so inference
+        needs the hint (shapes are static at rewrite time anyway)."""
+        name = old_var.name
+        if name not in hinted_vars:
+            attrs = dict(old_var.attrs)
+            if name in arg_params:
+                attrs.setdefault("__shape__",
+                                 tuple(arg_params[name].shape))
+            hinted_vars[name] = _Node(None, name, attrs, [],
+                                      annotations=dict(
+                                          old_var.annotations))
+        return hinted_vars[name]
+
+    def rewritten(entry):
+        old, idx = entry
+        return (new_of.get(id(old), old), idx)
+
+    def f32_input(entry):
+        """f32 view of a rewritten tensor (dequantize if int8-only)."""
+        old, idx = entry
+        if id(old) in triple_of and idx == 0:
+            node, qi, mi, xi = triple_of[id(old)]
+            deq = _Node("dequantize", old.name + "_dequantize", {},
+                        [(node, qi), (node, mi), (node, xi)])
+            return (deq, 0)
+        return rewritten(entry)
+
+    def int8_input(entry):
+        """(q, min, max) triple for a rewritten tensor, quantizing its
+        f32 value with calibrated ranges if it isn't int8 already."""
+        old, idx = entry
+        if id(old) in triple_of and idx == 0:
+            node, qi, mi, xi = triple_of[id(old)]
+            return (node, qi), (node, mi), (node, xi)
+        src = rewritten(entry)
+        attrs = {}
+        rng = stats.get((id(old), idx))
+        if rng is not None:
+            attrs = {"min_calib_range": rng[0], "max_calib_range": rng[1]}
+        qn = _Node("quantize_v2", old.name + "_quantize", attrs, [src],
+                   num_outputs=3)
+        return (qn, 0), (qn, 1), (qn, 2)
+
+    for node in Symbol(list(sym._outputs))._topo_nodes():
+        if node.is_var():
+            continue
+        quantizable = (
+            node.op in _QUANTIZABLE
+            and node.name not in excluded_sym_names
+            and node.op not in excluded_op_names
+            and len(node.inputs) >= 2
+            and node.inputs[1][0].is_var()  # weight must be a plain param
+            and node.inputs[1][0].name in arg_params
+        )
+        if quantizable:
+            wname = node.inputs[1][0].name
+            w = arg_params[wname]
+            amax_w = _weight_amax(w)
+            scale_w = 127.0 / amax_w
+            q_w = np.clip(np.round(w.asnumpy() * scale_w), -127, 127) \
+                .astype(np.int8)
+            from .. import nd
+            qarg_params.pop(wname, None)
+            qarg_params[wname + "_quantize"] = nd.array(q_w,
+                                                        dtype="int8")
+            qarg_params[wname + "_quantize_min"] = nd.array(
+                np.float32(-amax_w).reshape(()))
+            qarg_params[wname + "_quantize_max"] = nd.array(
+                np.float32(amax_w).reshape(()))
+            # bake shapes/dtypes into the vars: quantized ops have no
+            # shape-inference rules, and the shapes are static here anyway
+            wq_var = _Node(None, wname + "_quantize",
+                           {"__shape__": tuple(q_w.shape),
+                            "__dtype__": "int8"}, [])
+            wmin_var = _Node(None, wname + "_quantize_min",
+                             {"__shape__": ()}, [])
+            wmax_var = _Node(None, wname + "_quantize_max",
+                             {"__shape__": ()}, [])
+
+            dq, dmin, dmax = int8_input(node.inputs[0])
+            no_bias = bool(node.attrs.get("no_bias", False))
+            bias_in = None
+            if not no_bias and len(node.inputs) > 2:
+                bnode, bidx = node.inputs[2]
+                bias_in = ((hinted_var(bnode), bidx) if bnode.is_var()
+                           else rewritten(node.inputs[2]))
+            attrs = dict(node.attrs)
+            qop = ("quantized_conv" if node.op == "Convolution"
+                   else "quantized_fully_connected")
+            ins = [dq, (wq_var, 0)]
+            ins.append(bias_in if bias_in is not None else (wmin_var, 0))
+            if bias_in is None:
+                attrs["no_bias"] = True
+                ins[2] = (wmin_var, 0)  # placeholder, unused under no_bias
+            ins += [dmin, dmax, (wmin_var, 0), (wmax_var, 0)]
+            qnode = _Node(qop, node.name + "_quantize", attrs, ins,
+                          num_outputs=3)
+            # requantize int32 accum → int8 with the layer's calibrated
+            # OUTPUT range
+            rattrs = {}
+            rng = stats.get((id(node), 0))
+            if rng is not None:
+                rattrs = {"min_calib_range": rng[0],
+                          "max_calib_range": rng[1]}
+            rq = _Node("requantize", node.name + "_requantize", rattrs,
+                       [(qnode, 0), (qnode, 1), (qnode, 2)],
+                       num_outputs=3)
+            new_of[id(node)] = rq
+            triple_of[id(node)] = (rq, 0, 1, 2)
+            continue
+
+        passthrough = node.op in _PASSTHROUGH or (
+            node.op == "Activation"
+            and node.attrs.get("act_type") == "relu")
+        if passthrough and node.inputs and \
+                id(node.inputs[0][0]) in triple_of and \
+                node.inputs[0][1] == 0 and \
+                node.name not in excluded_sym_names:
+            q, mn, mx = int8_input(node.inputs[0])
+            qop = {"Pooling": "quantized_pooling",
+                   "Activation": "quantized_act"}.get(
+                       node.op, "quantized_flatten")
+            pn = _Node(qop, node.name + "_quantize",
+                       dict(node.attrs), [q, mn, mx], num_outputs=3)
+            new_of[id(node)] = pn
+            triple_of[id(node)] = (pn, 0, 1, 2)
+            continue
+
+        # ordinary op: consume f32 views of rewritten inputs
+        new_inputs = [f32_input(e) for e in node.inputs]
+        if new_inputs != node.inputs:
+            nn = _Node(node.op, node.name, dict(node.attrs), new_inputs,
+                       num_outputs=node.num_outputs,
+                       annotations=dict(node.annotations))
+            new_of[id(node)] = nn
+
+    outs = []
+    for (node, idx) in sym._outputs:
+        if id(node) in triple_of and idx == 0:
+            outs.append(f32_input((node, idx)))
+        else:
+            outs.append(rewritten((node, idx)))
+    return Symbol(outs), qarg_params
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   label_names=("softmax_label",), ctx=None,
+                   excluded_sym_names=(), excluded_op_names=(),
+                   calib_mode="naive", calib_data=None,
+                   num_calib_examples=None, quantized_dtype="int8",
+                   quantize_mode="smart", logger=None):
+    """Quantize a trained fp32 model to int8
+    (ref: contrib/quantization.py — quantize_model).
+
+    Returns ``(qsym, qarg_params, aux_params)``; bind qsym like any other
+    symbol (inference only — quantized ops carry no gradients).
+    """
+    del data_names, label_names, quantize_mode
+    if calib_mode not in ("none", "naive", "entropy"):
+        raise MXNetError("calib_mode must be none|naive|entropy, got %r"
+                         % (calib_mode,))
+    stats = None
+    if calib_mode != "none":
+        if calib_data is None:
+            raise MXNetError("calib_mode=%r needs calib_data" % calib_mode)
+        # tensors crossing a float<->int8 boundary: each quantizable
+        # node's data input and output
+        excluded = set(excluded_sym_names)
+        tensors, seen_t = [], set()
+        for node in Symbol(list(sym._outputs))._topo_nodes():
+            if node.is_var() or node.op not in _QUANTIZABLE or \
+                    node.name in excluded or node.op in excluded_op_names:
+                continue
+            for t in (node.inputs[0], (node, 0)):
+                key = (id(t[0]), t[1])
+                if key not in seen_t:
+                    seen_t.add(key)
+                    tensors.append(t)
+        stats, seen = _collect_stats(
+            sym, arg_params, aux_params, tensors, calib_data,
+            num_calib_examples, ctx, calib_mode)
+        if logger:
+            logger.info("calibrated %d tensors over %d examples (%s)",
+                        len(tensors), seen, calib_mode)
+    qsym, qarg = quantize_graph(
+        sym, arg_params, aux_params,
+        excluded_sym_names=excluded_sym_names,
+        excluded_op_names=excluded_op_names,
+        stats=stats, quantized_dtype=quantized_dtype)
+    return qsym, qarg, dict(aux_params)

@@ -76,7 +76,18 @@ def _worker_load(indices):
 
 
 def _worker_samples(indices):
-    return [_worker_dataset[i] for i in indices]
+    samples = [_worker_dataset[i] for i in indices]
+    for s in samples:
+        items = s if isinstance(s, tuple) else (s,)
+        if any(isinstance(i, NDArray) for i in items):
+            # same fork-safety guard as _np_batchify: pickling a device
+            # array re-enters JAX inside the forked child
+            raise TypeError(
+                "dataset returned NDArray samples under "
+                "thread_pool=False; process workers must stay numpy/PIL "
+                "(JAX is fork-unsafe). Return numpy from __getitem__, or "
+                "use thread workers.")
+    return samples
 
 
 class DataLoader:

@@ -14,6 +14,7 @@ from . import attention
 from . import linalg
 from . import contrib_ops
 from . import ctc
+from . import quantization
 
 from .registry import apply_op, get_op, list_ops, register, Op
 

@@ -1,0 +1,199 @@
+"""int8 quantization op family (ref: src/operator/quantization/* —
+quantize_v2, dequantize, requantize, quantized_conv, quantized_fully_
+connected, quantized_pooling, quantized_flatten).
+
+TPU-native design: symmetric signed-int8 (zero_point 0) everywhere — the
+MXU consumes s8×s8→s32 natively (``preferred_element_type=int32``), and
+symmetric quantization keeps the conv/fc epilogue a pure rescale that XLA
+fuses into the matmul. Quantized tensors travel as the reference's
+``(q, min_range, max_range)`` triple; the float range maps linearly onto
+the integer range of q's dtype (±127 for int8, ±int32_max for the conv/fc
+accumulator), so ``scale(q) = int_max(dtype) / max(|min|, |max|)``.
+
+The graph surgery that strings these ops together lives in
+``contrib/quantization.py — quantize_model``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+from .nn import convolution, pooling
+
+_INT8_MAX = 127.0
+_INT32_MAX = float(2 ** 31 - 1)
+
+
+def _amax(min_range, max_range):
+    """Symmetric float range from a (min, max) calibration pair."""
+    return jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+
+
+def _scale8(min_range, max_range):
+    return _INT8_MAX / jnp.maximum(_amax(min_range, max_range), 1e-30)
+
+
+@register("quantize_v2", aliases=("_contrib_quantize_v2",),
+          num_outputs=3, differentiable=False)
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """f32 → (int8, min, max) (ref: quantization/quantize_v2-inl.h).
+    Calibrated ranges come in as attrs; otherwise the range is computed
+    from the data (dynamic quantization)."""
+    if out_type not in ("int8", "auto"):
+        raise ValueError("TPU build quantizes to signed int8 only "
+                         "(got out_type=%r)" % (out_type,))
+    if min_calib_range is not None and max_calib_range is not None:
+        amax = jnp.maximum(abs(float(min_calib_range)),
+                           abs(float(max_calib_range)))
+        amax = jnp.asarray(amax, jnp.float32)
+    else:
+        amax = jnp.max(jnp.abs(data)).astype(jnp.float32)
+    scale = _INT8_MAX / jnp.maximum(amax, 1e-30)
+    q = jnp.clip(jnp.round(data.astype(jnp.float32) * scale),
+                 -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    return q, -amax, amax
+
+
+@register("dequantize", aliases=("_contrib_dequantize",),
+          differentiable=False)
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """(int8|int32, min, max) → f32 (ref: quantization/dequantize-inl.h)."""
+    del out_type
+    int_max = _INT8_MAX if data.dtype == jnp.int8 else _INT32_MAX
+    amax = _amax(min_range, max_range)
+    return data.astype(jnp.float32) * (amax / int_max)
+
+
+@register("requantize", aliases=("_contrib_requantize",),
+          num_outputs=3, differentiable=False)
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulator → int8 (ref: quantization/requantize-inl.h).
+    With calibrated ranges the rescale factor is a compile-time constant;
+    without, the range is taken from the actual int32 values (dynamic)."""
+    in_amax = _amax(min_range, max_range)
+    in_scale = _INT32_MAX / jnp.maximum(in_amax, 1e-30)
+    if min_calib_range is not None and max_calib_range is not None:
+        out_amax = jnp.asarray(
+            max(abs(float(min_calib_range)), abs(float(max_calib_range))),
+            jnp.float32)
+    else:
+        out_amax = jnp.max(jnp.abs(data)).astype(jnp.float32) / in_scale
+    out_scale = _INT8_MAX / jnp.maximum(out_amax, 1e-30)
+    q = jnp.clip(jnp.round(data.astype(jnp.float32) * (out_scale / in_scale)),
+                 -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    return q, -out_amax, out_amax
+
+
+def _accum_triple(out_i32, scale_prod):
+    """(int32 accum, its float range) — the int32 triple convention:
+    float = q / (int32_max / amax) with amax = int32_max / scale_prod."""
+    amax = _INT32_MAX / scale_prod
+    return out_i32, -amax, amax
+
+
+@register("quantized_conv", aliases=("_contrib_quantized_conv",),
+          num_outputs=3, differentiable=False)
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias=None, max_bias=None, kernel=(),
+                   stride=(), dilate=(), pad=(), num_filter=0, num_group=1,
+                   no_bias=False, layout=None):
+    """s8×s8→s32 convolution (ref: quantization/quantized_conv.cc).
+    Inference-only, like the reference (no gradient). The f32 bias is
+    folded into the int32 accumulator at the combined input scale."""
+    from .nn import _conv_dn
+    del num_filter
+    sd = _scale8(min_data, max_data)
+    sw = _scale8(min_weight, max_weight)
+    nd_ = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nd_
+    dilate = tuple(dilate) if dilate else (1,) * nd_
+    pad = tuple(pad) if pad else (0,) * nd_
+    dn = _conv_dn(layout, nd_)
+    # s8×s8 with an int32 accumulator — THE reason this op exists (a
+    # plain int8 conv would wrap at ±128)
+    out = jax.lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    if not no_bias and bias is not None:
+        del min_bias, max_bias  # bias arrives f32; scale is exact
+        c_ax = dn[2].index("C")
+        shape = [1] * out.ndim
+        shape[c_ax] = bias.shape[0]
+        b_i32 = jnp.round(bias.astype(jnp.float32) * (sd * sw)) \
+            .astype(jnp.int32)
+        out = out + b_i32.reshape(shape)
+    return _accum_triple(out, sd * sw)
+
+
+@register("quantized_fully_connected",
+          aliases=("_contrib_quantized_fully_connected",),
+          num_outputs=3, differentiable=False)
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias=None,
+                              max_bias=None, num_hidden=None, no_bias=False,
+                              flatten=True):
+    """s8×s8→s32 matmul (ref: quantization/quantized_fully_connected.cc)."""
+    del num_hidden, min_bias, max_bias
+    sd = _scale8(min_data, max_data)
+    sw = _scale8(min_weight, max_weight)
+    x = data.reshape((data.shape[0], -1)) if flatten and data.ndim > 2 \
+        else data
+    out = jax.lax.dot_general(
+        x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    if not no_bias and bias is not None:
+        b_i32 = jnp.round(bias.astype(jnp.float32) * (sd * sw)) \
+            .astype(jnp.int32)
+        out = out + b_i32
+    return _accum_triple(out, sd * sw)
+
+
+@register("quantized_pooling", aliases=("_contrib_quantized_pooling",),
+          num_outputs=3, differentiable=False)
+def quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
+                      global_pool=False, stride=(), pad=(),
+                      pooling_convention="valid", count_include_pad=True,
+                      layout=None):
+    """Pooling directly on int8 (ref: quantization/quantized_pooling.cc).
+    Max pool is exact; avg pool accumulates in f32 and re-rounds to the
+    same scale (range is preserved either way, so the triple passes
+    through)."""
+    if pool_type == "max":
+        out = pooling(data, kernel=kernel, pool_type="max",
+                      global_pool=global_pool, stride=stride, pad=pad,
+                      pooling_convention=pooling_convention, layout=layout)
+    else:
+        avg = pooling(data.astype(jnp.float32), kernel=kernel,
+                      pool_type=pool_type, global_pool=global_pool,
+                      stride=stride, pad=pad,
+                      pooling_convention=pooling_convention,
+                      count_include_pad=count_include_pad, layout=layout)
+        out = jnp.clip(jnp.round(avg), -_INT8_MAX, _INT8_MAX) \
+            .astype(jnp.int8)
+    return out, min_data, max_data
+
+
+@register("quantized_flatten", aliases=("_contrib_quantized_flatten",),
+          num_outputs=3, differentiable=False)
+def quantized_flatten(data, min_data, max_data):
+    """ref: quantization/quantized_flatten-inl.h."""
+    return (data.reshape((data.shape[0], -1)), min_data, max_data)
+
+
+@register("quantized_act", aliases=("_contrib_quantized_act",),
+          num_outputs=3, differentiable=False)
+def quantized_act(data, min_data, max_data, act_type="relu"):
+    """relu directly on int8 (ref: quantized_activation in the oneDNN
+    path). Exact: relu commutes with a positive scale and fixes 0, so
+    relu(dequantize(q)) == dequantize(max(q, 0)) and the range triple
+    passes through unchanged."""
+    if act_type != "relu":
+        raise ValueError("only relu stays exact on the int8 grid "
+                         "(got act_type=%r)" % (act_type,))
+    return jnp.maximum(data, 0), min_data, max_data

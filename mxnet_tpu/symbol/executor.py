@@ -198,13 +198,19 @@ class Executor:
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         type_dict = type_dict or {}
+        # a var's declared __dtype__ (sym.var(dtype=...)) is the default
+        # below an explicit type_dict entry — int8 params of a quantized
+        # graph must not materialize as f32
+        declared = {n.name: n.attrs["__dtype__"]
+                    for n in symbol._topo_nodes()
+                    if n.is_var() and "__dtype__" in n.attrs}
         args = {}
         for n, s in zip(arg_names, arg_shapes):
-            dt = np.dtype(type_dict.get(n, "float32"))
+            dt = np.dtype(type_dict.get(n, declared.get(n, "float32")))
             args[n] = NDArray(jnp.zeros(s, dtype=dt))
         aux = {}
         for n, s in zip(aux_names, aux_shapes):
-            dt = np.dtype(type_dict.get(n, "float32"))
+            dt = np.dtype(type_dict.get(n, declared.get(n, "float32")))
             aux[n] = NDArray(jnp.zeros(s, dtype=dt))
         return Executor(symbol, ctx, args, None, grad_req, aux)
 

@@ -382,3 +382,16 @@ def test_create_graph_cross_leaf_wgan_gp_vs_torch():
     p.backward()
     np.testing.assert_allclose(W.grad.asnumpy(), oracle_W,
                                rtol=1e-4, atol=1e-6)
+
+
+def test_create_graph_after_reattach():
+    """attach_grad() called again after the forward must not silently
+    zero create_graph gradients (leaves match by array identity, like
+    the first-order path)."""
+    x = mx.nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        x.attach_grad()  # fresh AGLeaf for the same array
+        g = autograd.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(g.asnumpy(), [12.0], rtol=1e-6)

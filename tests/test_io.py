@@ -542,8 +542,10 @@ def test_thread_worker_error_propagates():
         list(loader)
 
 
-@pytest.mark.skipif(os.cpu_count() is None or os.cpu_count() < 4,
-                    reason="needs >=4 cores for a meaningful A/B")
+@pytest.mark.skipif(len(getattr(os, "sched_getaffinity", lambda _: [0])(0))
+                    < 4,
+                    reason="needs >=4 schedulable cores for a "
+                           "meaningful A/B")
 def test_process_workers_beat_threads_on_gil_heavy_transform():
     """The reason the escape hatch exists: a GIL-bound transform chain
     serializes under threads but scales under processes."""
