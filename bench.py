@@ -42,7 +42,7 @@ _BUDGET = float(os.environ.get("BENCH_BUDGET", "1500"))
 # measured on the axon tunnel in round 3; CPU small-shape runs are cheaper
 # but CPU is the fallback path where the budget rarely binds
 _CONFIG_COST = {"resnet50": 420, "bert": 300, "lstm_ptb": 200,
-                "wide_deep": 200, "lenet": 150}
+                "wide_deep": 200, "lenet": 150, "pipeline": 150}
 
 
 def _remaining():
@@ -515,15 +515,94 @@ def bench_wide_deep(platform, dtype):
     if flops:
         flops /= batch
 
+    # MFU is near-meaningless for this config (tiny gemms, lookup-bound);
+    # the device-side metric that matters is embedding traffic: per
+    # sample, each id costs a gather (fwd) + scatter-add (bwd) row of
+    # embed_dim (deep) / 1 (wide logistic weights), f32 on both passes.
+    esize = np.dtype("float32").itemsize
+    emb_bytes_per_sample = 2 * esize * (n_wide * 1 + n_deep * 16)
     row = {
         "config": "wide_deep_train", "chips": 1, "batch_size": batch,
         "dtype": dtype,
         "images_or_tokens_per_sec_per_chip": round(samp_s, 2),
         "mfu": _mfu(samp_s, flops, platform), "platform": platform,
         "flops_per_sample": flops,
+        "embedding_bytes_per_sec": round(samp_s * emb_bytes_per_sample),
     }
     _emit_jsonl(row)
     return samp_s, row
+
+
+def bench_input_pipeline(platform, dtype):
+    """Host-feed ceiling (SURVEY hard part #4; VERDICT r4 #4): JPEG
+    decode + augment + batch through ImageRecordIter on ImageNet-shaped
+    records, NO model — measures whether the host can out-feed the
+    chip's train rate (target ≥2× config-2's img/s). Pure host work;
+    the `platform` tag records the host context it ran under."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import ImageRecordIter
+
+    del dtype
+    n_img = int(os.environ.get("BENCH_PIPE_IMAGES", "192"))
+    batch = int(os.environ.get("BENCH_PIPE_BATCH", "64"))
+    threads = int(os.environ.get("BENCH_PIPE_THREADS",
+                                 str(max(1, (os.cpu_count() or 1)))))
+    epochs = int(os.environ.get("BENCH_PIPE_EPOCHS", "3"))
+
+    tmp = tempfile.mkdtemp(prefix="mxt_pipe_bench_")
+    try:
+        frec, fidx = os.path.join(tmp, "i.rec"), os.path.join(tmp, "i.idx")
+        w = recordio.MXIndexedRecordIO(fidx, frec, "w")
+        rng = np.random.RandomState(0)
+        # piecewise-smooth synthetic photos: JPEG entropy (and therefore
+        # decode cost) in the ballpark of natural images, unlike pure
+        # noise which decodes slow and unlike flat color which is free
+        for i in range(n_img):
+            base = rng.randint(0, 255, (8, 8, 3))
+            img = np.kron(base, np.ones((32, 32, 1)))
+            img = np.clip(img + rng.randint(0, 12, img.shape),
+                          0, 255).astype(np.uint8)  # no uint8 wraparound
+            w.write_idx(i, recordio.pack_img(
+                recordio.IRHeader(0, float(i % 1000), i, 0), img,
+                img_fmt=".jpg", quality=90))
+        w.close()
+
+        it = ImageRecordIter(
+            path_imgrec=frec, path_imgidx=fidx,
+            data_shape=(3, 224, 224), batch_size=batch, shuffle=True,
+            rand_crop=True, rand_mirror=True,
+            preprocess_threads=threads, prefetch_buffer=4)
+        # warm epoch (thread spin-up, page cache), then timed epochs
+        for b in it:
+            pass
+        it.reset()
+        seen = 0
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            for b in it:
+                seen += b.data[0].shape[0]
+            it.reset()
+        dt = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    img_s = seen / dt
+    row = {
+        "config": "input_pipeline_only", "chips": 0, "batch_size": batch,
+        "dtype": "uint8->float32", "preprocess_threads": threads,
+        "host_cores": os.cpu_count(),
+        "images_or_tokens_per_sec_per_chip": round(img_s, 2),
+        "mfu": None, "platform": platform,
+        "flops_per_sample": None,
+        "note": "host-only: decode(224x224 jpeg)+augment+batch, no model",
+    }
+    _emit_jsonl(row)
+    return img_s, row
 
 
 def main():
@@ -531,7 +610,7 @@ def main():
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     configs = os.environ.get(
         "BENCH_CONFIGS",
-        "resnet50,bert,lstm_ptb,wide_deep,lenet").split(",")
+        "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline").split(",")
 
     # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
     metric_info = {
@@ -545,12 +624,15 @@ def main():
                       bench_wide_deep),
         "lenet": ("lenet_mnist_train_throughput", "images/sec/chip",
                   bench_lenet_mnist),
+        "pipeline": ("input_pipeline_throughput", "images/sec/host",
+                     bench_input_pipeline),
     }
     headline = None
     errors = []
     skipped = []
     best_resnet = None
-    for name in ("resnet50", "bert", "lstm_ptb", "wide_deep", "lenet"):
+    for name in ("resnet50", "bert", "lstm_ptb", "wide_deep", "lenet",
+                 "pipeline"):
         if name not in configs:
             continue
         cost = float(os.environ.get("BENCH_COST_%s" % name.upper(),

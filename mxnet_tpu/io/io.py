@@ -564,6 +564,11 @@ class ImageRecordIter(DataIter):
         self.resize = resize
         self.mean = np.array([mean_r, mean_g, mean_b], dtype=np.float32)
         self.std = np.array([std_r, std_g, std_b], dtype=np.float32)
+        # identity normalization is the common case — skip the two
+        # full-crop elementwise passes entirely then
+        self._normalize = bool(np.any(self.mean != 0.0)
+                               or np.any(self.std != 1.0))
+        self._inv_std = (1.0 / self.std).astype(np.float32)
         self.round_batch = round_batch
         self.preprocess_threads = max(1, preprocess_threads)
         self._rng = np.random.RandomState(seed)
@@ -655,8 +660,12 @@ class ImageRecordIter(DataIter):
                 self._prefetcher = None
 
     def _decode_one(self, raw, rng):
+        # stays uint8 through resize/crop/mirror (4-6x less data touched
+        # than converting the full frame to f32 first); the f32 convert +
+        # normalize run once on the crop, and the CHW transpose is
+        # returned as a VIEW — the worker copies it straight into the
+        # preallocated batch buffer (one strided copy, GIL released)
         header, img = self._unpack_img(raw)
-        img = img.astype(np.float32)
         if self.resize > 0:
             img = _resize_short(img, self.resize)
         c, h, w = self.data_shape
@@ -664,12 +673,20 @@ class ImageRecordIter(DataIter):
                     rand=self.rand_crop, rng=rng)
         if self.rand_mirror and rng.rand() < 0.5:
             img = img[:, ::-1, :]
-        img = (img - self.mean) / self.std
-        img = np.transpose(img, (2, 0, 1))  # HWC → CHW
+        img = np.transpose(img, (2, 0, 1))  # HWC → CHW (view)
         label = header.label
         if isinstance(label, np.ndarray) and self.label_width == 1:
             label = float(label[0])
         return img, label
+
+    def _store(self, slot, img):
+        """Write a CHW view into the f32 batch slot: the assignment does
+        transpose-copy AND uint8→f32 cast in one numpy pass; the (rare)
+        non-identity normalization then runs in place on the slot."""
+        slot[...] = img
+        if self._normalize:
+            slot -= self.mean.reshape(-1, 1, 1)
+            slot *= self._inv_std.reshape(-1, 1, 1)
 
     def next(self):
         from ..recordio import MXRecordIO
@@ -697,7 +714,10 @@ class ImageRecordIter(DataIter):
             for j in range(n_main):
                 raws[j] = self._prefetcher.pop()
 
-        results = [None] * len(idxs)
+        # preallocated batch buffer: workers copy their CHW views straight
+        # into it (parallel strided copies, no np.stack pass afterwards)
+        data = np.empty((len(idxs),) + tuple(self.data_shape), np.float32)
+        labels = [None] * len(idxs)
         # per-thread RNG (np.random.RandomState is not thread-safe), seeded
         # from the iterator's stream so a fixed seed stays deterministic
         rng_seeds = self._rng.randint(0, 2 ** 31 - 1,
@@ -730,7 +750,8 @@ class ImageRecordIter(DataIter):
                 for j in range(tid, len(idxs), self.preprocess_threads):
                     raw = raws[j] if raws[j] is not None \
                         else fetch(idxs[j])
-                    results[j] = self._decode_one(raw, rng)
+                    img, labels[j] = self._decode_one(raw, rng)
+                    self._store(data[j], img)
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 errors.append(e)
             finally:
@@ -750,8 +771,7 @@ class ImageRecordIter(DataIter):
             # worker otherwise shows up as an opaque None in np.stack
             raise errors[0]
 
-        data = np.stack([r[0] for r in results])
-        label = np.asarray([r[1] for r in results], dtype=np.float32)
+        label = np.asarray(labels, dtype=np.float32)
         return DataBatch(data=[_nd.array(data)], label=[_nd.array(label)],
                          pad=pad)
 
@@ -765,9 +785,9 @@ def _resize_short(img, size):
         new_h, new_w = size, int(w * size / h)
     else:
         new_h, new_w = int(h * size / w), size
-    pil = Image.fromarray(img.astype(np.uint8))
-    return np.asarray(pil.resize((new_w, new_h), Image.BILINEAR),
-                      dtype=np.float32)
+    pil = Image.fromarray(img if img.dtype == np.uint8
+                          else img.astype(np.uint8))
+    return np.asarray(pil.resize((new_w, new_h), Image.BILINEAR))
 
 
 def _crop(img, th, tw, rand=False, rng=None):
@@ -776,10 +796,11 @@ def _crop(img, th, tw, rand=False, rng=None):
         from PIL import Image
 
         scale = max(th / h, tw / w)
-        pil = Image.fromarray(img.astype(np.uint8))
+        pil = Image.fromarray(img if img.dtype == np.uint8
+                              else img.astype(np.uint8))
         img = np.asarray(
             pil.resize((int(np.ceil(w * scale)), int(np.ceil(h * scale))),
-                       Image.BILINEAR), dtype=np.float32)
+                       Image.BILINEAR))
         h, w = img.shape[:2]
     if rand:
         y = rng.randint(0, h - th + 1)
@@ -848,8 +869,9 @@ class ImageDetRecordIter(ImageRecordIter):
         # warp-resize straight to (w, h): the ONLY reshaping that keeps
         # normalized box coords valid (any crop would shift them)
         img = np.asarray(
-            Image.fromarray(img.astype(np.uint8)).resize(
-                (w, h), Image.BILINEAR), dtype=np.float32)
+            Image.fromarray(img if img.dtype == np.uint8
+                            else img.astype(np.uint8)).resize(
+                (w, h), Image.BILINEAR))
         lab = np.array(np.atleast_1d(np.asarray(header.label)),
                        dtype=np.float32)
         if self.rand_mirror and rng.rand() < 0.5:
@@ -864,8 +886,7 @@ class ImageDetRecordIter(ImageRecordIter):
                 base = hdr_w + i * obj_w
                 xmin, xmax = lab[base + 1], lab[base + 3]
                 lab[base + 1], lab[base + 3] = 1.0 - xmax, 1.0 - xmin
-        img = (img - self.mean) / self.std
-        img = np.transpose(img, (2, 0, 1))
+        img = np.transpose(img, (2, 0, 1))  # view; _store casts+normalizes
         if lab.size < self.label_pad_width:
             lab = np.concatenate([
                 lab, np.full(self.label_pad_width - lab.size,
