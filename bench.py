@@ -519,7 +519,7 @@ def bench_wide_deep(platform, dtype):
     # the device-side metric that matters is embedding traffic: per
     # sample, each id costs a gather (fwd) + scatter-add (bwd) row of
     # embed_dim (deep) / 1 (wide logistic weights), f32 on both passes.
-    esize = np.dtype("float32").itemsize
+    esize = 2 if dtype == "bfloat16" else 4  # net.cast covers the tables
     emb_bytes_per_sample = 2 * esize * (n_wide * 1 + n_deep * 16)
     row = {
         "config": "wide_deep_train", "chips": 1, "batch_size": batch,
@@ -576,7 +576,7 @@ def bench_input_pipeline(platform, dtype):
             path_imgrec=frec, path_imgidx=fidx,
             data_shape=(3, 224, 224), batch_size=batch, shuffle=True,
             rand_crop=True, rand_mirror=True,
-            preprocess_threads=threads, prefetch_buffer=4)
+            preprocess_threads=threads)
         # warm epoch (thread spin-up, page cache), then timed epochs
         for b in it:
             pass

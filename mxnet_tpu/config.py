@@ -78,6 +78,12 @@ _declare("MXT_KVSTORE_SECRET", str, None,
          "any non-loopback server bind; see async_server.py threat "
          "model.")
 
+_declare("MXT_BN_PALLAS", bool, False,
+         "Use the fused Pallas BatchNorm backward on channel-last "
+         "activations (ops/bn_pallas.py): both reductions in one joint "
+         "read of (x, dy). Default off until chip-measured vs the XLA "
+         "custom-VJP path (the A/B is staged in the recovery runbook).")
+
 _declare("MXT_AG_LEAN_TAPE", bool, False,
          "Skip storing per-node replay state (forward fn + primal "
          "inputs) on the autograd tape. Saves peak memory on very long "

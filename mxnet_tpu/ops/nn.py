@@ -312,6 +312,14 @@ def _bn_core_bwd(eps, red, res, cts):
     n = 1
     for i in red:
         n *= x.shape[i]
+    if ax == x.ndim - 1:  # channel-last (NHWC): the Pallas fast path
+        from . import bn_pallas
+        if bn_pallas.enabled():
+            c = x.shape[ax]
+            dx2, dg, db = bn_pallas.bn_bwd_pallas(
+                x.reshape(-1, c), ct_out.reshape(-1, c), mean, inv, g)
+            return (dx2.reshape(x.shape), dg.astype(g.dtype),
+                    db.astype(g.dtype))
     dy = ct_out.astype(jnp.float32)
     xhat = (x.astype(jnp.float32) - mean.reshape(shape)) * inv.reshape(shape)
     db = jnp.sum(dy, axis=red)

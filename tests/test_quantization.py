@@ -110,12 +110,13 @@ def _lenet_symbol():
     return sym.SoftmaxOutput(fc2, name="softmax")
 
 
-def _proto_dataset(n, img=12, classes=4, noise=0.3):
+def _proto_dataset(n, img=12, classes=4, noise=0.3, seed=42):
     """Learnable synthetic task: smooth, mutually-orthogonal per-class
     prototypes + noise (orthogonality guarantees separability, so the
     fp32 baseline trains to confident margins — without that, int8
     rounding collapses near-ties and the accuracy delta measures the
-    task's noise, not the quantizer)."""
+    task's noise, not the quantizer). Own RandomState: sharing the
+    module-level RS made the data depend on test execution order."""
     coarse = np.linalg.qr(np.random.RandomState(0).randn(9, 9))[0][:classes]
     protos = []
     for c in range(classes):
@@ -123,8 +124,9 @@ def _proto_dataset(n, img=12, classes=4, noise=0.3):
                      np.ones((img // 3 + 1, img // 3 + 1)))
         protos.append(up[:img, :img])
     protos = np.stack(protos)
-    y = RS.randint(0, classes, n)
-    x = protos[y] + noise * RS.randn(n, img, img)
+    r = np.random.RandomState(seed + n)
+    y = r.randint(0, classes, n)
+    x = protos[y] + noise * r.randn(n, img, img)
     return x[:, None].astype(np.float32), y.astype(np.float32)
 
 

@@ -45,6 +45,11 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
         BENCH_BUDGET=800 python bench.py
     run resnet_remat_dots 900 env BENCH_CONFIGS=resnet50 \
         BENCH_REMAT=dots_saveable BENCH_BUDGET=800 python bench.py
+    # BN Pallas A/B (r5: fused BN backward, ops/bn_pallas.py)
+    run resnet_bnpallas 900 env BENCH_CONFIGS=resnet50 MXT_BN_PALLAS=1 \
+        BENCH_BUDGET=800 python bench.py
+    run resnet_bnpallas_b256 900 env BENCH_CONFIGS=resnet50 \
+        MXT_BN_PALLAS=1 BENCH_BATCH=256 BENCH_BUDGET=800 python bench.py
     # 3) LSTM batch sweep + wavefront A/B (VERDICT #3)
     run lstm128 600 env BENCH_CONFIGS=lstm_ptb BENCH_LSTM_BATCH=128 \
         BENCH_BUDGET=500 python bench.py
