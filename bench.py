@@ -518,7 +518,8 @@ def bench_wide_deep(platform, dtype):
     # MFU is near-meaningless for this config (tiny gemms, lookup-bound);
     # the device-side metric that matters is embedding traffic: per
     # sample, each id costs a gather (fwd) + scatter-add (bwd) row of
-    # embed_dim (deep) / 1 (wide logistic weights), f32 on both passes.
+    # embed_dim (deep) / 1 (wide logistic weights), at the table dtype
+    # (bf16 after net.cast, else f32).
     esize = 2 if dtype == "bfloat16" else 4  # net.cast covers the tables
     emb_bytes_per_sample = 2 * esize * (n_wide * 1 + n_deep * 16)
     row = {

@@ -26,12 +26,14 @@ LeNet/ResNet stays within ~1pt of its fp32 accuracy.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ..base import MXNetError
 from ..symbol.symbol import Symbol, _Node, Group
 
-__all__ = ["quantize_model", "quantize_graph"]
+__all__ = ["quantize_model", "quantize_graph", "quantize_net"]
 
 _QUANTIZABLE = ("Convolution", "FullyConnected")
 _PASSTHROUGH = ("Pooling", "Flatten", "flatten")
@@ -371,3 +373,50 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
         excluded_op_names=excluded_op_names,
         stats=stats, quantized_dtype=quantized_dtype)
     return qsym, qarg, dict(aux_params)
+
+
+def quantize_net(network, quantized_dtype="int8", quantize_mode="smart",
+                 exclude_layers=(), exclude_operators=(),
+                 calib_data=None, calib_mode="naive", data_shapes=None,
+                 num_calib_examples=None, ctx=None, logger=None,
+                 tmpdir=None):
+    """Quantize a trained Gluon (Hybrid)Block to an int8 SymbolBlock
+    (ref: contrib/quantization.py — quantize_net_v2): export the block
+    to symbol+params, run quantize_model, and import the quantized pair
+    back as a SymbolBlock for inference.
+
+    ``data_shapes`` is accepted for reference signature parity but
+    unused: the reference needed it to bind before rewriting, while this
+    rewrite is shape-free and calib_mode='none' needs no binding at all.
+    """
+    del data_shapes
+    import shutil
+    import tempfile
+
+    from ..gluon import SymbolBlock
+    from ..model import load_checkpoint
+
+    d = tmpdir or tempfile.mkdtemp(prefix="mxt_qnet_")
+    own_tmp = tmpdir is None
+    try:
+        prefix = os.path.join(d, "net")
+        network.export(prefix, 0)
+        symbol, arg, aux = load_checkpoint(prefix, 0)
+        qsym, qarg, qaux = quantize_model(
+            symbol, arg, aux, ctx=ctx,
+            excluded_sym_names=exclude_layers,
+            excluded_op_names=exclude_operators,
+            calib_mode=calib_mode, calib_data=calib_data,
+            num_calib_examples=num_calib_examples,
+            quantized_dtype=quantized_dtype, quantize_mode=quantize_mode,
+            logger=logger)
+        qprefix = os.path.join(d, "qnet")
+        from ..model import save_checkpoint
+        save_checkpoint(qprefix, 0, qsym, qarg, qaux)
+        data_names = ["data"]  # exported gluon blocks use the data convention
+        return SymbolBlock.imports(qprefix + "-symbol.json", data_names,
+                                   qprefix + "-0000.params")
+    finally:
+        if own_tmp:
+            shutil.rmtree(d, ignore_errors=True)
+

@@ -35,12 +35,19 @@ class SymbolBlock(HybridBlock):
         self._sb_param_names = [n for n in arg_names
                                 if n not in self._input_names]
         self._sb_aux_names = list(aux_names)
+        # honor declared var dtypes (sym.var(dtype=...)): a quantized
+        # graph's int8 weights must not round-trip through f32 params
+        declared_dt = {n.name: n.attrs["__dtype__"]
+                       for n in outputs._topo_nodes()
+                       if n.is_var() and "__dtype__" in n.attrs}
         for n in self._sb_param_names:
-            p = self.params.get(n, allow_deferred_init=True)
+            kw = {"dtype": declared_dt[n]} if n in declared_dt else {}
+            p = self.params.get(n, allow_deferred_init=True, **kw)
             self._reg_params[n] = p
         for n in self._sb_aux_names:
+            kw = {"dtype": declared_dt[n]} if n in declared_dt else {}
             p = self.params.get(n, grad_req="null",
-                                allow_deferred_init=True)
+                                allow_deferred_init=True, **kw)
             self._reg_params[n] = p
         self._eval_cache = {}
 

@@ -42,15 +42,18 @@ register("min", aliases=("min_axis",))(_mk_reduce(jnp.min))
 
 
 @register("argmax", differentiable=False)
-def argmax(a, axis=None, keepdims=False):
+def argmax(a, axis=None, keepdims=False, dtype=None):
     out = jnp.argmax(a, axis=axis, keepdims=bool(keepdims))
-    return out.astype(jnp.float32)  # reference returns real dtype indices
+    # reference default returns real-dtype indices; f32 is exact only to
+    # 2^24, so large-tensor users pass dtype='int64' (the same escape
+    # hatch the reference grew for its large-tensor support)
+    return out.astype(jnp.dtype(dtype) if dtype else jnp.float32)
 
 
 @register("argmin", differentiable=False)
-def argmin(a, axis=None, keepdims=False):
+def argmin(a, axis=None, keepdims=False, dtype=None):
     out = jnp.argmin(a, axis=axis, keepdims=bool(keepdims))
-    return out.astype(jnp.float32)
+    return out.astype(jnp.dtype(dtype) if dtype else jnp.float32)
 
 
 @register("argmax_channel", differentiable=False)

@@ -34,6 +34,10 @@ def pytest_configure(config):
         "tpu: hardware smoke test — run with `MXT_TEST_TPU=1 pytest -m tpu` "
         "on a machine with a real TPU (round-2 lesson: interpret-mode-only "
         "Pallas coverage let a hardware-invalid BlockSpec ship)")
+    config.addinivalue_line(
+        "markers",
+        "nightly: slow/large-resource tier (ref: tests/nightly/) — run "
+        "with MXT_TEST_NIGHTLY=1; skipped in the default suite")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -49,6 +53,15 @@ def pytest_collection_modifyitems(config, items):
         return
     skip = pytest.mark.skip(
         reason="TPU lane disabled (set MXT_TEST_TPU=1 and run -m tpu)")
+    skip_nightly = pytest.mark.skip(
+        reason="nightly tier disabled (set MXT_TEST_NIGHTLY=1)")
+    nightly_on = os.environ.get("MXT_TEST_NIGHTLY", "") == "1"
     for item in items:
         if "tpu" in item.keywords:
             item.add_marker(skip)
+        # NB: get_closest_marker, not `in item.keywords` — keywords
+        # include ancestor node names, so the tests/nightly/ DIRECTORY
+        # name would gate unmarked tests living there
+        if item.get_closest_marker("nightly") is not None \
+                and not nightly_on:
+            item.add_marker(skip_nightly)

@@ -1,0 +1,91 @@
+"""Large-tensor tier (ref: tests/nightly/test_large_array.py — the
+reference's >2^31-element lane guarding against int32 index overflow in
+kernels). Run with ``MXT_TEST_NIGHTLY=1`` on a host with ≥16 GB free.
+
+XLA's index arithmetic is 64-bit-safe, but OUR framework code (shape
+math, flattening, recordio offsets, reductions) must be too — these pin
+the paths a 32-bit assumption would break. Arrays are int8/bool where
+possible to keep the footprint ~2-5 GB per test."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+pytestmark = pytest.mark.nightly
+
+LARGE = 2 ** 31 + 7  # one past the int32 boundary
+_mem_kb = 0
+try:
+    with open("/proc/meminfo") as f:
+        for line in f:
+            if line.startswith("MemAvailable"):
+                _mem_kb = int(line.split()[1])
+except OSError:
+    pass
+needs_ram = pytest.mark.skipif(
+    _mem_kb < 16 * 1024 * 1024,
+    reason="needs >=16 GB available RAM for >2^31-element arrays")
+
+
+@needs_ram
+def test_create_and_reduce_past_int32_elements():
+    x = nd.ones((LARGE,), dtype="int8")
+    assert x.size == LARGE
+    # int8 accumulation would wrap; widen first (the reduction itself is
+    # what must traverse >2^31 elements without 32-bit index overflow)
+    total = int(x.astype("int64").sum().asscalar())
+    assert total == LARGE
+
+
+@needs_ram
+def test_indexing_past_int32_boundary():
+    x = nd.zeros((LARGE,), dtype="int8")
+    x[LARGE - 1] = 7
+    x[2 ** 31 + 1] = 3
+    assert int(x[LARGE - 1].asscalar()) == 7
+    assert int(x[2 ** 31 + 1].asscalar()) == 3
+    assert int(x[0].asscalar()) == 0
+
+
+@needs_ram
+def test_reshape_and_slice_2d_large():
+    rows = 2 ** 16 + 1
+    cols = 2 ** 15 + 1  # rows*cols > 2^31
+    x = nd.ones((rows, cols), dtype="int8")
+    flat = x.reshape((-1,))
+    assert flat.shape == (rows * cols,)
+    tail = x[rows - 1, cols - 3:]
+    np.testing.assert_array_equal(tail.asnumpy(), np.ones(3, np.int8))
+
+
+@needs_ram
+def test_argmax_lands_past_int32():
+    x = nd.zeros((LARGE,), dtype="int8")
+    x[2 ** 31 + 3] = 1
+    # default f32 indices are exact only to 2^24 (reference parity) —
+    # the large-tensor escape hatch is dtype='int64'
+    idx = int(nd.argmax(x, axis=0, dtype="int64").asscalar())
+    assert idx == 2 ** 31 + 3
+
+
+@needs_ram
+def test_take_with_int64_indices():
+    x = nd.arange(0, 2 ** 8).astype("int8")
+    big = nd.ones((LARGE,), dtype="int8")
+    # gather FROM a large array with indices beyond 2^31
+    got = nd.take(big, nd.array(np.array([0, 2 ** 31 + 5, LARGE - 1],
+                                         np.int64)))
+    np.testing.assert_array_equal(got.asnumpy(), np.ones(3, np.int8))
+    del x
+
+
+def test_shape_size_arithmetic_is_64bit():
+    """Pure shape math (no allocation): size/infer paths must not wrap."""
+    from mxnet_tpu import symbol as sym
+    s = sym.Variable("data", shape=(2 ** 20, 2 ** 12))
+    out = sym.Reshape(s, shape=(-1,))
+    _, out_shapes, _ = out.infer_shape(data=(2 ** 20, 2 ** 12))
+    assert out_shapes[0] == (2 ** 32,)

@@ -1,0 +1,65 @@
+"""Model backwards compatibility (ref: tests/nightly/
+model_backwards_compatibility_check/ — checkpoints written by OLDER
+builds must keep loading and producing identical outputs).
+
+Golden fixtures live in tests/fixtures/backcompat_r5/ (committed, never
+regenerated): a round-5 binary checkpoint pair, a pre-r5 npz-era params
+file, and the pinned input/output. Cheap enough to run in the default
+suite — intentionally NOT nightly-gated, so a format regression fails CI
+immediately."""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+FIX = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "fixtures", "backcompat_r5")
+PFX = os.path.join(FIX, "mlp")
+
+
+def _pinned_io():
+    z = np.load(os.path.join(FIX, "io.npz"))
+    return z["x"], z["y"]
+
+
+def test_r5_binary_checkpoint_loads_and_matches():
+    X, want = _pinned_io()
+    symbol, arg, aux = mx.model.load_checkpoint(PFX, 0)
+    mod = mx.module.Module(symbol, data_names=["data"], label_names=None)
+    mod.bind(data_shapes=[("data", X.shape)], for_training=False)
+    mod.set_params(arg, aux)
+    mod.forward(mx.io.DataBatch(data=[nd.array(X)], label=None),
+                is_train=False)
+    got = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_r5_checkpoint_loads_through_symbolblock():
+    X, want = _pinned_io()
+    from mxnet_tpu.gluon import SymbolBlock
+    # the graph ends in SoftmaxOutput, so the label is an input of the
+    # imported block (reference convention: list it in input_names and
+    # feed a dummy at inference — SoftmaxOutput ignores it)
+    blk = SymbolBlock.imports(PFX + "-symbol.json",
+                              ["data", "softmax_label"],
+                              PFX + "-0000.params")
+    got = blk(nd.array(X), nd.zeros((X.shape[0],))).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_pre_r5_npz_era_params_still_load():
+    """Params written by rounds 1-4 (npz byte format) keep loading."""
+    X, want = _pinned_io()
+    loaded = nd.load(os.path.join(FIX, "mlp-npz-era.params"))
+    from mxnet_tpu.model import unpack_param_dict
+    arg, aux = unpack_param_dict(loaded)
+    symbol = mx.symbol.load(PFX + "-symbol.json")
+    mod = mx.module.Module(symbol, data_names=["data"], label_names=None)
+    mod.bind(data_shapes=[("data", X.shape)], for_training=False)
+    mod.set_params(arg, aux)
+    mod.forward(mx.io.DataBatch(data=[nd.array(X)], label=None),
+                is_train=False)
+    got = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
