@@ -1,0 +1,113 @@
+"""Post-training int8 quantization of a trained LeNet (ref:
+example/quantization/imagenet_gen_qsym_onedrive.py, shrunk to a
+synthetic task): train fp32 with Module.fit, calibrate + quantize with
+mx.contrib.quantization.quantize_model, compare accuracies, and save the
+deployable int8 pair (prefix-symbol.json + prefix-0000.params — the
+reference binary format, loadable by Module or SymbolBlock).
+
+Run:  python examples/quantize_lenet.py --epochs 3 --calib-mode naive
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def lenet_symbol(classes):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                             pad=(1, 1), name="conv1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max", name="pool1")
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=16,
+                             pad=(1, 1), name="conv2")
+    net = mx.sym.Activation(net, act_type="relu", name="relu2")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max", name="pool2")
+    net = mx.sym.Flatten(net, name="flat")
+    net = mx.sym.FullyConnected(net, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu3")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def synth_data(n, img=12, classes=4, noise=0.3, seed=0):
+    """Orthogonal smooth prototypes + noise — learnable and separable."""
+    coarse = np.linalg.qr(np.random.RandomState(0).randn(9, 9))[0][:classes]
+    protos = np.stack([
+        np.kron(c.reshape(3, 3) * 3.0,
+                np.ones((img // 3 + 1, img // 3 + 1)))[:img, :img]
+        for c in coarse])
+    r = np.random.RandomState(seed)
+    y = r.randint(0, classes, n)
+    x = protos[y] + noise * r.randn(n, img, img)
+    return x[:, None].astype(np.float32), y.astype(np.float32)
+
+
+def accuracy(symbol, arg, aux, X, y, batch):
+    batch = min(batch, len(X))  # whole-set batches still evaluate
+    mod = mx.module.Module(symbol, data_names=["data"], label_names=None)
+    mod.bind(data_shapes=[("data", (batch,) + X.shape[1:])],
+             for_training=False)
+    mod.set_params(arg, aux)
+    hit = tot = 0
+    for i in range(0, len(X) - batch + 1, batch):
+        mod.forward(mx.io.DataBatch(
+            data=[mx.nd.array(X[i:i + batch])], label=None),
+            is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
+        hit += int((pred == y[i:i + batch]).sum())
+        tot += batch
+    return hit / tot
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--train-size", type=int, default=768)
+    ap.add_argument("--calib-mode", default="naive",
+                    choices=["none", "naive", "entropy"])
+    ap.add_argument("--out-prefix", default="lenet_int8")
+    args = ap.parse_args()
+
+    mx.random.seed(7)
+    Xt, yt = synth_data(args.train_size, seed=1)
+    Xv, yv = synth_data(512, seed=2)
+    train = mx.io.NDArrayIter(Xt, yt, batch_size=args.batch_size,
+                              shuffle=True, label_name="softmax_label")
+
+    mod = mx.module.Module(lenet_symbol(4), data_names=["data"],
+                           label_names=["softmax_label"])
+    mod.fit(train, num_epoch=args.epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 2e-3}, eval_metric="acc")
+    arg, aux = mod.get_params()
+    symbol = mod._symbol
+
+    calib = mx.io.NDArrayIter(Xv[:256], yv[:256],
+                              batch_size=args.batch_size,
+                              label_name="softmax_label")
+    qsym, qarg, qaux = mx.contrib.quantization.quantize_model(
+        symbol, arg, aux, calib_mode=args.calib_mode,
+        calib_data=None if args.calib_mode == "none" else calib,
+        num_calib_examples=256)
+
+    acc_f = accuracy(symbol, arg, aux, Xv, yv, args.batch_size)
+    acc_q = accuracy(qsym, qarg, qaux, Xv, yv, args.batch_size)
+    print("fp32 val acc %.4f" % acc_f)
+    print("int8 val acc %.4f (calib=%s, delta %.4f)"
+          % (acc_q, args.calib_mode, acc_f - acc_q))
+
+    mx.model.save_checkpoint(args.out_prefix, 0, qsym, qarg, qaux)
+    print("saved %s-symbol.json + %s-0000.params (int8, reference "
+          "binary format)" % (args.out_prefix, args.out_prefix))
+
+
+if __name__ == "__main__":
+    main()

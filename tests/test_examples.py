@@ -29,6 +29,8 @@ EXAMPLES = {
         "--iters", "2", "--batch-size", "8", "--seq-len", "16",
         "--tp", "2"],
     "examples/train_ssd_toy.py": ["--iters", "4", "--batch-size", "8"],
+    "examples/quantize_lenet.py": ["--epochs", "1", "--train-size",
+                                   "192", "--calib-mode", "naive"],
     "examples/long_context_gpt.py": [
         "--devices", "4", "--seq-len", "64", "--steps", "1",
         "--batch-size", "1"],
