@@ -88,7 +88,7 @@ def main():
     mod.fit(train, num_epoch=args.epochs, optimizer="adam",
             optimizer_params={"learning_rate": 2e-3}, eval_metric="acc")
     arg, aux = mod.get_params()
-    symbol = mod._symbol
+    symbol = mod.symbol
 
     calib = mx.io.NDArrayIter(Xv[:256], yv[:256],
                               batch_size=args.batch_size,

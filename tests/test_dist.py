@@ -38,12 +38,22 @@ def test_launcher_cli_errors():
     assert "no command" in r.stderr
 
 
-@pytest.mark.parametrize("n", [2, 4])
-def test_dist_async_kvstore_hogwild(n):
+@pytest.mark.parametrize("n,secret", [
+    (2, None),
+    (4, None),
+    # MXT_KVSTORE_SECRET set: the launcher forwards the secret to every
+    # worker and frames are HMAC'd (nonce|dir|seq) — trust-boundary
+    # hardening, round 5
+    (4, "dist-test-secret"),
+])
+def test_dist_async_kvstore_hogwild(n, secret):
     """dist_async under the launcher engages the REAL parameter-server
     thread (async_server.py): pushes apply on arrival with no barrier."""
     env = dict(os.environ)
     env.pop("MXT_COORDINATOR", None)
+    env.pop("MXT_KVSTORE_SECRET", None)
+    if secret is not None:
+        env["MXT_KVSTORE_SECRET"] = secret
     r = subprocess.run(
         [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
          "-n", str(n), "--launcher", "local", sys.executable,

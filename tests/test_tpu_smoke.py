@@ -580,3 +580,37 @@ def test_bn_pallas_backward_hardware():
     assert _maxerr(db, odb) < 1.0          # f32 sums over 25k rows
     assert _maxerr(dg, odg) < 1.0
     assert _maxerr(dx, odx) < 0.05         # bf16 elementwise
+
+
+def test_quantized_conv_fc_hardware():
+    """s8xs8->s32 conv + matmul on the MXU (ops/quantization.py): the
+    int8 path must lower and match the f32 reference on chip."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.randn(8, 16, 28, 28).astype(np.float32))
+    W = rs.randn(32, 16, 3, 3).astype(np.float32)
+    b = rs.randn(32).astype(np.float32)
+    qx, xmn, xmx = nd.contrib.quantize_v2(x)
+    qw, wmn, wmx = nd.contrib.quantize_v2(nd.array(W))
+    acc, omn, omx = nd.contrib.quantized_conv(
+        qx, qw, nd.array(b), xmn, xmx, wmn, wmx,
+        kernel=(3, 3), num_filter=32, pad=(1, 1))
+    assert acc.dtype == np.int32
+    out = nd.contrib.dequantize(acc, omn, omx).asnumpy()
+    ref = nd.Convolution(x, nd.array(W), nd.array(b), kernel=(3, 3),
+                         num_filter=32, pad=(1, 1)).asnumpy()
+    denom = np.abs(ref).max()
+    assert np.abs(out - ref).max() / denom < 0.05, \
+        np.abs(out - ref).max() / denom
+
+    xf = nd.array(rs.randn(64, 256).astype(np.float32))
+    Wf = rs.randn(128, 256).astype(np.float32)
+    qxf, fmn, fmx = nd.contrib.quantize_v2(xf)
+    qwf, gmn, gmx = nd.contrib.quantize_v2(nd.array(Wf))
+    accf, fomn, fomx = nd.contrib.quantized_fully_connected(
+        qxf, qwf, None, fmn, fmx, gmn, gmx, num_hidden=128, no_bias=True)
+    outf = nd.contrib.dequantize(accf, fomn, fomx).asnumpy()
+    reff = xf.asnumpy() @ Wf.T
+    assert np.abs(outf - reff).max() / np.abs(reff).max() < 0.05
