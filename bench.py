@@ -103,7 +103,8 @@ def _init_backend():
     last = ""
     for i in range(tries):
         if _remaining() < timeout + 60:
-            last = "budget exhausted before attempt %d" % (i + 1)
+            last = ("budget exhausted before attempt %d; last error: %s"
+                    % (i + 1, last or "none"))
             tries = i
             break
         ok, last = _probe_axon(timeout)

@@ -54,7 +54,7 @@ def main():
     it = mx.io.ImageRecordIter(
         path_imgrec=args.rec, data_shape=shape,
         batch_size=args.batch_size, shuffle=True, rand_mirror=True,
-        preprocess_threads=4)
+        preprocess_threads=4, layout="NHWC")  # feed MXU-native batches
 
     mx.random.seed(0)
     # channels-last is the MXU-native layout
@@ -75,8 +75,8 @@ def main():
     for epoch in range(100):
         it.reset()
         for batch in it:
-            # device-side layout flip, fuses into the step
-            x = batch.data[0].astype(args.dtype).transpose((0, 2, 3, 1))
+            # iterator already emits NHWC — no layout flip anywhere
+            x = batch.data[0].astype(args.dtype)
             loss = step(x, batch.label[0])
             n += 1
             speedo(mx.model.BatchEndParam(epoch=epoch, nbatch=n,

@@ -551,11 +551,21 @@ class ImageRecordIter(DataIter):
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, resize=-1, label_width=1,
                  preprocess_threads=4, round_batch=True, seed=0,
-                 part_index=0, num_parts=1, **kwargs):
+                 part_index=0, num_parts=1, layout="NCHW", **kwargs):
         super().__init__(batch_size)
         from ..recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
 
         self._unpack_img = unpack_img
+        # TPU extension beyond the reference: layout="NHWC" emits
+        # channels-last batches directly — the worker's slot write
+        # becomes a contiguous memcpy (no CHW strided transpose) and an
+        # NHWC model (nn.layout_scope) consumes it without a device-side
+        # transpose. data_shape stays (C, H, W) in BOTH layouts, like
+        # the reference API.
+        if layout not in ("NCHW", "NHWC"):
+            raise MXNetError("ImageRecordIter layout must be NCHW or "
+                             "NHWC, got %r" % (layout,))
+        self.layout = layout
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle
@@ -629,7 +639,10 @@ class ImageRecordIter(DataIter):
 
     @property
     def provide_data(self):
-        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+        c, h, w = self.data_shape
+        shape = (self.batch_size, c, h, w) if self.layout == "NCHW" \
+            else (self.batch_size, h, w, c)
+        return [DataDesc("data", shape, layout=self.layout)]
 
     @property
     def provide_label(self):
@@ -661,10 +674,9 @@ class ImageRecordIter(DataIter):
 
     def _decode_one(self, raw, rng):
         # stays uint8 through resize/crop/mirror (4-6x less data touched
-        # than converting the full frame to f32 first); the f32 convert +
-        # normalize run once on the crop, and the CHW transpose is
-        # returned as a VIEW — the worker copies it straight into the
-        # preallocated batch buffer (one strided copy, GIL released)
+        # than converting the full frame to f32 first); returns the HWC
+        # crop as-is — _store does layout + f32 cast + normalize in one
+        # numpy pass straight into the preallocated batch buffer
         header, img = self._unpack_img(raw)
         if self.resize > 0:
             img = _resize_short(img, self.resize)
@@ -673,20 +685,26 @@ class ImageRecordIter(DataIter):
                     rand=self.rand_crop, rng=rng)
         if self.rand_mirror and rng.rand() < 0.5:
             img = img[:, ::-1, :]
-        img = np.transpose(img, (2, 0, 1))  # HWC → CHW (view)
         label = header.label
         if isinstance(label, np.ndarray) and self.label_width == 1:
             label = float(label[0])
-        return img, label
+        return img, label  # HWC; _store handles layout/cast/normalize
 
     def _store(self, slot, img):
-        """Write a CHW view into the f32 batch slot: the assignment does
-        transpose-copy AND uint8→f32 cast in one numpy pass; the (rare)
-        non-identity normalization then runs in place on the slot."""
-        slot[...] = img
-        if self._normalize:
-            slot -= self.mean.reshape(-1, 1, 1)
-            slot *= self._inv_std.reshape(-1, 1, 1)
+        """Write an HWC image into the f32 batch slot: the assignment
+        does layout-copy AND uint8→f32 cast in one numpy pass (for NHWC
+        it is a plain contiguous memcpy+cast); the (rare) non-identity
+        normalization then runs in place on the slot."""
+        if self.layout == "NCHW":
+            slot[...] = np.transpose(img, (2, 0, 1))
+            if self._normalize:
+                slot -= self.mean.reshape(-1, 1, 1)
+                slot *= self._inv_std.reshape(-1, 1, 1)
+        else:
+            slot[...] = img
+            if self._normalize:
+                slot -= self.mean
+                slot *= self._inv_std
 
     def next(self):
         from ..recordio import MXRecordIO
@@ -714,9 +732,11 @@ class ImageRecordIter(DataIter):
             for j in range(n_main):
                 raws[j] = self._prefetcher.pop()
 
-        # preallocated batch buffer: workers copy their CHW views straight
-        # into it (parallel strided copies, no np.stack pass afterwards)
-        data = np.empty((len(idxs),) + tuple(self.data_shape), np.float32)
+        # preallocated batch buffer (layout per provide_data): workers
+        # _store their HWC crops straight into it (parallel copies, no
+        # np.stack pass afterwards)
+        data = np.empty((len(idxs),) + self.provide_data[0].shape[1:],
+                        np.float32)
         labels = [None] * len(idxs)
         # per-thread RNG (np.random.RandomState is not thread-safe), seeded
         # from the iterator's stream so a fixed seed stays deterministic
@@ -886,7 +906,7 @@ class ImageDetRecordIter(ImageRecordIter):
                 base = hdr_w + i * obj_w
                 xmin, xmax = lab[base + 1], lab[base + 3]
                 lab[base + 1], lab[base + 3] = 1.0 - xmax, 1.0 - xmin
-        img = np.transpose(img, (2, 0, 1))  # view; _store casts+normalizes
+        # HWC out; _store handles layout/cast/normalize
         if lab.size < self.label_pad_width:
             lab = np.concatenate([
                 lab, np.full(self.label_pad_width - lab.size,

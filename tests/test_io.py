@@ -566,3 +566,26 @@ def test_process_workers_beat_threads_on_gil_heavy_transform():
     t_thread = min(run(True), run(True))
     t_proc = min(run(False), run(False))
     assert t_proc < t_thread * 1.1, (t_proc, t_thread)
+
+
+def test_image_record_iter_nhwc_layout(tmp_path):
+    """layout='NHWC' (TPU extension): channels-last batches, pixel-equal
+    to the NCHW path transposed."""
+    frec, fidx = _make_rec(tmp_path)
+    common = dict(path_imgrec=frec, path_imgidx=fidx,
+                  data_shape=(3, 16, 16), batch_size=4, shuffle=False,
+                  mean_r=10.0, std_r=2.0,  # exercise normalization too
+                  preprocess_threads=2)
+    nchw = list(ImageRecordIter(**common))
+    nhwc = list(ImageRecordIter(layout="NHWC", **common))
+    it = ImageRecordIter(layout="NHWC", **common)
+    assert it.provide_data[0].shape == (4, 16, 16, 3)
+    assert it.provide_data[0].layout == "NHWC"
+    for a, b in zip(nchw, nhwc):
+        np.testing.assert_array_equal(
+            a.data[0].asnumpy().transpose(0, 2, 3, 1),
+            b.data[0].asnumpy())
+        np.testing.assert_array_equal(a.label[0].asnumpy(),
+                                      b.label[0].asnumpy())
+    with pytest.raises(Exception):
+        ImageRecordIter(layout="NCWH", **common)
