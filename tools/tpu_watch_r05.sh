@@ -62,6 +62,11 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # 4) BERT through the canonical fused Trainer loop (VERDICT #4)
     run bert_gluon 900 env BENCH_CONFIGS=bert BENCH_BERT_PATH=trainer \
         BENCH_BUDGET=800 python bench.py
+    # BERT batch/seq levers (r5: MFU push past the 0.36 r3 row)
+    run bert_b64 900 env BENCH_CONFIGS=bert BENCH_BERT_BATCH=64 \
+        BENCH_BUDGET=800 python bench.py
+    run bert_b64_s256 900 env BENCH_CONFIGS=bert BENCH_BERT_BATCH=64 \
+        BENCH_BERT_SEQLEN=256 BENCH_BUDGET=800 python bench.py
     # 5) fresh hardware-lane log (validates post-crash health; artifact)
     MXT_TEST_TPU=1 timeout 1800 python -m pytest -m tpu -q \
         2>&1 | tee TPU_LANE_r05_post.txt >> "$LOG"
