@@ -1,11 +1,12 @@
 """Data iterators (ref: python/mxnet/io/__init__.py)."""
 from .io import (
     DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
-    CSVIter, MNISTIter, ImageRecordIter, ImageDetRecordIter,
+    CSVIter, MNISTIter, ImageRecordIter, ImageRecordUInt8Iter,
+    ImageDetRecordIter,
     LibSVMIter,
 )
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
-           "ImageDetRecordIter",
+           "ImageRecordUInt8Iter", "ImageDetRecordIter",
            "LibSVMIter"]

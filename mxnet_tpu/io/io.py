@@ -20,7 +20,8 @@ from ..ndarray.ndarray import NDArray
 from ..ndarray import ndarray as _nd
 
 __all__ = ["LibSVMIter", "DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "ImageRecordUInt8Iter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -566,6 +567,13 @@ class ImageRecordIter(DataIter):
             raise MXNetError("ImageRecordIter layout must be NCHW or "
                              "NHWC, got %r" % (layout,))
         self.layout = layout
+        # dtype="uint8" → reference ImageRecordUInt8Iter semantics: raw
+        # pixel batches (4× fewer host→device bytes; the model casts and
+        # normalizes on device where it fuses into the first conv)
+        self.dtype = np.dtype(kwargs.pop("dtype", "float32"))
+        if self.dtype not in (np.dtype("float32"), np.dtype("uint8")):
+            raise MXNetError("ImageRecordIter dtype must be float32 or "
+                             "uint8, got %s" % self.dtype)
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle
@@ -579,6 +587,11 @@ class ImageRecordIter(DataIter):
         self._normalize = bool(np.any(self.mean != 0.0)
                                or np.any(self.std != 1.0))
         self._inv_std = (1.0 / self.std).astype(np.float32)
+        if self.dtype == np.uint8 and self._normalize:
+            raise MXNetError(
+                "dtype='uint8' emits raw pixels; normalize on device "
+                "instead of passing mean_*/std_* (ref: "
+                "ImageRecordUInt8Iter has no mean/std params)")
         self.round_batch = round_batch
         self.preprocess_threads = max(1, preprocess_threads)
         self._rng = np.random.RandomState(seed)
@@ -642,7 +655,8 @@ class ImageRecordIter(DataIter):
         c, h, w = self.data_shape
         shape = (self.batch_size, c, h, w) if self.layout == "NCHW" \
             else (self.batch_size, h, w, c)
-        return [DataDesc("data", shape, layout=self.layout)]
+        return [DataDesc("data", shape, dtype=self.dtype,
+                         layout=self.layout)]
 
     @property
     def provide_label(self):
@@ -691,10 +705,11 @@ class ImageRecordIter(DataIter):
         return img, label  # HWC; _store handles layout/cast/normalize
 
     def _store(self, slot, img):
-        """Write an HWC image into the f32 batch slot: the assignment
-        does layout-copy AND uint8→f32 cast in one numpy pass (for NHWC
-        it is a plain contiguous memcpy+cast); the (rare) non-identity
-        normalization then runs in place on the slot."""
+        """Write an HWC image into the batch slot (dtype follows
+        self.dtype): the assignment does layout-copy AND any uint8→f32
+        cast in one numpy pass (for NHWC it is a plain contiguous
+        memcpy); the (rare) non-identity normalization then runs in
+        place on the slot — f32 mode only, the uint8 ctor rejects it."""
         if self.layout == "NCHW":
             slot[...] = np.transpose(img, (2, 0, 1))
             if self._normalize:
@@ -732,11 +747,11 @@ class ImageRecordIter(DataIter):
             for j in range(n_main):
                 raws[j] = self._prefetcher.pop()
 
-        # preallocated batch buffer (layout per provide_data): workers
-        # _store their HWC crops straight into it (parallel copies, no
-        # np.stack pass afterwards)
+        # preallocated batch buffer (layout/dtype per provide_data):
+        # workers _store their HWC crops straight into it (parallel
+        # copies, no np.stack pass afterwards)
         data = np.empty((len(idxs),) + self.provide_data[0].shape[1:],
-                        np.float32)
+                        self.dtype)
         labels = [None] * len(idxs)
         # per-thread RNG (np.random.RandomState is not thread-safe), seeded
         # from the iterator's stream so a fixed seed stays deterministic
@@ -829,6 +844,20 @@ def _crop(img, th, tw, rand=False, rng=None):
         y = (h - th) // 2
         x = (w - tw) // 2
     return img[y:y + th, x:x + tw, :]
+
+
+class ImageRecordUInt8Iter(ImageRecordIter):
+    """Raw-pixel record iterator (ref: src/io/iter_image_recordio_2.cc —
+    ImageRecordUInt8Iter registration): uint8 batches, no mean/std —
+    4× fewer host→device bytes; cast+normalize on device, where XLA
+    fuses them into the first conv."""
+
+    def __init__(self, *args, **kwargs):
+        if np.dtype(kwargs.setdefault("dtype", "uint8")) != np.uint8:
+            raise MXNetError(
+                "ImageRecordUInt8Iter emits uint8 by definition; use "
+                "ImageRecordIter for dtype=%r" % (kwargs["dtype"],))
+        super().__init__(*args, **kwargs)
 
 
 class ImageDetRecordIter(ImageRecordIter):

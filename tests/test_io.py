@@ -589,3 +589,37 @@ def test_image_record_iter_nhwc_layout(tmp_path):
                                       b.label[0].asnumpy())
     with pytest.raises(Exception):
         ImageRecordIter(layout="NCWH", **common)
+
+
+def test_image_record_uint8_iter(tmp_path):
+    """ImageRecordUInt8Iter (ref: iter_image_recordio_2.cc uint8
+    registration): raw uint8 batches, device-side normalization."""
+    from mxnet_tpu.io import ImageRecordUInt8Iter
+    frec, fidx = _make_rec(tmp_path)
+    it = ImageRecordUInt8Iter(path_imgrec=frec, path_imgidx=fidx,
+                              data_shape=(3, 16, 16), batch_size=4,
+                              shuffle=False, preprocess_threads=2)
+    b = next(iter(it))
+    assert b.data[0].dtype == np.uint8
+    assert it.provide_data[0].dtype == np.dtype("uint8")
+    # pixel-equal to the f32 path
+    it_f = ImageRecordIter(path_imgrec=frec, path_imgidx=fidx,
+                           data_shape=(3, 16, 16), batch_size=4,
+                           shuffle=False, preprocess_threads=2)
+    bf = next(iter(it_f))
+    np.testing.assert_array_equal(b.data[0].asnumpy().astype(np.float32),
+                                  bf.data[0].asnumpy())
+    # mean/std are a device-side job in uint8 mode
+    with pytest.raises(Exception, match="uint8"):
+        ImageRecordUInt8Iter(path_imgrec=frec, path_imgidx=fidx,
+                             data_shape=(3, 16, 16), batch_size=4,
+                             mean_r=1.0)
+
+
+def test_image_record_uint8_iter_rejects_conflicting_dtype(tmp_path):
+    from mxnet_tpu.io import ImageRecordUInt8Iter
+    frec, fidx = _make_rec(tmp_path)
+    with pytest.raises(Exception, match="uint8 by definition"):
+        ImageRecordUInt8Iter(path_imgrec=frec, path_imgidx=fidx,
+                             data_shape=(3, 16, 16), batch_size=4,
+                             dtype="float32")
