@@ -78,6 +78,12 @@ _declare("MXT_KVSTORE_SECRET", str, None,
          "any non-loopback server bind; see async_server.py threat "
          "model.")
 
+_declare("MXT_FLASH_BLOCK_Q", int, 128,
+         "Flash-attention query block rows (read at import; A/B knob "
+         "for the chip runbook).")
+_declare("MXT_FLASH_BLOCK_K", int, 128,
+         "Flash-attention key/value block rows (read at import).")
+
 _declare("MXT_BN_PALLAS", bool, False,
          "Use the fused Pallas BatchNorm backward on channel-last "
          "activations (ops/bn_pallas.py): both reductions in one joint "

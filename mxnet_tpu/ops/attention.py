@@ -22,8 +22,22 @@ import numpy as np
 from ..base import MXNetError
 from .registry import register
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# block sizes are read once at import through the config registry (typed
+# coercion + set_default support): bench/runbook A/Bs override via env
+# in fresh subprocesses, models never retrace
+from .. import config as _config
+
+
+def _block_cfg(name):
+    v = int(_config.get(name))
+    if v < 8 or v % 8:
+        raise MXNetError("%s must be a positive multiple of 8 (TPU "
+                         "sublane), got %d" % (name, v))
+    return v
+
+
+DEFAULT_BLOCK_Q = _block_cfg("MXT_FLASH_BLOCK_Q")
+DEFAULT_BLOCK_K = _block_cfg("MXT_FLASH_BLOCK_K")
 _NEG_INF = -1e30
 _LSE_LANES = 128  # lane-pad for the lse output (TPU (8,128) tiling)
 

@@ -54,6 +54,16 @@ def _detect():
     except Exception:  # noqa: BLE001
         add("FLASH_ATTENTION", False)
     add("SEQUENCE_PARALLEL", True)
+    add("INT8_QUANTIZATION", True)  # contrib.quantization, s8 MXU kernels
+    try:
+        from .ops import bn_pallas
+
+        # enabled(): flag + pallas + TPU backend — the condition under
+        # which the fused BN backward actually runs (same "usable here"
+        # semantics as FLASH_ATTENTION above)
+        add("BN_PALLAS", bn_pallas.enabled())
+    except Exception:  # noqa: BLE001
+        add("BN_PALLAS", False)
     return feats
 
 

@@ -67,6 +67,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
         BENCH_BUDGET=800 python bench.py
     run bert_b64_s256 900 env BENCH_CONFIGS=bert BENCH_BERT_BATCH=64 \
         BENCH_BERT_SEQLEN=256 BENCH_BUDGET=800 python bench.py
+    # block override only bites when seqlen exceeds it (blocks clamp to T)
+    run bert_flash_q256 900 env BENCH_CONFIGS=bert BENCH_BERT_BATCH=64 \
+        BENCH_BERT_SEQLEN=256 MXT_FLASH_BLOCK_Q=256 \
+        MXT_FLASH_BLOCK_K=256 BENCH_BUDGET=800 python bench.py
     # 5) fresh hardware-lane log (validates post-crash health; artifact)
     MXT_TEST_TPU=1 timeout 1800 python -m pytest -m tpu -q \
         2>&1 | tee TPU_LANE_r05_post.txt >> "$LOG"
