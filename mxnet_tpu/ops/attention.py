@@ -22,10 +22,13 @@ import numpy as np
 from ..base import MXNetError
 from .registry import register
 
-# block sizes are read once at import through the config registry (typed
-# coercion + set_default support): bench/runbook A/Bs override via env
-# in fresh subprocesses, models never retrace
+# block sizes come from the config registry (MXT_FLASH_BLOCK_Q/K),
+# read lazily on first kernel use and then cached — a bad value fails
+# the attention call with a typed error instead of breaking package
+# import, and config.set_default works until the first flash dispatch
 from .. import config as _config
+
+_blocks_cache = None
 
 
 def _block_cfg(name):
@@ -36,8 +39,13 @@ def _block_cfg(name):
     return v
 
 
-DEFAULT_BLOCK_Q = _block_cfg("MXT_FLASH_BLOCK_Q")
-DEFAULT_BLOCK_K = _block_cfg("MXT_FLASH_BLOCK_K")
+def default_blocks():
+    """(block_q, block_k) — cached after first use (stable jit keys)."""
+    global _blocks_cache
+    if _blocks_cache is None:
+        _blocks_cache = (_block_cfg("MXT_FLASH_BLOCK_Q"),
+                         _block_cfg("MXT_FLASH_BLOCK_K"))
+    return _blocks_cache
 _NEG_INF = -1e30
 _LSE_LANES = 128  # lane-pad for the lse output (TPU (8,128) tiling)
 
@@ -353,9 +361,9 @@ def _flash_fwd(q, k, v, bias, causal, sm_scale):
     if not _kv_fits_vmem(k):
         out, lse = _attention_scan_fwd(q, k, v, bias, causal, sm_scale)
     elif _use_pallas():
+        bq, bk = default_blocks()
         out, lse = _flash_forward_pallas(
-            q, k, v, bias, causal, sm_scale,
-            DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret=False)
+            q, k, v, bias, causal, sm_scale, bq, bk, interpret=False)
     else:
         out = _attention_reference(q, k, v, bias, causal, sm_scale)
         lse = None
