@@ -459,3 +459,54 @@ def test_sharded_step_zero1_composes_with_tp():
     losses = [float(step(nd.array(x), nd.array(y)).asscalar())
               for _ in range(3)]
     assert losses[-1] < losses[0]
+
+
+@with_seed()
+def test_sharded_step_fsdp_style_param_sharding():
+    """FSDP/ZeRO-3-style: rules shard the PARAMS over the data axis;
+    GSPMD all-gathers at use and keeps grads/updates sharded. Numerics
+    must match the replicated step exactly."""
+    np.random.seed(2)
+    x = np.random.uniform(-1, 1, (16, 4)).astype(np.float32)
+    y = np.random.randint(0, 3, (16,)).astype(np.float32)
+
+    mx.random.seed(11)
+    net_a = _mlp()
+    mx.random.seed(11)
+    net_b = _mlp()
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = parallel.make_mesh(axis_names=("data",))
+
+    step_ref = parallel.ShardedTrainStep(net_a, loss_fn, "adam",
+                                         {"learning_rate": 0.01},
+                                         mesh=mesh)
+    # dense0_weight is (16, 4): dim0 divides the 8-way axis — shard it
+    # over the SAME axis the batch uses. dense1_weight (3, 16) is left
+    # out of the rule ON PURPOSE: rules apply unconditionally (no
+    # divisibility fallback on this path), so a matching rule on an
+    # indivisible dim would error rather than silently replicate
+    rules = parallel.sharding_rule((r"dense0_weight", P("data", None)))
+    step_f = parallel.ShardedTrainStep(net_b, loss_fn, "adam",
+                                       {"learning_rate": 0.01},
+                                       mesh=mesh, rules=rules)
+    w = [p for n, p in sorted(net_b.collect_params().items())
+         if "dense0_weight" in n][0]
+    assert "data" in str(w.data().data.sharding.spec)
+    # each device holds 1/8 of the sharded weight (the FSDP memory win)
+    assert w.data().data.addressable_shards[0].data.shape[0] \
+        == w.shape[0] // 8
+
+    for _ in range(3):
+        la = step_ref(nd.array(x), nd.array(y))
+        lb = step_f(nd.array(x), nd.array(y))
+    assert abs(float(la.asscalar()) - float(lb.asscalar())) < 1e-5
+    for (na, pa), (nb, pb) in zip(sorted(net_a.collect_params().items()),
+                                  sorted(net_b.collect_params().items())):
+        assert_almost_equal(pa.data().asnumpy(), pb.data().asnumpy(),
+                            rtol=1e-4, atol=1e-5)
+    # the sharding must SURVIVE training — output propagation regressions
+    # would otherwise replicate the param after step 1 with identical
+    # numerics, silently losing the memory win this test locks in
+    assert "data" in str(w.data().data.sharding.spec)
+    assert w.data().data.addressable_shards[0].data.shape[0] \
+        == w.shape[0] // 8
