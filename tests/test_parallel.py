@@ -510,3 +510,25 @@ def test_sharded_step_fsdp_style_param_sharding():
     assert "data" in str(w.data().data.sharding.spec)
     assert w.data().data.addressable_shards[0].data.shape[0] \
         == w.shape[0] // 8
+
+
+@with_seed()
+def test_sharded_step_zero1_composes_with_remat():
+    """shard_update and remat both rewrite the step program — together
+    they must still train and keep states sharded."""
+    net = _mlp()
+    mesh = parallel.make_mesh(axis_names=("data",))
+    step = parallel.ShardedTrainStep(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 0.01}, mesh=mesh, remat="full",
+        shard_update=True)
+    assert any(z is not None for z in step._zero_shardings.values())
+    x = np.random.uniform(-1, 1, (16, 4)).astype(np.float32)
+    y = np.random.randint(0, 3, (16,)).astype(np.float32)
+    losses = [float(step(nd.array(x), nd.array(y)).asscalar())
+              for _ in range(4)]
+    assert all(np.isfinite(losses)) and min(losses[1:]) < losses[0]
+    for n in step._train_names:
+        if step._zero_shardings[n] is not None:
+            for s in step._states[n]:
+                assert "data" in str(s.sharding.spec)  # survived updates
