@@ -138,6 +138,11 @@ def _timed_steps(step, x, y, iters, warmup):
     # alone). Real training is pipelined the same way — the reference's
     # async engine never syncs per step either (SURVEY §3.1); the queue
     # stays bounded by iters, which is <= 50 everywhere.
+    # Returns (wall seconds, framework launch dispatches) for the timed
+    # window — the launch count (profiler.launch_count) makes fusion
+    # health visible per row: a fused step is exactly 1/step.
+    from mxnet_tpu import profiler
+
     sync_every = int(os.environ.get("BENCH_SYNC_EVERY", "0"))  # 0 = window end
     if not sync_every and iters > 50:
         sync_every = 50  # bound the un-synced queue (tunnel-wedge guard)
@@ -146,12 +151,21 @@ def _timed_steps(step, x, y, iters, warmup):
         loss = step(x, y)
         loss.wait_to_read()
     t0 = time.perf_counter()
+    l0 = profiler.launch_count()
     for i in range(iters):
         loss = step(x, y)
         if sync_every and (i + 1) % sync_every == 0:
             loss.wait_to_read()
     loss.wait_to_read()
-    return time.perf_counter() - t0
+    return time.perf_counter() - t0, profiler.launch_count() - l0
+
+
+def _step_stats(dt, launches, iters):
+    """The per-row fusion-health fields every _timed_steps config emits."""
+    return {
+        "step_time_ms": round(dt / iters * 1e3, 3),
+        "launches_per_step": round(launches / iters, 2),
+    }
 
 
 def _mfu(samples_per_sec, flops_per_sample, platform):
@@ -207,7 +221,7 @@ def bench_resnet50(platform, dtype, batch=None, remat="env"):
     x = x.astype(dtype)
     y = nd.array(rng.randint(0, 1000, (batch,)).astype(np.float32))
 
-    dt = _timed_steps(step, x, y, iters, warmup)
+    dt, launches = _timed_steps(step, x, y, iters, warmup)
     img_s = batch * iters / dt
 
     dump = os.environ.get("BENCH_DUMP_HLO")
@@ -236,6 +250,7 @@ def bench_resnet50(platform, dtype, batch=None, remat="env"):
         "images_or_tokens_per_sec_per_chip": round(img_s, 2),
         "mfu": _mfu(img_s, flops_per_img, platform), "platform": platform,
         "flops_per_sample": flops_per_img,
+        **_step_stats(dt, launches, iters),
     }
     _emit_jsonl(row)
     return img_s, row
@@ -292,14 +307,19 @@ def bench_bert_mlm(platform, dtype):
     y = nd.array(rng.randint(0, vocab, (batch, seq_len)).astype(np.float32))
     net(x)  # resolve deferred shapes
 
-    # BENCH_BERT_PATH=trainer drives the CANONICAL Gluon loop
-    # (hybridize + record/backward + fused donated Trainer.step) instead
-    # of ShardedTrainStep — measures what a reference-style user script
-    # gets (SURVEY §3.1), now that Trainer.step is one donated launch.
-    # A sharded step provides the flop accounting for BOTH paths (same
-    # model/loss/optimizer); on the trainer path it is built only AFTER
-    # the timed window so its Adam state doesn't inflate HBM use during
-    # the measurement.
+    # BENCH_BERT_PATH selects what a user script gets (SURVEY §3.1):
+    #   trainer    — the CANONICAL Gluon loop (hybridize + record/backward
+    #                + fused donated Trainer.step): forward launch +
+    #                per-node backward walk + 1 optimizer launch
+    #   fused_step — the same canonical API through Trainer.fuse_step
+    #                (gluon.CachedTrainStep): the WHOLE step is one
+    #                donated launch, like ShardedTrainStep but without
+    #                leaving the Gluon surface
+    #   sharded    — ShardedTrainStep (default; the headline config)
+    # A sharded step provides the flop accounting for ALL paths (same
+    # model/loss/optimizer); on the trainer/fused_step paths it is built
+    # only AFTER the timed window so its Adam state doesn't inflate HBM
+    # use during the measurement.
     path = os.environ.get("BENCH_BERT_PATH", "sharded")
 
     def make_sharded():
@@ -322,24 +342,33 @@ def bench_bert_mlm(platform, dtype):
             trainer.step(1)
             return loss
         sharded = None
+    elif path == "fused_step":
+        loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+        trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                                   {"learning_rate": 1e-4})
+        step = trainer.fuse_step(net, loss_fn)
+        sharded = None
     else:
         sharded = step = make_sharded()
 
-    dt = _timed_steps(step, x, y, iters, warmup)
+    dt, launches = _timed_steps(step, x, y, iters, warmup)
     tok_s = batch * seq_len * iters / dt
 
     flops_per_tok = (sharded or make_sharded()).flops_per_step(x, y)
     if flops_per_tok:
         flops_per_tok /= batch * seq_len
 
+    config_name = {"trainer": "bert_base_mlm_train_gluon",
+                   "fused_step": "bert_base_mlm_train_fused_step"}.get(
+                       path, "bert_base_mlm_train")
     row = {
-        "config": "bert_base_mlm_train" if path != "trainer"
-                  else "bert_base_mlm_train_gluon", "chips": 1,
+        "config": config_name, "chips": 1,
         "batch_size": batch,
         "seq_len": seq_len, "dtype": dtype,
         "images_or_tokens_per_sec_per_chip": round(tok_s, 2),
         "mfu": _mfu(tok_s, flops_per_tok, platform), "platform": platform,
         "flops_per_sample": flops_per_tok,
+        **_step_stats(dt, launches, iters),
     }
     _emit_jsonl(row)
     return tok_s, row
@@ -385,7 +414,7 @@ def bench_lenet_mnist(platform, dtype):
         net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
         {"learning_rate": 0.05, "momentum": 0.9})
 
-    dt = _timed_steps(step, x, y, iters, warmup)
+    dt, launches = _timed_steps(step, x, y, iters, warmup)
     img_s = batch * iters / dt
     flops = step.flops_per_step(x, y)
     if flops:
@@ -397,6 +426,7 @@ def bench_lenet_mnist(platform, dtype):
         "images_or_tokens_per_sec_per_chip": round(img_s, 2),
         "mfu": _mfu(img_s, flops, platform), "platform": platform,
         "flops_per_sample": flops,
+        **_step_stats(dt, launches, iters),
     }
     _emit_jsonl(row)
     return img_s, row
@@ -450,7 +480,7 @@ def bench_lstm_ptb(platform, dtype):
         net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
         {"learning_rate": 1.0})
 
-    dt = _timed_steps(step, x, y, iters, warmup)
+    dt, launches = _timed_steps(step, x, y, iters, warmup)
     tok_s = batch * seq_len * iters / dt
     flops_per_tok = step.flops_per_step(x, y)
     if flops_per_tok:
@@ -464,6 +494,7 @@ def bench_lstm_ptb(platform, dtype):
         "images_or_tokens_per_sec_per_chip": round(tok_s, 2),
         "mfu": _mfu(tok_s, flops_per_tok, platform), "platform": platform,
         "flops_per_sample": flops_per_tok,
+        **_step_stats(dt, launches, iters),
     }
     _emit_jsonl(row)
     return tok_s, row
@@ -522,7 +553,7 @@ def bench_wide_deep(platform, dtype):
         net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
         {"learning_rate": 1e-3})
 
-    dt = _timed_steps(step, x, y, iters, warmup)
+    dt, launches = _timed_steps(step, x, y, iters, warmup)
     samp_s = batch * iters / dt
     flops = step.flops_per_step(x, y)
     if flops:
@@ -542,6 +573,7 @@ def bench_wide_deep(platform, dtype):
         "mfu": _mfu(samp_s, flops, platform), "platform": platform,
         "flops_per_sample": flops,
         "embedding_bytes_per_sec": round(samp_s * emb_bytes_per_sample),
+        **_step_stats(dt, launches, iters),
     }
     _emit_jsonl(row)
     return samp_s, row

@@ -3,6 +3,24 @@ train_mnist.py + gluon examples). Uses the real MNIST files if
 --data-dir has them, else synthetic digits so the example always runs.
 
 Run:  python examples/train_mnist_gluon.py --epochs 2 --batch-size 256
+
+Demonstrates the fused train step (gluon.CachedTrainStep). Before —
+one launch for the forward, one per tape node for the backward, one for
+the optimizer::
+
+    with autograd.record():
+        out = net(data)
+        loss = loss_fn(out, label)
+    loss.backward()
+    trainer.step(batch_size)
+
+After — the WHOLE step is one donated XLA launch (identical numerics;
+ineligible configs fall back to the loop above automatically)::
+
+    step = trainer.fuse_step(net, loss_fn, return_outputs=True)
+    loss, out = step(data, label, batch_size)
+
+Pass --no-fused-step (or set MXT_FUSED_STEP=0) to run the eager loop.
 """
 import argparse
 
@@ -64,6 +82,10 @@ def main():
     p.add_argument("--no-hybridize", dest="hybridize",
                    action="store_false", default=True,
                    help="run the eager (non-jitted) path")
+    p.add_argument("--no-fused-step", dest="fused_step",
+                   action="store_false", default=True,
+                   help="use the eager record/backward/step loop instead "
+                        "of the one-launch fused train step")
     args = p.parse_args()
 
     mx.random.seed(42)
@@ -81,16 +103,25 @@ def main():
     metric = mx.metric.Accuracy()
     speedo = mx.callback.Speedometer(args.batch_size, frequent=20)
 
+    # forward + backward + optimizer as ONE donated XLA launch; outputs
+    # ride along as extra results of the same program so the metric needs
+    # no second forward
+    step = trainer.fuse_step(net, loss_fn, return_outputs=True) \
+        if args.fused_step else None
+
     for epoch in range(args.epochs):
         train_iter.reset()
         metric.reset()
         for i, batch in enumerate(train_iter):
             data, label = batch.data[0], batch.label[0]
-            with autograd.record():
-                out = net(data)
-                loss = loss_fn(out, label)
-            loss.backward()
-            trainer.step(args.batch_size)
+            if step is not None:
+                loss, out = step(data, label, args.batch_size)
+            else:
+                with autograd.record():
+                    out = net(data)
+                    loss = loss_fn(out, label)
+                loss.backward()
+                trainer.step(args.batch_size)
             metric.update([label], [out])
             speedo(mx.model.BatchEndParam(epoch=epoch, nbatch=i,
                                           eval_metric=metric, locals=None))

@@ -36,6 +36,17 @@ __all__ = [
 ]
 
 
+_launches = None  # profiler._launch_count, bound on first backward
+
+
+def _count_launch():
+    global _launches
+    if _launches is None:
+        from . import profiler
+        _launches = profiler._launch_count
+    _launches[0] += 1
+
+
 class _AGState(threading.local):
     def __init__(self):
         super().__init__()
@@ -246,6 +257,7 @@ def _run_backward(heads, head_grads, retain_graph=False, collect=None):
             continue
         if node.vjp_fn is None:
             continue
+        _count_launch()  # each vjp closure is its own dispatched execution
         in_cts = node.vjp_fn(node.full_ct())
         for parent, ct in zip(node.parents, in_cts):
             if parent is None:

@@ -61,6 +61,15 @@ _declare("MXT_FUSED_TRAINER", bool, True,
          "grads, no dist kvstore). 0 falls back to eager per-param "
          "updates.")
 
+_declare("MXT_FUSED_STEP", bool, True,
+         "Fuse the whole canonical Gluon train step (forward + backward + "
+         "optimizer update) into ONE donated XLA launch via "
+         "gluon.CachedTrainStep / Trainer.fuse_step, and fuse "
+         "Module.update's per-param loop the same way. Eligibility mirrors "
+         "MXT_FUSED_TRAINER (supported optimizer, dense grads, single "
+         "process, no dist kvstore); 0 forces the eager "
+         "record/backward/step path everywhere.")
+
 _declare("MXT_RNN_WAVEFRONT", bool, False,
          "Run multi-layer unidirectional LSTM as a diagonal wavefront: "
          "all layers' recurrent gemms batch into one einsum per diagonal "

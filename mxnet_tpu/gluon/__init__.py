@@ -4,6 +4,7 @@ from .parameter import Parameter, Constant, ParameterDict
 from .block import Block, HybridBlock
 from .symbol_block import SymbolBlock
 from .trainer import Trainer
+from .train_step import CachedTrainStep, train_step
 from . import nn
 from . import rnn
 from . import loss
@@ -14,5 +15,5 @@ from . import contrib
 from .utils import split_and_load, split_data
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
-           "SymbolBlock", "Trainer", "nn", "rnn", "loss", "utils",
-           "split_and_load", "split_data"]
+           "SymbolBlock", "Trainer", "CachedTrainStep", "train_step", "nn",
+           "rnn", "loss", "utils", "split_and_load", "split_data"]

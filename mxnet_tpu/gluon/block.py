@@ -275,6 +275,10 @@ class HybridBlock(Block):
         self._active = False
         self._jit_cache = {}
         self._flags = {}
+        # flat (sorted, initialized) Parameter list for _call_cached_op;
+        # rebuilding it from collect_params() every call walks the whole
+        # block tree — real per-step Python overhead on the hot path
+        self._cached_flat_params = None
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
                   **kwargs):
@@ -285,6 +289,7 @@ class HybridBlock(Block):
                            static_shape=static_shape, **kwargs)
         self._active = active
         self._jit_cache = {}
+        self._cached_flat_params = None
         for child in self._children.values():
             if isinstance(child, HybridBlock):
                 child.hybridize(active, static_alloc=static_alloc,
@@ -292,6 +297,7 @@ class HybridBlock(Block):
 
     def cast(self, dtype):
         self._jit_cache = {}
+        self._cached_flat_params = None
         super().cast(dtype)
 
     def infer_shape(self, *args):
@@ -370,11 +376,17 @@ class HybridBlock(Block):
             # a stale non-ring trace is never reused inside the scope
             return super().__call__(*args, **kwargs)
         self._ensure_initialized(*args)
-        params = [
-            (name, p) for name, p in sorted(self.collect_params().items())
-            if p._data is not None
-        ]
-        param_objs = [p for _, p in params]
+        param_objs = self._cached_flat_params
+        if param_objs is None:
+            # built once after deferred init resolves; invalidated by
+            # hybridize()/cast() (structural changes require re-hybridize,
+            # matching CachedOp). Buffers are NOT cached — p.data() below
+            # stays live across set_data/force_reinit rebinds.
+            param_objs = [
+                p for _, p in sorted(self.collect_params().items())
+                if p._data is not None
+            ]
+            self._cached_flat_params = param_objs
         param_nds = [p.data() for p in param_objs]
         train = ag.is_training()
         entry = self._jit_cache.get(train)

@@ -17,6 +17,7 @@ numpy in workers, device copy in the consumer).
 """
 from __future__ import annotations
 
+import collections
 import concurrent.futures
 import multiprocessing
 
@@ -32,12 +33,66 @@ __all__ = ["DataLoader", "default_batchify_fn"]
 def default_batchify_fn(data):
     """Stack samples into a batch (ref: dataloader.py — default_batchify_fn)."""
     if isinstance(data[0], NDArray):
-        return _nd.array(np.stack([d.asnumpy() for d in data]))
+        # ONE stacked device op instead of an asnumpy() host sync per
+        # sample per batch (each sync is a full dispatch round-trip)
+        import jax.numpy as jnp
+
+        return NDArray(jnp.stack([d.data for d in data]))
     if isinstance(data[0], tuple):
         data = zip(*data)
         return [default_batchify_fn(i) for i in data]
     out = np.asarray(data)
     return _nd.array(out, dtype=out.dtype)
+
+
+def _issue_device_put(batch):
+    """Issue (async) device placement for every array in a batch. XLA
+    dispatch returns immediately, so by the time the consumer's train step
+    touches the batch the H2D transfer has been overlapping compute."""
+    import jax
+
+    if isinstance(batch, list):
+        return [_issue_device_put(b) for b in batch]
+    if isinstance(batch, tuple):
+        return tuple(_issue_device_put(b) for b in batch)
+    if isinstance(batch, dict):
+        return {k: _issue_device_put(v) for k, v in batch.items()}
+    if isinstance(batch, NDArray):
+        batch._set_data(jax.device_put(batch.data))
+    return batch
+
+
+class _DevicePrefetcher:
+    """Double-buffer: keep ``depth`` batches materialized ahead of the
+    consumer, issuing each one's ``device_put`` as soon as it is pulled —
+    so batch N+1's host→device transfer overlaps the step running on
+    batch N. Order-preserving; purely a scheduling wrapper."""
+
+    def __init__(self, it, depth=2, to_device=True):
+        self._it = iter(it)
+        self._depth = max(1, depth)
+        self._to_device = to_device
+        self._buf = collections.deque()
+
+    def _pull(self):
+        if self._it is None:
+            return
+        try:
+            batch = next(self._it)
+        except StopIteration:
+            self._it = None
+            return
+        if self._to_device:
+            batch = _issue_device_put(batch)
+        self._buf.append(batch)
+
+    def __iter__(self):
+        while len(self._buf) < self._depth and self._it is not None:
+            self._pull()
+        while self._buf:
+            batch = self._buf.popleft()
+            self._pull()  # refill BEFORE yielding: next H2D is in flight
+            yield batch
 
 
 def _np_batchify(data):
@@ -96,9 +151,20 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=True):
+                 thread_pool=True, prefetch_to_device=False):
+        """prefetch: how many batches to keep in flight ahead of the
+        consumer (default 2*num_workers). Honored on the num_workers=0
+        path too — the serial loader then pulls ``prefetch`` batches
+        ahead through the device prefetcher instead of silently ignoring
+        the argument.
+
+        prefetch_to_device: double-buffer device placement — issue the
+        next batch's ``device_put`` while the current step runs, so H2D
+        transfer overlaps compute (the tf.data prefetch_to_device
+        analog)."""
         self._dataset = dataset
         del pin_memory  # device placement is one device_put on TPU
+        self._prefetch_to_device = prefetch_to_device
 
         if batch_sampler is None:
             if batch_size is None:
@@ -134,20 +200,31 @@ class DataLoader:
     def _load_batch(self, indices):
         return self._batchify_fn([self._dataset[i] for i in indices])
 
+    def _iter_serial(self):
+        for indices in self._batch_sampler:
+            yield self._load_batch(indices)
+
     def __iter__(self):
         if self._num_workers == 0:
-            for indices in self._batch_sampler:
-                yield self._load_batch(indices)
+            base = self._iter_serial()
+            if self._prefetch > 0 or self._prefetch_to_device:
+                # honor prefetch without workers: pull ahead on the
+                # consumer thread so the next batch's transfers are
+                # already dispatched when the current step runs
+                base = _DevicePrefetcher(base, self._prefetch or 2,
+                                         self._prefetch_to_device)
+            yield from base
             return
-        if self._thread_pool:
-            yield from self._iter_threads()
-        else:
-            yield from self._iter_processes()
+        base = self._iter_threads() if self._thread_pool \
+            else self._iter_processes()
+        if self._prefetch_to_device:
+            base = _DevicePrefetcher(base, 2, True)
+        yield from base
 
     def _iter_threads(self):
         with concurrent.futures.ThreadPoolExecutor(
                 max_workers=self._num_workers) as pool:
-            pending = []
+            pending = collections.deque()
             it = iter(self._batch_sampler)
             try:
                 for _ in range(max(1, self._prefetch)):
@@ -155,7 +232,7 @@ class DataLoader:
             except StopIteration:
                 it = None
             while pending:
-                batch = pending.pop(0).result()
+                batch = pending.popleft().result()
                 if it is not None:
                     try:
                         pending.append(pool.submit(self._load_batch,
@@ -177,7 +254,7 @@ class DataLoader:
                 max_workers=self._num_workers, mp_context=ctx,
                 initializer=_worker_init,
                 initargs=(self._dataset,)) as pool:
-            pending = []
+            pending = collections.deque()
             it = iter(self._batch_sampler)
             try:
                 for _ in range(max(1, self._prefetch)):
@@ -185,7 +262,7 @@ class DataLoader:
             except StopIteration:
                 it = None
             while pending:
-                raw = pending.pop(0).result()
+                raw = pending.popleft().result()
                 if it is not None:
                     try:
                         pending.append(pool.submit(job, next(it)))

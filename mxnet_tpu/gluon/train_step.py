@@ -1,0 +1,420 @@
+"""CachedTrainStep — the canonical Gluon train loop as ONE donated launch.
+
+The reference's canonical loop
+
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(batch_size)
+
+pays one XLA launch for the hybridized forward, one per tape node for the
+backward vjp walk (autograd.py — _run_backward), and one for the fused
+optimizer update (gluon/trainer.py — _FusedUpdate). At ~3.4 ms per launch
+on the axon tunnel (PERF.md §1.2) the backward walk alone dominates small
+steps. ShardedTrainStep (parallel/sharded.py) already proves whole-step
+fusion with buffer donation works here; CachedTrainStep brings the same
+treatment to the single-device canonical path without asking the user to
+leave the Gluon API: forward + loss + `jax.value_and_grad` over the
+flattened parameter pytree + the per-parameter optimizer math
+(`_FusedUpdate._param_update`, the exact kernels the eager Updater runs)
+compile into ONE `jax.jit` program with weights, optimizer state, and aux
+state donated. XLA's fuser then does the heavy lifting across the whole
+step ("Operator Fusion in XLA", arXiv:2301.13062); donation gives the
+in-place weight-update behavior of the weight-update treatment in
+arXiv:2004.13336 on a single chip.
+
+Aux states (BatchNorm running stats) ride the CachedOp rebind protocol
+(gluon/block.py — _build_cached): the traced Parameter wrappers are
+inspected after the forward and whatever they rebound to is returned as
+extra (donated-in, written-back) outputs. The PRNG key is derived ON
+DEVICE via fold_in(base_key, t), and all dynamic scalars (t, lr, wd,
+rescale_grad) enter as traced 0-d arguments, so lr schedulers never
+retrace.
+
+Ineligible configurations (unsupported optimizer, sparse grads, dist
+kvstore, multi-process, grad_req='add') fall back transparently to the
+eager record/backward/step loop — same numerics, more launches. Gate:
+``MXT_FUSED_STEP`` (default on, mirrors ``MXT_FUSED_TRAINER``).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+
+from ..base import MXNetError
+from .. import autograd as ag
+from .. import optimizer as opt
+from .. import random as _random
+from ..ndarray.ndarray import NDArray
+from ..ndarray import ndarray as _nd
+from .block import Block, _trace_depth
+from .parameter import param_trace_scope
+from .trainer import _FusedUpdate
+
+__all__ = ["CachedTrainStep", "train_step", "FusedApply"]
+
+
+def _config():
+    from .. import config
+    return config
+
+
+def _count_launch():
+    from .. import profiler
+    profiler._launch_count[0] += 1
+
+
+class CachedTrainStep:
+    """One donated XLA launch per training step for a Gluon block.
+
+    Usage::
+
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 1e-3})
+        step = trainer.fuse_step(net, loss_fn)   # or gluon.train_step(...)
+        for x, y in loader:
+            loss = step(x, y)                    # params update in place
+
+    ``step(x, y, batch_size=None)`` is numerically identical to the
+    canonical record/backward/step loop with ``batch_size`` defaulting to
+    ``x.shape[batch_axis]`` (the gradient seed is ones over the loss —
+    exactly what ``loss.backward()`` does — and the optimizer rescales by
+    ``trainer._scale / batch_size``, exactly what ``trainer.step`` does).
+    The returned loss has the same shape ``loss_fn`` produces.
+
+    With ``return_outputs=True`` each call returns ``(loss, outputs)`` so
+    metrics can be fed without a second forward — the outputs are extra
+    results of the same single program, not another launch.
+
+    Eligibility is decided once, lazily, on the first call (the trainer's
+    kvstore decision and deferred parameter shapes must be resolved
+    first); an ineligible config records ``fallback_reason`` and every
+    call runs the eager loop instead — no exception, no retrace loop.
+    A step that cannot run fused for transient reasons (uneven optimizer
+    update counts left by a prior eager/kvstore path) also falls back,
+    per step, and re-enters the fused path once counts are even again.
+    """
+
+    def __init__(self, net, loss_fn, trainer, batch_axis=0,
+                 return_outputs=False):
+        self._net = net
+        self._loss_fn = loss_fn
+        self._trainer = trainer
+        self._batch_axis = batch_axis
+        self._return_outputs = return_outputs
+        self._jit = None
+        self._fallback_reason = None
+        self._base_key = None
+        self._all_params = None
+        self._train_names = None
+        self._aux_names = None
+        self._indices = None
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def fused(self):
+        """True once the fused program is built (first call succeeded)."""
+        return self._jit is not None
+
+    @property
+    def fallback_reason(self):
+        """Why the fused path is permanently unavailable (None if fused
+        or not yet decided)."""
+        return self._fallback_reason
+
+    # -- eligibility -----------------------------------------------------
+    @staticmethod
+    def eligible(trainer, net):
+        """Reason string if the whole-step fusion cannot be used, else
+        None. Mirrors _FusedUpdate.eligible plus whole-step-specific
+        constraints (grad_req='write' only; trainer params == net
+        params). Call after the trainer's kvstore is initialized."""
+        o = trainer._optimizer
+        if not _config().get("MXT_FUSED_STEP"):
+            return "MXT_FUSED_STEP=0"
+        if type(o).__name__ not in _FusedUpdate._SUPPORTED or \
+                type(o).__module__ != opt.Optimizer.__module__:
+            return "optimizer %s has no fused whole-step builder" \
+                % type(o).__name__
+        if getattr(o, "multi_precision", False):
+            return "multi_precision optimizer"
+        if getattr(o, "aggregate_num", 0):
+            return "aggregate_num optimizer"
+        if trainer._update_on_kvstore:
+            return "update_on_kvstore"
+        kv = trainer._kvstore
+        if kv is not None and (kv.type.startswith("dist") or
+                               trainer._compression_params):
+            return "distributed/compressed kvstore"
+        if jax.process_count() > 1:
+            return "multi-process"
+        net_params = net.collect_params()
+        trainable = {n for n, p in net_params.items()
+                     if p.grad_req != "null"}
+        for name, p in net_params.items():
+            if p.grad_req == "null":
+                continue
+            if p.grad_req != "write":
+                return "grad_req=%r on %s (whole-step fusion computes " \
+                    "fresh grads; accumulation needs the eager loop)" \
+                    % (p.grad_req, name)
+            if getattr(p, "_grad_stype", "default") != "default":
+                return "sparse gradient on %s" % name
+            if name not in trainer._param2idx:
+                return "parameter %s not managed by this trainer" % name
+        for p in trainer._params:
+            if p.grad_req != "null" and p.name not in trainable:
+                return "trainer manages parameter %s outside the net" \
+                    % p.name
+        return None
+
+    # -- build -----------------------------------------------------------
+    def _build(self, x):
+        net, tr = self._net, self._trainer
+        # resolve deferred shapes with one throwaway eager forward in
+        # predict mode (the HybridBlock._ensure_initialized treatment,
+        # generalized to plain Blocks)
+        if any(p._deferred_init is not None
+               for p in net.collect_params().values()):
+            with ag.pause(train_mode=False):
+                _trace_depth.depth += 1
+                try:
+                    net(x)
+                finally:
+                    _trace_depth.depth -= 1
+        self._all_params = OrderedDict(sorted(net.collect_params().items()))
+        for name, p in self._all_params.items():
+            if p._data is None:
+                raise MXNetError(
+                    "parameter %s is not initialized (run net.initialize() "
+                    "before the first step)" % name)
+        self._train_names = [n for n, p in self._all_params.items()
+                             if p.grad_req != "null"]
+        self._aux_names = [n for n, p in self._all_params.items()
+                           if p.grad_req == "null"]
+        self._indices = [tr._param2idx[n] for n in self._train_names]
+
+        o = tr._optimizer
+        upds = [_FusedUpdate._param_update(o, i) for i in self._indices]
+        all_params = self._all_params
+        train_names, aux_names = self._train_names, self._aux_names
+        loss_fn = self._loss_fn
+
+        def pure_loss(train_vals, aux_vals, xv, yv, key):
+            """Forward + loss as a pure function of the param pytree; aux
+            rebinds (BatchNorm running stats) captured via the CachedOp
+            protocol (block.py — _build_cached)."""
+            wrappers = {}
+            for n, v in zip(train_names, train_vals):
+                wrappers[n] = NDArray(v)
+            for n, v in zip(aux_names, aux_vals):
+                wrappers[n] = NDArray(v)
+            mapping = {all_params[n]: w for n, w in wrappers.items()}
+            _trace_depth.depth += 1
+            try:
+                with ag.pause(train_mode=True), _random.key_scope(key), \
+                        param_trace_scope(mapping):
+                    out = Block.__call__(net, NDArray(xv))
+                    outs = list(out) if isinstance(out, (list, tuple)) \
+                        else [out]
+                    loss = loss_fn(outs[0] if len(outs) == 1 else outs,
+                                   NDArray(yv))
+            finally:
+                _trace_depth.depth -= 1
+            new_aux = tuple(jax.lax.stop_gradient(wrappers[n].data)
+                            for n in aux_names)
+            out_datas = tuple(jax.lax.stop_gradient(o_.data)
+                              for o_ in outs)
+            # grad of the SUM == the implicit all-ones seed loss.backward()
+            # uses; rescale_grad (1/batch) is applied inside the update
+            return loss.data.sum(), (loss.data, new_aux, out_datas)
+
+        def step(train_vals, states, aux_vals, xv, yv, base_key, t, lr,
+                 wd, rescale):
+            # per-step key derived on device: no host-side split launch
+            key = jax.random.fold_in(base_key, t)
+            (_, (loss_vec, new_aux, outs)), grads = jax.value_and_grad(
+                pure_loss, has_aux=True)(train_vals, aux_vals, xv, yv, key)
+            new_train, new_states = [], []
+            for f, w, g, s in zip(upds, train_vals, grads, states):
+                w2, s2 = f(w, g, s, t, lr, wd, rescale)
+                new_train.append(w2)
+                new_states.append(s2)
+            return (loss_vec, tuple(new_train), tuple(new_states), new_aux,
+                    outs)
+
+        # weights + optimizer state + aux donated: buffers are reused
+        # across steps (the static_alloc analog) and the Parameter
+        # wrappers rebind to the outputs
+        self._jit = jax.jit(step, donate_argnums=(0, 1, 2))
+
+    # -- per-step host path ------------------------------------------------
+    def _fused_step(self, x, y, batch_size):
+        """One fused launch. Returns None if host-side invariants don't
+        hold this step (caller falls back to the eager loop)."""
+        tr = self._trainer
+        o = tr._optimizer
+        updater = tr._updaters[0]
+        for n, i in zip(self._train_names, self._indices):
+            if i not in updater.states:
+                updater.states[i] = o.create_state_multi_precision(
+                    i, self._all_params[n].data())
+                updater.states_synced[i] = True
+        # the fused program uses ONE step count for every parameter; if a
+        # prior eager/kvstore path left counts uneven, stay eager
+        counts = {o._index_update_count.get(i, o.begin_num_update)
+                  for i in self._indices}
+        if len(counts) > 1:
+            return None
+        rescale = tr._scale / batch_size
+        tr._check_and_rescale_grad(rescale)
+        # host bookkeeping mirrors the eager order (_update_count then
+        # _get_lr): the scheduler sees the post-bump num_update
+        for i in self._indices:
+            o._update_count(i)
+        t = o._index_update_count[self._indices[0]] if self._indices else 1
+        lr = o.lr_scheduler(o.num_update) if o.lr_scheduler is not None \
+            else o.lr
+        wd = o.wd
+        ws = tuple(self._all_params[n].data().data
+                   for n in self._train_names)
+        ss = tuple(tuple(l.data
+                         for l in _FusedUpdate._leaves(updater.states[i]))
+                   for i in self._indices)
+        aux = tuple(self._all_params[n].data().data
+                    for n in self._aux_names)
+        if self._base_key is None:
+            # drawn lazily so mx.random.seed() between construction and
+            # the first step still takes effect
+            self._base_key = _random.new_key()
+        loss_vec, new_w, new_s, new_aux, outs = self._jit(
+            ws, ss, aux, x.data, y.data, self._base_key, t, float(lr),
+            float(wd), float(rescale))
+        _count_launch()
+        for n, i, w2, s2 in zip(self._train_names, self._indices, new_w,
+                                new_s):
+            self._all_params[n].data()._set_data(w2)
+            for leaf, v in zip(_FusedUpdate._leaves(updater.states[i]), s2):
+                leaf._set_data(v)
+        for n, v in zip(self._aux_names, new_aux):
+            self._all_params[n].data()._set_data(v)
+        loss = NDArray(loss_vec)
+        if self._return_outputs:
+            out_nds = [NDArray(o_) for o_ in outs]
+            return loss, out_nds[0] if len(out_nds) == 1 else out_nds
+        return loss
+
+    def _eager_step(self, x, y, batch_size):
+        """The canonical loop, verbatim — identical numerics, more
+        launches."""
+        with ag.record():
+            out = self._net(x)
+            outs = out if not isinstance(out, (list, tuple)) else \
+                (out[0] if len(out) == 1 else list(out))
+            loss = self._loss_fn(outs, y)
+        loss.backward()
+        self._trainer.step(batch_size)
+        if self._return_outputs:
+            return loss, outs
+        return loss
+
+    def __call__(self, x, y, batch_size=None):
+        if not isinstance(x, NDArray):
+            x = _nd.array(x)
+        if not isinstance(y, NDArray):
+            y = _nd.array(y)
+        if batch_size is None:
+            batch_size = x.shape[self._batch_axis]
+        tr = self._trainer
+        if not tr._kv_initialized:
+            tr._init_kvstore()
+        if tr._params_to_init:
+            tr._init_params()
+        if self._jit is None and self._fallback_reason is None:
+            self._fallback_reason = self.eligible(tr, self._net)
+            if self._fallback_reason is None:
+                self._build(x)
+        if self._jit is not None:
+            result = self._fused_step(x, y, batch_size)
+            if result is not None:
+                return result
+        return self._eager_step(x, y, batch_size)
+
+
+def train_step(net, loss_fn, trainer, batch_axis=0, return_outputs=False):
+    """Build a fused (one donated launch) training step for ``net``, with
+    transparent fallback to the eager record/backward/step loop — the
+    functional spelling of ``trainer.fuse_step(net, loss_fn)``."""
+    return CachedTrainStep(net, loss_fn, trainer, batch_axis=batch_axis,
+                           return_outputs=return_outputs)
+
+
+class FusedApply:
+    """Fuse a list of per-index optimizer updates into ONE donated launch.
+
+    The _FusedUpdate jit brought to any (weights, grads) list keyed by
+    updater indices — Module.update's per-parameter loop rides this so the
+    symbolic path's optimizer phase is one launch too, sharing
+    ``_FusedUpdate._param_update`` for numerics (identical to the eager
+    ``Updater`` call, fewer launches). Falls back (returns False) when a
+    per-step invariant doesn't hold; the caller then runs the eager loop.
+    """
+
+    def __init__(self, optimizer, indices):
+        self._opt = optimizer
+        self._indices = list(indices)
+        upds = [_FusedUpdate._param_update(optimizer, i)
+                for i in self._indices]
+
+        def step(ws, gs, ss, t, lr, wd, rescale):
+            out_w, out_s = [], []
+            for f, w, g, s in zip(upds, ws, gs, ss):
+                w2, s2 = f(w, g, s, t, lr, wd, rescale)
+                out_w.append(w2)
+                out_s.append(s2)
+            return tuple(out_w), tuple(out_s)
+
+        self._jit = jax.jit(step, donate_argnums=(0, 2))
+
+    @staticmethod
+    def supported(optimizer):
+        """Static (per-optimizer) half of the eligibility check; dense
+        grads are re-checked per call."""
+        return (_config().get("MXT_FUSED_STEP")
+                and type(optimizer).__name__ in _FusedUpdate._SUPPORTED
+                and type(optimizer).__module__ == opt.Optimizer.__module__
+                and not getattr(optimizer, "multi_precision", False)
+                and not getattr(optimizer, "aggregate_num", 0))
+
+    def __call__(self, updater, weights, grads):
+        o = self._opt
+        for i, w, g in zip(self._indices, weights, grads):
+            if getattr(g, "stype", "default") != "default":
+                return False
+            if i not in updater.states:
+                updater.states[i] = o.create_state_multi_precision(i, w)
+                updater.states_synced[i] = True
+        counts = {o._index_update_count.get(i, o.begin_num_update)
+                  for i in self._indices}
+        if len(counts) > 1:
+            return False
+        for i in self._indices:
+            o._update_count(i)
+        t = o._index_update_count[self._indices[0]] if self._indices else 1
+        lr = o.lr_scheduler(o.num_update) if o.lr_scheduler is not None \
+            else o.lr
+        wd = o.wd
+        ws = tuple(w.data for w in weights)
+        gs = tuple(g.data for g in grads)
+        ss = tuple(tuple(l.data
+                         for l in _FusedUpdate._leaves(updater.states[i]))
+                   for i in self._indices)
+        new_w, new_s = self._jit(ws, gs, ss, t, float(lr), float(wd),
+                                 float(o.rescale_grad))
+        _count_launch()
+        for w, i, w2, s2 in zip(weights, self._indices, new_w, new_s):
+            w._set_data(w2)
+            for leaf, v in zip(_FusedUpdate._leaves(updater.states[i]), s2):
+                leaf._set_data(v)
+        return True

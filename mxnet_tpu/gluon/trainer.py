@@ -235,6 +235,8 @@ class _FusedUpdate:
                    for i in self._indices)
         new_w, new_s = self._jit(ws, gs, ss, t, float(lr), float(wd),
                                  float(rescale))
+        from .. import profiler
+        profiler._launch_count[0] += 1
         for i, w2, s2 in zip(self._indices, new_w, new_s):
             params[i].data()._set_data(w2)
             for leaf, v in zip(self._leaves(updater.states[i]), s2):
@@ -366,6 +368,18 @@ class Trainer:
 
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
+
+    def fuse_step(self, net, loss_fn, batch_axis=0, return_outputs=False):
+        """Whole-step fusion: forward + backward + optimizer update as ONE
+        donated XLA launch (gluon/train_step.py — CachedTrainStep), with
+        transparent fallback to the eager record/backward/step loop when
+        this trainer's config is ineligible. Returns a callable
+        ``step(x, y, batch_size=None) -> loss`` (or ``(loss, outputs)``
+        with ``return_outputs=True``)."""
+        from .train_step import CachedTrainStep
+
+        return CachedTrainStep(net, loss_fn, self, batch_axis=batch_axis,
+                               return_outputs=return_outputs)
 
     # ------------------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):

@@ -68,6 +68,7 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
         self._monitor = None
+        self._fused_update = None  # None = undecided, False = ineligible
 
     # -- introspection -------------------------------------------------
     @property
@@ -233,6 +234,7 @@ class Module(BaseModule):
             self._optimizer = opt_mod.create(
                 optimizer, param_idx2name=idx2name, **opt_params)
         self._updater = opt_mod.get_updater(self._optimizer)
+        self._fused_update = None  # rebuild against the new optimizer
         self.optimizer_initialized = True
 
     # -- execution -----------------------------------------------------
@@ -262,11 +264,41 @@ class Module(BaseModule):
     def update(self):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
+        if self._fused_update is None:
+            self._fused_update = self._build_fused_update()
+        if self._fused_update:
+            weights = [self._exec.arg_dict[n]
+                       for n in self._fused_update._names]
+            grads = [self._exec.grad_dict[n]
+                     for n in self._fused_update._names]
+            if self._fused_update(self._updater, weights, grads):
+                return  # one donated launch covered every parameter
         for i, name in enumerate(self._param_names):
             grad = self._exec.grad_dict.get(name)
             if grad is None:
                 continue
             self._updater(i, grad, self._exec.arg_dict[name])
+
+    def _build_fused_update(self):
+        """Fuse the per-parameter updater loop into one donated launch —
+        the same machinery (and numerics) as the gluon fused trainer/
+        CachedTrainStep (gluon/train_step.py — FusedApply). Returns False
+        when ineligible (unsupported optimizer, no grads); the eager loop
+        then runs exactly as before."""
+        from ..gluon.train_step import FusedApply
+
+        # the updater's optimizer is what the eager loop applies (a state
+        # load may have swapped it in) — fuse against that same object
+        optimizer = self._updater.optimizer
+        if not FusedApply.supported(optimizer):
+            return False
+        pairs = [(i, name) for i, name in enumerate(self._param_names)
+                 if self._exec.grad_dict.get(name) is not None]
+        if not pairs:
+            return False
+        fused = FusedApply(optimizer, [i for i, _ in pairs])
+        fused._names = [name for _, name in pairs]
+        return fused
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded
@@ -318,6 +350,9 @@ class Module(BaseModule):
         assert self.optimizer_initialized
         with open(fname, "rb") as f:
             self._updater.set_states(f.read())
+        # the fused update closed over the pre-load optimizer object
+        # (hyper-params, update counts) — rebuild on next update()
+        self._fused_update = None
 
     # set_params comes from BaseModule; params land when bound
     def set_params(self, arg_params, aux_params, allow_missing=False,

@@ -27,6 +27,17 @@ def _config():
     from .. import config
     return config
 
+
+_launches = None  # profiler._launch_count, bound on first dispatch
+
+
+def _count_launch():
+    global _launches
+    if _launches is None:
+        from .. import profiler
+        _launches = profiler._launch_count
+    _launches[0] += 1
+
 __all__ = ["Op", "register", "get_op", "list_ops", "apply_op"]
 
 _OPS: dict[str, "Op"] = {}
@@ -120,6 +131,7 @@ def apply_op(op, *inputs, out=None, **kwargs):
         kwargs["train_mode"] = ag.is_training()
     raw = [x.data if isinstance(x, NDArray) else x for x in inputs]
     fn = functools.partial(op.fn, **kwargs) if kwargs else op.fn
+    _count_launch()  # one imperative invoke = one dispatched execution
 
     parents = None
     if ag.is_recording() and op.differentiable:

@@ -21,7 +21,7 @@ from .base import MXNetError
 
 __all__ = ["set_config", "set_state", "state", "start", "stop", "pause",
            "resume", "dump", "dumps", "Domain", "Task", "Frame", "Counter",
-           "Marker"]
+           "Marker", "record_launch", "launch_count", "reset_launch_count"]
 
 _config = {
     "filename": "profile_output",
@@ -39,6 +39,31 @@ _trace_dir = None
 # aggregate table: name -> [count, total_sec, min_sec, max_sec]
 _agg = {}
 _counters = {}
+# compiled-program executions dispatched by the framework since the last
+# reset: every apply_op invoke, every backward vjp call, and every fused
+# jit step (trainer/_FusedUpdate, gluon CachedTrainStep, ShardedTrainStep,
+# Module's fused update) bumps this — ONE slot of mutable state so the hot
+# paths can increment without a function call into this module
+_launch_count = [0]
+
+
+def record_launch(n=1):
+    """Count ``n`` compiled-program executions (XLA launches) dispatched.
+    Called from apply_op / the fused-step jit dispatch sites; each launch
+    costs ~3.4 ms on the axon tunnel (PERF.md §1.2), so this counter is
+    the cheapest fusion-health signal: a fused train step should show
+    exactly 1 per step."""
+    _launch_count[0] += n
+
+
+def launch_count():
+    return _launch_count[0]
+
+
+def reset_launch_count():
+    prev = _launch_count[0]
+    _launch_count[0] = 0
+    return prev
 
 
 def set_config(**kwargs):
@@ -121,9 +146,11 @@ def dumps(reset=False):
                      % (name, cnt, tot * 1e3, mn * 1e3, mx * 1e3))
     for name in sorted(_counters):
         lines.append("    %-24s value=%s" % (name, _counters[name]))
+    lines.append("    %-24s value=%d" % ("xla_launches", _launch_count[0]))
     if reset:
         _agg.clear()
         _counters.clear()
+        _launch_count[0] = 0
     return "\n".join(lines)
 
 

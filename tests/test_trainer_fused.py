@@ -11,7 +11,8 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import autograd as ag
 from mxnet_tpu import nd
-from mxnet_tpu.gluon import Trainer, nn
+from mxnet_tpu import profiler
+from mxnet_tpu.gluon import CachedTrainStep, Trainer, nn, train_step
 from mxnet_tpu.gluon.trainer import _FusedUpdate
 
 
@@ -228,3 +229,275 @@ def test_tied_parameter_shape_mismatch_raises():
                                             params=self.embed.params)
 
         Bad()
+
+
+# ---------------------------------------------------------------------------
+# CachedTrainStep — the whole canonical loop as ONE donated launch
+# (gluon/train_step.py). Numerics must match record/backward/step exactly,
+# including optimizer state and BatchNorm running stats; ineligible configs
+# must fall back to the eager loop with identical results.
+# ---------------------------------------------------------------------------
+def _make_bn_net(seed=0):
+    mx.random.seed(seed)
+    net = nn.HybridSequential(prefix="fstep_")
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.BatchNorm(),
+                nn.Dense(4, in_units=16))
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+def _batches(steps=5, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(nd.array(rng.uniform(-1, 1, (8, 8)).astype(np.float32)),
+             nd.array(rng.uniform(-1, 1, (8, 4)).astype(np.float32)))
+            for _ in range(steps)]
+
+
+def _eager_loop(net, trainer, loss_fn, data):
+    losses = []
+    for x, y in data:
+        with ag.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(x.shape[0])
+        losses.append(loss.asnumpy())
+    return losses
+
+
+def _states_np(trainer):
+    out = {}
+    for i, s in trainer._updaters[0].states.items():
+        leaves = s if isinstance(s, tuple) else (() if s is None else (s,))
+        out[i] = [l.asnumpy() for l in leaves]
+    return out
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4}),
+    ("adam", {"learning_rate": 1e-2}),
+])
+def test_cached_train_step_matches_eager(optimizer, opt_params):
+    loss_fn = mx.gluon.loss.L2Loss()
+    data = _batches()
+
+    net_f = _make_bn_net()
+    tr_f = Trainer(net_f.collect_params(), optimizer, dict(opt_params))
+    step = tr_f.fuse_step(net_f, loss_fn)
+    losses_f = [step(x, y).asnumpy() for x, y in data]
+    assert step.fused and step.fallback_reason is None
+
+    net_e = _make_bn_net()
+    tr_e = Trainer(net_e.collect_params(), optimizer, dict(opt_params))
+    losses_e = _eager_loop(net_e, tr_e, loss_fn, data)
+
+    for lf, le in zip(losses_f, losses_e):
+        np.testing.assert_allclose(lf, le, rtol=1e-6, atol=1e-6)
+    wf, we = _weights(net_f), _weights(net_e)
+    assert wf.keys() == we.keys()
+    for k in wf:  # includes BatchNorm running_mean/var aux state
+        np.testing.assert_allclose(wf[k], we[k], rtol=1e-6, atol=1e-6,
+                                   err_msg=k)
+    sf, se = _states_np(tr_f), _states_np(tr_e)
+    assert sf.keys() == se.keys()
+    for i in sf:
+        for a, b in zip(sf[i], se[i]):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+    assert tr_f._optimizer.num_update == tr_e._optimizer.num_update == 5
+
+
+def test_cached_train_step_single_launch_per_step():
+    """Fused steady state = EXACTLY one compiled execution per training
+    step (the whole point of whole-step fusion; ~3.4 ms per launch on the
+    axon tunnel)."""
+    loss_fn = mx.gluon.loss.L2Loss()
+    net = _make_bn_net()
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    step = tr.fuse_step(net, loss_fn)
+    data = _batches(steps=5)
+    step(*data[0]).wait_to_read()  # build + compile + base-key draw
+    step(*data[1]).wait_to_read()
+    c0 = profiler.launch_count()
+    for x, y in data[2:]:
+        step(x, y).wait_to_read()
+    assert profiler.launch_count() - c0 == 3
+    # ...and the eager loop pays strictly more per step
+    net_e = _make_bn_net()
+    tr_e = Trainer(net_e.collect_params(), "adam", {"learning_rate": 1e-2})
+    _eager_loop(net_e, tr_e, loss_fn, data[:1])
+    c1 = profiler.launch_count()
+    _eager_loop(net_e, tr_e, loss_fn, data[1:2])
+    assert profiler.launch_count() - c1 > 1
+
+
+def test_cached_train_step_no_per_step_retrace():
+    """Dynamic scalars (t, lr via scheduler, wd, rescale) are traced 0-d
+    args — the jit cache must stop growing after the donated outputs
+    re-enter as inputs once."""
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    loss_fn = mx.gluon.loss.L2Loss()
+    net = _make_bn_net()
+    tr = Trainer(net.collect_params(), "sgd",
+                 {"learning_rate": 0.5, "momentum": 0.9,
+                  "lr_scheduler": FactorScheduler(step=2, factor=0.5)})
+    step = tr.fuse_step(net, loss_fn)
+    data = _batches(steps=8)
+    for x, y in data:
+        step(x, y)
+    assert step._jit._cache_size() <= 2
+
+
+def test_cached_train_step_ineligible_falls_back():
+    """Unsupported optimizer: no exception, results identical to the
+    hand-written eager loop."""
+    loss_fn = mx.gluon.loss.L2Loss()
+    data = _batches()
+    net_a = _make_bn_net()
+    tr_a = Trainer(net_a.collect_params(), "adadelta",
+                   {"learning_rate": 1.0})
+    step = train_step(net_a, loss_fn, tr_a)
+    losses_a = [step(x, y).asnumpy() for x, y in data]
+    assert step.fused is False
+    assert "AdaDelta" in step.fallback_reason
+
+    net_b = _make_bn_net()
+    tr_b = Trainer(net_b.collect_params(), "adadelta",
+                   {"learning_rate": 1.0})
+    losses_b = _eager_loop(net_b, tr_b, loss_fn, data)
+    for la, lb in zip(losses_a, losses_b):
+        np.testing.assert_array_equal(la, lb)
+    wf, we = _weights(net_a), _weights(net_b)
+    for k in wf:
+        np.testing.assert_array_equal(wf[k], we[k], err_msg=k)
+
+
+def test_cached_train_step_flag_off(monkeypatch):
+    monkeypatch.setenv("MXT_FUSED_STEP", "0")
+    loss_fn = mx.gluon.loss.L2Loss()
+    net = _make_bn_net()
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    step = tr.fuse_step(net, loss_fn)
+    data = _batches(steps=2)
+    for x, y in data:
+        step(x, y)
+    assert step.fused is False
+    assert step.fallback_reason == "MXT_FUSED_STEP=0"
+    assert tr._optimizer.num_update == 2  # the eager loop really trained
+
+
+def test_cached_train_step_return_outputs():
+    loss_fn = mx.gluon.loss.L2Loss()
+    net = _make_bn_net()
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    step = tr.fuse_step(net, loss_fn, return_outputs=True)
+    x, y = _batches(steps=1)[0]
+    loss, out = step(x, y)
+    assert loss.shape == (8,) and out.shape == (8, 4)
+    # outputs are the pre-update forward: match a replayed forward on the
+    # pre-step weights
+    net_e = _make_bn_net()
+    tr_e = Trainer(net_e.collect_params(), "adam", {"learning_rate": 1e-2})
+    with ag.record():
+        out_e = net_e(x)
+        loss_e = loss_fn(out_e, y)
+    np.testing.assert_allclose(out.asnumpy(), out_e.asnumpy(),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(loss.asnumpy(), loss_e.asnumpy(),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_module_fused_update_matches_eager(monkeypatch, tmp_path):
+    """Module.update rides FusedApply (same machinery/numerics as the
+    gluon fused step) — results must match the eager per-param loop."""
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.module import Module
+
+    def run(env):
+        if env is not None:
+            monkeypatch.setenv("MXT_FUSED_STEP", env)
+        else:
+            monkeypatch.delenv("MXT_FUSED_STEP", raising=False)
+        mx.random.seed(0)
+        rng = np.random.RandomState(0)
+        x = rng.uniform(-1, 1, (32, 8)).astype(np.float32)
+        y = rng.randint(0, 4, (32,)).astype(np.float32)
+        data = sym.var("data")
+        net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+        net = sym.Activation(net, act_type="relu")
+        net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+        net = sym.SoftmaxOutput(net, name="softmax")
+        mod = Module(net, data_names=("data",),
+                     label_names=("softmax_label",))
+        it = NDArrayIter(x, y, batch_size=8)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(initializer=mx.init.Uniform(0.05))
+        mod.init_optimizer(optimizer="sgd", optimizer_params=(
+            ("learning_rate", 0.1), ("momentum", 0.9)))
+        for _ in range(2):
+            it.reset()
+            for batch in it:
+                mod.forward(batch, is_train=True)
+                mod.backward()
+                mod.update()
+        arg, aux = mod.get_params()
+        return {k: v.asnumpy() for k, v in arg.items()}, mod
+
+    wf, mod_f = run(None)
+    assert mod_f._fused_update, "fused Module.update should be eligible"
+    we, mod_e = run("0")
+    assert mod_e._fused_update is False
+    assert wf.keys() == we.keys()
+    for k in wf:
+        np.testing.assert_allclose(wf[k], we[k], rtol=1e-6, atol=1e-6,
+                                   err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# DataLoader prefetch (gluon/data/dataloader.py — _DevicePrefetcher):
+# prefetched batches must equal non-prefetched ones in value AND order.
+# ---------------------------------------------------------------------------
+def test_dataloader_prefetch_matches():
+    from mxnet_tpu.gluon import data as gdata
+
+    rng = np.random.RandomState(0)
+    npx = rng.uniform(0, 1, (37, 3)).astype(np.float32)
+    npy = np.arange(37).astype(np.float32)
+    ds = gdata.ArrayDataset(npx, npy)
+
+    def collect(**kw):
+        return [(bx.asnumpy(), by.asnumpy())
+                for bx, by in gdata.DataLoader(ds, batch_size=5, **kw)]
+
+    plain = collect()
+    assert len(plain) == 8
+    for kw in ({"prefetch": 2},                          # serial load-ahead
+               {"prefetch": 3, "prefetch_to_device": True},
+               {"num_workers": 2, "prefetch_to_device": True}):
+        got = collect(**kw)
+        assert len(got) == len(plain), kw
+        for (ax, ay), (bx, by) in zip(plain, got):
+            np.testing.assert_array_equal(ax, bx)
+            np.testing.assert_array_equal(ay, by)
+
+
+def test_dataloader_ndarray_samples_batched_read():
+    """NDArray samples batchify through ONE stacked device op — values
+    and dtypes must match the per-sample numpy stacking it replaced."""
+    from mxnet_tpu.gluon import data as gdata
+
+    rng = np.random.RandomState(0)
+    npx = rng.uniform(0, 1, (10, 3)).astype(np.float32)
+    ds = gdata.SimpleDataset(
+        [(nd.array(npx[i]), nd.array([float(i)])) for i in range(10)])
+    batches = list(gdata.DataLoader(ds, batch_size=4))
+    assert len(batches) == 3
+    bx, by = batches[0]
+    assert bx.dtype == np.float32 and bx.shape == (4, 3)
+    np.testing.assert_allclose(bx.asnumpy(), npx[:4], rtol=1e-7)
+    np.testing.assert_array_equal(
+        by.asnumpy().ravel(), np.arange(4, dtype=np.float32))
