@@ -56,6 +56,7 @@ from . import profiler
 from . import monitor
 from . import image
 from . import config
+from . import resilience
 from . import visualization
 from . import visualization as viz
 from . import amp
@@ -70,7 +71,7 @@ __all__ = [
     "lr_scheduler", "callback", "recordio", "io", "parallel", "symbol",
     "sym", "Symbol", "module", "mod", "Module", "BucketingModule", "model",
     "save_checkpoint", "load_checkpoint", "profiler", "monitor",
-    "operator", "image", "config", "amp", "contrib",
+    "operator", "image", "config", "amp", "contrib", "resilience",
     "SequentialModule", "visualization", "viz", "runtime", "util", "rnn",
     "attribute", "AttrScope", "name", "engine",
 ]

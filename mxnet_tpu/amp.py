@@ -155,11 +155,26 @@ class LossScaler:
                 self.loss_scale *= self._factor
                 self._unskipped = 0
 
+    # -- persistence (resilience.CheckpointManager rides this so a
+    # resumed run re-enters with the backed-off scale, not the init one)
+    def state_dict(self):
+        return {"loss_scale": float(self.loss_scale),
+                "unskipped": int(self._unskipped)}
+
+    def load_state_dict(self, state):
+        self.loss_scale = float(state["loss_scale"])
+        self._unskipped = int(state.get("unskipped", 0))
+
 
 def init_trainer(trainer):
     """Attach dynamic loss scaling to a Trainer (ref: amp.init_trainer):
     after this, ``trainer.step`` unscales gradients and SKIPS the update
-    when they overflowed, then updates the scale."""
+    when they overflowed, then updates the scale.
+
+    A ``CachedTrainStep`` built from this trainer with
+    ``MXT_SKIP_NONFINITE=1`` drives the same scaler from its in-program
+    overflow flag (one host read per step, no extra launches) — see
+    resilience.py."""
     if getattr(trainer, "_amp_scaler", None) is not None:
         return
     scaler = LossScaler()

@@ -176,6 +176,8 @@ class AsyncParamServer:
         self._store = {}     # key -> np.ndarray (the weight)
         self._updater = None
         self._mutate = threading.Lock()  # ps-lite customer-thread analog
+        self._conns = set()  # live client sockets, torn down by close()
+        self._conns_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -192,6 +194,12 @@ class AsyncParamServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return  # socket closed during shutdown
+            if self._stop.is_set():
+                # a connect that raced close(): refuse service
+                conn.close()
+                return
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True, name="kv-async-conn").start()
 
@@ -299,21 +307,62 @@ class AsyncParamServer:
                 pass
         finally:
             conn.close()
+            with self._conns_lock:
+                self._conns.discard(conn)
 
     def close(self):
+        """Stop serving: wake the (possibly accept()-blocked) listener —
+        a blocked accept holds a kernel reference that would otherwise
+        keep the port alive — and tear down live client connections, so
+        'server gone' is observable by workers (their retries then fail
+        over to KVStoreError instead of talking to a zombie)."""
         self._stop.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
 
 class AsyncClient:
-    """One worker's connection to the async server."""
+    """One worker's connection to the async server.
+
+    ``request`` is fault-tolerant: a connection-shaped failure (peer
+    reset, injected ``MXT_FAULT`` drop) tears the socket down and
+    reconnects — full banner handshake included — under the
+    resilience retry policy (exponential backoff + jitter, bounded
+    retries, per-op deadline). A server that is truly gone raises
+    :class:`~..resilience.KVStoreError` instead of hanging. Delivery is
+    at-least-once: a drop in the window between the server applying a
+    push and its ack being read re-sends the push (the reference's
+    hogwild async mode tolerates duplicate gradient application the same
+    way it tolerates staleness)."""
 
     def __init__(self, host, port, timeout=30.0):
+        self._host = host
+        self._port = port
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._connect()
+
+    def _connect(self):
         import time
 
+        host, port, timeout = self._host, self._port, self._timeout
         deadline = time.monotonic() + timeout
         last = None
         while time.monotonic() < deadline:
@@ -367,12 +416,24 @@ class AsyncClient:
             raise
         self._ch = _Channel(self._sock, secret if server_auth else None,
                             nonce, b"C")
-        self._lock = threading.Lock()
+
+    def _reconnect(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._connect()
 
     def request(self, op, key=None, payload=None):
-        with self._lock:
-            self._ch.send((op, key, payload))
-            status, result = self._ch.recv()
+        from . import resilience
+
+        def attempt():
+            with self._lock:
+                self._ch.send((op, key, payload))
+                return self._ch.recv()
+
+        status, result = resilience.kv_retry(
+            op, key, attempt, reconnect=self._reconnect)
         if status != "ok":
             raise MXNetError("async kvstore server error: %s" % result)
         return result

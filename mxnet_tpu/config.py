@@ -99,6 +99,33 @@ _declare("MXT_BN_PALLAS", bool, False,
          "read of (x, dy). Default off until chip-measured vs the XLA "
          "custom-VJP path (the A/B is staged in the recovery runbook).")
 
+_declare("MXT_SKIP_NONFINITE", bool, False,
+         "Skip the optimizer update (weights, optimizer state, step "
+         "counter all untouched) whenever any gradient is non-finite. "
+         "Eager Trainer.step/Module.update run one fused multi_all_finite "
+         "check; the fused CachedTrainStep compiles the guard into its "
+         "single launch via lax.cond (read when the fused program builds). "
+         "Skips land in the 'skipped_nonfinite_steps' profiler counter.")
+
+_declare("MXT_FAULT", str, None,
+         "Deterministic fault injection (resilience.py), e.g. "
+         "'kv_drop:p=0.5,seed=7,n=10;kv_delay:p=0.2,ms=5;"
+         "ckpt_crash:at=manifest,n=1'. kv_drop/kv_delay hit kvstore "
+         "network ops; ckpt_crash raises SimulatedCrash at a named "
+         "CheckpointManager write phase (params|states|manifest|rotate).")
+
+_declare("MXT_KV_RETRIES", int, 4,
+         "Max retries for a kvstore network op (dist push reduction, "
+         "async client request) before raising KVStoreError.")
+_declare("MXT_KV_RETRY_BASE", float, 0.05,
+         "Base seconds for kvstore retry exponential backoff "
+         "(base * 2^(attempt-1), plus jitter).")
+_declare("MXT_KV_RETRY_MAX", float, 2.0,
+         "Cap in seconds on a single kvstore retry backoff delay.")
+_declare("MXT_KV_DEADLINE", float, 30.0,
+         "Per-op deadline in seconds for kvstore network ops; exceeding "
+         "it raises KVStoreError instead of hanging the worker.")
+
 _declare("MXT_AG_LEAN_TAPE", bool, False,
          "Skip storing per-node replay state (forward fn + primal "
          "inputs) on the autograd tape. Saves peak memory on very long "

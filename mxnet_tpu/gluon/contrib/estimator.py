@@ -103,10 +103,20 @@ class CheckpointHandler(EventHandler):
     """Save parameters each epoch, optionally only on metric improvement
     (ref: event_handler.py — CheckpointHandler). mode: "auto" (default)
     infers the direction from the monitor's name — loss-like monitors
-    minimize, accuracy-like maximize; "max"/"min" force it."""
+    minimize, accuracy-like maximize; "max"/"min" force it.
+
+    ``full_state=True`` upgrades the per-epoch save from bare params to
+    an atomic full-training-state checkpoint (params + trainer/optimizer
+    state + epoch cursor + loss-scale + PRNG, one CRC'd manifest —
+    resilience.CheckpointManager), rotated to the last ``keep_last``.
+    With ``resume_from_checkpoint=True`` a killed run restarts where it
+    left off: ``train_begin`` restores the newest valid checkpoint and
+    fast-forwards ``estimator.epoch``, so ``fit(epochs=N)`` trains the
+    REMAINING epochs of the original schedule."""
 
     def __init__(self, model_dir, model_prefix="model", monitor=None,
-                 save_best=False, mode="auto"):
+                 save_best=False, mode="auto", full_state=False,
+                 resume_from_checkpoint=False, keep_last=3):
         import os
 
         os.makedirs(model_dir, exist_ok=True)
@@ -115,11 +125,37 @@ class CheckpointHandler(EventHandler):
         self.monitor = monitor
         self.save_best = save_best
         self.mode = mode
+        self.full_state = full_state
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.keep_last = keep_last
         self._best = None
+        self._manager = None
+
+    def _mgr(self, estimator):
+        if self._manager is None:
+            from ...resilience import CheckpointManager
+
+            self._manager = CheckpointManager(
+                self.model_dir, net=estimator.net,
+                trainer=estimator.trainer, prefix=self.model_prefix,
+                keep_last=self.keep_last)
+        return self._manager
+
+    def train_begin(self, estimator):
+        if self.full_state and self.resume_from_checkpoint:
+            state = self._mgr(estimator).resume()
+            if state is not None:
+                # fires before fit() reads its start epoch, so the loop
+                # continues right after the last completed epoch
+                estimator.epoch = state.epoch + 1
 
     def epoch_end(self, estimator):
         import os
 
+        if self.full_state:
+            self._mgr(estimator).save(epoch=estimator.epoch,
+                                      step=estimator.epoch + 1)
+            return
         path = os.path.join(self.model_dir, "%s-%04d.params"
                             % (self.model_prefix, estimator.epoch))
         if not self.save_best:

@@ -23,6 +23,7 @@ import multiprocessing
 
 import numpy as np
 
+from ...base import MXNetError
 from ...ndarray.ndarray import NDArray
 from ...ndarray import ndarray as _nd
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
@@ -245,9 +246,13 @@ class DataLoader:
         """Reference-style fork workers. dataset[i] + numpy batchify run
         in the child; device placement (and any custom batchify_fn, which
         may build NDArrays) runs in the parent. Child exceptions re-raise
-        at .result(); an abruptly dead worker (OOM-kill) surfaces as
-        BrokenProcessPool rather than hanging the loader (which a plain
-        multiprocessing.Pool would)."""
+        at .result(); an abruptly dead worker (OOM-kill, SIGKILL) is
+        detected by the executor and surfaced as a descriptive
+        MXNetError rather than hanging the consumer (which a plain
+        multiprocessing.Pool would do: its result queue just never
+        delivers)."""
+        from concurrent.futures.process import BrokenProcessPool
+
         ctx = multiprocessing.get_context("fork")
         job = _worker_samples if self._custom_batchify else _worker_load
         with concurrent.futures.ProcessPoolExecutor(
@@ -262,7 +267,15 @@ class DataLoader:
             except StopIteration:
                 it = None
             while pending:
-                raw = pending.popleft().result()
+                try:
+                    raw = pending.popleft().result()
+                except BrokenProcessPool as e:
+                    raise MXNetError(
+                        "DataLoader worker process died unexpectedly "
+                        "(killed by the OS — OOM? — or crashed hard). "
+                        "Reduce worker memory use or num_workers, or "
+                        "switch to thread workers (thread_pool=True)."
+                    ) from e
                 if it is not None:
                     try:
                         pending.append(pool.submit(job, next(it)))

@@ -35,6 +35,12 @@ Ineligible configurations (unsupported optimizer, sparse grads, dist
 kvstore, multi-process, grad_req='add') fall back transparently to the
 eager record/backward/step loop — same numerics, more launches. Gate:
 ``MXT_FUSED_STEP`` (default on, mirrors ``MXT_FUSED_TRAINER``).
+
+With ``MXT_SKIP_NONFINITE=1`` the resilience non-finite guard compiles
+INTO the program (resilience.py): a ``lax.cond`` makes the whole
+weight/state/aux update the identity when any gradient is non-finite,
+the step counter stays put, and the overflow flag returns as one extra
+scalar output — one host read, still exactly one launch per step.
 """
 from __future__ import annotations
 
@@ -110,6 +116,8 @@ class CachedTrainStep:
         self._train_names = None
         self._aux_names = None
         self._indices = None
+        self._guard = False
+        self._built_opt = None
 
     # -- introspection ---------------------------------------------------
     @property
@@ -196,6 +204,11 @@ class CachedTrainStep:
         self._indices = [tr._param2idx[n] for n in self._train_names]
 
         o = tr._optimizer
+        self._built_opt = o
+        # the guard compiles INTO the program, so the flag is read once
+        # at build time (toggling the env later needs a fresh step fn)
+        self._guard = bool(_config().get("MXT_SKIP_NONFINITE"))
+        guard = self._guard
         upds = [_FusedUpdate._param_update(o, i) for i in self._indices]
         all_params = self._all_params
         train_names, aux_names = self._train_names, self._aux_names
@@ -236,13 +249,37 @@ class CachedTrainStep:
             key = jax.random.fold_in(base_key, t)
             (_, (loss_vec, new_aux, outs)), grads = jax.value_and_grad(
                 pure_loss, has_aux=True)(train_vals, aux_vals, xv, yv, key)
-            new_train, new_states = [], []
-            for f, w, g, s in zip(upds, train_vals, grads, states):
-                w2, s2 = f(w, g, s, t, lr, wd, rescale)
-                new_train.append(w2)
-                new_states.append(s2)
-            return (loss_vec, tuple(new_train), tuple(new_states), new_aux,
-                    outs)
+
+            def _apply(_):
+                new_train, new_states = [], []
+                for f, w, g, s in zip(upds, train_vals, grads, states):
+                    w2, s2 = f(w, g, s, t, lr, wd, rescale)
+                    new_train.append(w2)
+                    new_states.append(s2)
+                return tuple(new_train), tuple(new_states), new_aux
+
+            if not guard:
+                new_train, new_states, kept_aux = _apply(None)
+                return (loss_vec, new_train, new_states, kept_aux, outs)
+
+            # non-finite step guard (resilience.py): the all-finite check
+            # and the identity-on-overflow update are part of THIS program
+            # — zero extra launches; the flag is one extra (scalar) output
+            # and aux (BatchNorm stats) also roll back so a NaN forward
+            # never pollutes the running statistics
+            import jax.numpy as jnp
+
+            finite = jnp.bool_(True)
+            for g in grads:
+                finite = jnp.logical_and(finite, jnp.isfinite(g).all())
+
+            def _skip(_):
+                return tuple(train_vals), tuple(states), tuple(aux_vals)
+
+            new_train, new_states, kept_aux = jax.lax.cond(
+                finite, _apply, _skip, None)
+            return (loss_vec, new_train, new_states, kept_aux, outs,
+                    finite)
 
         # weights + optimizer state + aux donated: buffers are reused
         # across steps (the static_alloc analog) and the Parameter
@@ -269,13 +306,28 @@ class CachedTrainStep:
             return None
         rescale = tr._scale / batch_size
         tr._check_and_rescale_grad(rescale)
-        # host bookkeeping mirrors the eager order (_update_count then
-        # _get_lr): the scheduler sees the post-bump num_update
-        for i in self._indices:
-            o._update_count(i)
-        t = o._index_update_count[self._indices[0]] if self._indices else 1
-        lr = o.lr_scheduler(o.num_update) if o.lr_scheduler is not None \
-            else o.lr
+        if self._guard:
+            # speculative bookkeeping: the step count only advances after
+            # the ONE host read of the in-program finite flag, so a
+            # skipped step leaves every counter untouched. t/num_update
+            # are computed as _update_count WOULD leave them (counts are
+            # even here — the fused precondition above).
+            base = o._index_update_count.get(
+                self._indices[0], o.begin_num_update) \
+                if self._indices else 0
+            t = base + 1 if self._indices else 1
+            num_update = max(o.num_update, t)
+            lr = o.lr_scheduler(num_update) if o.lr_scheduler is not None \
+                else o.lr
+        else:
+            # host bookkeeping mirrors the eager order (_update_count then
+            # _get_lr): the scheduler sees the post-bump num_update
+            for i in self._indices:
+                o._update_count(i)
+            t = o._index_update_count[self._indices[0]] \
+                if self._indices else 1
+            lr = o.lr_scheduler(o.num_update) \
+                if o.lr_scheduler is not None else o.lr
         wd = o.wd
         ws = tuple(self._all_params[n].data().data
                    for n in self._train_names)
@@ -288,10 +340,16 @@ class CachedTrainStep:
             # drawn lazily so mx.random.seed() between construction and
             # the first step still takes effect
             self._base_key = _random.new_key()
-        loss_vec, new_w, new_s, new_aux, outs = self._jit(
+        result = self._jit(
             ws, ss, aux, x.data, y.data, self._base_key, t, float(lr),
             float(wd), float(rescale))
         _count_launch()
+        if self._guard:
+            loss_vec, new_w, new_s, new_aux, outs, finite = result
+        else:
+            loss_vec, new_w, new_s, new_aux, outs = result
+        # rebind unconditionally: donation consumed the input buffers, and
+        # on a skipped step the outputs ARE the (identity) old values
         for n, i, w2, s2 in zip(self._train_names, self._indices, new_w,
                                 new_s):
             self._all_params[n].data()._set_data(w2)
@@ -299,6 +357,20 @@ class CachedTrainStep:
                 leaf._set_data(v)
         for n, v in zip(self._aux_names, new_aux):
             self._all_params[n].data()._set_data(v)
+        if self._guard:
+            import numpy as _np
+
+            ok = bool(_np.asarray(finite))  # the ONE host read
+            if ok:
+                for i in self._indices:
+                    o._update_count(i)
+            else:
+                from .. import resilience
+                resilience.record_skipped_step()
+            scaler = getattr(tr, "_amp_scaler", None)
+            if scaler is not None:
+                # dynamic loss-scale backoff driven from the same flag
+                scaler.update_scale(not ok)
         loss = NDArray(loss_vec)
         if self._return_outputs:
             out_nds = [NDArray(o_) for o_ in outs]
@@ -331,6 +403,12 @@ class CachedTrainStep:
             tr._init_kvstore()
         if tr._params_to_init:
             tr._init_params()
+        if self._jit is not None and tr._optimizer is not self._built_opt:
+            # trainer.load_states swapped the optimizer object; the jit
+            # closed over the old hyper-params — rebuild against the live
+            # one so a resumed run stays fused with the right settings
+            self._jit = None
+            self._fallback_reason = None
         if self._jit is None and self._fallback_reason is None:
             self._fallback_reason = self.eligible(tr, self._net)
             if self._fallback_reason is None:

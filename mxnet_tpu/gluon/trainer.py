@@ -384,13 +384,20 @@ class Trainer:
     # ------------------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce + optimizer update, scaled by 1/batch_size
-        (ref: trainer.py — step)."""
+        (ref: trainer.py — step). With ``MXT_SKIP_NONFINITE=1`` a batch
+        whose gradients contain NaN/Inf is skipped wholesale — weights,
+        optimizer state, and update counts untouched (resilience.py)."""
         rescale_grad = self._scale / batch_size
         self._check_and_rescale_grad(rescale_grad)
         if not self._kv_initialized:
             self._init_kvstore()
         if self._params_to_init:
             self._init_params()
+        from .. import resilience
+        if resilience.skip_nonfinite_enabled() and \
+                self._grads_overflowed():
+            resilience.record_skipped_step()
+            return
         if self._fused is None:
             self._fused = _FusedUpdate(self) if _FusedUpdate.eligible(self) \
                 else False
@@ -398,6 +405,17 @@ class Trainer:
             return  # one donated launch covered reduce (identity) + update
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+
+    def _grads_overflowed(self):
+        """True if any live gradient is non-finite — one fused device
+        check + one host read for the whole set (the LossScaler
+        machinery; resilience.all_finite)."""
+        from .. import resilience
+
+        grads = [p.grad() for p in self._params
+                 if p.grad_req != "null" and p._data is not None
+                 and getattr(p._data, "_grad", None) is not None]
+        return bool(grads) and not resilience.all_finite(grads)
 
     def _check_and_rescale_grad(self, scale):
         if self._update_on_kvstore and self._kv_initialized and \
@@ -467,14 +485,28 @@ class Trainer:
 
     # -- state persistence (ref: trainer.py — save_states/load_states) -----
     def save_states(self, fname):
-        assert self._optimizer is not None
+        """Serialize optimizer state + update counts. Valid at ANY point
+        — including before the first ``step()`` (per-parameter state is
+        created lazily, so an early save just records the optimizer and
+        empty state dicts); failure modes raise a clear MXNetError
+        rather than an IndexError/AssertionError."""
+        if self._optimizer is None:
+            raise MXNetError(
+                "Trainer has no optimizer — cannot save states")
         if not self._kv_initialized:
             self._init_kvstore()
         if self._params_to_init:
             self._init_params()
         if self._update_on_kvstore:
+            if self._kvstore is None or self._kvstore._updater is None:
+                raise MXNetError(
+                    "update_on_kvstore trainer has no server-side "
+                    "updater yet — cannot save states")
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
         else:
+            if not self._updaters:
+                raise MXNetError(
+                    "Trainer has no updater — cannot save states")
             with open(fname, "wb") as fout:
                 fout.write(self._updaters[0].get_states(dump_optimizer=True))
 
@@ -483,6 +515,9 @@ class Trainer:
             self._init_kvstore()
         if self._params_to_init:
             self._init_params()
+        if not self._update_on_kvstore and not self._updaters:
+            raise MXNetError(
+                "Trainer has no updater — cannot load states")
         # the fused step closes over the optimizer OBJECT (hyper-params,
         # update counts); loading swaps it — rebuild on next step
         self._fused = None

@@ -77,8 +77,11 @@ def download(url, path=None, overwrite=False, sha1_hash=None,
              retries=5, verify_ssl=True):
     """Fetch a URL to a local file (ref: gluon/utils.py — download).
     Same signature/return contract; in a no-egress environment the
-    urllib call raises and the error says so plainly."""
+    urllib call raises and the error says so plainly. Failed attempts
+    back off exponentially (0.5 s, 1 s, 2 s, ... capped at 8 s) instead
+    of hammering the server in a tight loop."""
     import os
+    import time
     import urllib.request
 
     if path is None:
@@ -93,14 +96,16 @@ def download(url, path=None, overwrite=False, sha1_hash=None,
     d = os.path.dirname(os.path.abspath(fname))
     if d:
         os.makedirs(d, exist_ok=True)
-    last = None
-    for _ in range(max(1, retries)):
-        try:
-            ctx = None
-            if not verify_ssl:
-                import ssl
+    ctx = None
+    if not verify_ssl:
+        import ssl
 
-                ctx = ssl._create_unverified_context()
+        ctx = ssl._create_unverified_context()
+    last = None
+    for attempt in range(max(1, retries)):
+        if attempt:
+            time.sleep(min(0.5 * (2 ** (attempt - 1)), 8.0))
+        try:
             with urllib.request.urlopen(url, context=ctx) as r, \
                     open(fname, "wb") as f:
                 f.write(r.read())

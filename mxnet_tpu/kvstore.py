@@ -35,8 +35,10 @@ from .base import MXNetError
 from .ndarray.ndarray import NDArray
 from .ndarray import ndarray as _nd
 from . import optimizer as opt
+from . import resilience
+from .resilience import KVStoreError
 
-__all__ = ["KVStore", "GradientCompression", "create"]
+__all__ = ["KVStore", "GradientCompression", "KVStoreError", "create"]
 
 
 def _key_str(key):
@@ -220,7 +222,14 @@ class KVStore:
                 # compress this worker's contribution before it crosses
                 # the network (ref: push-side compression in kvstore_dist)
                 merged = self._maybe_compress(k, merged)
-                merged = self._dist_reduce(merged)
+                # the cross-process reduction is the network step: retry
+                # transient drops with backoff, raise KVStoreError (not a
+                # hang) when the budget is exhausted (resilience.kv_retry;
+                # MXT_FAULT kv_drop/kv_delay inject here). The reduction
+                # is pure — a retried attempt is idempotent; the store
+                # mutation below happens only after it succeeds.
+                merged = resilience.kv_retry(
+                    "push", k, lambda m=merged: self._dist_reduce(m))
             if k not in self._store:
                 self._store[k] = merged.copy()
                 continue

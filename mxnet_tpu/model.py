@@ -1,6 +1,12 @@
 """Checkpoint helpers (ref: python/mxnet/model.py — save_checkpoint /
 load_checkpoint; format: prefix-symbol.json + prefix-%04d.params with
 ``arg:``/``aux:`` key prefixes, identical to the reference on-disk layout).
+
+These cover the symbolic graph + parameters ONLY — no optimizer state,
+cursor, loss-scale, or PRNG, and the write is not crash-atomic. For
+full-training-state checkpoints with CRC-verified atomic publication and
+auto-resume (the Gluon path), use ``resilience.CheckpointManager``; the
+mapping is documented in MIGRATION.md.
 """
 from __future__ import annotations
 

@@ -264,6 +264,16 @@ class Module(BaseModule):
     def update(self):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
+        from .. import resilience
+        if resilience.skip_nonfinite_enabled():
+            grads = [g for g in (self._exec.grad_dict.get(n)
+                                 for n in self._param_names)
+                     if g is not None]
+            if grads and not resilience.all_finite(grads):
+                # skip-step guard (MXT_SKIP_NONFINITE): weights, optimizer
+                # state, and update counts all stay untouched
+                resilience.record_skipped_step()
+                return
         if self._fused_update is None:
             self._fused_update = self._build_fused_update()
         if self._fused_update:

@@ -21,7 +21,8 @@ from .base import MXNetError
 
 __all__ = ["set_config", "set_state", "state", "start", "stop", "pause",
            "resume", "dump", "dumps", "Domain", "Task", "Frame", "Counter",
-           "Marker", "record_launch", "launch_count", "reset_launch_count"]
+           "Marker", "record_launch", "launch_count", "reset_launch_count",
+           "counter_value"]
 
 _config = {
     "filename": "profile_output",
@@ -64,6 +65,12 @@ def reset_launch_count():
     prev = _launch_count[0]
     _launch_count[0] = 0
     return prev
+
+
+def counter_value(name, default=0):
+    """Current value of a named profiler Counter (the dumps() table
+    entries) — e.g. resilience's 'skipped_nonfinite_steps'."""
+    return _counters.get(name, default)
 
 
 def set_config(**kwargs):

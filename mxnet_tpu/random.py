@@ -14,7 +14,8 @@ import threading
 
 import jax
 
-__all__ = ["seed", "new_key", "key_scope", "current_seed"]
+__all__ = ["seed", "new_key", "key_scope", "current_seed", "get_state",
+           "set_state"]
 
 
 class _RandState(threading.local):
@@ -48,6 +49,36 @@ def seed(seed_state, ctx="all"):
 
 def current_seed():
     return _state.seed_ if _state.seed_ is not None else _DEFAULT_SEED
+
+
+def get_state():
+    """JSON-serializable snapshot of the global PRNG: the seed AND the
+    evolved key (the key advances by split on every new_key() draw, so
+    the seed alone cannot reproduce mid-run state). Checkpointing rides
+    this (resilience.CheckpointManager)."""
+    import numpy as np
+
+    key = _state.key
+    data = None
+    if key is not None:
+        data = np.asarray(jax.random.key_data(key),
+                          dtype=np.uint32).tolist()
+    return {"seed": _state.seed_, "key_data": data}
+
+
+def set_state(state):
+    """Restore a get_state() snapshot (inverse operation)."""
+    import numpy as np
+
+    _state.seed_ = state.get("seed")
+    data = state.get("key_data")
+    if data is not None:
+        _state.key = jax.random.wrap_key_data(
+            np.asarray(data, dtype=np.uint32))
+    elif _state.seed_ is not None:
+        _state.key = jax.random.key(int(_state.seed_))
+    else:
+        _state.key = None
 
 
 class key_scope:

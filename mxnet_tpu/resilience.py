@@ -1,0 +1,558 @@
+"""Resilience — skip-step guards, atomic checkpoints, and fault-tolerant
+KVStore plumbing for long-running training.
+
+The north-star system trains for days; three failure modes dominate real
+pods and each gets a pillar here:
+
+1. **Non-finite step guard.** A single NaN/Inf batch silently corrupts
+   weights and every step after it. With ``MXT_SKIP_NONFINITE=1`` the
+   optimizer update is skipped whenever any gradient is non-finite: the
+   eager ``Trainer.step``/``Module.update`` paths run one fused
+   ``multi_all_finite`` check (ref: src/operator/contrib/all_finite.cc —
+   the machinery behind AMP's dynamic loss scaling), and the fused
+   ``CachedTrainStep`` compiles the check *into* the one-launch program
+   via ``jax.lax.cond`` so the guard costs zero extra launches — the
+   weight/state/aux update is the identity on overflow, the step counter
+   does not advance, and the flag comes back as one extra output (one
+   host read). Skipped steps land in the ``skipped_nonfinite_steps``
+   profiler counter.
+
+2. **Atomic checkpoint + auto-resume.** :class:`CheckpointManager`
+   writes net params + ``Trainer.save_states`` + the epoch/step cursor +
+   loss-scale + PRNG state as ONE manifest with per-file CRC32, via
+   tmp-file → fsync → ``os.replace`` (crash-safe at any byte: a reader
+   only trusts checkpoints whose manifest exists and whose CRCs verify).
+   Keep-last-K rotation bounds disk; :meth:`CheckpointManager.resume`
+   restores everything — including fused-step re-eligibility, since
+   ``Trainer.load_states`` keeps optimizer update counts even and
+   ``CachedTrainStep`` rebuilds against the swapped optimizer object.
+
+3. **KVStore retry.** :func:`kv_retry` wraps network-facing kvstore ops
+   (dist push reductions, every ``AsyncClient`` request) in exponential
+   backoff + jitter with bounded retries and a per-op deadline; a server
+   that is truly gone surfaces as a clean :class:`KVStoreError` instead
+   of a hang.
+
+Everything above is testable deterministically through the ``MXT_FAULT``
+hook: a seeded injector that drops sockets, delays acks, and crashes
+checkpoint writes at named points.
+
+``MXT_FAULT`` grammar (semicolon-separated rules)::
+
+    kv_drop:p=0.5,seed=7,n=10    # drop kvstore ops w.p. 0.5 (max 10)
+    kv_delay:p=0.2,ms=5,seed=1   # delay acks 5 ms w.p. 0.2
+    ckpt_crash:at=manifest,n=1   # SimulatedCrash at a checkpoint phase
+                                 # (at= params | states | manifest | rotate)
+
+``p`` defaults to 1.0, ``n`` (max firings) to unlimited, ``seed`` to 0.
+One injector instance lives per distinct spec string so the drawn
+sequence is reproducible; :func:`reset_faults` rewinds it.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random as _pyrandom
+import time
+import zlib
+from collections import namedtuple
+
+from .base import MXNetError
+
+__all__ = [
+    "KVStoreError", "SimulatedCrash", "FaultInjector", "reset_faults",
+    "fault_point", "crash_point", "RetryPolicy", "kv_retry",
+    "skip_nonfinite_enabled", "all_finite", "record_skipped_step",
+    "skipped_step_count", "CheckpointManager", "ResumeState",
+]
+
+
+class KVStoreError(MXNetError):
+    """A kvstore network operation failed permanently: retries/backoff
+    were exhausted or the per-op deadline passed. Raised instead of
+    letting a dead server hang the worker."""
+
+
+class SimulatedCrash(RuntimeError):
+    """Raised by the ``MXT_FAULT`` ``ckpt_crash`` rule to emulate the
+    process being killed at a specific byte of a checkpoint write.
+    Deliberately NOT an MXNetError: production code must never catch it
+    accidentally — only the test harness does."""
+
+
+# --------------------------------------------------------------------------
+# fault injection
+# --------------------------------------------------------------------------
+class FaultInjector:
+    """Deterministic (seeded) fault source parsed from an MXT_FAULT spec."""
+
+    def __init__(self, spec):
+        self.spec = spec
+        self._rules = {}
+        self._rng = {}
+        self._fired = {}
+        for part in filter(None, (s.strip() for s in spec.split(";"))):
+            kind, _, body = part.partition(":")
+            kind = kind.strip()
+            params = {}
+            for kv in filter(None, (s.strip() for s in body.split(","))):
+                k, _, v = kv.partition("=")
+                params[k.strip()] = v.strip()
+            self._rules[kind] = params
+            self._rng[kind] = _pyrandom.Random(int(params.get("seed", 0)))
+            self._fired[kind] = 0
+
+    def rule(self, kind):
+        return self._rules.get(kind)
+
+    def should(self, kind):
+        """Draw the (seeded) dice for ``kind``; respects the ``n`` cap."""
+        params = self._rules.get(kind)
+        if params is None:
+            return False
+        cap = params.get("n")
+        if cap is not None and self._fired[kind] >= int(cap):
+            return False
+        p = float(params.get("p", 1.0))
+        if p < 1.0 and self._rng[kind].random() >= p:
+            return False
+        self._fired[kind] += 1
+        return True
+
+    def maybe_delay(self):
+        """Sleep if a kv_delay rule fires (delayed-ack emulation)."""
+        if self.should("kv_delay"):
+            ms = float(self._rules["kv_delay"].get("ms", 1.0))
+            time.sleep(ms / 1e3)
+
+    def maybe_drop(self):
+        """Raise ConnectionError if a kv_drop rule fires — the injected
+        socket drop rides the SAME retry path real drops do."""
+        if self.should("kv_drop"):
+            raise ConnectionError(
+                "injected socket drop (MXT_FAULT %r)" % self.spec)
+
+    def crash_point(self, point):
+        """Raise SimulatedCrash if a ckpt_crash rule targets ``point``."""
+        params = self._rules.get("ckpt_crash")
+        if params is not None and params.get("at") == point \
+                and self.should("ckpt_crash"):
+            raise SimulatedCrash(
+                "injected crash at checkpoint phase %r (MXT_FAULT %r)"
+                % (point, self.spec))
+
+
+class _NullInjector:
+    spec = ""
+
+    @staticmethod
+    def rule(kind):
+        return None
+
+    @staticmethod
+    def should(kind):
+        return False
+
+    @staticmethod
+    def maybe_delay():
+        pass
+
+    @staticmethod
+    def maybe_drop():
+        pass
+
+    @staticmethod
+    def crash_point(point):
+        pass
+
+
+_NULL = _NullInjector()
+_injectors = {}  # spec string -> FaultInjector (RNG state persists)
+
+
+def _fault():
+    from . import config
+
+    spec = config.get("MXT_FAULT")
+    if not spec:
+        return _NULL
+    if spec not in _injectors:
+        _injectors[spec] = FaultInjector(spec)
+    return _injectors[spec]
+
+
+def fault_point():
+    """The active injector (a no-op singleton when MXT_FAULT is unset)."""
+    return _fault()
+
+
+def crash_point(point):
+    """Module-level shorthand: raise SimulatedCrash when the active
+    MXT_FAULT targets checkpoint phase ``point``."""
+    _fault().crash_point(point)
+
+
+def reset_faults():
+    """Forget cached injectors so a re-used spec re-seeds from scratch
+    (test isolation helper)."""
+    _injectors.clear()
+
+
+# --------------------------------------------------------------------------
+# retry policy
+# --------------------------------------------------------------------------
+class RetryPolicy:
+    """Exponential backoff + jitter with bounded retries and a deadline."""
+
+    def __init__(self, retries=4, base=0.05, max_delay=2.0, deadline=30.0,
+                 jitter=0.1):
+        self.retries = int(retries)
+        self.base = float(base)
+        self.max_delay = float(max_delay)
+        self.deadline = float(deadline)
+        self.jitter = float(jitter)
+
+    @classmethod
+    def from_config(cls):
+        from . import config
+
+        return cls(retries=config.get("MXT_KV_RETRIES"),
+                   base=config.get("MXT_KV_RETRY_BASE"),
+                   max_delay=config.get("MXT_KV_RETRY_MAX"),
+                   deadline=config.get("MXT_KV_DEADLINE"))
+
+    def delay(self, attempt):
+        """Backoff before retry ``attempt`` (1-based): base·2^(a-1),
+        capped, plus up to ``jitter`` fraction of random spread so a
+        fleet of workers doesn't reconnect in lockstep."""
+        d = min(self.base * (2.0 ** (attempt - 1)), self.max_delay)
+        return d * (1.0 + self.jitter * _pyrandom.random())
+
+
+def kv_retry(op, key, fn, reconnect=None, policy=None):
+    """Run kvstore op ``fn`` under the retry policy with fault injection.
+
+    Connection-shaped failures (ConnectionError/OSError — including the
+    injected drops from ``MXT_FAULT``) are retried with exponential
+    backoff; ``reconnect`` (if given) is invoked between attempts to
+    re-establish the transport. Bounded by both the retry count and the
+    per-op deadline; exhaustion raises :class:`KVStoreError` — the
+    worker never hangs on a dead server. ``fn`` must be idempotent up to
+    the failure point (callers inject/mutate state only after the
+    network step succeeds)."""
+    policy = policy or RetryPolicy.from_config()
+    inj = _fault()
+    deadline_ts = time.monotonic() + policy.deadline
+    attempt = 0
+    while True:
+        try:
+            inj.maybe_drop()
+            inj.maybe_delay()
+            return fn()
+        except (ConnectionError, OSError) as e:
+            attempt += 1
+            if attempt > policy.retries:
+                raise KVStoreError(
+                    "kvstore %s(%r) failed after %d retries: %s"
+                    % (op, key, policy.retries, e)) from e
+            d = policy.delay(attempt)
+            if time.monotonic() + d > deadline_ts:
+                raise KVStoreError(
+                    "kvstore %s(%r) exceeded its %.1fs deadline "
+                    "(attempt %d): %s"
+                    % (op, key, policy.deadline, attempt, e)) from e
+            time.sleep(d)
+            if reconnect is not None:
+                try:
+                    reconnect()
+                except (OSError, MXNetError) as re:
+                    # the transport cannot come back — the server is
+                    # truly gone; fail cleanly rather than spinning out
+                    # the remaining budget
+                    raise KVStoreError(
+                        "kvstore %s(%r): reconnect failed, server "
+                        "unreachable: %s" % (op, key, re)) from re
+
+
+# --------------------------------------------------------------------------
+# non-finite step guard helpers
+# --------------------------------------------------------------------------
+def skip_nonfinite_enabled():
+    from . import config
+
+    return bool(config.get("MXT_SKIP_NONFINITE"))
+
+
+def all_finite(arrays):
+    """True iff every element of every array is finite. ONE fused device
+    check + one host read for the whole set (ref: all_finite.cc —
+    MultiAllFinite), same machinery amp.LossScaler.has_overflow uses."""
+    from .ndarray.ndarray import NDArray
+
+    flat = []
+    for a in arrays:
+        if hasattr(a, "_values"):  # row_sparse: check the stored values
+            v = a._values
+            flat.append(v if isinstance(v, NDArray) else NDArray(v))
+        else:
+            flat.append(a if isinstance(a, NDArray) else NDArray(a))
+    if not flat:
+        return True
+    from . import nd
+
+    flag = nd.multi_all_finite(*flat, num_arrays=len(flat))
+    return float(flag.asnumpy()[0]) == 1.0
+
+
+_SKIP_COUNTER = "skipped_nonfinite_steps"
+_skip_counter = None
+
+
+def record_skipped_step(n=1):
+    """Bump the skipped-step profiler counter (shows in profiler.dumps())."""
+    global _skip_counter
+    from . import profiler
+
+    if _skip_counter is None or _SKIP_COUNTER not in profiler._counters:
+        _skip_counter = profiler.Counter(None, _SKIP_COUNTER)
+    _skip_counter.increment(n)
+
+
+def skipped_step_count():
+    from . import profiler
+
+    return profiler.counter_value(_SKIP_COUNTER)
+
+
+# --------------------------------------------------------------------------
+# atomic checkpoint + auto-resume
+# --------------------------------------------------------------------------
+ResumeState = namedtuple("ResumeState",
+                         ["epoch", "step", "extra", "tag", "manifest"])
+
+_MANIFEST_SUFFIX = ".manifest.json"
+_FORMAT_VERSION = 1
+
+
+def _crc_file(path):
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _fsync_path(path):
+    with open(path, "rb") as f:
+        os.fsync(f.fileno())
+
+
+def _dir_fsync(path):
+    """Durably record renames in the directory entry (best-effort on
+    platforms whose directory fds reject fsync)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _publish(tmp, final):
+    """tmp → fsync → atomic rename: ``final`` either doesn't exist or is
+    the complete new content, at every possible crash byte."""
+    _fsync_path(tmp)
+    os.replace(tmp, final)
+
+
+class CheckpointManager:
+    """Atomic full-training-state checkpoints with keep-last-K rotation.
+
+    Unlike the symbolic ``save_checkpoint`` (model.py — params + symbol
+    only) and bare ``Trainer.save_states`` (optimizer state only), one
+    ``save()`` captures the WHOLE run: net parameters, trainer/optimizer
+    state, the epoch/step cursor, AMP loss-scale, and the global PRNG
+    state — published as payload files plus one CRC-carrying manifest.
+    Write order is payloads → manifest, every file via tmp + fsync +
+    ``os.replace``; a crash at any byte leaves either the previous
+    checkpoint set or the complete new one, never a torn state visible
+    to :meth:`resume` (which also re-verifies sizes + CRC32 so torn or
+    bit-rotted payloads demote to the previous checkpoint).
+
+    Usage::
+
+        mgr = resilience.CheckpointManager("ckpts", net=net,
+                                           trainer=trainer, keep_last=3)
+        start = 0
+        state = mgr.resume()
+        if state is not None:
+            start = state.step          # params/opt/PRNG already restored
+        for t in range(start, steps):
+            step(x_t, y_t)
+            mgr.save(step=t + 1)
+    """
+
+    def __init__(self, directory, net=None, trainer=None, prefix="ckpt",
+                 keep_last=3):
+        self.directory = str(directory)
+        self.net = net
+        self.trainer = trainer
+        self.prefix = prefix
+        self.keep_last = max(1, int(keep_last))
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- write -------------------------------------------------------------
+    def _tag(self, step):
+        return "%s-%010d" % (self.prefix, step)
+
+    def save(self, epoch=0, step=0, extra=None, net=None, trainer=None):
+        """Publish one atomic checkpoint for cursor ``(epoch, step)``.
+        ``extra`` is any JSON-serializable payload riding the manifest
+        (e.g. dataloader cursor). Returns the manifest path."""
+        net = net if net is not None else self.net
+        trainer = trainer if trainer is not None else self.trainer
+        inj = _fault()
+        tag = self._tag(step)
+        files = {}
+
+        def _payload(name, writer, phase):
+            final = os.path.join(self.directory, name)
+            tmp = final + ".tmp"
+            writer(tmp)
+            inj.crash_point(phase)  # kill BEFORE publish: final untouched
+            _publish(tmp, final)
+            files[name] = {"crc32": _crc_file(final),
+                           "size": os.path.getsize(final)}
+
+        if net is not None:
+            _payload(tag + ".params", net.save_parameters, "params")
+        if trainer is not None:
+            _payload(tag + ".states", trainer.save_states, "states")
+
+        from . import random as _random
+
+        scaler = getattr(trainer, "_amp_scaler", None) \
+            if trainer is not None else None
+        meta = {
+            "format": _FORMAT_VERSION,
+            "tag": tag,
+            "epoch": int(epoch),
+            "step": int(step),
+            "time": time.time(),
+            "loss_scale": scaler.state_dict() if scaler is not None
+            else None,
+            "prng": _random.get_state(),
+            "extra": extra,
+            "files": files,
+        }
+        manifest = os.path.join(self.directory, tag + _MANIFEST_SUFFIX)
+        tmp = manifest + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(meta, indent=1, sort_keys=True))
+            f.flush()
+            os.fsync(f.fileno())
+        inj.crash_point("manifest")
+        os.replace(tmp, manifest)
+        _dir_fsync(self.directory)
+        inj.crash_point("rotate")
+        self._rotate()
+        return manifest
+
+    def _rotate(self):
+        entries = self.checkpoints()
+        for meta, manifest in entries[:-self.keep_last]:
+            # manifest first: the checkpoint becomes invisible atomically,
+            # then its payloads are garbage and safe to delete
+            for path in [manifest] + [
+                    os.path.join(self.directory, n)
+                    for n in meta.get("files", {})]:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+    # -- read --------------------------------------------------------------
+    def _validate(self, manifest):
+        """Parsed meta if the manifest and every payload verify, else
+        None (truncated/corrupt checkpoints demote silently)."""
+        try:
+            with open(manifest) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if meta.get("format") != _FORMAT_VERSION:
+            return None
+        for name, want in meta.get("files", {}).items():
+            path = os.path.join(self.directory, name)
+            try:
+                if os.path.getsize(path) != want["size"] or \
+                        _crc_file(path) != want["crc32"]:
+                    return None
+            except OSError:
+                return None
+        return meta
+
+    def checkpoints(self):
+        """[(meta, manifest_path)] for every VALID checkpoint, oldest
+        first (step order)."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        pre = self.prefix + "-"
+        for name in names:
+            if not (name.startswith(pre)
+                    and name.endswith(_MANIFEST_SUFFIX)):
+                continue
+            manifest = os.path.join(self.directory, name)
+            meta = self._validate(manifest)
+            if meta is not None:
+                out.append((meta, manifest))
+        out.sort(key=lambda e: e[0]["step"])
+        return out
+
+    def latest(self):
+        """Meta of the newest valid checkpoint, or None."""
+        entries = self.checkpoints()
+        return entries[-1][0] if entries else None
+
+    def resume(self, net=None, trainer=None):
+        """Restore the newest valid checkpoint. Loads params into the
+        net, optimizer state into the trainer (``load_states`` keeps the
+        fused step re-eligible: update counts stay even and the fused
+        program rebuilds against the swapped optimizer), the AMP
+        loss-scale, and the PRNG state. Returns a :class:`ResumeState`
+        cursor, or None when no valid checkpoint exists."""
+        net = net if net is not None else self.net
+        trainer = trainer if trainer is not None else self.trainer
+        entries = self.checkpoints()
+        if not entries:
+            return None
+        meta, manifest = entries[-1]
+        tag = meta["tag"]
+        if net is not None and (tag + ".params") in meta["files"]:
+            net.load_parameters(os.path.join(self.directory,
+                                             tag + ".params"))
+        if trainer is not None and (tag + ".states") in meta["files"]:
+            trainer.load_states(os.path.join(self.directory,
+                                             tag + ".states"))
+        if trainer is not None and meta.get("loss_scale") is not None:
+            scaler = getattr(trainer, "_amp_scaler", None)
+            if scaler is None:
+                from .amp import LossScaler
+
+                scaler = LossScaler()
+                trainer._amp_scaler = scaler
+            scaler.load_state_dict(meta["loss_scale"])
+        if meta.get("prng") is not None:
+            from . import random as _random
+
+            _random.set_state(meta["prng"])
+        return ResumeState(epoch=meta["epoch"], step=meta["step"],
+                           extra=meta.get("extra"), tag=tag,
+                           manifest=manifest)

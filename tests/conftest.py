@@ -38,6 +38,14 @@ def pytest_configure(config):
         "markers",
         "nightly: slow/large-resource tier (ref: tests/nightly/) — run "
         "with MXT_TEST_NIGHTLY=1; skipped in the default suite")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests (kill-and-resume soaks) — excluded "
+        "from the tier-1 gate, which runs -m 'not slow'")
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (seeded MXT_FAULT, "
+        "resilience.py) — fast enough to run in tier-1")
 
 
 def pytest_collection_modifyitems(config, items):

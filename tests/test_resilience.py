@@ -1,0 +1,734 @@
+"""Resilience subsystem (mxnet_tpu/resilience.py): fused + eager
+non-finite step guards, atomic checkpoint/auto-resume, and fault-injected
+KVStore retry.
+
+The fault-injection tests run deterministically off a seeded ``MXT_FAULT``
+spec (marker: chaos); the long kill-and-resume soak is marked slow and
+stays out of tier-1.
+"""
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu import nd, profiler, resilience
+from mxnet_tpu.gluon import Trainer, nn
+from mxnet_tpu.resilience import (CheckpointManager, KVStoreError,
+                                  SimulatedCrash)
+
+
+@pytest.fixture(autouse=True)
+def _fault_isolation(monkeypatch):
+    """Every test starts with no armed faults and a fresh injector RNG."""
+    monkeypatch.delenv("MXT_FAULT", raising=False)
+    resilience.reset_faults()
+    yield
+    resilience.reset_faults()
+
+
+def _make_net(seed=7, prefix="res_"):
+    mx.random.seed(seed)
+    net = nn.HybridSequential(prefix=prefix)
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(4, in_units=16))
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+def _batch(t, nan=False):
+    rng = np.random.RandomState(100 + t)
+    x = rng.uniform(-1, 1, (8, 8)).astype(np.float32)
+    y = rng.uniform(-1, 1, (8, 4)).astype(np.float32)
+    if nan:
+        x[0, 0] = np.nan
+    return nd.array(x), nd.array(y)
+
+
+def _weights(net):
+    return {k: v.data().asnumpy().copy()
+            for k, v in net.collect_params().items()}
+
+
+def _states(trainer):
+    out = {}
+    for i, s in trainer._updaters[0].states.items():
+        leaves = s if isinstance(s, tuple) else (() if s is None else (s,))
+        out[i] = [l.asnumpy().copy() for l in leaves]
+    return out
+
+
+_loss_fn = mx.gluon.loss.L2Loss()
+
+
+# ---------------------------------------------------------------------------
+# pillar 1 — non-finite step guard
+# ---------------------------------------------------------------------------
+def test_fused_guard_one_launch_and_nan_skip(monkeypatch):
+    """Guard enabled: still EXACTLY one launch per step, and a NaN batch
+    leaves weights + optimizer state bit-identical while bumping the
+    skipped-step counter and freezing the step count."""
+    monkeypatch.setenv("MXT_SKIP_NONFINITE", "1")
+    net = _make_net()
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    step = tr.fuse_step(net, _loss_fn)
+    data = [_batch(t) for t in range(4)]
+    bad = _batch(99, nan=True)
+    step(*data[0]).wait_to_read()  # build + compile
+    step(*data[1]).wait_to_read()
+    assert step.fused and step._guard
+
+    c0 = profiler.launch_count()
+    step(*data[2]).wait_to_read()
+    assert profiler.launch_count() - c0 == 1  # guard costs zero launches
+
+    w0, s0 = _weights(net), _states(tr)
+    n0 = tr._optimizer.num_update
+    k0 = resilience.skipped_step_count()
+    c1 = profiler.launch_count()
+    loss = step(*bad)
+    assert profiler.launch_count() - c1 == 1
+    assert not np.isfinite(loss.asnumpy()).all()  # loss still reported
+    w1, s1 = _weights(net), _states(tr)
+    for k in w0:
+        np.testing.assert_array_equal(w0[k], w1[k], err_msg=k)
+    for i in s0:
+        for a, b in zip(s0[i], s1[i]):
+            np.testing.assert_array_equal(a, b)
+    assert tr._optimizer.num_update == n0  # counter did not advance
+    assert resilience.skipped_step_count() == k0 + 1
+
+    # a clean step afterwards updates again
+    step(*data[3])
+    assert tr._optimizer.num_update == n0 + 1
+
+
+def test_fused_guard_matches_eager_numerics(monkeypatch):
+    """With finite batches the guard is numerically invisible."""
+    data = [_batch(t) for t in range(3)]
+
+    monkeypatch.setenv("MXT_SKIP_NONFINITE", "1")
+    net_g = _make_net()
+    tr_g = Trainer(net_g.collect_params(), "sgd",
+                   {"learning_rate": 0.1, "momentum": 0.9})
+    step = tr_g.fuse_step(net_g, _loss_fn)
+    for x, y in data:
+        step(x, y)
+    assert step.fused and step._guard
+
+    monkeypatch.delenv("MXT_SKIP_NONFINITE")
+    monkeypatch.setenv("MXT_FUSED_STEP", "0")
+    monkeypatch.setenv("MXT_FUSED_TRAINER", "0")
+    net_e = _make_net()
+    tr_e = Trainer(net_e.collect_params(), "sgd",
+                   {"learning_rate": 0.1, "momentum": 0.9})
+    for x, y in data:
+        with ag.record():
+            loss = _loss_fn(net_e(x), y)
+        loss.backward()
+        tr_e.step(8)
+
+    wg, we = _weights(net_g), _weights(net_e)
+    for k in wg:
+        np.testing.assert_allclose(wg[k], we[k], rtol=1e-6, atol=1e-6,
+                                   err_msg=k)
+    assert tr_g._optimizer.num_update == tr_e._optimizer.num_update == 3
+
+
+def test_fused_guard_drives_loss_scaler(monkeypatch):
+    """The AMP LossScaler backs off from the in-program overflow flag —
+    one host read, no extra launches."""
+    from mxnet_tpu.amp import LossScaler
+
+    monkeypatch.setenv("MXT_SKIP_NONFINITE", "1")
+    net = _make_net()
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    scaler = LossScaler(init_scale=2.0 ** 10)
+    tr._amp_scaler = scaler
+    step = tr.fuse_step(net, _loss_fn)
+    step(*_batch(0))
+    assert scaler.loss_scale == 2.0 ** 10 and scaler._unskipped == 1
+    step(*_batch(99, nan=True))
+    assert scaler.loss_scale == 2.0 ** 9  # halved on overflow
+    assert scaler._unskipped == 0
+
+
+@pytest.mark.parametrize("fused_trainer", ["1", "0"])
+def test_eager_trainer_skip_nonfinite(monkeypatch, fused_trainer):
+    monkeypatch.setenv("MXT_SKIP_NONFINITE", "1")
+    monkeypatch.setenv("MXT_FUSED_TRAINER", fused_trainer)
+    net = _make_net()
+    tr = Trainer(net.collect_params(), "sgd",
+                 {"learning_rate": 0.1, "momentum": 0.9})
+    x, y = _batch(0)
+    with ag.record():
+        loss = _loss_fn(net(x), y)
+    loss.backward()
+    tr.step(8)
+    w0, n0 = _weights(net), tr._optimizer.num_update
+    k0 = resilience.skipped_step_count()
+
+    bx, by = _batch(1, nan=True)
+    with ag.record():
+        loss = _loss_fn(net(bx), by)
+    loss.backward()
+    tr.step(8)  # grads are NaN: the whole update is skipped
+    for k, v in _weights(net).items():
+        np.testing.assert_array_equal(v, w0[k], err_msg=k)
+    assert tr._optimizer.num_update == n0
+    assert resilience.skipped_step_count() == k0 + 1
+
+
+def test_module_update_skip_nonfinite(monkeypatch):
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.module import Module
+
+    monkeypatch.setenv("MXT_SKIP_NONFINITE", "1")
+    mx.random.seed(0)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="resfc")
+    out = sym.SoftmaxOutput(net, name="softmax")
+    mod = Module(out, label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+
+    x = np.random.RandomState(0).uniform(-1, 1, (4, 8)).astype(np.float32)
+    lbl = np.array([0, 1, 2, 3], np.float32)
+    batch = DataBatch(data=[nd.array(x)], label=[nd.array(lbl)])
+    mod.forward(batch)
+    mod.backward()
+    mod.update()
+    w0 = {n: a.asnumpy().copy() for n, a in mod._exec.arg_dict.items()
+          if n.startswith("resfc")}
+
+    bad = x.copy()
+    bad[0, 0] = np.inf
+    k0 = resilience.skipped_step_count()
+    mod.forward(DataBatch(data=[nd.array(bad)], label=[nd.array(lbl)]))
+    mod.backward()
+    mod.update()  # non-finite grads: skipped wholesale
+    for n, a in mod._exec.arg_dict.items():
+        if n.startswith("resfc"):
+            np.testing.assert_array_equal(a.asnumpy(), w0[n], err_msg=n)
+    assert resilience.skipped_step_count() == k0 + 1
+
+
+# ---------------------------------------------------------------------------
+# pillar 2 — atomic checkpoint + auto-resume
+# ---------------------------------------------------------------------------
+def _train_fused(net, trainer, start, stop, mgr=None, save_every=1,
+                 crash_collector=None):
+    step = trainer.fuse_step(net, _loss_fn)
+    for t in range(start, stop):
+        step(*_batch(t))
+        if mgr is not None and (t + 1) % save_every == 0:
+            try:
+                mgr.save(epoch=0, step=t + 1)
+            except SimulatedCrash:
+                crash_collector.append(t + 1)
+                return step
+    return step
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 1e-2}),
+])
+def test_kill_and_resume_matches_uninterrupted(tmp_path, monkeypatch,
+                                               optimizer, opt_params):
+    """Kill mid-epoch — during a checkpoint write, at the manifest crash
+    point — then resume: final params bit-identical to an uninterrupted
+    run over the same batch sequence."""
+    total = 6
+
+    net_u = _make_net()
+    tr_u = Trainer(net_u.collect_params(), optimizer, dict(opt_params))
+    _train_fused(net_u, tr_u, 0, total)
+    ref = _weights(net_u)
+
+    ckdir = str(tmp_path / "ck")
+    net1 = _make_net()
+    tr1 = Trainer(net1.collect_params(), optimizer, dict(opt_params))
+    mgr1 = CheckpointManager(ckdir, net=net1, trainer=tr1, keep_last=2)
+    crashes = []
+    _train_fused(net1, tr1, 0, 4, mgr=mgr1)           # ckpts 1..4 land
+    monkeypatch.setenv("MXT_FAULT", "ckpt_crash:at=manifest,n=1")
+    resilience.reset_faults()
+    _train_fused(net1, tr1, 4, total, mgr=mgr1,
+                 crash_collector=crashes)              # save(5) crashes
+    assert crashes == [5]
+    monkeypatch.delenv("MXT_FAULT")
+    resilience.reset_faults()
+
+    # "new process": fresh net (different init!), fresh trainer — resume
+    # must restore params, optimizer state, counters, and stay fused
+    net2 = _make_net(seed=99)
+    tr2 = Trainer(net2.collect_params(), optimizer, dict(opt_params))
+    mgr2 = CheckpointManager(ckdir, net=net2, trainer=tr2, keep_last=2)
+    state = mgr2.resume()
+    assert state is not None and state.step == 4
+    assert tr2._optimizer.num_update == 4
+    step2 = _train_fused(net2, tr2, state.step, total)
+    assert step2.fused, step2.fallback_reason  # fused-step re-eligibility
+    got = _weights(net2)
+    for k in ref:
+        np.testing.assert_array_equal(ref[k], got[k], err_msg=k)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("point", ["params", "states", "manifest"])
+def test_ckpt_crash_point_leaves_previous_intact(tmp_path, monkeypatch,
+                                                 point):
+    net = _make_net()
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    mgr = CheckpointManager(str(tmp_path), net=net, trainer=tr)
+    step = tr.fuse_step(net, _loss_fn)
+    step(*_batch(0))
+    mgr.save(step=1)
+    step(*_batch(1))
+    monkeypatch.setenv("MXT_FAULT", "ckpt_crash:at=%s,n=1" % point)
+    resilience.reset_faults()
+    with pytest.raises(SimulatedCrash):
+        mgr.save(step=2)
+    # the torn write is invisible; the previous checkpoint still resumes
+    assert mgr.latest()["step"] == 1
+    # the n=1 budget is spent: the very next save succeeds end-to-end
+    mgr.save(step=2)
+    assert mgr.latest()["step"] == 2
+
+
+def test_truncated_checkpoint_falls_back(tmp_path):
+    """A payload truncated after publication (torn FS write, bit rot) is
+    rejected by size/CRC and resume() demotes to the previous one."""
+    net = _make_net()
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    mgr = CheckpointManager(str(tmp_path), net=net, trainer=tr)
+    step = tr.fuse_step(net, _loss_fn)
+    step(*_batch(0))
+    mgr.save(step=1)
+    w1 = _weights(net)
+    step(*_batch(1))
+    mgr.save(step=2)
+
+    params2 = [n for n in os.listdir(str(tmp_path))
+               if n.endswith("0000000002.params")][0]
+    path = os.path.join(str(tmp_path), params2)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[:len(blob) // 2])
+
+    assert [m["step"] for m, _ in mgr.checkpoints()] == [1]
+    net2 = _make_net(seed=99)
+    tr2 = Trainer(net2.collect_params(), "adam", {"learning_rate": 1e-2})
+    mgr2 = CheckpointManager(str(tmp_path), net=net2, trainer=tr2)
+    state = mgr2.resume()
+    assert state.step == 1
+    for k, v in _weights(net2).items():
+        np.testing.assert_array_equal(v, w1[k], err_msg=k)
+
+
+def test_corrupt_manifest_ignored(tmp_path):
+    net = _make_net()
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    mgr = CheckpointManager(str(tmp_path), net=net, trainer=tr)
+    step = tr.fuse_step(net, _loss_fn)
+    step(*_batch(0))
+    mgr.save(step=1)
+    with open(os.path.join(str(tmp_path),
+                           "ckpt-0000000009.manifest.json"), "w") as f:
+        f.write("{not json")
+    assert mgr.latest()["step"] == 1
+
+
+def test_checkpoint_rotation_keeps_last_k(tmp_path):
+    net = _make_net()
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    mgr = CheckpointManager(str(tmp_path), net=net, trainer=tr,
+                            keep_last=2)
+    step = tr.fuse_step(net, _loss_fn)
+    for t in range(4):
+        step(*_batch(t))
+        mgr.save(step=t + 1)
+    steps = [m["step"] for m, _ in mgr.checkpoints()]
+    assert steps == [3, 4]
+    # rotated payloads are gone from disk too
+    leftovers = [n for n in os.listdir(str(tmp_path))
+                 if "0000000001" in n or "0000000002" in n]
+    assert leftovers == []
+
+
+def test_checkpoint_restores_loss_scale_and_prng(tmp_path):
+    from mxnet_tpu.amp import LossScaler
+
+    net = _make_net()
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    tr._amp_scaler = LossScaler(init_scale=2.0 ** 8)
+    tr._amp_scaler.loss_scale = 128.0  # pretend backoff happened
+    mx.random.seed(42)
+    mx.random.new_key()  # evolve past the seed
+    mgr = CheckpointManager(str(tmp_path), net=net, trainer=tr)
+    step = tr.fuse_step(net, _loss_fn)
+    step(*_batch(0))
+    key_state = mx.random.get_state()
+    mgr.save(step=1)
+
+    net2 = _make_net(seed=99)
+    tr2 = Trainer(net2.collect_params(), "adam", {"learning_rate": 1e-2})
+    mgr2 = CheckpointManager(str(tmp_path), net=net2, trainer=tr2)
+    assert mgr2.resume() is not None
+    assert tr2._amp_scaler.loss_scale == 128.0
+    restored = mx.random.get_state()
+    assert restored["seed"] == 42
+    assert restored["key_data"] == key_state["key_data"]
+
+
+def test_resume_empty_dir_returns_none(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.resume() is None and mgr.latest() is None
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_kill_resume_soak(tmp_path, monkeypatch):
+    """Repeated kill/resume cycles — each cycle dies at a different
+    checkpoint-write phase — must still land bit-identical to one
+    uninterrupted run."""
+    total = 12
+    net_u = _make_net()
+    tr_u = Trainer(net_u.collect_params(), "adam", {"learning_rate": 1e-2})
+    _train_fused(net_u, tr_u, 0, total)
+    ref = _weights(net_u)
+
+    ckdir = str(tmp_path / "soak")
+    cursor = 0
+    points = ["params", "states", "manifest", "rotate"]
+    for cycle in range(5):
+        # same init seed as the reference run: a cycle with no checkpoint
+        # yet must start exactly where the uninterrupted run started
+        net = _make_net()
+        tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+        mgr = CheckpointManager(ckdir, net=net, trainer=tr, keep_last=2)
+        state = mgr.resume()
+        cursor = state.step if state is not None else 0
+        if cursor >= total:
+            break
+        kill_at = min(cursor + 3, total)
+        monkeypatch.setenv(
+            "MXT_FAULT",
+            "ckpt_crash:at=%s,n=1" % points[cycle % len(points)])
+        resilience.reset_faults()
+        crashes = []
+        step = _train_fused(net, tr, cursor, kill_at, mgr=mgr,
+                            crash_collector=crashes)
+        monkeypatch.delenv("MXT_FAULT")
+        resilience.reset_faults()
+        if not crashes and kill_at >= total:
+            break
+    final_net = _make_net()
+    final_tr = Trainer(final_net.collect_params(), "adam",
+                       {"learning_rate": 1e-2})
+    mgr = CheckpointManager(ckdir, net=final_net, trainer=final_tr,
+                            keep_last=2)
+    state = mgr.resume()
+    _train_fused(final_net, final_tr,
+                 state.step if state is not None else 0, total)
+    got = _weights(final_net)
+    for k in ref:
+        np.testing.assert_array_equal(ref[k], got[k], err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# pillar 3 — KVStore retry + fault injection
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_dist_sync_push_retries_through_drops(monkeypatch):
+    """Injected socket drops on dist_sync push recover within the retry
+    budget (p=1 with a hard n cap: the failure sequence is exact)."""
+    monkeypatch.setenv("MXT_KV_RETRY_BASE", "0.001")
+    monkeypatch.setenv("MXT_FAULT", "kv_drop:p=1.0,n=3")
+    resilience.reset_faults()
+    kv = mx.kv.create("dist_sync")
+    kv.init(3, nd.ones((4,)))
+    kv.push(3, nd.array(np.full(4, 2.0, np.float32)))  # 3 drops, then ok
+    out = nd.zeros((4,))
+    kv.pull(3, out)
+    np.testing.assert_array_equal(out.asnumpy(), np.full(4, 2.0))
+
+
+@pytest.mark.chaos
+def test_dist_sync_push_exhausted_raises_kvstore_error(monkeypatch):
+    monkeypatch.setenv("MXT_KV_RETRY_BASE", "0.001")
+    monkeypatch.setenv("MXT_KV_RETRIES", "2")
+    monkeypatch.setenv("MXT_FAULT", "kv_drop:p=1.0")
+    resilience.reset_faults()
+    kv = mx.kv.create("dist_sync")
+    kv.init(5, nd.ones((4,)))
+    with pytest.raises(KVStoreError, match="failed after 2 retries"):
+        kv.push(5, nd.ones((4,)))
+
+
+@pytest.mark.chaos
+def test_dist_sync_trainer_trains_through_drops(monkeypatch):
+    """The whole eager dist_sync training path (push→server update→pull)
+    survives a burst of injected drops and keeps training."""
+    monkeypatch.setenv("MXT_KV_RETRY_BASE", "0.001")
+    monkeypatch.setenv("MXT_FAULT", "kv_drop:p=0.5,seed=11,n=6")
+    resilience.reset_faults()
+    net = _make_net()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                 kvstore="dist_sync")
+    w0 = _weights(net)
+    for t in range(4):
+        x, y = _batch(t)
+        with ag.record():
+            loss = _loss_fn(net(x), y)
+        loss.backward()
+        tr.step(8)
+    assert any((w0[k] != v).any() for k, v in _weights(net).items())
+
+
+@pytest.mark.chaos
+def test_async_client_reconnects_through_drops(monkeypatch):
+    from mxnet_tpu.async_server import AsyncParamServer, AsyncClient
+
+    monkeypatch.setenv("MXT_KV_RETRY_BASE", "0.001")
+    srv = AsyncParamServer("127.0.0.1", 0)
+    try:
+        port = srv._sock.getsockname()[1]
+        cli = AsyncClient("127.0.0.1", port, timeout=5.0)
+        cli.request("init", "0", np.ones(3, np.float32))
+        monkeypatch.setenv("MXT_FAULT", "kv_drop:p=1.0,n=2")
+        resilience.reset_faults()
+        # two injected drops → two reconnect+retry cycles → success
+        cli.request("push", "0", np.full(3, 5.0, np.float32))
+        monkeypatch.delenv("MXT_FAULT")
+        resilience.reset_faults()
+        got = cli.request("pull", "0")
+        np.testing.assert_array_equal(got, np.full(3, 5.0))
+        cli.close()
+    finally:
+        srv.close()
+
+
+@pytest.mark.chaos
+def test_async_client_dead_server_raises_not_hangs(monkeypatch):
+    import time
+
+    from mxnet_tpu.async_server import AsyncParamServer, AsyncClient
+
+    monkeypatch.setenv("MXT_KV_RETRY_BASE", "0.001")
+    monkeypatch.setenv("MXT_KV_RETRIES", "1")
+    srv = AsyncParamServer("127.0.0.1", 0)
+    port = srv._sock.getsockname()[1]
+    cli = AsyncClient("127.0.0.1", port, timeout=2.0)
+    cli.request("init", "0", np.ones(3, np.float32))
+    srv.close()  # server truly gone: listener AND live conns torn down
+    cli._timeout = 1.0  # bound the reconnect probe for the test
+    t0 = time.monotonic()
+    with pytest.raises(KVStoreError):
+        cli.request("push", "0", np.ones(3, np.float32))
+    assert time.monotonic() - t0 < 10.0  # clean error, not a hang
+
+
+def test_retry_policy_backoff_shape():
+    p = resilience.RetryPolicy(retries=5, base=0.1, max_delay=0.8,
+                               deadline=30, jitter=0.0)
+    assert [p.delay(a) for a in (1, 2, 3, 4, 5)] == \
+        [0.1, 0.2, 0.4, 0.8, 0.8]
+
+
+def test_kv_retry_deadline(monkeypatch):
+    calls = {"n": 0}
+
+    def always_drop():
+        calls["n"] += 1
+        raise ConnectionError("down")
+
+    policy = resilience.RetryPolicy(retries=100, base=0.05,
+                                    max_delay=0.05, deadline=0.01)
+    with pytest.raises(KVStoreError, match="deadline"):
+        resilience.kv_retry("push", "k", always_drop, policy=policy)
+    assert calls["n"] == 1  # the deadline cut the budget short
+
+
+# ---------------------------------------------------------------------------
+# satellites
+# ---------------------------------------------------------------------------
+def test_save_states_before_first_step(tmp_path):
+    """No IndexError/AssertionError before the first step(): an early
+    save records the optimizer + empty state and loads back cleanly."""
+    net = _make_net()
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    fname = str(tmp_path / "early.states")
+    tr.save_states(fname)  # before any step
+    tr2 = Trainer(_make_net().collect_params(), "adam",
+                  {"learning_rate": 1e-2})
+    tr2.load_states(fname)
+    assert tr2._optimizer.num_update == 0
+
+    tr3 = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
+    tr3._optimizer = None
+    with pytest.raises(mx.MXNetError, match="no optimizer"):
+        tr3.save_states(str(tmp_path / "x.states"))
+
+
+def test_load_states_then_fuse_step_rebuilds(tmp_path):
+    """load_states swaps the optimizer object; the fused step must
+    rebuild against it and continue bit-identically with the donor."""
+    net1 = _make_net()
+    tr1 = Trainer(net1.collect_params(), "adam", {"learning_rate": 1e-2})
+    step1 = tr1.fuse_step(net1, _loss_fn)
+    for t in range(3):
+        step1(*_batch(t))
+    states = str(tmp_path / "t.states")
+    params = str(tmp_path / "t.params")
+    tr1.save_states(states)
+    net1.save_parameters(params)
+
+    net2 = _make_net(seed=99)
+    tr2 = Trainer(net2.collect_params(), "adam", {"learning_rate": 1e-2})
+    step2 = tr2.fuse_step(net2, _loss_fn)
+    for t in range(2):  # diverge first so the restore must really work
+        step2(*_batch(50 + t))
+    old_opt = tr2._optimizer
+    net2.load_parameters(params)
+    tr2.load_states(states)
+    assert tr2._optimizer is not old_opt
+    assert tr2._optimizer.num_update == 3
+
+    for t in range(3, 5):  # both continue over the same batches
+        step1(*_batch(t))
+        step2(*_batch(t))
+    assert step2.fused and step2._built_opt is tr2._optimizer
+    w1, w2 = _weights(net1), _weights(net2)
+    for k in w1:
+        np.testing.assert_array_equal(w1[k], w2[k], err_msg=k)
+
+
+def test_load_checkpoint_reader_leniency(tmp_path):
+    """Extra (unprefixed) keys are skipped, missing keys simply absent —
+    and the strict unpacker still rejects malformed dicts."""
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.model import unpack_param_dict
+
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="lenfc")
+    out = sym.SoftmaxOutput(fc, name="softmax")
+    prefix = str(tmp_path / "model")
+    arg = {"lenfc_weight": nd.ones((4, 8)), "lenfc_bias": nd.zeros((4,))}
+    mx.save_checkpoint(prefix, 1, out, arg, {})
+
+    pfile = prefix + "-0001.params"
+    blob = nd.load(pfile)
+    blob["stray_unprefixed_key"] = nd.ones((2,))
+    del blob["arg:lenfc_bias"]
+    nd.save(pfile, blob)
+
+    sym2, arg2, aux2 = mx.load_checkpoint(prefix, 1)
+    assert set(arg2) == {"lenfc_weight"}  # stray skipped, missing absent
+    assert aux2 == {}
+    assert "lenfc_weight" in sym2.list_arguments()
+
+    with pytest.raises(mx.MXNetError, match="no arg:/aux: prefix"):
+        unpack_param_dict({"nope": nd.ones((1,))}, strict=True)
+
+
+def test_download_backoff_and_hoisted_ssl(monkeypatch, tmp_path):
+    import ssl
+    import time as time_mod
+    import urllib.request
+
+    from mxnet_tpu.gluon import utils as gutils
+
+    sleeps = []
+    monkeypatch.setattr(time_mod, "sleep", sleeps.append)
+    ctx_calls = {"n": 0}
+    real_ctx = ssl._create_unverified_context
+
+    def counting_ctx(*a, **k):
+        ctx_calls["n"] += 1
+        return real_ctx(*a, **k)
+
+    monkeypatch.setattr(ssl, "_create_unverified_context", counting_ctx)
+    attempts = {"n": 0}
+
+    def failing_urlopen(url, context=None):
+        attempts["n"] += 1
+        raise OSError("no egress")
+
+    monkeypatch.setattr(urllib.request, "urlopen", failing_urlopen)
+    with pytest.raises(OSError, match="failed after 4"):
+        gutils.download("http://example.invalid/f.bin",
+                        path=str(tmp_path / "f.bin"), retries=4,
+                        verify_ssl=False)
+    assert attempts["n"] == 4
+    assert sleeps == [0.5, 1.0, 2.0]  # exponential, between attempts only
+    assert ctx_calls["n"] == 1        # context hoisted out of the loop
+
+
+class _KillerDataset:
+    """Worker suicide at one index — emulates the OOM killer."""
+
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 4:
+            os.kill(os.getpid(), signal.SIGKILL)
+        return np.zeros(2, np.float32)
+
+
+def test_dataloader_dead_process_worker_raises():
+    from mxnet_tpu.gluon.data import DataLoader
+
+    loader = DataLoader(_KillerDataset(), batch_size=2, num_workers=1,
+                        thread_pool=False)
+    with pytest.raises(mx.MXNetError, match="worker process died"):
+        for _ in loader:
+            pass
+
+
+def test_estimator_full_state_checkpoint_resume(tmp_path):
+    """CheckpointHandler(full_state=True) + resume_from_checkpoint: a
+    killed fit() picks up at the next epoch and lands identical to an
+    uninterrupted run."""
+    from mxnet_tpu.gluon.contrib.estimator import (CheckpointHandler,
+                                                   Estimator)
+
+    rng = np.random.RandomState(3)
+    data = [(nd.array(rng.uniform(-1, 1, (8, 8)).astype(np.float32)),
+             nd.array(rng.uniform(-1, 1, (8, 4)).astype(np.float32)))
+            for _ in range(3)]
+
+    def fit(epochs, handler=None, seed=7):
+        net = _make_net(seed=seed)
+        tr = Trainer(net.collect_params(), "adam",
+                     {"learning_rate": 1e-2})
+        est = Estimator(net, _loss_fn, trainer=tr)
+        est.fit(data, epochs=epochs,
+                event_handlers=[handler] if handler else None)
+        return est
+
+    ref = fit(3)
+
+    ckdir = str(tmp_path / "est")
+    h1 = CheckpointHandler(ckdir, full_state=True)
+    est1 = fit(2, handler=h1)  # "killed" after epoch 1's checkpoint
+    assert est1.epoch == 2
+
+    h2 = CheckpointHandler(ckdir, full_state=True,
+                           resume_from_checkpoint=True)
+    est2 = fit(1, handler=h2, seed=99)  # resumes at epoch 2, runs it
+    assert est2.epoch == 3
+    wr, w2 = _weights(ref.net), _weights(est2.net)
+    for k in wr:
+        np.testing.assert_array_equal(wr[k], w2[k], err_msg=k)
