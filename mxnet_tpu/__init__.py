@@ -57,6 +57,7 @@ from . import monitor
 from . import image
 from . import config
 from . import resilience
+from . import membership
 from . import visualization
 from . import visualization as viz
 from . import amp
@@ -72,6 +73,7 @@ __all__ = [
     "sym", "Symbol", "module", "mod", "Module", "BucketingModule", "model",
     "save_checkpoint", "load_checkpoint", "profiler", "monitor",
     "operator", "image", "config", "amp", "contrib", "resilience",
+    "membership",
     "SequentialModule", "visualization", "viz", "runtime", "util", "rnn",
     "attribute", "AttrScope", "name", "engine",
 ]

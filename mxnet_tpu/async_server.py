@@ -44,6 +44,15 @@ trusted). Enforcement:
   is a clean error, not a protocol desync.
 - TLS/on-wire privacy is out of scope (the reference has none either);
   run on a trusted network segment.
+
+Membership (ps-lite ``Van`` analog, see membership.py): the server keeps
+a MembershipTable — register/heartbeat/deregister ops, a reaper thread
+that fences workers after ``MXT_LIVENESS_TIMEOUT`` silent seconds, and
+elastic barrier/reduce rendezvous that release over LIVE members. Data
+frames may carry a (worker_id, generation) credential; a fenced
+generation gets a typed ``stale`` reply (→ StaleWorkerError) so zombies
+can never corrupt the store. The banner carries a per-instance boot id
+so a reconnecting client detects a server restart and resyncs.
 """
 from __future__ import annotations
 
@@ -54,8 +63,11 @@ import pickle
 import socket
 import struct
 import threading
+import time
 
 from .base import MXNetError
+from .membership import (BarrierTimeout, MembershipTable, StaleWorkerError,
+                         snapshot_checksums)
 
 ASYNC_PORT_OFFSET = 1717
 
@@ -87,6 +99,14 @@ def server_address():
 _MAC_LEN = hashlib.sha256().digest_size
 _BANNER_MAGIC = b"MXKV"
 _NONCE_LEN = 16
+_BOOT_ID_LEN = 8
+
+# data ops that mutate server-side state: with membership active (any
+# registered member + MXT_MEMBERSHIP on) these REQUIRE a live credential,
+# so a restarted-but-unregistered worker cannot corrupt weights. 'reset'
+# is exempt: it is the coordinated whole-world restart issued from inside
+# KVStore.create() before the new world's members have registered.
+_FENCED_OPS = frozenset(("init", "push", "set_optimizer", "set_states"))
 
 
 def _shared_secret():
@@ -178,6 +198,16 @@ class AsyncParamServer:
         self._mutate = threading.Lock()  # ps-lite customer-thread analog
         self._conns = set()  # live client sockets, torn down by close()
         self._conns_lock = threading.Lock()
+        # boot id: lets a reconnecting client detect that the server it
+        # reached is a RESTARTED instance (empty store, empty membership)
+        # rather than the one it handshook with — the banner carries it
+        self.boot_id = os.urandom(_BOOT_ID_LEN)
+        # membership view (ps-lite Van analog): registrations, heartbeat
+        # stamps, generation fencing, and the elastic barrier/reduce
+        # rendezvous all live here; the reaper thread declares workers
+        # dead after MXT_LIVENESS_TIMEOUT seconds of silence
+        self.membership = MembershipTable()
+        self._world = 0  # reset count: store-generation rendezvous token
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -186,6 +216,20 @@ class AsyncParamServer:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="kv-async-accept")
         self._accept_thread.start()
+        self._reap_thread = threading.Thread(
+            target=self._reap_loop, daemon=True, name="kv-member-reaper")
+        self._reap_thread.start()
+
+    def _reap_loop(self):
+        """Declare silent workers dead (config read per tick so tests can
+        shrink the windows on the process-wide server singleton)."""
+        from . import config
+
+        while not self._stop.is_set():
+            interval = float(config.get("MXT_HEARTBEAT_INTERVAL"))
+            timeout = float(config.get("MXT_LIVENESS_TIMEOUT"))
+            self.membership.reap(timeout)
+            self._stop.wait(max(0.01, min(interval / 2.0, 0.5)))
 
     # -- server side -------------------------------------------------------
     def _accept_loop(self):
@@ -204,97 +248,48 @@ class AsyncParamServer:
                              daemon=True, name="kv-async-conn").start()
 
     def _serve(self, conn):
-        from .ndarray.ndarray import NDArray
-        import numpy as np
-        import jax.numpy as jnp
-
-        # banner: announce auth mode (+ per-connection nonce when on) so
-        # a secret-presence mismatch fails loudly instead of desyncing
+        # banner: announce auth mode + this instance's boot id (+ a
+        # per-connection nonce when auth is on) so a secret-presence
+        # mismatch fails loudly and a reconnecting client can detect a
+        # server RESTART (different boot id → resync, not silent reuse)
         secret = self._secret
         flags = 1 if secret is not None else 0
         nonce = os.urandom(_NONCE_LEN) if secret is not None else b""
         try:
-            conn.sendall(_BANNER_MAGIC + bytes([flags]) + nonce)
+            conn.sendall(_BANNER_MAGIC + bytes([flags]) + self.boot_id +
+                         nonce)
         except OSError:
             conn.close()
             return
         ch = _Channel(conn, secret, nonce, b"S")
-
-        def _recv_frame():
-            return ch.recv()
-
-        _send_msg = ch.send
         try:
             while True:
                 try:
-                    op, key, payload = _recv_frame()
+                    frame = ch.recv()
                 except MXNetError:
                     # auth failure: drop without answering (an
                     # unauthenticated peer learns nothing); errors AFTER
                     # auth go back as ("err", ...) frames below
                     return
+                if len(frame) == 4:
+                    # membership-credentialed frame (worker_id, generation)
+                    op, key, payload, cred = frame
+                else:
+                    (op, key, payload), cred = frame, None
                 if isinstance(key, str) and key.isdigit():
                     # the eager updater keys optimizer state and lr/wd
                     # multipliers by int for digit keys (kvstore.py push)
                     key = int(key)
-                if op == "reset":
-                    with self._mutate:
-                        self._store.clear()
-                        self._updater = None
-                    _send_msg(("ok", None))
-                elif op == "init":
-                    with self._mutate:
-                        # first writer wins (every worker sends its init)
-                        self._store.setdefault(key, np.array(payload))
-                    _send_msg(("ok", None))
-                elif op == "push":
-                    with self._mutate:
-                        w = self._store.get(key)
-                        if w is None:
-                            # first push initializes, like KVStoreLocal
-                            self._store[key] = np.array(payload)
-                            _send_msg(("ok", None))
-                            continue
-                        if self._updater is not None:
-                            w_nd = NDArray(jnp.asarray(w))
-                            self._updater(key,
-                                          NDArray(jnp.asarray(payload)),
-                                          w_nd)
-                            self._store[key] = np.asarray(w_nd.data)
-                        else:
-                            # replace semantics, matching the local
-                            # no-updater path (CopyFromTo(merged, &local))
-                            self._store[key] = np.array(payload)
-                    _send_msg(("ok", None))
-                elif op == "pull":
-                    w = self._store.get(key)
-                    if w is None:
-                        _send_msg(("err",
-                                         "key %r not initialized" % key))
-                    else:
-                        _send_msg(("ok", np.array(w)))
-                elif op == "set_optimizer":
-                    from . import optimizer as opt
-
-                    with self._mutate:
-                        self._updater = opt.get_updater(
-                            pickle.loads(payload))
-                    _send_msg(("ok", None))
-                elif op == "get_states":
-                    with self._mutate:
-                        blob = (self._updater.get_states(payload)
-                                if self._updater is not None else None)
-                    _send_msg(("ok", blob))
-                elif op == "set_states":
-                    with self._mutate:
-                        if self._updater is None:
-                            _send_msg(("err",
-                                             "no server-side optimizer"))
-                            continue
-                        self._updater.set_states(payload)
-                    _send_msg(("ok", None))
-                else:
-                    _send_msg(("err", "unknown op %r" % op))
+                try:
+                    reply = self._handle(op, key, payload, cred)
+                except StaleWorkerError as e:
+                    # fenced frame: refused, but the connection stays up
+                    # (the client raises a typed error; a rejoin may
+                    # follow on the same socket)
+                    reply = ("stale", str(e))
+                except BarrierTimeout as e:
+                    reply = ("timeout", str(e))
+                ch.send(reply)
         except (ConnectionError, EOFError):
             pass
         except MXNetError as e:
@@ -302,13 +297,148 @@ class AsyncParamServer:
             # mismatch in an update): report it to the worker instead of
             # a bare EOF. (Auth failures return early above, unanswered.)
             try:
-                _send_msg(("err", "server error: %s" % e))
+                ch.send(("err", "server error: %s" % e))
             except OSError:
                 pass
         finally:
             conn.close()
             with self._conns_lock:
                 self._conns.discard(conn)
+
+    def _fencing_active(self):
+        from . import config
+
+        return bool(config.get("MXT_MEMBERSHIP")) \
+            and self.membership.has_members()
+
+    def _handle(self, op, key, payload, cred):
+        """One request → one reply tuple. StaleWorkerError/BarrierTimeout
+        propagate to _serve, which answers without dropping the
+        connection."""
+        from .ndarray.ndarray import NDArray
+        import numpy as np
+        import jax.numpy as jnp
+
+        # stale-push fencing: a credentialed frame must come from the
+        # current LIVE incarnation of its worker; with membership active,
+        # mutating the store additionally requires a credential, so a
+        # restarted-but-unregistered worker can never corrupt weights
+        if cred is not None:
+            self.membership.check(cred[0], cred[1])
+        elif op in _FENCED_OPS and self._fencing_active():
+            raise StaleWorkerError(
+                "%r from an unregistered connection while membership is "
+                "active — register (or rejoin) before mutating server "
+                "state" % op)
+
+        if op == "reset":
+            with self._mutate:
+                self._store.clear()
+                self._updater = None
+                self._world += 1
+                world = self._world
+            # new store world: members must re-register (the generation
+            # counter survives, so pre-reset credentials stay fenced)
+            self.membership.reset()
+            return ("ok", world)
+        elif op == "world":
+            # store-generation rendezvous: workers wait for rank 0's Nth
+            # reset before touching world N (replaces the jax collective
+            # barrier that used to guard creation — no XLA dependency)
+            with self._mutate:
+                return ("ok", self._world)
+        elif op == "init":
+            with self._mutate:
+                # first writer wins (every worker sends its init)
+                self._store.setdefault(key, np.array(payload))
+            return ("ok", None)
+        elif op == "push":
+            with self._mutate:
+                w = self._store.get(key)
+                if w is None:
+                    # first push initializes, like KVStoreLocal
+                    self._store[key] = np.array(payload)
+                    return ("ok", None)
+                if self._updater is not None:
+                    w_nd = NDArray(jnp.asarray(w))
+                    self._updater(key,
+                                  NDArray(jnp.asarray(payload)),
+                                  w_nd)
+                    self._store[key] = np.asarray(w_nd.data)
+                else:
+                    # replace semantics, matching the local
+                    # no-updater path (CopyFromTo(merged, &local))
+                    self._store[key] = np.array(payload)
+            return ("ok", None)
+        elif op == "pull":
+            w = self._store.get(key)
+            if w is None:
+                return ("err", "key %r not initialized" % key)
+            return ("ok", np.array(w))
+        elif op == "set_optimizer":
+            from . import optimizer as opt
+
+            with self._mutate:
+                self._updater = opt.get_updater(pickle.loads(payload))
+            return ("ok", None)
+        elif op == "get_states":
+            with self._mutate:
+                blob = (self._updater.get_states(payload)
+                        if self._updater is not None else None)
+            return ("ok", blob)
+        elif op == "set_states":
+            with self._mutate:
+                if self._updater is None:
+                    return ("err", "no server-side optimizer")
+                self._updater.set_states(payload)
+            return ("ok", None)
+        # -- membership ops (ref: ps-lite Van ADD_NODE/HEARTBEAT) --------
+        elif op == "register":
+            worker_id, want_snapshot = payload
+            gen, epoch, rejoin = self.membership.register(worker_id)
+            from . import resilience
+
+            inj = resilience.fault_point()
+            if inj.should("rejoin_race"):
+                # widen the window between fencing the old generation
+                # and answering the rejoin: a zombie push racing the
+                # re-registration must STILL be refused in here
+                time.sleep(
+                    float(inj.rule("rejoin_race").get("ms", 20.0)) / 1e3)
+            snap = None
+            if want_snapshot or rejoin:
+                # rejoin handoff: the current store + optimizer states
+                # under a CRC32 manifest (the wire analog of
+                # CheckpointManager's per-file CRCs)
+                with self._mutate:
+                    weights = {k: np.array(v)
+                               for k, v in self._store.items()}
+                    states = (self._updater.get_states(False)
+                              if self._updater is not None else None)
+                snap = {"weights": weights, "states": states,
+                        "epoch": epoch,
+                        "crc32": snapshot_checksums(weights)}
+            return ("ok", (gen, epoch, snap))
+        elif op == "heartbeat":
+            worker_id, gen = payload
+            epoch, lost = self.membership.heartbeat(worker_id, gen)
+            return ("ok", (epoch, lost))
+        elif op == "deregister":
+            worker_id, gen = payload
+            self.membership.deregister(worker_id, gen)
+            return ("ok", None)
+        elif op == "members":
+            return ("ok", self.membership.view())
+        elif op == "barrier":
+            worker_id, gen, tag, timeout = payload
+            epoch = self.membership.barrier(worker_id, gen, tag, timeout)
+            return ("ok", epoch)
+        elif op == "reduce":
+            worker_id, gen, seq, array, timeout = payload
+            total, wids = self.membership.reduce(
+                worker_id, gen, key, seq, np.asarray(array), timeout)
+            return ("ok", (total, wids))
+        return ("err", "unknown op %r" % op)
 
     def close(self):
         """Stop serving: wake the (possibly accept()-blocked) listener —
@@ -357,7 +487,22 @@ class AsyncClient:
         self._port = port
         self._timeout = timeout
         self._lock = threading.Lock()
+        self._cred = None        # (worker_id, generation) membership token
+        self._boot_id = None     # server instance id from the banner
+        self._saw_restart = False
+        self.server_restarts = 0
+        # resync hook: invoked (with this client) after a reconnect that
+        # landed on a RESTARTED server instance — the kvstore wires this
+        # to membership re-registration so pushes are not stale-fenced
+        # against the new server's empty membership table
+        self.on_server_restart = None
         self._connect()
+
+    def set_credentials(self, worker_id, generation):
+        """Attach the membership fencing token: every subsequent frame
+        carries (worker_id, generation) and the server refuses it once
+        the generation is fenced (StaleWorkerError)."""
+        self._cred = (int(worker_id), int(generation))
 
     def _connect(self):
         import time
@@ -386,13 +531,22 @@ class AsyncClient:
         # hang us) and the socket is closed on any handshake failure.
         try:
             self._sock.settimeout(timeout)
-            head = _recv_exact(self._sock, len(_BANNER_MAGIC) + 1)
+            head = _recv_exact(self._sock,
+                               len(_BANNER_MAGIC) + 1 + _BOOT_ID_LEN)
             if head[:len(_BANNER_MAGIC)] != _BANNER_MAGIC:
                 raise MXNetError(
                     "peer at %s:%d did not send an async kvstore banner "
                     "(not an async server, or a pre-r5 build)"
                     % (host, port))
             server_auth = bool(head[len(_BANNER_MAGIC)] & 1)
+            boot_id = head[len(_BANNER_MAGIC) + 1:]
+            # a different boot id on reconnect = the server RESTARTED
+            # mid-run (fresh store, fresh membership): flag it so the
+            # resync hook runs instead of silently reusing stale
+            # expectations against the new instance
+            if self._boot_id is not None and boot_id != self._boot_id:
+                self._saw_restart = True
+            self._boot_id = boot_id
             secret = _shared_secret()
             if server_auth and secret is None:
                 raise MXNetError(
@@ -423,17 +577,36 @@ class AsyncClient:
         except OSError:
             pass
         self._connect()
+        if self._saw_restart:
+            self._saw_restart = False
+            self.server_restarts += 1
+            cb = self.on_server_restart
+            if cb is not None:
+                # resync (e.g. membership re-registration) BEFORE the
+                # retried frame is re-sent — it picks up new credentials
+                cb(self)
 
     def request(self, op, key=None, payload=None):
         from . import resilience
+        from .membership import StaleWorkerError
+        from .resilience import KVStoreError
 
         def attempt():
             with self._lock:
-                self._ch.send((op, key, payload))
+                # frame built per attempt so a resync hook's refreshed
+                # credentials apply to the retried send
+                if self._cred is not None:
+                    self._ch.send((op, key, payload, self._cred))
+                else:
+                    self._ch.send((op, key, payload))
                 return self._ch.recv()
 
         status, result = resilience.kv_retry(
             op, key, attempt, reconnect=self._reconnect)
+        if status == "stale":
+            raise StaleWorkerError(result)
+        if status == "timeout":
+            raise KVStoreError(result)
         if status != "ok":
             raise MXNetError("async kvstore server error: %s" % result)
         return result

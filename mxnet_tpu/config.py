@@ -112,7 +112,37 @@ _declare("MXT_FAULT", str, None,
          "'kv_drop:p=0.5,seed=7,n=10;kv_delay:p=0.2,ms=5;"
          "ckpt_crash:at=manifest,n=1'. kv_drop/kv_delay hit kvstore "
          "network ops; ckpt_crash raises SimulatedCrash at a named "
-         "CheckpointManager write phase (params|states|manifest|rotate).")
+         "CheckpointManager write phase (params|states|manifest|rotate); "
+         "hb_drop loses membership heartbeats on the wire, "
+         "worker_freeze:worker=I[,after=K] freezes worker I's heartbeat "
+         "thread (zombie emulation), rejoin_race:ms=N widens the "
+         "server-side re-registration fencing window.")
+
+_declare("MXT_MEMBERSHIP", bool, True,
+         "Elastic membership for the dist kvstore (membership.py): "
+         "workers register with the coordinator-side server, heartbeat "
+         "on a background thread, and every data frame is fenced by "
+         "(worker_id, generation) so a zombie or restarted-but-"
+         "unregistered worker can never corrupt server state. 0 "
+         "disables registration/fencing (pre-membership behavior).")
+_declare("MXT_ELASTIC", bool, False,
+         "Route dist_sync reductions through the membership server "
+         "(kvstore 'reduce' rendezvous) instead of XLA collectives so "
+         "sync mode DEGRADES over survivors when a worker dies instead "
+         "of hanging in a collective. Opt-in: the collective path is "
+         "faster but cannot drop a dead peer.")
+_declare("MXT_HEARTBEAT_INTERVAL", float, 2.0,
+         "Seconds between membership heartbeats (membership.py; ref: "
+         "ps-lite Van's heartbeat timer).")
+_declare("MXT_LIVENESS_TIMEOUT", float, 10.0,
+         "Seconds without a heartbeat before the membership reaper "
+         "declares a worker dead, fences its generation, and bumps the "
+         "membership epoch (lost_workers profiler counter).")
+_declare("MXT_BARRIER_TIMEOUT", float, None,
+         "Deadline in seconds for KVStore barriers (both the membership "
+         "barrier and the jax.distributed sync path). Unset falls back "
+         "to MXT_KV_DEADLINE; exceeding it raises KVStoreError instead "
+         "of hanging on a peer that will never arrive.")
 
 _declare("MXT_KV_RETRIES", int, 4,
          "Max retries for a kvstore network op (dist push reduction, "

@@ -46,6 +46,12 @@ class EventHandler:
     def batch_end(self, estimator):
         pass
 
+    def workers_lost(self, estimator):
+        """Fired when the dist kvstore's membership reaper declares one
+        or more workers dead (estimator.lost_workers holds the running
+        total; sync reductions have degraded to the survivors)."""
+        pass
+
 
 class LoggingHandler(EventHandler):
     """Log metrics every `log_interval` batches + per epoch
@@ -73,6 +79,12 @@ class LoggingHandler(EventHandler):
                  if m.num_inst]
         self.logger.info("epoch %d done (%.1fs): %s", estimator.epoch,
                          time.time() - self._tic, " ".join(msgs))
+
+    def workers_lost(self, estimator):
+        self.logger.warning(
+            "epoch %d batch %d: membership declared worker(s) dead "
+            "(%d lost so far) — training degrades over the survivors",
+            estimator.epoch, estimator.batch_idx, estimator.lost_workers)
 
 
 def _default_monitor(estimator):
@@ -243,6 +255,7 @@ class Estimator:
         self.trainer = trainer
         self.epoch = 0
         self.batch_idx = 0
+        self.lost_workers = 0  # membership deaths observed (dist kvstore)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -309,6 +322,15 @@ class Estimator:
                     self.trainer.step(batch_size)
                     self._update_metrics(self.train_metrics, label, pred,
                                          loss)
+                    # elastic membership: surface reaper-declared deaths
+                    # as an estimator event (reads the heartbeat-cached
+                    # count — no extra network traffic per batch)
+                    kv = getattr(self.trainer, "_kvstore", None)
+                    if kv is not None and hasattr(kv, "lost_workers"):
+                        lost = kv.lost_workers()
+                        if lost > self.lost_workers:
+                            self.lost_workers = lost
+                            fire("workers_lost")
                     fire("batch_end")
                     if batches is not None and self.batch_idx + 1 >= batches:
                         break

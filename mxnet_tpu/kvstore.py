@@ -25,11 +25,26 @@ buffers and the optional server-side updater. Types:
 ``set_optimizer`` installs an Updater so ``push`` applies updates
 server-side (update_on_kvstore=True path), exactly like
 KVStoreDistServer::ApplyUpdates.
+
+Elastic membership (membership.py; ``MXT_MEMBERSHIP``, default on):
+multi-process ``dist_async`` workers register with the coordinator-side
+server, heartbeat on a background thread, and stamp every frame with a
+(worker_id, generation) fencing token — a worker that misses its
+``MXT_LIVENESS_TIMEOUT`` window is declared dead, its generation is
+fenced (zombie pushes raise :class:`StaleWorkerError`), barriers release
+over survivors, and a restarted worker rejoins with a fresh generation
+plus a CRC-verified parameter snapshot. ``MXT_ELASTIC=1`` additionally
+routes the sync dist types' reductions through the same membership
+server so a mid-reduction death degrades the round to the survivors
+(renormalized by num_workers/len(survivors)) instead of hanging a
+collective.
 """
 from __future__ import annotations
 
+import itertools
 import os
 import pickle
+import threading
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
@@ -37,12 +52,20 @@ from .ndarray import ndarray as _nd
 from . import optimizer as opt
 from . import resilience
 from .resilience import KVStoreError
+from .membership import StaleWorkerError
 
-__all__ = ["KVStore", "GradientCompression", "KVStoreError", "create"]
+__all__ = ["KVStore", "GradientCompression", "KVStoreError",
+           "StaleWorkerError", "create"]
 
 
 def _key_str(key):
     return str(key)
+
+
+# per-process count of engaged multi-worker dist_async stores: creation
+# is collective, so every process's Nth store rendezvouses on the
+# server's Nth reset (see KVStore._await_world)
+_async_world_counter = itertools.count(1)
 
 
 class GradientCompression:
@@ -95,19 +118,72 @@ class KVStore:
         self._str_key_dict = {}
         self._async = None         # AsyncClient when true async is active
         self._async_server = None  # rank 0 owns the server thread
+        self._member = None        # WorkerMembership when elastic
+        self._barrier_seq = 0      # unique tags for membership barriers
+        self._reduce_seq = {}      # key -> elastic reduce round counter
         if kv_type == "dist_async":
             self._maybe_start_async()
+        elif kv_type.startswith("dist"):
+            self._maybe_start_elastic()
+
+    def _worker_id(self):
+        """Stable identity for membership: the launcher's MXT_WORKER_ID
+        survives a respawn even before jax.distributed re-initializes;
+        fall back to the jax process index."""
+        wid = os.environ.get("MXT_WORKER_ID")
+        return int(wid) if wid is not None else self.rank
+
+    def _engage_membership(self, host, port):
+        """Register with the coordinator-side membership table, start
+        heartbeats, and stamp the data client's frames with the
+        (worker_id, generation) fencing token."""
+        from . import membership
+
+        self._member = membership.WorkerMembership(
+            host, port, self._worker_id())
+        self._member.register()
+        self._member.start_heartbeats()
+        if self._async is not None:
+            self._async.set_credentials(self._member.worker_id,
+                                        self._member.generation)
+            self._async.on_server_restart = self._on_server_restart
+
+    def attach_membership(self, member):
+        """Adopt an externally managed WorkerMembership (tests, custom
+        launchers): frames are credentialed and barriers/reductions go
+        elastic through it."""
+        self._member = member
+        if self._async is not None:
+            self._async.set_credentials(member.worker_id,
+                                        member.generation)
+            self._async.on_server_restart = self._on_server_restart
+        return self
+
+    def _on_server_restart(self, client):
+        """The data client reconnected to a RESTARTED server (boot id
+        changed): its membership table is empty, so re-register for a
+        fresh generation before the retried frame is re-sent."""
+        if self._member is not None:
+            self._member.re_register()
+            client.set_credentials(self._member.worker_id,
+                                   self._member.generation)
+
+    def lost_workers(self):
+        """Workers declared dead by the liveness reaper so far (0 without
+        membership). Cached from heartbeat replies — no extra traffic."""
+        return self._member.lost_total if self._member is not None else 0
 
     def _maybe_start_async(self):
         """Engage the real hogwild parameter server (async_server.py) when
         running multi-process under the launcher; single-process
         dist_async keeps synchronous local semantics (create() warns)."""
-        from . import async_server
+        from . import async_server, config
 
         addr = async_server.server_address()
         if addr is None or self.num_workers <= 1:
             return
         host, port = addr
+        world = next(_async_world_counter)
         if self.rank == 0:
             # singleton per process; a fresh KVStore generation resets
             # the server state
@@ -115,12 +191,64 @@ class KVStore:
             reset = async_server.AsyncClient(host, port)
             reset.request("reset")
             reset.close()
-        # rendezvous (ps-lite init is one too): nobody talks to the
-        # server until rank 0's reset is acked, so a fast worker can't
-        # have its init wiped (and then have a first PUSH take the
-        # first-push-initializes branch with a gradient as the weight)
-        self._barrier()
+        else:
+            # rendezvous (ps-lite init is one too): nobody talks to the
+            # server until rank 0's reset for THIS store generation is
+            # acked, so a fast worker can't have its init wiped (and
+            # then have a first PUSH take the first-push-initializes
+            # branch with a gradient as the weight). Store creation is
+            # collective, so every process's Nth dist_async store waits
+            # on the server's Nth reset — a plain poll over the server
+            # transport, no XLA collective needed.
+            self._await_world(host, port, world)
         self._async = async_server.AsyncClient(host, port)
+        if config.get("MXT_MEMBERSHIP"):
+            self._engage_membership(host, port)
+            # and the world itself must FORM before elastic semantics
+            # (live-member barriers) can exclude anyone
+            self._member.wait_for_world(self.num_workers)
+
+    @staticmethod
+    def _await_world(host, port, world):
+        import time
+
+        from . import async_server, config
+
+        deadline = time.monotonic() + float(config.get("MXT_KV_DEADLINE"))
+        probe = async_server.AsyncClient(host, port)
+        try:
+            while probe.request("world") < world:
+                if time.monotonic() > deadline:
+                    raise KVStoreError(
+                        "dist_async store generation %d never opened: "
+                        "rank 0's reset did not arrive within the "
+                        "MXT_KV_DEADLINE window" % world)
+                time.sleep(0.01)
+        finally:
+            probe.close()
+
+    def _maybe_start_elastic(self):
+        """Opt-in elastic membership for the sync dist types
+        (MXT_ELASTIC=1): rank 0 hosts the membership server on the async
+        port; reductions rendezvous there so a dead peer degrades the
+        sum over survivors instead of hanging an XLA collective."""
+        from . import async_server, config
+
+        if not (config.get("MXT_ELASTIC") and config.get("MXT_MEMBERSHIP")):
+            return
+        addr = async_server.server_address()
+        if addr is None or self.num_workers <= 1:
+            return
+        host, port = addr
+        if self.rank == 0:
+            self._async_server = async_server.get_server(host, port)
+        # non-zero ranks rely on the client's bounded connect retry to
+        # ride out the server coming up
+        self._engage_membership(host, port)
+        # registration rendezvous: survivors-only degradation starts
+        # from a FORMED world — without this an early worker's first
+        # reduce would release solo before its peers register
+        self._member.wait_for_world(self.num_workers)
 
     # -- identity ----------------------------------------------------------
     @property
@@ -194,15 +322,44 @@ class KVStore:
                              else v.data)
         return NDArray(total)
 
-    def _dist_reduce(self, merged):
+    def _dist_reduce(self, merged, key=None):
         """Cross-process gradient sum for dist types. With one process this
         is the identity; under jax.distributed the arrays are process-local
-        and reduced via a tiny pjit psum (parallel.allreduce)."""
+        and reduced via a tiny pjit psum (parallel.allreduce). With
+        elastic membership attached the sum instead rendezvouses at the
+        membership server, which releases over LIVE members only — a
+        peer that dies mid-reduction degrades the round to the
+        survivors instead of hanging a collective."""
         if self.num_workers <= 1:
             return merged
+        if self._member is not None and self._type != "dist_async":
+            return self._elastic_reduce(key, merged)
         from .parallel import allreduce_across_processes
 
         return allreduce_across_processes(merged)
+
+    def _elastic_reduce(self, key, merged):
+        """Membership-mediated sum with survivor renormalization: when
+        contributors < num_workers the sum is scaled by
+        num_workers/len(survivors) so the reduced gradient stays an
+        unbiased estimate of the full-cohort gradient (the
+        cross-replica line of work in PAPERS.md assumes exactly this
+        calibration under elasticity)."""
+        import numpy as np
+
+        from .sparse import BaseSparseNDArray
+
+        self._reduce_seq[key] = seq = self._reduce_seq.get(key, 0) + 1
+        if isinstance(merged, BaseSparseNDArray):
+            # elastic rounds sum densely (per-worker index sets cannot
+            # align when the member set changes mid-round)
+            arr = merged.asnumpy()
+        else:
+            arr = np.asarray(merged.data)
+        total, contributors = self._member.reduce(key, seq, arr)
+        if len(contributors) < self.num_workers:
+            total = total * (float(self.num_workers) / len(contributors))
+        return NDArray(total)
 
     def push(self, key, value, priority=0):
         del priority  # XLA async dispatch owns scheduling
@@ -229,7 +386,8 @@ class KVStore:
                 # is pure — a retried attempt is idempotent; the store
                 # mutation below happens only after it succeeds.
                 merged = resilience.kv_retry(
-                    "push", k, lambda m=merged: self._dist_reduce(m))
+                    "push", k, lambda m=merged, kk=k: self._dist_reduce(
+                        m, kk))
             if k not in self._store:
                 self._store[k] = merged.copy()
                 continue
@@ -379,11 +537,51 @@ class KVStore:
         else:
             self._updater.set_states(blob)
 
-    def _barrier(self):
-        if self.num_workers > 1:
-            from jax.experimental import multihost_utils
+    def _barrier(self, tag="kvstore_barrier"):
+        """Cross-worker rendezvous. With membership attached the barrier
+        releases over LIVE members only (a dead peer is dropped within
+        one liveness window); either way it is deadline-bounded
+        (MXT_BARRIER_TIMEOUT, falling back to MXT_KV_DEADLINE) and
+        raises KVStoreError instead of waiting forever on a peer that
+        will never arrive."""
+        if self.num_workers <= 1:
+            return
+        if self._member is not None:
+            # unique per-call tag: barrier calls are collective, so every
+            # worker's Nth barrier lands on the same tag
+            self._barrier_seq += 1
+            self._member.barrier("%s:%d" % (tag, self._barrier_seq))
+            return
+        from . import config
 
-            multihost_utils.sync_global_devices("kvstore_barrier")
+        deadline = config.get("MXT_BARRIER_TIMEOUT")
+        if deadline is None:
+            deadline = config.get("MXT_KV_DEADLINE")
+        box = {}
+
+        def run():
+            try:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(tag)
+                box["ok"] = True
+            except BaseException as e:  # surfaced to the caller below
+                box["err"] = e
+
+        # the jax collective has no timeout of its own: run it on a
+        # daemon thread and bound the join, so a peer that never arrives
+        # becomes a typed error instead of a worker wedged forever
+        t = threading.Thread(target=run, daemon=True, name="kv-barrier")
+        t.start()
+        t.join(float(deadline))
+        if t.is_alive():
+            raise KVStoreError(
+                "kvstore barrier %r exceeded its %.1fs deadline "
+                "(MXT_BARRIER_TIMEOUT/MXT_KV_DEADLINE) — a peer is "
+                "unreachable and will never arrive" % (tag,
+                                                       float(deadline)))
+        if "err" in box:
+            raise box["err"]
 
 
 _KV_TYPES = ("local", "device", "nccl", "dist", "dist_sync", "dist_async",

@@ -1,52 +1,109 @@
 """kvstore server-role entry (ref: python/mxnet/kvstore_server.py — the
 process that blocks in MXKVStoreRunServer under DMLC_ROLE=server).
 
-The TPU build has no SEPARATE parameter-server process role: synchronous
-gradient exchange is compiled into the training step as XLA collectives
-over ICI/DCN (SURVEY §2.4 — the worker/server topology collapses into
-SPMD), and ``tools/launch.py`` starts only workers. The one surface that
-does need a server — ``dist_async`` hogwild — runs as a THREAD inside
-worker 0 (see async_server.py), so there is still nothing to launch on a
-dedicated server node. This module keeps the import surface so
-reference-style launches fail with an explanation instead of an
+The TPU build has no SEPARATE parameter-server process role for *sync*
+training: gradient exchange compiles into the training step as XLA
+collectives over ICI/DCN (SURVEY §2.4 — the worker/server topology
+collapses into SPMD), and ``tools/launch.py`` starts only workers. Two
+surfaces do need a server and both are the SAME one — the
+membership-enabled async server (async_server.py): ``dist_async``
+hogwild runs it as a thread inside worker 0, and this module now hosts
+it standalone for deployments that want the membership/elasticity
+coordinator (heartbeats, stale-push fencing, rejoin snapshots —
+membership.py) to outlive any single worker::
+
+    MXT_COORDINATOR=host:port python -m mxnet_tpu.kvstore_server
+
+Without ``MXT_COORDINATOR`` there is still nothing to serve, and
+construction fails with the design explanation instead of an
 ImportError.
 """
 from __future__ import annotations
 
 import os
+import threading
 
 from .base import MXNetError
 
-__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+__all__ = ["KVStoreServer", "_init_kvstore_server_module", "main"]
 
 
 class KVStoreServer:
-    """ref: kvstore_server.py — KVStoreServer. Not instantiable here."""
+    """ref: kvstore_server.py — KVStoreServer. ``run()`` hosts the
+    membership-enabled async parameter server at the address derived
+    from ``MXT_COORDINATOR`` and blocks until :meth:`close` (or the
+    server is torn down). Constructible only when a coordinator is
+    configured — otherwise the TPU build has, by design, nothing to
+    serve."""
 
-    def __init__(self, kvstore):
-        raise MXNetError(
-            "the TPU build has no separate parameter-server process: "
-            "sync dist training uses SPMD collectives compiled into the "
-            "step (parallel.ShardedTrainStep), and dist_async's hogwild "
-            "server runs as a thread inside worker 0 (async_server.py). "
-            "Launch workers only — nothing runs on a server node.")
+    def __init__(self, kvstore=None):
+        del kvstore  # reference parity: the C handle is meaningless here
+        from . import async_server
 
-    def run(self):  # pragma: no cover - unreachable (init raises)
-        raise NotImplementedError
+        self._addr = async_server.server_address()
+        if self._addr is None:
+            raise MXNetError(
+                "the TPU build has no separate parameter-server process "
+                "for sync training: SPMD collectives are compiled into "
+                "the step (parallel.ShardedTrainStep), and dist_async's "
+                "hogwild + membership server runs as a thread inside "
+                "worker 0 (async_server.py). To host that server "
+                "standalone, set MXT_COORDINATOR=host:port and run "
+                "`python -m mxnet_tpu.kvstore_server`.")
+        self._server = None
+        self._stop = threading.Event()
+
+    def run(self):
+        """Serve until close(): binds the membership/async server (store
+        ops + register/heartbeat/barrier/reduce) on the coordinator's
+        async port and parks this thread."""
+        from . import async_server
+
+        host, port = self._addr
+        self._server = async_server.get_server(host, port)
+        print("KVSTORE_SERVER_READY %s:%d" % (host, port), flush=True)
+        try:
+            while not self._server._stop.is_set() \
+                    and not self._stop.wait(0.2):
+                pass
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self._server.close()
+
+    def close(self):
+        self._stop.set()
 
 
 def _init_kvstore_server_module():
     """ref: kvstore_server.py — called at import under DMLC_ROLE=server
-    (the reference blocks in the server loop there; here a stale
-    reference-style launch fails fast with the design explanation)."""
+    (the reference blocks in the server loop there). With a coordinator
+    configured the role is now real — serving happens via
+    ``python -m mxnet_tpu.kvstore_server`` — so only a coordinator-less
+    reference-style launch fails fast with the design explanation."""
     role = os.environ.get("DMLC_ROLE", "")
-    if role == "server" or role == "scheduler":
+    if role in ("server", "scheduler") \
+            and not os.environ.get("MXT_COORDINATOR"):
         raise MXNetError(
-            "DMLC_ROLE=%s detected: reference-style parameter-server "
-            "launches are not used by the TPU build. Use tools/launch.py "
-            "(workers only; rendezvous via MXT_COORDINATOR)." % role)
+            "DMLC_ROLE=%s detected without MXT_COORDINATOR: reference-"
+            "style parameter-server launches are not used by the TPU "
+            "build. Use tools/launch.py (workers only; rendezvous via "
+            "MXT_COORDINATOR), or host the membership/async server with "
+            "`MXT_COORDINATOR=host:port python -m "
+            "mxnet_tpu.kvstore_server`." % role)
 
 
 # match the reference's import-time behavior: a server/scheduler-role
 # process must not silently proceed as a worker
 _init_kvstore_server_module()
+
+
+def main():
+    KVStoreServer().run()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,513 @@
+"""Elastic membership for the distributed KVStore — heartbeats, liveness,
+stale-push fencing, and worker rejoin (ref: ps-lite's ``Van`` membership
+under src/kvstore/kvstore_dist_server.h: ADD_NODE/HEARTBEAT control
+messages and the per-node timestamp table the scheduler reaps).
+
+PR 2 made the dist paths survive *transient* faults; this module handles
+*permanent* ones — a worker that died, froze, or rebooted:
+
+1. **Heartbeats + liveness.** Every worker registers with the
+   coordinator-side server (the authenticated async-server transport)
+   and heartbeats on a background thread every ``MXT_HEARTBEAT_INTERVAL``
+   seconds. The server's :class:`MembershipTable` stamps each beat; a
+   reaper thread declares a worker dead after ``MXT_LIVENESS_TIMEOUT``
+   seconds of silence, fences its generation, and bumps the membership
+   *epoch* (the version number of the member view).
+
+2. **Stale-push fencing.** Registration assigns a monotonically
+   increasing *generation* number (never reused, even across store
+   resets). Data frames carry ``(worker_id, generation)``; the server
+   rejects any frame whose generation is fenced — dead, replaced by a
+   re-registration, or never registered — with a typed
+   :class:`StaleWorkerError`, so a zombie's delayed in-flight push can
+   never corrupt server-side weights (the classic fencing-token design).
+
+3. **Elastic degradation + rejoin.** :meth:`MembershipTable.barrier` and
+   :meth:`MembershipTable.reduce` release against the LIVE member set,
+   not the static world size: when a peer is declared dead mid-round the
+   survivors complete (the kvstore renormalizes the reduced sum by
+   ``num_workers / len(survivors)`` so the gradient stays an unbiased
+   full-batch estimate) and the loss lands in the ``lost_workers``
+   profiler counter. A restarted worker rejoins by re-registering: it
+   receives a fresh generation, the current epoch, and a CRC-verified
+   full parameter snapshot of the server store (the wire analog of
+   resilience.CheckpointManager's CRC'd manifest) before it may push.
+
+Failure modes are deterministic through the seeded ``MXT_FAULT`` rules:
+``hb_drop`` loses heartbeats on the wire, ``worker_freeze:worker=I``
+freezes worker I's heartbeat thread (the process lives on as a zombie),
+and ``rejoin_race:ms=N`` widens the server-side window between fencing
+the old generation and answering the re-registration.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from .base import MXNetError
+from .resilience import KVStoreError
+
+__all__ = [
+    "StaleWorkerError", "BarrierTimeout", "MemberInfo", "MembershipTable",
+    "WorkerMembership", "record_lost_workers", "lost_worker_count",
+]
+
+
+class StaleWorkerError(KVStoreError):
+    """A frame arrived from a fenced-out (worker_id, generation): the
+    worker was declared dead, was replaced by a re-registration, or
+    never registered. The server refuses the frame so a zombie's delayed
+    push cannot corrupt server-side weights; the worker must re-register
+    (rejoin) before it may speak again."""
+
+
+class BarrierTimeout(KVStoreError):
+    """A membership barrier/reduce exceeded its deadline — a live peer
+    never arrived. Raised instead of hanging the waiting workers."""
+
+
+_LOST_COUNTER = "lost_workers"
+_lost_counter = None
+
+
+def record_lost_workers(n=1):
+    """Bump the lost-worker profiler counter (shows in profiler.dumps())."""
+    global _lost_counter
+    from . import profiler
+
+    if _lost_counter is None or _LOST_COUNTER not in profiler._counters:
+        _lost_counter = profiler.Counter(None, _LOST_COUNTER)
+    _lost_counter.increment(n)
+
+
+def lost_worker_count():
+    from . import profiler
+
+    return profiler.counter_value(_LOST_COUNTER)
+
+
+class MemberInfo:
+    """One registered worker: its fencing generation and last heartbeat."""
+
+    __slots__ = ("worker_id", "generation", "last_beat", "alive")
+
+    def __init__(self, worker_id, generation, now):
+        self.worker_id = worker_id
+        self.generation = generation
+        self.last_beat = now
+        self.alive = True
+
+
+class MembershipTable:
+    """Server-side membership view (ref: ps-lite Postoffice's node table).
+
+    Thread-safe; one Condition serializes mutation and wakes barrier and
+    reduce waiters when the view changes (arrival, death, rejoin). The
+    generation counter is global and monotone — it survives
+    :meth:`reset` so a generation can never be reused and an old world's
+    frames always fence out.
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._members = {}      # worker_id -> MemberInfo
+        self._epoch = 0         # bumped on every view change
+        self._next_gen = 1      # global monotone fencing-token counter
+        self._lost_total = 0    # workers declared dead (not deregistered)
+        self._barriers = {}     # tag -> set(worker_id) arrived
+        self._barrier_done = {}  # tag -> released-waiter refcount
+        self._reduces = {}      # (key, seq) -> {"sum", "wids", "done"}
+
+    # -- registration ------------------------------------------------------
+    def register(self, worker_id, now=None):
+        """Admit (or re-admit) a worker. Returns ``(generation, epoch,
+        rejoin)`` — ``rejoin`` is True when this worker_id was known
+        before (crashed/fenced/restarted), which entitles it to a state
+        snapshot. The previous generation, if any, is fenced by the
+        replacement."""
+        now = time.monotonic() if now is None else now
+        with self._cond:
+            rejoin = worker_id in self._members
+            gen = self._next_gen
+            self._next_gen += 1
+            self._members[worker_id] = MemberInfo(worker_id, gen, now)
+            self._epoch += 1
+            self._cond.notify_all()
+            return gen, self._epoch, rejoin
+
+    def deregister(self, worker_id, generation):
+        """Graceful leave: removed from the view without counting as
+        lost. A stale generation is ignored (a zombie cannot evict its
+        replacement)."""
+        with self._cond:
+            m = self._members.get(worker_id)
+            if m is not None and m.generation == generation:
+                del self._members[worker_id]
+                self._epoch += 1
+                self._cond.notify_all()
+
+    def reset(self):
+        """New store world (kvstore 'reset'): forget members but KEEP the
+        generation counter so pre-reset credentials stay fenced."""
+        with self._cond:
+            self._members.clear()
+            self._barriers.clear()
+            self._barrier_done.clear()
+            self._reduces.clear()
+            self._epoch += 1
+            self._cond.notify_all()
+
+    # -- liveness ----------------------------------------------------------
+    def _check_locked(self, worker_id, generation):
+        m = self._members.get(worker_id)
+        if m is None:
+            raise StaleWorkerError(
+                "worker %r (generation %r) is not a registered member — "
+                "a restarted worker must re-register (rejoin) before it "
+                "may push" % (worker_id, generation))
+        if m.generation != generation:
+            raise StaleWorkerError(
+                "worker %r generation %r is fenced out (current "
+                "generation %r): frames from the old incarnation are "
+                "rejected" % (worker_id, generation, m.generation))
+        if not m.alive:
+            raise StaleWorkerError(
+                "worker %r (generation %r) was declared dead after "
+                "missing its liveness window — re-register to rejoin"
+                % (worker_id, generation))
+
+    def check(self, worker_id, generation):
+        """Raise :class:`StaleWorkerError` unless (worker_id, generation)
+        is the current, live incarnation."""
+        with self._cond:
+            self._check_locked(worker_id, generation)
+
+    def heartbeat(self, worker_id, generation, now=None):
+        """Stamp a beat. Returns ``(epoch, lost_total)`` so workers learn
+        membership changes for free on every beat."""
+        now = time.monotonic() if now is None else now
+        with self._cond:
+            self._check_locked(worker_id, generation)
+            self._members[worker_id].last_beat = now
+            return self._epoch, self._lost_total
+
+    def reap(self, timeout, now=None):
+        """Declare workers dead whose last beat is older than ``timeout``
+        seconds. Returns the newly dead worker_ids; bumps the epoch and
+        the ``lost_workers`` profiler counter, and wakes barrier/reduce
+        waiters so survivors release."""
+        now = time.monotonic() if now is None else now
+        with self._cond:
+            dead = [m for m in self._members.values()
+                    if m.alive and now - m.last_beat > timeout]
+            for m in dead:
+                m.alive = False
+            if dead:
+                self._lost_total += len(dead)
+                self._epoch += 1
+                self._cond.notify_all()
+        if dead:
+            record_lost_workers(len(dead))
+        return [m.worker_id for m in dead]
+
+    # -- views -------------------------------------------------------------
+    def _live_ids_locked(self):
+        return {w for w, m in self._members.items() if m.alive}
+
+    def live_ids(self):
+        with self._cond:
+            return self._live_ids_locked()
+
+    def has_members(self):
+        with self._cond:
+            return bool(self._members)
+
+    def view(self):
+        """Serializable snapshot of the membership state."""
+        with self._cond:
+            return {
+                "epoch": self._epoch,
+                "members": {w: m.generation
+                            for w, m in self._members.items() if m.alive},
+                "dead": {w: m.generation
+                         for w, m in self._members.items() if not m.alive},
+                "lost_total": self._lost_total,
+            }
+
+    # -- elastic rendezvous ------------------------------------------------
+    def barrier(self, worker_id, generation, tag, timeout, poll=0.05):
+        """Block until every LIVE member arrived at ``tag``. A member
+        declared dead while others wait is dropped from the release
+        condition (sync degrades instead of hanging); a live peer that
+        never arrives within ``timeout`` raises :class:`BarrierTimeout`.
+        Returns the epoch at release."""
+        deadline = time.monotonic() + float(timeout)
+        with self._cond:
+            self._check_locked(worker_id, generation)
+            arrived = self._barriers.setdefault(tag, set())
+            arrived.add(worker_id)
+            self._cond.notify_all()
+            try:
+                while not arrived >= self._live_ids_locked():
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise BarrierTimeout(
+                            "membership barrier %r timed out after %.1fs "
+                            "waiting on live workers %s"
+                            % (tag, float(timeout),
+                               sorted(self._live_ids_locked() - arrived)))
+                    self._cond.wait(min(poll, remaining))
+                return self._epoch
+            finally:
+                done = self._barrier_done.get(tag, 0) + 1
+                self._barrier_done[tag] = done
+                if done >= len(arrived):
+                    self._barriers.pop(tag, None)
+                    self._barrier_done.pop(tag, None)
+
+    def reduce(self, worker_id, generation, key, seq, array, timeout,
+               poll=0.05):
+        """Elastic sum-reduction round ``(key, seq)``: contributions from
+        live members accumulate server-side; the round releases when
+        every live member has contributed (deaths shrink the wait set —
+        the reaper wakes the waiters). Re-sent contributions from the
+        at-least-once retry path are idempotent (one add per worker).
+        Returns ``(sum, sorted(contributor_ids))`` — the CALLER
+        renormalizes by its static world size if survivors < world."""
+        rkey = (key, seq)
+        deadline = time.monotonic() + float(timeout)
+        array = np.asarray(array)
+        with self._cond:
+            self._check_locked(worker_id, generation)
+            ent = self._reduces.setdefault(
+                rkey, {"sum": None, "wids": set(), "done": 0})
+            if worker_id not in ent["wids"]:
+                ent["wids"].add(worker_id)
+                ent["sum"] = array.copy() if ent["sum"] is None \
+                    else ent["sum"] + array
+                self._cond.notify_all()
+            try:
+                while not ent["wids"] >= self._live_ids_locked():
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise BarrierTimeout(
+                            "membership reduce %r seq %d timed out after "
+                            "%.1fs waiting on live workers %s"
+                            % (key, seq, float(timeout),
+                               sorted(self._live_ids_locked()
+                                      - ent["wids"])))
+                    self._cond.wait(min(poll, remaining))
+                return np.array(ent["sum"]), sorted(ent["wids"])
+            finally:
+                ent["done"] += 1
+                if ent["done"] >= len(ent["wids"]):
+                    self._reduces.pop(rkey, None)
+
+
+def snapshot_checksums(weights):
+    """CRC32 per array — the wire analog of CheckpointManager's per-file
+    manifest CRCs, so a rejoin snapshot is verified before it is
+    trusted."""
+    return {k: zlib.crc32(np.ascontiguousarray(v).tobytes()) & 0xFFFFFFFF
+            for k, v in weights.items()}
+
+
+def verify_snapshot(snap):
+    """Raise MXNetError if a rejoin snapshot fails its CRC manifest."""
+    if snap is None:
+        return None
+    want = snap.get("crc32", {})
+    got = snapshot_checksums(snap.get("weights", {}))
+    if want != got:
+        bad = sorted(k for k in set(want) | set(got)
+                     if want.get(k) != got.get(k))
+        raise MXNetError(
+            "rejoin snapshot failed CRC verification for keys %s "
+            "(corrupt handoff)" % bad)
+    return snap
+
+
+class WorkerMembership:
+    """One worker's membership session: registration, the background
+    heartbeat thread, and the elastic barrier/reduce client calls.
+
+    Owns its own control connection to the server (separate from the
+    data client) so a long-blocked push can never starve the heartbeat.
+    ``MXT_FAULT`` hooks: ``hb_drop`` loses individual beats on the wire;
+    ``worker_freeze:worker=I[,after=K]`` permanently freezes worker I's
+    beats after K sends (the zombie scenario — the process and its data
+    connection stay alive while the server declares it dead).
+    """
+
+    def __init__(self, host, port, worker_id, timeout=30.0):
+        from .async_server import AsyncClient
+
+        self.worker_id = int(worker_id)
+        self.generation = None
+        self.epoch = 0
+        self.lost_total = 0
+        self.snapshot = None
+        self.frozen = False
+        self.fenced = False
+        self._ctl = AsyncClient(host, port, timeout=timeout)
+        # barrier/reduce block server-side until the round releases — on
+        # their own connection so a long rendezvous can never starve the
+        # heartbeat (a worker must not be reaped for WAITING)
+        self._rdv = None
+        self._addr = (host, port, timeout)
+        self._stop = threading.Event()
+        self._thread = None
+        self._beats = 0
+
+    def _rendezvous_client(self):
+        if self._rdv is None:
+            from .async_server import AsyncClient
+
+            host, port, timeout = self._addr
+            self._rdv = AsyncClient(host, port, timeout=timeout)
+        return self._rdv
+
+    # -- registration / rejoin --------------------------------------------
+    def register(self, want_snapshot=False):
+        """Register (or rejoin). Fences any previous incarnation of this
+        worker_id; on rejoin the server hands back a CRC-verified full
+        parameter snapshot so the worker can resync before pushing."""
+        status = self._ctl.request(
+            "register", None, (self.worker_id, bool(want_snapshot)))
+        gen, epoch, snap = status
+        self.generation = gen
+        self.epoch = epoch
+        self.snapshot = verify_snapshot(snap)
+        self.fenced = False
+        return self
+
+    def re_register(self):
+        """Rejoin after a fencing or server restart: fresh generation,
+        current epoch, full snapshot; restarts heartbeats if the sender
+        stopped."""
+        self.register(want_snapshot=True)
+        if self._thread is not None and not self._thread.is_alive() \
+                and not self._stop.is_set():
+            self._thread = None
+            self.start_heartbeats()
+        return self.snapshot
+
+    # -- heartbeats --------------------------------------------------------
+    def heartbeat_now(self):
+        """One synchronous beat; updates the cached epoch/lost view."""
+        epoch, lost = self._ctl.request(
+            "heartbeat", None, (self.worker_id, self.generation))
+        self.epoch = epoch
+        self.lost_total = lost
+        return epoch, lost
+
+    def start_heartbeats(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._beat_loop, daemon=True,
+            name="kv-heartbeat-w%d" % self.worker_id)
+        self._thread.start()
+        return self
+
+    def _interval(self):
+        from . import config
+
+        return float(config.get("MXT_HEARTBEAT_INTERVAL"))
+
+    def _beat_loop(self):
+        from . import resilience
+
+        while not self._stop.wait(self._interval()):
+            inj = resilience.fault_point()
+            frz = inj.rule("worker_freeze")
+            if frz is not None \
+                    and int(frz.get("worker", -1)) == self.worker_id \
+                    and self._beats >= int(frz.get("after", 0)) \
+                    and inj.should("worker_freeze"):
+                # the zombie scenario: beats stop but the process (and
+                # its data connection) lives on — the reaper must fence
+                self.frozen = True
+                return
+            self._beats += 1
+            if inj.should("hb_drop"):
+                continue  # beat lost on the wire
+            try:
+                self.heartbeat_now()
+            except StaleWorkerError:
+                # fenced (declared dead or replaced): stop beating — a
+                # zombie must NOT auto-rejoin; rejoin is explicit
+                self.fenced = True
+                return
+            except (MXNetError, ConnectionError, OSError):
+                pass  # server unreachable this beat; keep trying
+
+    # -- elastic rendezvous ------------------------------------------------
+    def _deadline(self):
+        from . import config
+
+        t = config.get("MXT_BARRIER_TIMEOUT")
+        return float(t if t is not None else config.get("MXT_KV_DEADLINE"))
+
+    def barrier(self, tag, timeout=None):
+        """Barrier over LIVE members (dead peers are excluded by the
+        server). Raises KVStoreError on deadline instead of hanging."""
+        timeout = self._deadline() if timeout is None else float(timeout)
+        return self._rendezvous_client().request(
+            "barrier", None, (self.worker_id, self.generation, tag,
+                              timeout))
+
+    def reduce(self, key, seq, array, timeout=None):
+        """Elastic sum-reduction; returns (sum, contributor_ids)."""
+        timeout = self._deadline() if timeout is None else float(timeout)
+        return self._rendezvous_client().request(
+            "reduce", key, (self.worker_id, self.generation, seq,
+                            np.asarray(array), timeout))
+
+    def members(self):
+        """Current server-side membership view."""
+        return self._ctl.request("members")
+
+    def wait_for_world(self, n, timeout=None):
+        """Block until ``n`` live members are registered (bounded poll).
+        Registration is a rendezvous — like ps-lite's ADD_NODE barrier:
+        the elastic live-member semantics (degrade over survivors) only
+        apply AFTER the world has formed, otherwise an early worker's
+        first reduce would release solo before its peers even register.
+        Raises :class:`BarrierTimeout` when the world never forms."""
+        timeout = self._deadline() if timeout is None else float(timeout)
+        deadline = time.monotonic() + timeout
+        while True:
+            view = self.members()
+            if len(view["members"]) >= n:
+                self.epoch = view["epoch"]
+                self.lost_total = view["lost_total"]
+                return view
+            if time.monotonic() >= deadline:
+                raise BarrierTimeout(
+                    "membership world never formed: %d/%d workers "
+                    "registered within %.1fs (%s)"
+                    % (len(view["members"]), n, timeout,
+                       sorted(view["members"])))
+            time.sleep(0.02)
+
+    # -- teardown ----------------------------------------------------------
+    def stop(self, deregister=True):
+        """Stop the heartbeat thread; optionally leave gracefully (a
+        deregistered worker does not count as lost)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if deregister and self.generation is not None and not self.fenced:
+            try:
+                self._ctl.request(
+                    "deregister", None, (self.worker_id, self.generation))
+            except (MXNetError, ConnectionError, OSError):
+                pass
+        if self._rdv is not None:
+            self._rdv.close()
+        self._ctl.close()

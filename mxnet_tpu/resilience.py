@@ -43,6 +43,13 @@ checkpoint writes at named points.
     kv_delay:p=0.2,ms=5,seed=1   # delay acks 5 ms w.p. 0.2
     ckpt_crash:at=manifest,n=1   # SimulatedCrash at a checkpoint phase
                                  # (at= params | states | manifest | rotate)
+    hb_drop:p=0.5,seed=3         # lose membership heartbeats on the wire
+    worker_freeze:worker=2,after=1  # freeze worker 2's heartbeat thread
+                                 # after 1 beat (zombie: process lives,
+                                 # server declares it dead and fences it)
+    rejoin_race:ms=30            # widen the server-side window between
+                                 # fencing the old generation and
+                                 # answering a re-registration
 
 ``p`` defaults to 1.0, ``n`` (max firings) to unlimited, ``seed`` to 0.
 One injector instance lives per distinct spec string so the drawn

@@ -22,12 +22,13 @@ import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import nd  # noqa: E402
 
 
-def _barrier():
+def _barrier(kv):
     """Cross-process rendezvous (the test needs a 'everyone pushed'
-    point; REAL training would not barrier — that is the async point)."""
-    from jax.experimental import multihost_utils
-
-    multihost_utils.sync_global_devices("dist_async_test")
+    point; REAL training would not barrier — that is the async point).
+    Rides the MEMBERSHIP barrier over the server transport: the jax
+    collective barrier needs a TPU/GPU backend, membership rides TCP and
+    additionally excludes dead peers."""
+    kv._barrier("dist_async_test")
 
 
 def main():
@@ -43,7 +44,7 @@ def main():
     kv.init("w", nd.zeros((3, 2)))
     for _ in range(rank + 1):
         kv.push("w", nd.ones((3, 2)))
-    _barrier()  # test-only: wait until every worker's pushes are acked
+    _barrier(kv)  # test-only: wait until every worker's pushes are acked
     out = nd.zeros((3, 2))
     kv.pull("w", out=out)
     total = sum(r + 1 for r in range(nw))
@@ -56,7 +57,7 @@ def main():
     mine = nd.zeros((2,))
     kv.pull("v", out=mine)
     assert float(mine.asnumpy()[0]) <= -(rank + 1) + 1e-6  # mine applied
-    _barrier()
+    _barrier(kv)
 
     # 3) accumulate mode (no optimizer on this key's server... same
     # server; push after set_optimizer applies SGD — verify pulls agree
@@ -75,18 +76,19 @@ def main():
         assert os.path.getsize(f.name) > 0
         kv.load_optimizer_states(f.name)
         os.unlink(f.name)
-    _barrier()
+    _barrier(kv)
 
     # 5) store re-creation: no EADDRINUSE, fresh state after reset
-    kv2 = mx.kv.create("dist_async")
-    _barrier()  # reset (rank 0, inside create) before anyone inits
+    kv2 = mx.kv.create("dist_async")  # creation itself rendezvouses:
+    # non-zero ranks wait for rank 0's reset (server 'world' poll) and
+    # membership re-forms before create returns — no barrier needed
     kv2.init("z", nd.ones((2,)))
     out2 = nd.zeros((2,))
     kv2.pull("z", out=out2)
     np.testing.assert_allclose(out2.asnumpy(), 1.0)
     # no optimizer on the fresh generation: push REPLACES (CopyFromTo)
     kv2.push("z", nd.full((2,), 5.0 + rank))
-    _barrier()
+    _barrier(kv2)
     kv2.pull("z", out=out2)
     assert out2.asnumpy()[0] in [5.0 + r for r in range(nw)]
     # first push to an uninitialized key initializes it
@@ -98,9 +100,8 @@ def main():
     # 6) the canonical Trainer loop over the async store: each worker
     # trains at its own pace (update_on_kvstore: push grad, server
     # applies, pull weight back) — the reference's async training shape
-    _barrier()
+    _barrier(kv2)  # everyone done with kv2 before its world is reset
     kv3 = mx.kv.create("dist_async")
-    _barrier()  # reset before anyone registers params
     from mxnet_tpu import autograd as ag
     from mxnet_tpu import gluon
 
@@ -117,7 +118,7 @@ def main():
         loss.backward()
         trainer.step(4)
     assert trainer._update_on_kvstore is True
-    _barrier()  # all pushes acked server-side
+    _barrier(kv3)  # all pushes acked server-side
     # sharp check: the SERVER optimizer's update counter proves every
     # worker's every push was applied exactly once (weight-value checks
     # alone are tautological — all ranks pull the same server state)

@@ -1,0 +1,117 @@
+"""Worker script for the real-process elastic soak (slow tier): run via
+
+    python tools/launch.py -n 3 --launcher local --respawn \
+        python tests/dist/elastic_worker.py
+
+Worker 2's FIRST incarnation SIGKILLs itself mid-epoch; the launcher
+respawns it with its original rank/env, and the second incarnation
+re-registers (rejoin), receives the snapshot handoff, and pushes again.
+Worker 0 hosts the membership server thread and asserts the full
+sequence: death observed within the liveness window → rejoin observed →
+final store state reflects the rejoined push. File markers under
+ELASTIC_TEST_DIR coordinate incarnations (the launcher gives a respawn
+the SAME env, which is the point).
+
+Uses the membership/async server directly (no jax.distributed) so a
+SIGKILL + respawn does not have to renegotiate the JAX coordination
+service — exactly the standalone-server topology kvstore_server hosts.
+"""
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from mxnet_tpu import async_server  # noqa: E402
+from mxnet_tpu.membership import WorkerMembership  # noqa: E402
+
+DEADLINE = 60.0
+
+
+def _wait(cond, msg):
+    t0 = time.monotonic()
+    while not cond():
+        assert time.monotonic() - t0 < DEADLINE, "timeout: " + msg
+        time.sleep(0.02)
+
+
+def main():
+    rank = int(os.environ["MXT_WORKER_ID"])
+    n = int(os.environ["MXT_NUM_WORKERS"])
+    mdir = os.environ["ELASTIC_TEST_DIR"]
+    host, port = async_server.server_address()
+    if rank == 0:
+        async_server.get_server(host, port)  # server thread lives here
+
+    marker = os.path.join(mdir, "spawned_%d" % rank)
+    first = not os.path.exists(marker)
+    with open(marker, "a") as f:
+        f.write("x")
+
+    m = WorkerMembership(host, port, rank)
+    m.register(want_snapshot=not first)
+    m.start_heartbeats()
+    cli = async_server.AsyncClient(host, port)
+    cli.set_credentials(rank, m.generation)
+
+    if rank == 0:
+        cli.request("init", "w", np.zeros((4,), np.float32))
+    _wait(lambda: _has_key(cli), "key init")
+    cli.request("push", "w", np.full((4,), rank + 1.0, np.float32))
+
+    if rank == 2 and first:
+        # die mid-epoch, hard — the launcher must respawn us with the
+        # SAME rank/env so the second incarnation rejoins
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    if rank == 2 and not first:
+        # rejoin handoff: the server knew this worker_id → snapshot
+        assert m.snapshot is not None and "w" in m.snapshot["weights"], \
+            "rejoin snapshot missing"
+        cli.request("push", "w", np.full((4,), 42.0, np.float32))
+        with open(os.path.join(mdir, "rejoined"), "w") as f:
+            f.write("ok")
+
+    if rank == 0:
+        # death within the liveness window, then the rejoin, then the
+        # rejoined incarnation's push landed
+        _wait(lambda: 2 in m.members()["dead"]
+              or os.path.exists(os.path.join(mdir, "rejoined")),
+              "worker 2 declared dead")
+        _wait(lambda: os.path.exists(os.path.join(mdir, "rejoined")),
+              "worker 2 rejoin")
+        _wait(lambda: cli.request("pull", "w")[0] == 42.0,
+              "rejoined push visible")
+        # survivors kept pushing throughout
+        cli.request("push", "w", np.full((4,), 7.0, np.float32))
+    if rank == 1:
+        _wait(lambda: os.path.exists(os.path.join(mdir, "rejoined")),
+              "rejoin before worker 1 exits")
+
+    print("ELASTIC_PASS rank=%d/%d first=%s" % (rank, n, first),
+          flush=True)
+    m.stop(deregister=True)
+    cli.close()
+    if rank == 0:
+        # worker 0 hosts the server: stay up until every peer reported
+        _wait(lambda: os.path.exists(os.path.join(mdir, "rejoined")),
+              "final drain")
+
+
+def _has_key(cli):
+    try:
+        cli.request("pull", "w")
+        return True
+    except Exception:
+        return False
+
+
+if __name__ == "__main__":
+    main()

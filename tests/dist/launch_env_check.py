@@ -1,0 +1,76 @@
+"""Worker script for the launcher env-plumbing test: asserts the rank /
+coordinator / secret env contract tools/launch.py promises, then
+completes one cross-process sync reduction to prove the rendezvous env
+actually works end to end.
+
+Run: python tools/launch.py -n 2 --launcher local \
+         python tests/dist/launch_env_check.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+
+
+def main():
+    # -- env contract (satellite: launch_local plumbing was untested) --
+    coord = os.environ["MXT_COORDINATOR"]
+    host, _, port = coord.rpartition(":")
+    assert host == "127.0.0.1" and int(port) > 0, coord
+    n = int(os.environ["MXT_NUM_WORKERS"])
+    rank = int(os.environ["MXT_WORKER_ID"])
+    assert 0 <= rank < n, (rank, n)
+    # reference-compatible spellings forwarded too
+    assert os.environ["DMLC_NUM_WORKER"] == str(n)
+    assert os.environ["DMLC_WORKER_ID"] == str(rank)
+    assert os.environ["DMLC_ROLE"] == "worker"
+    # secret forwarding: the launcher inherits the parent env wholesale
+    want_secret = os.environ.get("LAUNCH_TEST_EXPECT_SECRET")
+    if want_secret:
+        assert os.environ.get("MXT_KVSTORE_SECRET") == want_secret, \
+            "secret not forwarded to worker env"
+
+    # -- one sync reduction through the launched rendezvous --
+    # CPU processes have no XLA cross-process collectives, so the
+    # reduction rides the elastic membership server (MXT_ELASTIC=1):
+    # rank 0 hosts it at the coordinator-derived port, every worker
+    # registers + heartbeats, and push rendezvouses the sum there —
+    # the same code path production uses for degradable sync
+    os.environ["MXT_ELASTIC"] = "1"
+    mx.parallel.init_distributed()
+    kv = mx.kv.create("dist_sync")
+    assert kv.num_workers == n, (kv.num_workers, n)
+    assert kv.rank == rank, (kv.rank, rank)
+    assert kv._member is not None, "elastic membership did not engage"
+    kv.init("e", nd.zeros((2, 2)))
+    kv.push("e", nd.full((2, 2), rank + 1.0))
+    out = nd.zeros((2, 2))
+    kv.pull("e", out=out)
+    np.testing.assert_allclose(out.asnumpy(),
+                               sum(r + 1.0 for r in range(n)))
+    print("ENV_PASS rank=%d/%d" % (rank, n), flush=True)
+    # drain: the rank-0 process hosts the server thread — peers leave
+    # first (graceful deregister) so no reply is torn mid-send
+    kv._barrier("env_check_done")
+    if rank != 0:
+        kv._member.stop(deregister=True)
+    else:
+        import time
+
+        deadline = time.monotonic() + 30.0
+        while set(kv._member.members()["members"]) != {0}:
+            assert time.monotonic() < deadline, "peers never drained"
+            time.sleep(0.02)
+
+
+if __name__ == "__main__":
+    main()

@@ -2,12 +2,21 @@
 cluster': real kvstore code over localhost processes via the launcher,
 no mocks)."""
 import os
+import socket
 import subprocess
 import sys
 
 import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 @pytest.mark.parametrize("n", [2, 4])
@@ -61,3 +70,131 @@ def test_dist_async_kvstore_hogwild(n, secret):
         capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
     assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
     assert r.stdout.count("ASYNC_PASS") == n, r.stdout[-2000:]
+
+
+def test_launch_local_env_plumbing_and_sync_reduction():
+    """Satellite: launch_local's rank/coordinator/secret forwarding was
+    untested — 2 subprocess workers assert the env contract and complete
+    a sync reduction through the launched rendezvous."""
+    env = dict(os.environ)
+    env.pop("MXT_COORDINATOR", None)
+    env["MXT_KVSTORE_SECRET"] = "env-plumb-secret"
+    env["LAUNCH_TEST_EXPECT_SECRET"] = "env-plumb-secret"
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", sys.executable,
+         os.path.join(ROOT, "tests", "dist", "launch_env_check.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert r.stdout.count("ENV_PASS") == 2, r.stdout[-2000:]
+
+
+def test_worker_env_contract():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import launch
+    finally:
+        sys.path.pop(0)
+    env = launch._worker_env({"MXT_KVSTORE_SECRET": "s3"},
+                             "127.0.0.1:9999", 4, 2)
+    assert env["MXT_COORDINATOR"] == "127.0.0.1:9999"
+    assert env["MXT_NUM_WORKERS"] == "4" and env["MXT_WORKER_ID"] == "2"
+    assert env["DMLC_NUM_WORKER"] == "4" and env["DMLC_WORKER_ID"] == "2"
+    assert env["DMLC_ROLE"] == "worker"
+    assert env["MXT_KVSTORE_SECRET"] == "s3"  # base env forwarded
+
+
+def test_launch_respawn_restarts_crashed_worker(tmp_path):
+    """--respawn restarts a non-zero exit with the ORIGINAL rank/env:
+    worker 1 crashes on its first incarnation (sentinel file) and
+    succeeds on the respawn; the launch as a whole exits 0."""
+    env = dict(os.environ)
+    env["CRASH_MARKER"] = str(tmp_path / "spawn_")
+    prog = ("import os,sys;"
+            "p=os.environ['CRASH_MARKER']+os.environ['MXT_WORKER_ID'];"
+            "first=not os.path.exists(p);open(p,'a').write('x');"
+            "sys.exit(1 if first and os.environ['MXT_WORKER_ID']=='1' "
+            "else 0)")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "--respawn",
+         sys.executable, "-c", prog],
+        capture_output=True, text=True, timeout=120, env=env, cwd=ROOT)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "respawning with original rank/env" in r.stderr
+    # worker 1 ran twice (crash + respawn), worker 0 once
+    assert (tmp_path / "spawn_1").read_text() == "xx"
+    assert (tmp_path / "spawn_0").read_text() == "x"
+
+
+def test_launch_respawn_budget_exhausted(tmp_path):
+    """A worker that keeps crashing exhausts --max-restarts and the
+    launch reports its failure instead of looping forever."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "1", "--launcher", "local", "--respawn",
+         "--max-restarts", "1", sys.executable, "-c",
+         "import sys; sys.exit(3)"],
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
+    assert r.returncode == 3, (r.returncode, r.stderr)
+    assert r.stderr.count("respawning") == 1
+
+
+def test_kvstore_server_role_serves_standalone():
+    """Satellite: `python -m mxnet_tpu.kvstore_server` launched as a
+    role actually serves — a client can push/pull through it (the
+    membership/async server hosted standalone)."""
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("MXT_KVSTORE_SECRET", None)
+    env["MXT_COORDINATOR"] = "127.0.0.1:%d" % port
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.Popen(
+        [sys.executable, "-m", "mxnet_tpu.kvstore_server"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=ROOT)
+    try:
+        line = p.stdout.readline()
+        assert "KVSTORE_SERVER_READY" in line, (line, p.stderr.read()
+                                                if p.poll() else "")
+        import numpy as np
+
+        from mxnet_tpu import async_server
+
+        cli = async_server.AsyncClient("127.0.0.1", port +
+                                       async_server.ASYNC_PORT_OFFSET,
+                                       timeout=15.0)
+        cli.request("init", "w", np.full((2,), 4.0, np.float32))
+        np.testing.assert_array_equal(cli.request("pull", "w"),
+                                      np.full((2,), 4.0))
+        cli.close()
+    finally:
+        p.terminate()
+        p.wait(timeout=30)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_elastic_rejoin_real_processes(tmp_path):
+    """Real-process acceptance variant (slow): 3 workers under
+    --respawn, worker 2 SIGKILLs itself mid-epoch, is respawned with its
+    original rank/env, rejoins via snapshot handoff, and the survivors
+    observe the death within the liveness window."""
+    env = dict(os.environ)
+    env.pop("MXT_COORDINATOR", None)
+    env["ELASTIC_TEST_DIR"] = str(tmp_path)
+    env["MXT_HEARTBEAT_INTERVAL"] = "0.1"
+    env["MXT_LIVENESS_TIMEOUT"] = "0.5"
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "3", "--launcher", "local", "--respawn",
+         sys.executable,
+         os.path.join(ROOT, "tests", "dist", "elastic_worker.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    # 4 passes: ranks 0/1 + BOTH incarnations... the killed first
+    # incarnation never prints, so: rank0, rank1, rank2-respawn
+    assert r.stdout.count("ELASTIC_PASS") == 3, r.stdout[-2000:]
+    assert "first=False" in r.stdout  # the rejoined incarnation
+    assert (tmp_path / "rejoined").exists()
+    assert (tmp_path / "spawned_2").read_text() == "xx"  # ran twice

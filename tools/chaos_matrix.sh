@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Chaos matrix: sweep the seeded MXT_FAULT rules across injector seeds
+# and fail on ANY hang (every cell runs under `timeout`).
+#
+# The chaos-marked tests (tests/test_membership.py, tests/test_resilience.py)
+# arm their own MXT_FAULT specs; they read MXT_CHAOS_SEED (set per cell
+# here) so each sweep re-seeds the injector RNGs — kv_drop/kv_delay,
+# ckpt_crash, and the membership rules hb_drop / worker_freeze /
+# rejoin_race all get exercised at every seed.
+#
+# Usage: tools/chaos_matrix.sh [seed...]          (default seeds: 0 1 2)
+#        CHAOS_CELL_TIMEOUT=600 tools/chaos_matrix.sh 7 11
+set -u
+
+cd "$(dirname "$0")/.."
+
+SEEDS=("$@")
+[ "${#SEEDS[@]}" -eq 0 ] && SEEDS=(0 1 2)
+CELL_TIMEOUT="${CHAOS_CELL_TIMEOUT:-600}"
+FILES=(tests/test_membership.py tests/test_resilience.py)
+
+fail=0
+for seed in "${SEEDS[@]}"; do
+    echo "== chaos sweep: MXT_CHAOS_SEED=$seed (cell timeout ${CELL_TIMEOUT}s)"
+    timeout -k 10 "$CELL_TIMEOUT" env JAX_PLATFORMS=cpu \
+        MXT_CHAOS_SEED="$seed" \
+        python -m pytest "${FILES[@]}" -q -m "chaos and not slow" \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    rc=$?
+    if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+        echo "!! HANG: seed=$seed exceeded ${CELL_TIMEOUT}s" >&2
+        fail=1
+    elif [ "$rc" -ne 0 ]; then
+        echo "!! FAIL: seed=$seed rc=$rc" >&2
+        fail=1
+    fi
+done
+
+[ "$fail" -eq 0 ] && echo "chaos matrix: all seeds clean"
+exit "$fail"

@@ -21,6 +21,11 @@ the coordinator) and launches one worker per host:
 
 Workers read MXT_COORDINATOR / MXT_NUM_WORKERS / MXT_WORKER_ID (set
 here) via ``mxnet_tpu.parallel.init_distributed()``.
+
+``--respawn`` (local launcher) supervises the workers: a crashed one is
+restarted with its original rank/env so it rejoins the kvstore
+membership view (fresh generation + snapshot handoff, membership.py),
+up to ``--max-restarts`` times per slot.
 """
 from __future__ import annotations
 
@@ -51,17 +56,45 @@ def _worker_env(base, coordinator, n, i):
     return env
 
 
-def launch_local(n, command):
+def launch_local(n, command, respawn=False, max_restarts=2):
+    """Start n local workers. With ``respawn`` the launcher supervises
+    them: a worker that exits non-zero (crash, SIGKILL) is restarted
+    with its ORIGINAL rank/env — same MXT_WORKER_ID, same coordinator,
+    same forwarded secret — so the membership rejoin path (re-register,
+    fresh generation, snapshot handoff) is exercised end to end. Each
+    slot restarts at most ``max_restarts`` times."""
+    import time
+
     coordinator = "127.0.0.1:%d" % _free_port()
-    procs = []
-    for i in range(n):
-        procs.append(subprocess.Popen(
-            command, env=_worker_env(os.environ, coordinator, n, i)))
-    rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
-    return rc
+    envs = [_worker_env(os.environ, coordinator, n, i) for i in range(n)]
+    procs = [subprocess.Popen(command, env=envs[i]) for i in range(n)]
+    if not respawn:
+        rc = 0
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+        return rc
+    restarts = [0] * n
+    final = [None] * n
+    while any(f is None for f in final):
+        for i, p in enumerate(procs):
+            if final[i] is not None or p.poll() is None:
+                continue
+            rc = p.returncode
+            if rc == 0:
+                final[i] = 0
+            elif restarts[i] < max_restarts:
+                restarts[i] += 1
+                sys.stderr.write(
+                    "launch: worker %d exited rc=%d — respawning with "
+                    "original rank/env (%d/%d)\n"
+                    % (i, rc, restarts[i], max_restarts))
+                sys.stderr.flush()
+                procs[i] = subprocess.Popen(command, env=envs[i])
+            else:
+                final[i] = rc
+        time.sleep(0.05)
+    return next((rc for rc in final if rc), 0)
 
 
 def launch_ssh(n, hostfile, command):
@@ -96,12 +129,23 @@ def main():
     ap.add_argument("--launcher", choices=("local", "ssh"),
                     default="local")
     ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("--respawn", action="store_true",
+                    help="supervise local workers: restart a crashed "
+                         "worker with its original rank/env so it "
+                         "rejoins the membership view (local launcher "
+                         "only)")
+    ap.add_argument("--max-restarts", type=int, default=2,
+                    help="per-worker restart budget under --respawn")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
         ap.error("no command to launch")
     if args.launcher == "local":
-        return launch_local(args.num_workers, args.command)
+        return launch_local(args.num_workers, args.command,
+                            respawn=args.respawn,
+                            max_restarts=args.max_restarts)
+    if args.respawn:
+        ap.error("--respawn supports the local launcher only")
     if not args.hostfile:
         ap.error("ssh launcher requires -H hostfile")
     return launch_ssh(args.num_workers, args.hostfile, args.command)
