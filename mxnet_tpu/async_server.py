@@ -210,7 +210,14 @@ class AsyncParamServer:
         self._world = 0  # reset count: store-generation rendezvous token
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
+        try:
+            self._sock.bind((host, port))
+        except OSError:
+            # leave no half-open socket behind: the caller may fall
+            # back to client-only mode against whoever owns the port
+            # (standalone kvstore_server hosting the coordinator)
+            self._sock.close()
+            raise
         self._sock.listen(64)
         self._stop = threading.Event()
         self._accept_thread = threading.Thread(
@@ -290,7 +297,9 @@ class AsyncParamServer:
                 except BarrierTimeout as e:
                     reply = ("timeout", str(e))
                 ch.send(reply)
-        except (ConnectionError, EOFError):
+        except (OSError, EOFError):
+            # includes EBADF from close() tearing the socket out from
+            # under a handler blocked in recv (server shutdown/bounce)
             pass
         except MXNetError as e:
             # post-auth handler failure (bad optimizer config, shape
@@ -417,6 +426,12 @@ class AsyncParamServer:
                               if self._updater is not None else None)
                 snap = {"weights": weights, "states": states,
                         "epoch": epoch,
+                        # last released barrier/reduce rounds: a
+                        # rejoined worker resumes at the SURVIVORS'
+                        # sequence numbers instead of restarting at 0
+                        # (fresh counters would never match their
+                        # rounds and every rendezvous would time out)
+                        "seqs": self.membership.rendezvous_seqs(),
                         "crc32": snapshot_checksums(weights)}
             return ("ok", (gen, epoch, snap))
         elif op == "heartbeat":
@@ -490,6 +505,7 @@ class AsyncClient:
         self._cred = None        # (worker_id, generation) membership token
         self._boot_id = None     # server instance id from the banner
         self._saw_restart = False
+        self._needs_resync = False  # restarted server, state NOT restored
         self.server_restarts = 0
         # resync hook: invoked (with this client) after a reconnect that
         # landed on a RESTARTED server instance — the kvstore wires this
@@ -501,8 +517,11 @@ class AsyncClient:
     def set_credentials(self, worker_id, generation):
         """Attach the membership fencing token: every subsequent frame
         carries (worker_id, generation) and the server refuses it once
-        the generation is fenced (StaleWorkerError)."""
+        the generation is fenced (StaleWorkerError). Fresh credentials
+        are the caller's acknowledgment of the current server world, so
+        this also clears the restarted-server mutation fence."""
         self._cred = (int(worker_id), int(generation))
+        self._needs_resync = False
 
     def _connect(self):
         import time
@@ -585,24 +604,60 @@ class AsyncClient:
                 # resync (e.g. membership re-registration) BEFORE the
                 # retried frame is re-sent — it picks up new credentials
                 cb(self)
+            else:
+                # nobody restored the restarted instance's (empty)
+                # store and optimizer: fence mutating ops until the
+                # owner resyncs (set_credentials after an explicit
+                # re-registration clears it). Reads stay open — pulls
+                # against the empty store are typed errors, and a
+                # rejoin needs register/heartbeat to pass.
+                self._needs_resync = True
 
-    def request(self, op, key=None, payload=None):
+    def request(self, op, key=None, payload=None, deadline=None):
+        """One op round-trip under the retry policy. ``deadline``
+        overrides the per-op retry deadline AND puts a recv timeout on
+        the socket for this request — rendezvous ops (barrier/reduce)
+        pass their rendezvous timeout plus a margin so the server's
+        typed release/timeout reply wins the race against the transport
+        giving up (a premature client retry would park a duplicate
+        waiter server-side)."""
         from . import resilience
         from .membership import StaleWorkerError
         from .resilience import KVStoreError
 
         def attempt():
             with self._lock:
-                # frame built per attempt so a resync hook's refreshed
-                # credentials apply to the retried send
-                if self._cred is not None:
-                    self._ch.send((op, key, payload, self._cred))
-                else:
-                    self._ch.send((op, key, payload))
-                return self._ch.recv()
+                if self._needs_resync and op in _FENCED_OPS:
+                    raise KVStoreError(
+                        "async kvstore server RESTARTED mid-run (boot id "
+                        "changed) and its store/optimizer were not "
+                        "restored — refusing %r: a retried push against "
+                        "the empty store would install a raw gradient "
+                        "as the weight. Re-register (rejoin) and re-seed "
+                        "server state, then set_credentials." % (op,))
+                if deadline is not None:
+                    self._sock.settimeout(float(deadline))
+                try:
+                    # frame built per attempt so a resync hook's
+                    # refreshed credentials apply to the retried send
+                    if self._cred is not None:
+                        self._ch.send((op, key, payload, self._cred))
+                    else:
+                        self._ch.send((op, key, payload))
+                    return self._ch.recv()
+                finally:
+                    if deadline is not None:
+                        try:
+                            self._sock.settimeout(None)
+                        except OSError:
+                            pass
 
+        policy = None
+        if deadline is not None:
+            policy = resilience.RetryPolicy.from_config()
+            policy.deadline = float(deadline)
         status, result = resilience.kv_retry(
-            op, key, attempt, reconnect=self._reconnect)
+            op, key, attempt, reconnect=self._reconnect, policy=policy)
         if status == "stale":
             raise StaleWorkerError(result)
         if status == "timeout":

@@ -142,7 +142,10 @@ _declare("MXT_BARRIER_TIMEOUT", float, None,
          "Deadline in seconds for KVStore barriers (both the membership "
          "barrier and the jax.distributed sync path). Unset falls back "
          "to MXT_KV_DEADLINE; exceeding it raises KVStoreError instead "
-         "of hanging on a peer that will never arrive.")
+         "of hanging on a peer that will never arrive. Rendezvous "
+         "requests give the transport this window plus a small margin "
+         "so the server's typed timeout reply beats the client-side "
+         "retry (no duplicate waiters).")
 
 _declare("MXT_KV_RETRIES", int, 4,
          "Max retries for a kvstore network op (dist push reduction, "

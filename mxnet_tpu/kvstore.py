@@ -121,6 +121,14 @@ class KVStore:
         self._member = None        # WorkerMembership when elastic
         self._barrier_seq = 0      # unique tags for membership barriers
         self._reduce_seq = {}      # key -> elastic reduce round counter
+        # async mode keeps a client-side shadow of the last weights this
+        # worker observed (init values + every pull; pushes too when the
+        # no-updater path makes the push BE the weight). A server that
+        # restarts mid-run boots with an empty store — the resync hook
+        # re-seeds it from this shadow so a survivor's retried push
+        # cannot take the first-push-initializes branch and install a
+        # raw gradient as the weight. One numpy copy per key.
+        self._shadow = {}
         if kv_type == "dist_async":
             self._maybe_start_async()
         elif kv_type.startswith("dist"):
@@ -142,6 +150,7 @@ class KVStore:
         self._member = membership.WorkerMembership(
             host, port, self._worker_id())
         self._member.register()
+        self._adopt_rendezvous_seqs()
         self._member.start_heartbeats()
         if self._async is not None:
             self._async.set_credentials(self._member.worker_id,
@@ -153,20 +162,56 @@ class KVStore:
         launchers): frames are credentialed and barriers/reductions go
         elastic through it."""
         self._member = member
+        self._adopt_rendezvous_seqs()
         if self._async is not None:
             self._async.set_credentials(member.worker_id,
                                         member.generation)
             self._async.on_server_restart = self._on_server_restart
         return self
 
+    def _adopt_rendezvous_seqs(self):
+        """Resume at the SURVIVORS' rendezvous rounds after a rejoin:
+        the registration snapshot carries the server's last released
+        barrier/reduce sequence numbers, and this store's counters
+        fast-forward to them. Counters restarting at 0 would tag rounds
+        the survivors already finished — their barriers and elastic
+        reduce rounds would never match ours again and both sides would
+        end in BarrierTimeout."""
+        snap = self._member.snapshot if self._member is not None else None
+        seqs = (snap or {}).get("seqs")
+        if not seqs:
+            return
+        if seqs.get("barrier"):
+            self._barrier_seq = max(self._barrier_seq,
+                                    max(seqs["barrier"].values()))
+        for k, s in seqs.get("reduce", {}).items():
+            self._reduce_seq[k] = max(self._reduce_seq.get(k, 0), s)
+
     def _on_server_restart(self, client):
         """The data client reconnected to a RESTARTED server (boot id
-        changed): its membership table is empty, so re-register for a
-        fresh generation before the retried frame is re-sent."""
-        if self._member is not None:
-            self._member.re_register()
-            client.set_credentials(self._member.worker_id,
-                                   self._member.generation)
+        changed): its membership table, store, AND optimizer are all
+        empty. Re-register for a fresh generation, then restore server
+        state BEFORE the retried frame is re-sent — against an
+        un-reseeded store the retried push would take the
+        first-push-initializes branch and install a raw GRADIENT as the
+        weight, and every later push would REPLACE instead of update
+        (set_optimizer is only shipped once at store creation)."""
+        if self._member is None:
+            return
+        self._member.re_register()
+        client.set_credentials(self._member.worker_id,
+                               self._member.generation)
+        self._adopt_rendezvous_seqs()
+        if self._optimizer is not None:
+            # every reconnecting worker re-ships it (no rank gate: rank
+            # 0 may be the one that died); the updater's slot state
+            # restarts fresh, like resuming a checkpoint without states
+            client.request("set_optimizer", None,
+                           pickle.dumps(self._optimizer))
+        for k, arr in self._shadow.items():
+            # re-seed from the last weights this worker observed —
+            # init is first-writer-wins across the reconnecting fleet
+            client.request("init", k, arr)
 
     def lost_workers(self):
         """Workers declared dead by the liveness reaper so far (0 without
@@ -185,12 +230,34 @@ class KVStore:
         host, port = addr
         world = next(_async_world_counter)
         if self.rank == 0:
-            # singleton per process; a fresh KVStore generation resets
-            # the server state
-            self._async_server = async_server.get_server(host, port)
-            reset = async_server.AsyncClient(host, port)
-            reset.request("reset")
-            reset.close()
+            try:
+                # singleton per process; a fresh KVStore generation
+                # resets the server state
+                self._async_server = async_server.get_server(host, port)
+            except OSError:
+                # the coordinator port is already served by ANOTHER
+                # process (standalone `python -m mxnet_tpu.kvstore_server`,
+                # or a worker 0 whose server thread outlived us): be a
+                # plain client of it instead of dying with EADDRINUSE
+                self._async_server = None
+            ctl = async_server.AsyncClient(host, port)
+            try:
+                if world == 1 and ctl.request("members")["members"]:
+                    # this process's FIRST store, yet the membership
+                    # table already has live workers: we are a respawned
+                    # rank 0 joining a RUNNING world (tools/launch.py
+                    # --respawn preserves MXT_WORKER_ID=0). A reset here
+                    # would wipe the live store and fence every survivor
+                    # with an unrecoverable StaleWorkerError — rejoin
+                    # below instead (register hands back the snapshot
+                    # plus the survivors' rendezvous seqs). Later store
+                    # generations (world > 1) are collective re-creates
+                    # and reset as before.
+                    pass
+                else:
+                    ctl.request("reset")
+            finally:
+                ctl.close()
         else:
             # rendezvous (ps-lite init is one too): nobody talks to the
             # server until rank 0's reset for THIS store generation is
@@ -241,7 +308,11 @@ class KVStore:
             return
         host, port = addr
         if self.rank == 0:
-            self._async_server = async_server.get_server(host, port)
+            try:
+                self._async_server = async_server.get_server(host, port)
+            except OSError:
+                # port already served (standalone coordinator): client
+                self._async_server = None
         # non-zero ranks rely on the client's bounded connect retry to
         # ride out the server coming up
         self._engage_membership(host, port)
@@ -286,6 +357,7 @@ class KVStore:
             for k, v in zip(keys, values):
                 arr = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
                 self._async.request("init", k, arr)  # first writer wins
+                self._shadow[k] = arr
             return
         for k, v in zip(keys, values):
             if k in self._store:
@@ -371,7 +443,12 @@ class KVStore:
             for k, v in zip(keys, values):
                 merged = self._merge(v)
                 merged = self._maybe_compress(k, merged)
-                self._async.request("push", k, merged.asnumpy())
+                arr = merged.asnumpy()
+                self._async.request("push", k, arr)
+                if self._updater is None:
+                    # no server-side optimizer: the push IS the new
+                    # weight (replace semantics) — keep the shadow live
+                    self._shadow[k] = arr
             return
         for k, v in zip(keys, values):
             merged = self._merge(v)
@@ -414,7 +491,9 @@ class KVStore:
         """Current value of a key: from the async server in hogwild mode,
         else the local store."""
         if self._async is not None:
-            return NDArray(self._async.request("pull", k))
+            arr = self._async.request("pull", k)
+            self._shadow[k] = arr  # last observed weight (restart re-seed)
+            return NDArray(arr)
         if k in self._store:
             return self._store[k]
         raise MXNetError("key %s has not been initialized" % (k,))

@@ -117,9 +117,11 @@ class MembershipTable:
         self._epoch = 0         # bumped on every view change
         self._next_gen = 1      # global monotone fencing-token counter
         self._lost_total = 0    # workers declared dead (not deregistered)
-        self._barriers = {}     # tag -> set(worker_id) arrived
-        self._barrier_done = {}  # tag -> released-waiter refcount
-        self._reduces = {}      # (key, seq) -> {"sum", "wids", "done"}
+        self._barriers = {}     # tag -> {"arrived": set, "waiters": int}
+        self._barrier_released = set()  # tags whose round has released
+        self._barrier_last = {}  # tag base -> last released numeric seq
+        self._reduces = {}      # (key, seq) -> in-flight round entry
+        self._reduce_last = {}  # key -> (seq, sum, wids) last released
 
     # -- registration ------------------------------------------------------
     def register(self, worker_id, now=None):
@@ -155,8 +157,10 @@ class MembershipTable:
         with self._cond:
             self._members.clear()
             self._barriers.clear()
-            self._barrier_done.clear()
+            self._barrier_released.clear()
+            self._barrier_last.clear()
             self._reduces.clear()
+            self._reduce_last.clear()
             self._epoch += 1
             self._cond.notify_all()
 
@@ -238,59 +242,109 @@ class MembershipTable:
             }
 
     # -- elastic rendezvous ------------------------------------------------
+    def rendezvous_seqs(self):
+        """Last RELEASED barrier/reduce round per tag base / key. Handed
+        to a rejoining worker inside the registration snapshot so its
+        client-side counters resume at the survivors' rounds: a
+        respawned worker whose counters restarted at 0 would tag rounds
+        the survivors already finished, and every later rendezvous on
+        both sides would time out."""
+        with self._cond:
+            return {"barrier": dict(self._barrier_last),
+                    "reduce": {k: s for k, (s, _, _)
+                               in self._reduce_last.items()}}
+
+    def _release_barrier_locked(self, tag):
+        if tag in self._barrier_released:
+            return
+        self._barrier_released.add(tag)
+        base, sep, num = tag.rpartition(":")
+        if sep and num.isdigit():
+            self._barrier_last[base] = max(
+                self._barrier_last.get(base, 0), int(num))
+
     def barrier(self, worker_id, generation, tag, timeout, poll=0.05):
         """Block until every LIVE member arrived at ``tag``. A member
         declared dead while others wait is dropped from the release
         condition (sync degrades instead of hanging); a live peer that
         never arrives within ``timeout`` raises :class:`BarrierTimeout`.
-        Returns the epoch at release."""
+        Returns the epoch at release.
+
+        At-least-once safe: duplicate waiters for one (tag, worker) —
+        a client retry whose first frame is still parked — are
+        refcounted, so the round's bookkeeping is freed exactly when
+        the last waiter leaves; a retry arriving AFTER the round
+        released is acked immediately (tags are never reused) instead
+        of recreating the entry and leaking it."""
         deadline = time.monotonic() + float(timeout)
         with self._cond:
             self._check_locked(worker_id, generation)
-            arrived = self._barriers.setdefault(tag, set())
-            arrived.add(worker_id)
+            if tag in self._barrier_released:
+                return self._epoch
+            ent = self._barriers.setdefault(
+                tag, {"arrived": set(), "waiters": 0})
+            ent["arrived"].add(worker_id)
+            ent["waiters"] += 1
             self._cond.notify_all()
             try:
-                while not arrived >= self._live_ids_locked():
+                while tag not in self._barrier_released \
+                        and not ent["arrived"] >= self._live_ids_locked():
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         raise BarrierTimeout(
                             "membership barrier %r timed out after %.1fs "
                             "waiting on live workers %s"
                             % (tag, float(timeout),
-                               sorted(self._live_ids_locked() - arrived)))
+                               sorted(self._live_ids_locked()
+                                      - ent["arrived"])))
                     self._cond.wait(min(poll, remaining))
+                self._release_barrier_locked(tag)
                 return self._epoch
             finally:
-                done = self._barrier_done.get(tag, 0) + 1
-                self._barrier_done[tag] = done
-                if done >= len(arrived):
+                ent["waiters"] -= 1
+                if ent["waiters"] <= 0:
                     self._barriers.pop(tag, None)
-                    self._barrier_done.pop(tag, None)
 
     def reduce(self, worker_id, generation, key, seq, array, timeout,
                poll=0.05):
         """Elastic sum-reduction round ``(key, seq)``: contributions from
         live members accumulate server-side; the round releases when
         every live member has contributed (deaths shrink the wait set —
-        the reaper wakes the waiters). Re-sent contributions from the
-        at-least-once retry path are idempotent (one add per worker).
-        Returns ``(sum, sorted(contributor_ids))`` — the CALLER
-        renormalizes by its static world size if survivors < world."""
+        the reaper wakes the waiters). Returns
+        ``(sum, sorted(contributor_ids))`` — the CALLER renormalizes by
+        its static world size if survivors < world.
+
+        At-least-once safe: a contribution re-sent while the round is
+        open is idempotent (one add per worker); one re-sent after the
+        round released replays the released result instead of opening a
+        fresh solo round that would wait out the full timeout; one older
+        than the last released round is a stale frame and is refused."""
         rkey = (key, seq)
         deadline = time.monotonic() + float(timeout)
         array = np.asarray(array)
         with self._cond:
             self._check_locked(worker_id, generation)
+            last = self._reduce_last.get(key)
+            if rkey not in self._reduces and last is not None \
+                    and seq <= last[0]:
+                if seq == last[0]:
+                    return np.array(last[1]), list(last[2])
+                raise BarrierTimeout(
+                    "membership reduce %r seq %d is older than the last "
+                    "released round %d — the round is gone and cannot "
+                    "be joined" % (key, seq, last[0]))
             ent = self._reduces.setdefault(
-                rkey, {"sum": None, "wids": set(), "done": 0})
-            if worker_id not in ent["wids"]:
+                rkey, {"sum": None, "wids": set(), "waiters": 0,
+                       "released": None})
+            if ent["released"] is None and worker_id not in ent["wids"]:
                 ent["wids"].add(worker_id)
                 ent["sum"] = array.copy() if ent["sum"] is None \
                     else ent["sum"] + array
                 self._cond.notify_all()
+            ent["waiters"] += 1
             try:
-                while not ent["wids"] >= self._live_ids_locked():
+                while ent["released"] is None \
+                        and not ent["wids"] >= self._live_ids_locked():
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         raise BarrierTimeout(
@@ -300,10 +354,18 @@ class MembershipTable:
                                sorted(self._live_ids_locked()
                                       - ent["wids"])))
                     self._cond.wait(min(poll, remaining))
-                return np.array(ent["sum"]), sorted(ent["wids"])
+                if ent["released"] is None:
+                    ent["released"] = (np.array(ent["sum"]),
+                                       sorted(ent["wids"]))
+                    prev = self._reduce_last.get(key)
+                    if prev is None or seq > prev[0]:
+                        self._reduce_last[key] = (
+                            seq, ent["released"][0], ent["released"][1])
+                total, wids = ent["released"]
+                return np.array(total), list(wids)
             finally:
-                ent["done"] += 1
-                if ent["done"] >= len(ent["wids"]):
+                ent["waiters"] -= 1
+                if ent["waiters"] <= 0:
                     self._reduces.pop(rkey, None)
 
 
@@ -328,6 +390,15 @@ def verify_snapshot(snap):
             "rejoin snapshot failed CRC verification for keys %s "
             "(corrupt handoff)" % bad)
     return snap
+
+
+# A rendezvous request is legitimately held server-side for up to its
+# full timeout before the typed release/timeout reply comes back. The
+# transport gets that window PLUS this margin, so the server's reply
+# always wins the race against the client-side deadline — otherwise the
+# client gives up first, retries, and seeds duplicate server-side
+# waiters for rounds that were about to answer.
+_RENDEZVOUS_MARGIN = 5.0
 
 
 class WorkerMembership:
@@ -436,9 +507,15 @@ class WorkerMembership:
             self._beats += 1
             if inj.should("hb_drop"):
                 continue  # beat lost on the wire
+            gen = self.generation
             try:
                 self.heartbeat_now()
             except StaleWorkerError:
+                if self.generation != gen:
+                    # a concurrent re-registration replaced our
+                    # credentials while this beat was in flight — the
+                    # NEW generation is live, keep beating under it
+                    continue
                 # fenced (declared dead or replaced): stop beating — a
                 # zombie must NOT auto-rejoin; rejoin is explicit
                 self.fenced = True
@@ -455,18 +532,23 @@ class WorkerMembership:
 
     def barrier(self, tag, timeout=None):
         """Barrier over LIVE members (dead peers are excluded by the
-        server). Raises KVStoreError on deadline instead of hanging."""
+        server). Raises KVStoreError on deadline instead of hanging.
+        The transport deadline is the rendezvous timeout plus
+        ``_RENDEZVOUS_MARGIN`` so the server's typed release/timeout
+        reply beats the client-side retry."""
         timeout = self._deadline() if timeout is None else float(timeout)
         return self._rendezvous_client().request(
             "barrier", None, (self.worker_id, self.generation, tag,
-                              timeout))
+                              timeout),
+            deadline=timeout + _RENDEZVOUS_MARGIN)
 
     def reduce(self, key, seq, array, timeout=None):
         """Elastic sum-reduction; returns (sum, contributor_ids)."""
         timeout = self._deadline() if timeout is None else float(timeout)
         return self._rendezvous_client().request(
             "reduce", key, (self.worker_id, self.generation, seq,
-                            np.asarray(array), timeout))
+                            np.asarray(array), timeout),
+            deadline=timeout + _RENDEZVOUS_MARGIN)
 
     def members(self):
         """Current server-side membership view."""

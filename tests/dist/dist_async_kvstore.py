@@ -86,6 +86,10 @@ def main():
     out2 = nd.zeros((2,))
     kv2.pull("z", out=out2)
     np.testing.assert_allclose(out2.asnumpy(), 1.0)
+    # everyone observes the init value BEFORE anyone pushes: async mode
+    # makes no cross-worker ordering promise, so without this barrier a
+    # fast peer's push can land before a slow worker's first pull
+    _barrier(kv2)
     # no optimizer on the fresh generation: push REPLACES (CopyFromTo)
     kv2.push("z", nd.full((2,), 5.0 + rank))
     _barrier(kv2)

@@ -360,11 +360,18 @@ def test_barrier_excludes_dead_and_times_out_on_live(server):
     srv, port = server
     ms = [_member(port, i) for i in range(2)]
     try:
-        # both live and only one arrives → bounded KVStoreError, no hang
+        # both live and only one arrives → bounded KVStoreError, no hang.
+        # The match pins the SERVER's typed timeout reply: the transport
+        # deadline is rendezvous + margin, so the server's answer wins
+        # the race against a client-side retry (which would park a
+        # duplicate waiter and inflate the effective deadline).
         t0 = time.monotonic()
-        with pytest.raises(KVStoreError, match="timed out"):
+        with pytest.raises(KVStoreError, match="waiting on live workers"):
             ms[0].barrier("lonely", timeout=WINDOW)
         assert time.monotonic() - t0 < 3 * WINDOW
+        # the timed-out round left no bookkeeping behind
+        _wait_until(lambda: not srv.membership._barriers,
+                    msg="barrier table drained")
         # kill worker 1's beats: after death, a solo barrier releases
         ms[1]._stop.set()
         _wait_until(lambda: 1 in ms[0].members()["dead"],
@@ -454,6 +461,138 @@ def test_reduce_is_idempotent_per_worker(server):
         total, wids = out[0]
         np.testing.assert_allclose(total, 2.0)
         assert wids == [0, 1]
+    finally:
+        for m in ms:
+            m.stop(deregister=False)
+
+
+def test_barrier_duplicate_waiter_refcount_and_replay():
+    """Review fix: a client-retry duplicate waiter for the same
+    (tag, worker) must not leak bookkeeping — cleanup is refcounted by
+    WAITER, not by arrived-worker count — and a retry arriving AFTER
+    the round released is acked immediately instead of recreating the
+    entry (which leaked forever: tags are never reused)."""
+    tbl = MembershipTable()
+    g0, _, _ = tbl.register(0)
+    g1, _, _ = tbl.register(1)
+    done = []
+
+    def wait0():
+        done.append(tbl.barrier(0, g0, "t:1", timeout=5.0))
+
+    dups = [threading.Thread(target=wait0) for _ in range(2)]
+    for t in dups:
+        t.start()
+    _wait_until(lambda: tbl._barriers.get("t:1", {}).get("waiters") == 2,
+                msg="duplicate waiters parked")
+    done.append(tbl.barrier(1, g1, "t:1", timeout=5.0))
+    for t in dups:
+        t.join(5.0)
+    assert len(done) == 3
+    assert tbl._barriers == {}, "waiter refcount leaked an entry"
+    # at-least-once replay: the released tag acks immediately
+    t0 = time.monotonic()
+    tbl.barrier(0, g0, "t:1", timeout=5.0)
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_reduce_replay_after_release_and_stale_seq_refused():
+    """Review fix: a reduce frame retried after its round was popped
+    used to open a fresh solo round and wait out the full timeout — it
+    now replays the released result; a frame older than the last
+    released round is refused with a typed error."""
+    tbl = MembershipTable()
+    g0, _, _ = tbl.register(0)
+    g1, _, _ = tbl.register(1)
+    out = {}
+
+    def contribute(i, g):
+        out[i] = tbl.reduce(i, g, "k", 2, np.ones((2,), np.float32),
+                            timeout=5.0)
+
+    ths = [threading.Thread(target=contribute, args=a)
+           for a in ((0, g0), (1, g1))]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(5.0)
+    np.testing.assert_allclose(out[0][0], 2.0)
+    assert tbl._reduces == {}, "reduce round leaked an entry"
+    # replay: the released round answers immediately with its result
+    t0 = time.monotonic()
+    total, wids = tbl.reduce(0, g0, "k", 2, np.ones((2,), np.float32),
+                             timeout=5.0)
+    assert time.monotonic() - t0 < 1.0
+    np.testing.assert_allclose(total, 2.0)
+    assert wids == [0, 1]
+    # a zombie frame for an already-finished older round is refused
+    with pytest.raises(BarrierTimeout, match="older"):
+        tbl.reduce(0, g0, "k", 1, np.ones((2,), np.float32), timeout=5.0)
+
+
+def test_rejoined_worker_resumes_rendezvous_seqs(monkeypatch, server):
+    """Review fix: a respawned worker's KVStore used to restart its
+    barrier/reduce counters at 0 and could never match the survivors'
+    rounds again; the rejoin snapshot now carries the server-issued
+    last released sequence numbers and the fresh store fast-forwards."""
+    srv, port = server
+    monkeypatch.setattr(KVStore, "num_workers",
+                        property(lambda self: 2))
+    from mxnet_tpu import nd
+
+    ms = [_member(port, i) for i in range(2)]
+    kvs = []
+    for i in range(2):
+        kv = KVStore("dist_sync")
+        kv.attach_membership(ms[i])
+        kvs.append(kv)
+
+    def one_round(kv, value, outs):
+        kv.init("g", nd.zeros((2,)))
+        kv.push("g", nd.full((2,), value))
+        o = nd.zeros((2,))
+        kv.pull("g", out=o)
+        outs.append(o.asnumpy())
+        kv._barrier()
+
+    try:
+        outs = []
+        ths = [threading.Thread(target=one_round, args=(kvs[i], i + 1.0,
+                                                        outs))
+               for i in range(2)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(10 * WINDOW)
+        assert len(outs) == 2
+        for o in outs:
+            np.testing.assert_allclose(o, 3.0)  # 1+2
+
+        # "respawn" worker 1: its old incarnation stops, a fresh one
+        # re-registers (rejoin) and a FRESH KVStore adopts the
+        # server-issued seqs from the snapshot
+        ms[1].stop(deregister=False)
+        m1b = WorkerMembership("127.0.0.1", port, 1)
+        m1b.register(want_snapshot=True)
+        m1b.start_heartbeats()
+        ms.append(m1b)
+        kv1b = KVStore("dist_sync")
+        kv1b.attach_membership(m1b)
+        assert kv1b._barrier_seq == kvs[0]._barrier_seq
+        assert kv1b._reduce_seq.get("g") == kvs[0]._reduce_seq.get("g")
+
+        # and a joint round with the survivor actually completes:
+        # matching (key, seq) and matching barrier tags
+        outs2 = []
+        ths = [threading.Thread(target=one_round, args=(kv, v, outs2))
+               for kv, v in ((kvs[0], 5.0), (kv1b, 7.0))]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(10 * WINDOW)
+        assert len(outs2) == 2, "rejoined round never released"
+        for o in outs2:
+            np.testing.assert_allclose(o, 12.0)
     finally:
         for m in ms:
             m.stop(deregister=False)
@@ -554,6 +693,143 @@ def test_server_bounce_detected_and_resynced(monkeypatch):
         m.stop(deregister=False)
         cli.close()
         srv2.close()
+
+
+def _rebind(port, deadline=10.0):
+    """Bind a fresh server instance on a just-freed port (bounded)."""
+    t0 = time.monotonic()
+    while True:
+        try:
+            return async_server.AsyncParamServer("127.0.0.1", port)
+        except OSError:
+            assert time.monotonic() - t0 < deadline, "port never freed"
+            time.sleep(0.05)
+
+
+@pytest.mark.chaos
+def test_server_restart_resync_restores_optimizer_and_weights(monkeypatch):
+    """Review fix (high): a bounced server boots with an empty store and
+    no optimizer — the resync hook must restore BOTH before the
+    survivor's retried frame lands, else the retried push takes the
+    first-push-initializes branch (a raw gradient becomes the weight)
+    and every later push replaces instead of updating: silent
+    corruption while training appears to continue."""
+    monkeypatch.setenv("MXT_KV_RETRY_BASE", "0.01")
+    from mxnet_tpu import nd, optimizer
+
+    srv = async_server.AsyncParamServer("127.0.0.1", 0)
+    port = srv._sock.getsockname()[1]
+    m = _member(port, 0)
+    kv = KVStore("local")
+    kv._type = "dist_async"
+    kv._async = async_server.AsyncClient("127.0.0.1", port)
+    kv.attach_membership(m)
+    kv.set_optimizer(optimizer.SGD(learning_rate=1.0))
+    kv.init("w", nd.full((2,), 10.0))
+    kv.push("w", nd.ones((2,)))      # SGD lr=1: w = 10 - 1 = 9
+    out = nd.zeros((2,))
+    kv.pull("w", out=out)            # shadow caches the observed 9.0
+    np.testing.assert_allclose(out.asnumpy(), 9.0)
+
+    srv.close()
+    srv2 = _rebind(port)
+    try:
+        kv.push("w", nd.full((2,), 2.0))  # retried against the restart
+        assert kv._async.server_restarts == 1
+        kv.pull("w", out=out)
+        # restored weight 9 updated BY the gradient: 9 - 2 = 7 — not the
+        # raw gradient 2.0 (first-push-initializes) and not a replace
+        # to 2.0 (lost optimizer)
+        np.testing.assert_allclose(out.asnumpy(), 7.0)
+    finally:
+        m.stop(deregister=False)
+        kv._async.close()
+        srv2.close()
+
+
+@pytest.mark.chaos
+def test_server_restart_without_resync_refuses_mutation(monkeypatch):
+    """Review fix (high): with NO resync hook installed, a retried
+    mutating op against a restarted (empty) server fails loudly with
+    KVStoreError instead of silently installing a gradient as the
+    weight; an explicit re-registration + set_credentials clears the
+    fence."""
+    monkeypatch.setenv("MXT_KV_RETRY_BASE", "0.01")
+    srv = async_server.AsyncParamServer("127.0.0.1", 0)
+    port = srv._sock.getsockname()[1]
+    cli = async_server.AsyncClient("127.0.0.1", port)
+    cli.request("init", "w", np.ones((2,), np.float32))
+    srv.close()
+    srv2 = _rebind(port)
+    m = None
+    try:
+        with pytest.raises(KVStoreError, match="RESTARTED"):
+            cli.request("push", "w", np.full((2,), 3.0, np.float32))
+        assert not srv2._store, "the fenced push still mutated the store"
+        # reads stay open (a recovery path needs them) — the empty
+        # store answers with a typed error, not corruption
+        with pytest.raises(MXNetError, match="not initialized"):
+            cli.request("pull", "w")
+        # explicit rejoin acknowledges the new world and clears the fence
+        m = WorkerMembership("127.0.0.1", port, 0).register()
+        cli.set_credentials(0, m.generation)
+        cli.request("push", "w", np.full((2,), 3.0, np.float32))
+        np.testing.assert_array_equal(cli.request("pull", "w"),
+                                      np.full((2,), 3.0))
+    finally:
+        if m is not None:
+            m.stop(deregister=False)
+        cli.close()
+        srv2.close()
+
+
+def test_rank0_respawn_rejoins_live_world_instead_of_reset(monkeypatch):
+    """Review fix: a respawned rank 0 (tools/launch.py --respawn keeps
+    MXT_WORKER_ID=0) must treat a membership table with live members as
+    a RUNNING world and rejoin it — its old 'reset' wiped the live
+    store and fenced every survivor with an unrecoverable
+    StaleWorkerError. And when the coordinator port is already served
+    (standalone kvstore_server), rank 0 falls back to a plain client
+    instead of dying with EADDRINUSE."""
+    import itertools
+
+    from mxnet_tpu import kvstore as kvmod
+
+    srv = async_server.AsyncParamServer("127.0.0.1", 0)  # standalone
+    port = srv._sock.getsockname()[1]
+    monkeypatch.setenv(
+        "MXT_COORDINATOR",
+        "127.0.0.1:%d" % (port - async_server.ASYNC_PORT_OFFSET))
+    monkeypatch.setattr(KVStore, "num_workers",
+                        property(lambda self: 2))
+    # a respawned process is creating its FIRST store
+    monkeypatch.setattr(kvmod, "_async_world_counter", itertools.count(1))
+
+    # the surviving world: worker 1 registered and store populated
+    m1 = _member(port, 1)
+    c1 = async_server.AsyncClient("127.0.0.1", port)
+    c1.set_credentials(1, m1.generation)
+    c1.request("init", "w", np.full((2,), 4.0, np.float32))
+    kv = None
+    try:
+        kv = KVStore("dist_async")  # the respawned rank 0
+        assert kv._async is not None, "async mode did not engage"
+        assert kv._async_server is None, "re-hosted an occupied port"
+        assert srv._store, "rank-0 respawn reset wiped the live store"
+        np.testing.assert_array_equal(kv._async.request("pull", "w"),
+                                      np.full((2,), 4.0))
+        # the survivor's generation is still honored (not fenced)
+        c1.request("push", "w", np.full((2,), 6.0, np.float32))
+        # and rank 0 itself is a registered member of the live world
+        assert 0 in m1.members()["members"]
+    finally:
+        if kv is not None and kv._member is not None:
+            kv._member.stop(deregister=False)
+        if kv is not None and kv._async is not None:
+            kv._async.close()
+        m1.stop(deregister=False)
+        c1.close()
+        srv.close()
 
 
 # ---------------------------------------------------------------------------
