@@ -42,7 +42,8 @@ _BUDGET = float(os.environ.get("BENCH_BUDGET", "1500"))
 # measured on the axon tunnel in round 3; CPU small-shape runs are cheaper
 # but CPU is the fallback path where the budget rarely binds
 _CONFIG_COST = {"resnet50": 420, "bert": 300, "lstm_ptb": 200,
-                "wide_deep": 200, "lenet": 150, "pipeline": 150}
+                "wide_deep": 200, "lenet": 150, "pipeline": 150,
+                "async_ab": 90}
 
 
 def _remaining():
@@ -138,9 +139,11 @@ def _timed_steps(step, x, y, iters, warmup):
     # alone). Real training is pipelined the same way — the reference's
     # async engine never syncs per step either (SURVEY §3.1); the queue
     # stays bounded by iters, which is <= 50 everywhere.
-    # Returns (wall seconds, framework launch dispatches) for the timed
-    # window — the launch count (profiler.launch_count) makes fusion
-    # health visible per row: a fused step is exactly 1/step.
+    # Returns (wall seconds, framework launch dispatches, host syncs) for
+    # the timed window — the launch count (profiler.launch_count) makes
+    # fusion health visible per row (a fused step is exactly 1/step), and
+    # the host-sync count makes ASYNC health visible: a K-deep engine
+    # window shows <= 1/K framework reads per step.
     from mxnet_tpu import profiler
 
     sync_every = int(os.environ.get("BENCH_SYNC_EVERY", "0"))  # 0 = window end
@@ -152,19 +155,22 @@ def _timed_steps(step, x, y, iters, warmup):
         loss.wait_to_read()
     t0 = time.perf_counter()
     l0 = profiler.launch_count()
+    h0 = profiler.host_sync_count()
     for i in range(iters):
         loss = step(x, y)
         if sync_every and (i + 1) % sync_every == 0:
             loss.wait_to_read()
     loss.wait_to_read()
-    return time.perf_counter() - t0, profiler.launch_count() - l0
+    return (time.perf_counter() - t0, profiler.launch_count() - l0,
+            profiler.host_sync_count() - h0)
 
 
-def _step_stats(dt, launches, iters):
+def _step_stats(dt, launches, syncs, iters):
     """The per-row fusion-health fields every _timed_steps config emits."""
     return {
         "step_time_ms": round(dt / iters * 1e3, 3),
         "launches_per_step": round(launches / iters, 2),
+        "host_syncs_per_step": round(syncs / iters, 3),
     }
 
 
@@ -221,7 +227,7 @@ def bench_resnet50(platform, dtype, batch=None, remat="env"):
     x = x.astype(dtype)
     y = nd.array(rng.randint(0, 1000, (batch,)).astype(np.float32))
 
-    dt, launches = _timed_steps(step, x, y, iters, warmup)
+    dt, launches, syncs = _timed_steps(step, x, y, iters, warmup)
     img_s = batch * iters / dt
 
     dump = os.environ.get("BENCH_DUMP_HLO")
@@ -250,7 +256,7 @@ def bench_resnet50(platform, dtype, batch=None, remat="env"):
         "images_or_tokens_per_sec_per_chip": round(img_s, 2),
         "mfu": _mfu(img_s, flops_per_img, platform), "platform": platform,
         "flops_per_sample": flops_per_img,
-        **_step_stats(dt, launches, iters),
+        **_step_stats(dt, launches, syncs, iters),
     }
     _emit_jsonl(row)
     return img_s, row
@@ -351,7 +357,7 @@ def bench_bert_mlm(platform, dtype):
     else:
         sharded = step = make_sharded()
 
-    dt, launches = _timed_steps(step, x, y, iters, warmup)
+    dt, launches, syncs = _timed_steps(step, x, y, iters, warmup)
     tok_s = batch * seq_len * iters / dt
 
     flops_per_tok = (sharded or make_sharded()).flops_per_step(x, y)
@@ -368,7 +374,7 @@ def bench_bert_mlm(platform, dtype):
         "images_or_tokens_per_sec_per_chip": round(tok_s, 2),
         "mfu": _mfu(tok_s, flops_per_tok, platform), "platform": platform,
         "flops_per_sample": flops_per_tok,
-        **_step_stats(dt, launches, iters),
+        **_step_stats(dt, launches, syncs, iters),
     }
     _emit_jsonl(row)
     return tok_s, row
@@ -414,7 +420,7 @@ def bench_lenet_mnist(platform, dtype):
         net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
         {"learning_rate": 0.05, "momentum": 0.9})
 
-    dt, launches = _timed_steps(step, x, y, iters, warmup)
+    dt, launches, syncs = _timed_steps(step, x, y, iters, warmup)
     img_s = batch * iters / dt
     flops = step.flops_per_step(x, y)
     if flops:
@@ -426,7 +432,7 @@ def bench_lenet_mnist(platform, dtype):
         "images_or_tokens_per_sec_per_chip": round(img_s, 2),
         "mfu": _mfu(img_s, flops, platform), "platform": platform,
         "flops_per_sample": flops,
-        **_step_stats(dt, launches, iters),
+        **_step_stats(dt, launches, syncs, iters),
     }
     _emit_jsonl(row)
     return img_s, row
@@ -480,7 +486,7 @@ def bench_lstm_ptb(platform, dtype):
         net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
         {"learning_rate": 1.0})
 
-    dt, launches = _timed_steps(step, x, y, iters, warmup)
+    dt, launches, syncs = _timed_steps(step, x, y, iters, warmup)
     tok_s = batch * seq_len * iters / dt
     flops_per_tok = step.flops_per_step(x, y)
     if flops_per_tok:
@@ -494,7 +500,7 @@ def bench_lstm_ptb(platform, dtype):
         "images_or_tokens_per_sec_per_chip": round(tok_s, 2),
         "mfu": _mfu(tok_s, flops_per_tok, platform), "platform": platform,
         "flops_per_sample": flops_per_tok,
-        **_step_stats(dt, launches, iters),
+        **_step_stats(dt, launches, syncs, iters),
     }
     _emit_jsonl(row)
     return tok_s, row
@@ -553,7 +559,7 @@ def bench_wide_deep(platform, dtype):
         net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
         {"learning_rate": 1e-3})
 
-    dt, launches = _timed_steps(step, x, y, iters, warmup)
+    dt, launches, syncs = _timed_steps(step, x, y, iters, warmup)
     samp_s = batch * iters / dt
     flops = step.flops_per_step(x, y)
     if flops:
@@ -573,7 +579,7 @@ def bench_wide_deep(platform, dtype):
         "mfu": _mfu(samp_s, flops, platform), "platform": platform,
         "flops_per_sample": flops,
         "embedding_bytes_per_sec": round(samp_s * emb_bytes_per_sample),
-        **_step_stats(dt, launches, iters),
+        **_step_stats(dt, launches, syncs, iters),
     }
     _emit_jsonl(row)
     return samp_s, row
@@ -651,12 +657,89 @@ def bench_input_pipeline(platform, dtype):
     return img_s, row
 
 
+def bench_async_ab(platform, dtype):
+    """Async dispatch A/B (engine.py): the SAME fused Gluon step with the
+    non-finite guard compiled in, run with the in-flight window at K=1
+    (synchronous: every step's flag read back immediately) and at K=4
+    (deferred: one mask read retires 4 steps' flags). The delta is pure
+    dispatch/round-trip overhead — visible on CPU, dominant on the axon
+    tunnel where every host read costs ~100ms+ RTT."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine, nd, profiler
+    from mxnet_tpu.gluon import Trainer, nn
+
+    del dtype  # f32: the A/B isolates dispatch, not math throughput
+    batch = int(os.environ.get("BENCH_AB_BATCH", "64"))
+    hidden = int(os.environ.get("BENCH_AB_HIDDEN", "256"))
+    iters = int(os.environ.get("BENCH_AB_ITERS", "40"))
+    warmup = int(os.environ.get("BENCH_AB_WARMUP", "3"))
+    window = int(os.environ.get("BENCH_AB_INFLIGHT", "4"))
+
+    prev_guard = os.environ.get("MXT_SKIP_NONFINITE")
+    os.environ["MXT_SKIP_NONFINITE"] = "1"
+    try:
+        def run(k):
+            mx.random.seed(0)
+            net = nn.Sequential(prefix="ab%d_" % k)
+            with net.name_scope():
+                net.add(nn.Dense(hidden, activation="relu"),
+                        nn.Dense(hidden, activation="relu"),
+                        nn.Dense(10))
+            net.initialize()
+            tr = Trainer(net.collect_params(), "adam",
+                         {"learning_rate": 1e-3})
+            step = tr.fuse_step(net,
+                                mx.gluon.loss.SoftmaxCrossEntropyLoss())
+            rng = np.random.RandomState(0)
+            x = nd.array(rng.uniform(-1, 1, (batch, 32)).astype(np.float32))
+            y = nd.array(rng.randint(0, 10, (batch,)).astype(np.float32))
+            with engine.bulk(k):
+                for _ in range(warmup):
+                    step(x, y).wait_to_read()
+                t0 = time.perf_counter()
+                h0 = profiler.host_sync_count()
+                for _ in range(iters):
+                    step(x, y)
+                nd.waitall()
+                dt = time.perf_counter() - t0
+                syncs = profiler.host_sync_count() - h0
+            return dt / iters * 1e3, syncs / iters
+
+        sync_ms, sync_sps = run(1)
+        async_ms, async_sps = run(window)
+    finally:
+        if prev_guard is None:
+            os.environ.pop("MXT_SKIP_NONFINITE", None)
+        else:
+            os.environ["MXT_SKIP_NONFINITE"] = prev_guard
+
+    speedup = sync_ms / async_ms if async_ms else 0.0
+    row = {
+        "config": "fused_step_async_ab", "chips": 1, "batch_size": batch,
+        "dtype": "float32", "platform": platform,
+        "inflight_window": window,
+        "sync_step_time_ms": round(sync_ms, 3),
+        "async_step_time_ms": round(async_ms, 3),
+        "host_syncs_per_step_sync": round(sync_sps, 3),
+        "host_syncs_per_step_async": round(async_sps, 3),
+        "images_or_tokens_per_sec_per_chip": round(
+            batch * 1e3 / async_ms, 2),
+        "mfu": None, "flops_per_sample": None,
+        "async_speedup": round(speedup, 3),
+    }
+    _emit_jsonl(row)
+    return speedup, row
+
+
 def main():
     platform, note = _init_backend()
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     configs = os.environ.get(
         "BENCH_CONFIGS",
-        "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline").split(",")
+        "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline,async_ab"
+    ).split(",")
 
     # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
     metric_info = {
@@ -672,13 +755,15 @@ def main():
                   bench_lenet_mnist),
         "pipeline": ("input_pipeline_throughput", "images/sec/host",
                      bench_input_pipeline),
+        "async_ab": ("async_dispatch_speedup", "x (sync/async step time)",
+                     bench_async_ab),
     }
     headline = None
     errors = []
     skipped = []
     best_resnet = None
     for name in ("resnet50", "bert", "lstm_ptb", "wide_deep", "lenet",
-                 "pipeline"):
+                 "pipeline", "async_ab"):
         if name not in configs:
             continue
         cost = float(os.environ.get("BENCH_COST_%s" % name.upper(),

@@ -21,6 +21,20 @@ ineligible configs fall back to the loop above automatically)::
     loss, out = step(data, label, batch_size)
 
 Pass --no-fused-step (or set MXT_FUSED_STEP=0) to run the eager loop.
+
+Async dispatch (engine.py): the fused step never blocks on a host read
+— the engine keeps up to K steps in flight and defers flag/bookkeeping
+reads (bit-exact numerics; metrics accumulate on device)::
+
+    with mx.engine.bulk(8):          # or MXT_MAX_INFLIGHT=8
+        for data, label in batches:
+            loss, out = step(data, label, batch_size)
+            metric.update([label], [out])   # device-side running sums
+    mx.nd.waitall()                  # barrier: land deferred counters
+    print(metric.get())              # the ONE host read
+
+Pass --inflight K to set the window here (0 keeps the MXT_MAX_INFLIGHT
+default of 2; 1 forces synchronous per-step reads).
 """
 import argparse
 
@@ -86,6 +100,11 @@ def main():
                    action="store_false", default=True,
                    help="use the eager record/backward/step loop instead "
                         "of the one-launch fused train step")
+    p.add_argument("--inflight", type=int, default=0,
+                   help="async dispatch window depth K (engine.bulk): the "
+                        "host runs up to K fused steps ahead, deferring "
+                        "host reads; 0 = MXT_MAX_INFLIGHT default, "
+                        "1 = synchronous")
     args = p.parse_args()
 
     mx.random.seed(42)
@@ -109,23 +128,33 @@ def main():
     step = trainer.fuse_step(net, loss_fn, return_outputs=True) \
         if args.fused_step else None
 
-    for epoch in range(args.epochs):
-        train_iter.reset()
-        metric.reset()
-        for i, batch in enumerate(train_iter):
-            data, label = batch.data[0], batch.label[0]
-            if step is not None:
-                loss, out = step(data, label, args.batch_size)
-            else:
-                with autograd.record():
-                    out = net(data)
-                    loss = loss_fn(out, label)
-                loss.backward()
-                trainer.step(args.batch_size)
-            metric.update([label], [out])
-            speedo(mx.model.BatchEndParam(epoch=epoch, nbatch=i,
-                                          eval_metric=metric, locals=None))
-        print("epoch %d: train acc %.4f" % (epoch, metric.get()[1]))
+    import contextlib
+
+    # async dispatch: inside engine.bulk(K) the fused step defers its
+    # host reads and Accuracy accumulates on device — the loop below
+    # performs NO per-batch device->host round-trip
+    window = mx.engine.bulk(args.inflight) if args.inflight \
+        else contextlib.nullcontext()
+    with window:
+        for epoch in range(args.epochs):
+            train_iter.reset()
+            metric.reset()
+            for i, batch in enumerate(train_iter):
+                data, label = batch.data[0], batch.label[0]
+                if step is not None:
+                    loss, out = step(data, label, args.batch_size)
+                else:
+                    with autograd.record():
+                        out = net(data)
+                        loss = loss_fn(out, label)
+                    loss.backward()
+                    trainer.step(args.batch_size)
+                metric.update([label], [out])
+                speedo(mx.model.BatchEndParam(epoch=epoch, nbatch=i,
+                                              eval_metric=metric,
+                                              locals=None))
+            nd.waitall()  # barrier: land deferred flags/counters
+            print("epoch %d: train acc %.4f" % (epoch, metric.get()[1]))
 
 
 if __name__ == "__main__":

@@ -36,15 +36,15 @@ __all__ = [
 ]
 
 
-_launches = None  # profiler._launch_count, bound on first backward
+_launches = None  # profiler.record_launch, bound on first backward
 
 
 def _count_launch():
     global _launches
     if _launches is None:
         from . import profiler
-        _launches = profiler._launch_count
-    _launches[0] += 1
+        _launches = profiler.record_launch
+    _launches()
 
 
 class _AGState(threading.local):

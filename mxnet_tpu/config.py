@@ -99,6 +99,15 @@ _declare("MXT_BN_PALLAS", bool, False,
          "read of (x, dy). Default off until chip-measured vs the XLA "
          "custom-VJP path (the A/B is staged in the recovery runbook).")
 
+_declare("MXT_MAX_INFLIGHT", int, 2,
+         "Depth of the async dispatch window (engine.py): the host may "
+         "run up to K fused steps ahead of the device before a deferred "
+         "host read (non-finite flag, step token) retires the oldest "
+         "in-flight step. 1 = synchronous (one host read per step, the "
+         "pre-async behavior); capped at 15 (the flag-mask width). "
+         "engine.bulk/set_bulk_size override it per scope — the "
+         "ThreadedEngine bulking knob made real.")
+
 _declare("MXT_SKIP_NONFINITE", bool, False,
          "Skip the optimizer update (weights, optimizer state, step "
          "counter all untouched) whenever any gradient is non-finite. "

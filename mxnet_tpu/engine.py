@@ -1,33 +1,218 @@
-"""Engine controls (ref: python/mxnet/engine.py — bulk/set_bulk_size).
+"""Async dispatch engine — ThreadedEngine semantics over XLA
+(ref: src/engine/threaded_engine.h + python/mxnet/engine.py).
 
-The reference's engine bulks consecutive async ops into one scheduling
-unit to cut per-op dispatch cost. Here XLA compiles whole programs and
-fuses internally, so bulking is structural, not a runtime switch —
-these shims keep the API importable and record the requested size."""
+The reference's ThreadedEngine lets the *host* run ahead of the device:
+ops enqueue into a dependency queue, reads are the only sync points, and
+``MXNET_ENGINE_BULK_SIZE`` bounds how much work is in flight. XLA's async
+dispatch covers the device half of that, but until now every fused train
+step still synchronized per step — the non-finite guard flag was read
+back immediately, so each ~3.4 ms launch (PERF.md §1.2) paid a full
+host↔device round-trip and the host could never pipeline.
+
+This module is the missing host half:
+
+- :class:`StepStream` is the per-call-site dependency queue: every
+  dispatched fused step pushes a token; host-consumed scalars (the guard
+  flag mask, a throttle read of the loss) ride tokens as deferred
+  :class:`~mxnet_tpu.ndarray.pending.PendingValue` handles and are only
+  materialized when the token *retires* — once the in-flight window is
+  full, or at an explicit barrier.
+- the window depth K comes from ``MXT_MAX_INFLIGHT`` (default 2), and
+  :func:`bulk`/:func:`set_bulk_size` are now the REAL knob instead of
+  no-op shims: ``with engine.bulk(1):`` forces synchronous per-step
+  reads, ``engine.bulk(8)`` lets 8 steps pipeline. The window also
+  bounds backpressure: a retirement blocks until its step finished, so
+  the un-synced dispatch queue (and the HBM working set behind the
+  donated buffers) can never grow past ~2K steps.
+- guard flags travel as a device-carried bitmask (one uint32 riding the
+  fused program), so ONE host read retires up to K steps' worth of
+  flags: host_syncs/step <= 1/K instead of 1.
+- :func:`wait_all` drains every live stream — ``mx.nd.waitall()`` routes
+  through it, making it the barrier tests and chaos_matrix.sh rely on,
+  exactly like the reference's ``Engine::WaitForAll``.
+
+Deferred-read callbacks retire on whichever thread triggers the read, so
+everything here and the profiler counters it bumps are lock-guarded.
+"""
 from __future__ import annotations
 
 import contextlib
+import threading
+import weakref
 
-__all__ = ["bulk", "set_bulk_size"]
+__all__ = ["bulk", "set_bulk_size", "max_inflight", "StepStream",
+           "wait_all", "inflight_depth"]
 
-_BULK_SIZE = 15  # reference default (MXNET_ENGINE_BULK_SIZE)
+# flag bits a single snapshot read may cover: the mask is a uint32 riding
+# the fused program, and with snapshots every K pushes plus one token
+# still in the window, up to 2K bits can be pending at a read -> K <= 15
+_MASK_BITS = 15
+
+_lock = threading.RLock()
+_streams = weakref.WeakSet()  # every live StepStream, for wait_all()
+_BULK_SIZE = None  # set_bulk_size override; None -> MXT_MAX_INFLIGHT
+
+
+def _config():
+    from . import config
+
+    return config
+
+
+def max_inflight():
+    """Effective dispatch-window depth K: the ``set_bulk_size`` override
+    when one is active, else ``MXT_MAX_INFLIGHT``; clamped to [1, 15]."""
+    size = _BULK_SIZE
+    if size is None:
+        size = _config().get("MXT_MAX_INFLIGHT")
+    return max(1, min(int(size), _MASK_BITS))
 
 
 def set_bulk_size(size):
-    """Returns the previous size (ref: engine.py — set_bulk_size).
-    No-op on execution: under jit every traced program is already one
-    'bulk'."""
+    """Set the in-flight step window depth; returns the previous
+    effective depth (ref: engine.py — set_bulk_size). Unlike the earlier
+    shim this is load-bearing: fused steps defer their host reads until
+    ``size`` steps are in flight."""
     global _BULK_SIZE
-    prev, _BULK_SIZE = _BULK_SIZE, int(size)
+    with _lock:
+        prev = max_inflight()
+        _BULK_SIZE = int(size)
     return prev
 
 
 @contextlib.contextmanager
 def bulk(size):
     """with-scope analog of the reference's engine bulking
-    (ref: engine.py — bulk)."""
+    (ref: engine.py — bulk): ``with engine.bulk(1):`` is the synchronous
+    A/B baseline, larger sizes deepen the dispatch pipeline."""
     prev = set_bulk_size(size)
     try:
         yield
     finally:
         set_bulk_size(prev)
+
+
+def inflight_depth():
+    """Total dispatched-but-unobserved steps across all live streams
+    (also published as the ``dispatch_depth`` profiler gauge)."""
+    with _lock:
+        return sum(s.pending for s in _streams)
+
+
+def _update_depth_gauge():
+    from . import profiler
+
+    profiler.set_gauge("dispatch_depth", inflight_depth())
+
+
+class _Token:
+    """One retirement point in a stream: a deferred host read covering
+    every step dispatched since the previous token."""
+
+    __slots__ = ("pv", "has_flags", "upto")
+
+    def __init__(self, pv, has_flags, upto):
+        self.pv = pv
+        self.has_flags = has_flags
+        self.upto = upto
+
+
+class StepStream:
+    """The dependency queue for ONE dispatch site (a CachedTrainStep, a
+    guarded _FusedUpdate): ``push()`` records a dispatched launch, every
+    K-th push becomes a snapshot token carrying a deferred read, and
+    tokens retire oldest-first as the window slides. ``on_flags`` (if
+    given) receives one ``finite: bool`` per retired step, in dispatch
+    order — deferred bookkeeping (update counts, loss-scale, skipped-step
+    counter) lives in that callback."""
+
+    def __init__(self, name="step", on_flags=None):
+        self.name = name
+        self._on_flags = on_flags
+        self._dispatched = 0
+        self._consumed = 0
+        self._last_snap = 0
+        self._window = []  # snapshot tokens not yet retired
+        self._latest = None  # (sync_value, flags) of the newest push
+        self._retire_lock = threading.RLock()
+        with _lock:
+            _streams.add(self)
+
+    @property
+    def pending(self):
+        """Steps dispatched but not yet observed on host."""
+        return self._dispatched - self._consumed
+
+    def push(self, sync_value, flags=None):
+        """Record one dispatched fused step.
+
+        ``sync_value``: any device output of the step (used for the
+        throttle read when there are no flags). ``flags``: the step's
+        output guard bitmask (newest bit = this step), read deferred.
+        """
+        from .ndarray.pending import PendingValue
+
+        retire = []
+        with _lock:
+            self._dispatched += 1
+            self._latest = (sync_value, flags)
+            k = max_inflight()
+            if self._dispatched - self._last_snap >= k:
+                src = flags if flags is not None else sync_value
+                tok = _Token(PendingValue(src), flags is not None,
+                             self._dispatched)
+                self._last_snap = self._dispatched
+                self._window.append(tok)
+                if k == 1:
+                    retire.append(self._window.pop())
+                else:
+                    while len(self._window) > 1:
+                        retire.append(self._window.pop(0))
+        if retire:
+            with self._retire_lock:
+                for tok in retire:
+                    self._retire(tok)
+        _update_depth_gauge()
+
+    def _retire(self, tok):
+        """Materialize one token's deferred read and catch host-side
+        bookkeeping up to it. Serialized per stream by _retire_lock."""
+        n = tok.upto - self._consumed
+        if n <= 0:
+            return
+        value = tok.pv.get()  # blocks until the covered steps finished
+        if tok.has_flags and self._on_flags is not None:
+            mask = int(value)
+            for k in range(n - 1, -1, -1):  # oldest step first
+                self._on_flags((mask >> k) & 1 == 0)
+        self._consumed = tok.upto
+
+    def flush(self):
+        """Drain: retire every queued token, then synthesize one for any
+        steps dispatched since the last snapshot, so ``pending`` is 0 and
+        all deferred bookkeeping has landed."""
+        from .ndarray.pending import PendingValue
+
+        with self._retire_lock:
+            with _lock:
+                tokens, self._window = self._window, []
+                latest = self._latest
+                upto = self._dispatched
+                self._last_snap = upto
+            for tok in tokens:
+                self._retire(tok)
+            if self._consumed < upto and latest is not None:
+                sync_value, flags = latest
+                src = flags if flags is not None else sync_value
+                self._retire(_Token(PendingValue(src), flags is not None,
+                                    upto))
+        _update_depth_gauge()
+
+
+def wait_all():
+    """Drain every live stream's in-flight window (the host half of
+    ``Engine::WaitForAll``; ``mx.nd.waitall()`` calls this first)."""
+    with _lock:
+        streams = list(_streams)
+    for s in streams:
+        s.flush()

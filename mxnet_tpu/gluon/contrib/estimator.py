@@ -12,6 +12,7 @@ import logging
 import time
 
 from ... import autograd
+from ... import engine as _engine
 from ...base import MXNetError
 from ... import metric as metric_mod
 from ..trainer import Trainer
@@ -334,6 +335,11 @@ class Estimator:
                     fire("batch_end")
                     if batches is not None and self.batch_idx + 1 >= batches:
                         break
+                # drain the async dispatch window so epoch-end handlers
+                # (checkpointing, logging, early stop) observe caught-up
+                # counters and final weights — the per-batch loop itself
+                # never forces a host read (metrics accumulate on device)
+                _engine.wait_all()
                 if val_data is not None:
                     self.evaluate(val_data)
                 epoch_trained = True
@@ -344,5 +350,6 @@ class Estimator:
                 self.epoch += 1
             # else (raised mid-epoch): resume repeats the cut epoch
             logging.getLogger("estimator").info("early stop: %s", e)
+        _engine.wait_all()
         fire("train_end")
         return self

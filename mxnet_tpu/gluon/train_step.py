@@ -38,9 +38,17 @@ eager record/backward/step loop — same numerics, more launches. Gate:
 
 With ``MXT_SKIP_NONFINITE=1`` the resilience non-finite guard compiles
 INTO the program (resilience.py): a ``lax.cond`` makes the whole
-weight/state/aux update the identity when any gradient is non-finite,
-the step counter stays put, and the overflow flag returns as one extra
-scalar output — one host read, still exactly one launch per step.
+weight/state/aux update the identity when any gradient is non-finite and
+the step counter stays put. The flag is NOT read back per step: the step
+count rides the program as a donated device scalar and the last 31 flags
+as a device bitmask, so the host dispatches up to ``MXT_MAX_INFLIGHT``
+steps ahead (engine.StepStream) and ONE deferred mask read retires a
+whole window's bookkeeping — update counts, ``LossScaler.update_scale``,
+the ``skipped_nonfinite_steps`` counter — without ever touching the
+weights path (the skip is on-device, so numerics are bit-exact at any
+window depth). An ``lr_scheduler`` makes the learning rate depend on the
+data-dependent step count, so guard + scheduler forces the window to 1
+(the pre-async per-step read).
 """
 from __future__ import annotations
 
@@ -68,7 +76,7 @@ def _config():
 
 def _count_launch():
     from .. import profiler
-    profiler._launch_count[0] += 1
+    profiler.record_launch()
 
 
 class CachedTrainStep:
@@ -118,6 +126,10 @@ class CachedTrainStep:
         self._indices = None
         self._guard = False
         self._built_opt = None
+        self._stream = None      # engine.StepStream (async dispatch window)
+        self._t_dev = None       # device-carried step count (guard mode)
+        self._mask_dev = None    # device-carried flag bitmask (guard mode)
+        self._hyper_cache = None  # (lr, wd, float(lr), float(wd))
 
     # -- introspection ---------------------------------------------------
     @property
@@ -243,53 +255,113 @@ class CachedTrainStep:
             # uses; rescale_grad (1/batch) is applied inside the update
             return loss.data.sum(), (loss.data, new_aux, out_datas)
 
-        def step(train_vals, states, aux_vals, xv, yv, base_key, t, lr,
-                 wd, rescale):
-            # per-step key derived on device: no host-side split launch
-            key = jax.random.fold_in(base_key, t)
-            (_, (loss_vec, new_aux, outs)), grads = jax.value_and_grad(
-                pure_loss, has_aux=True)(train_vals, aux_vals, xv, yv, key)
-
-            def _apply(_):
+        if not guard:
+            def step(train_vals, states, aux_vals, xv, yv, base_key, t, lr,
+                     wd, rescale):
+                # per-step key derived on device: no host-side split launch
+                key = jax.random.fold_in(base_key, t)
+                (_, (loss_vec, new_aux, outs)), grads = jax.value_and_grad(
+                    pure_loss, has_aux=True)(train_vals, aux_vals, xv, yv,
+                                             key)
                 new_train, new_states = [], []
                 for f, w, g, s in zip(upds, train_vals, grads, states):
                     w2, s2 = f(w, g, s, t, lr, wd, rescale)
                     new_train.append(w2)
                     new_states.append(s2)
-                return tuple(new_train), tuple(new_states), new_aux
-
-            if not guard:
-                new_train, new_states, kept_aux = _apply(None)
-                return (loss_vec, new_train, new_states, kept_aux, outs)
-
+                return (loss_vec, tuple(new_train), tuple(new_states),
+                        new_aux, outs)
+        else:
             # non-finite step guard (resilience.py): the all-finite check
             # and the identity-on-overflow update are part of THIS program
-            # — zero extra launches; the flag is one extra (scalar) output
-            # and aux (BatchNorm stats) also roll back so a NaN forward
-            # never pollutes the running statistics
-            import jax.numpy as jnp
+            # — zero extra launches. The step count t is CARRIED on device
+            # (advances only when the step applied) and the flag lands in
+            # a carried bitmask (newest step = bit 0) instead of being
+            # read back per step: the engine's in-flight window reads the
+            # mask once per K steps and replays the bits into host
+            # bookkeeping. aux (BatchNorm stats) also roll back so a NaN
+            # forward never pollutes the running statistics.
+            def step(train_vals, states, aux_vals, xv, yv, base_key, t,
+                     mask, lr, wd, rescale):
+                import jax.numpy as jnp
 
-            finite = jnp.bool_(True)
-            for g in grads:
-                finite = jnp.logical_and(finite, jnp.isfinite(g).all())
+                t_upd = t + 1  # the count this update applies at
+                key = jax.random.fold_in(base_key, t_upd)
+                (_, (loss_vec, new_aux, outs)), grads = jax.value_and_grad(
+                    pure_loss, has_aux=True)(train_vals, aux_vals, xv, yv,
+                                             key)
 
-            def _skip(_):
-                return tuple(train_vals), tuple(states), tuple(aux_vals)
+                def _apply(_):
+                    new_train, new_states = [], []
+                    for f, w, g, s in zip(upds, train_vals, grads, states):
+                        w2, s2 = f(w, g, s, t_upd, lr, wd, rescale)
+                        new_train.append(w2)
+                        new_states.append(s2)
+                    return tuple(new_train), tuple(new_states), new_aux
 
-            new_train, new_states, kept_aux = jax.lax.cond(
-                finite, _apply, _skip, None)
-            return (loss_vec, new_train, new_states, kept_aux, outs,
-                    finite)
+                def _skip(_):
+                    return (tuple(train_vals), tuple(states),
+                            tuple(aux_vals))
+
+                finite = jnp.bool_(True)
+                for g in grads:
+                    finite = jnp.logical_and(finite, jnp.isfinite(g).all())
+                new_train, new_states, kept_aux = jax.lax.cond(
+                    finite, _apply, _skip, None)
+                t_new = t + jnp.where(finite, 1, 0)
+                mask_new = (mask << 1) | jnp.where(finite, 0, 1)
+                return (loss_vec, new_train, new_states, kept_aux, outs,
+                        t_new, mask_new)
 
         # weights + optimizer state + aux donated: buffers are reused
         # across steps (the static_alloc analog) and the Parameter
         # wrappers rebind to the outputs
         self._jit = jax.jit(step, donate_argnums=(0, 1, 2))
+        from .. import engine
+        self._stream = engine.StepStream(
+            name="fused_step",
+            on_flags=self._consume_flag if guard else None)
 
     # -- per-step host path ------------------------------------------------
+    def _consume_flag(self, finite):
+        """Land ONE step's deferred guard flag into host bookkeeping —
+        called from the engine window's retirement (in dispatch order),
+        possibly several steps after the launch."""
+        o = self._built_opt
+        if finite:
+            for i in self._indices:
+                o._update_count(i)
+        else:
+            from .. import resilience
+            resilience.record_skipped_step()
+        scaler = getattr(self._trainer, "_amp_scaler", None)
+        if scaler is not None:
+            # dynamic loss-scale backoff driven from the same flag,
+            # consumed from the trailing window
+            scaler.update_scale(not finite)
+
+    def _reset_async(self):
+        """Land every deferred flag and drop the device-carried step
+        count; the next fused step re-derives it from host counts. Called
+        before any path that advances host counts outside the stream."""
+        if self._stream is not None and self._stream.pending:
+            self._stream.flush()
+        self._t_dev = None
+        self._mask_dev = None
+
+    def _host_hypers(self, o):
+        """(lr, wd) as host floats, cached between steps — with no
+        scheduler they only change when the user assigns them, so the
+        per-step float() conversions stay off the dispatch hot path."""
+        cache = self._hyper_cache
+        if cache is None or cache[0] != o.lr or cache[1] != o.wd:
+            cache = (o.lr, o.wd, float(o.lr), float(o.wd))  # sync-ok: host scalars, cached
+            self._hyper_cache = cache
+        return cache[2], cache[3]
+
     def _fused_step(self, x, y, batch_size):
-        """One fused launch. Returns None if host-side invariants don't
-        hold this step (caller falls back to the eager loop)."""
+        """One fused launch, dispatched asynchronously. Returns None if
+        host-side invariants don't hold this step (caller falls back to
+        the eager loop)."""
         tr = self._trainer
         o = tr._optimizer
         updater = tr._updaters[0]
@@ -303,32 +375,46 @@ class CachedTrainStep:
         counts = {o._index_update_count.get(i, o.begin_num_update)
                   for i in self._indices}
         if len(counts) > 1:
+            self._reset_async()
             return None
         rescale = tr._scale / batch_size
         tr._check_and_rescale_grad(rescale)
+        sched = o.lr_scheduler
         if self._guard:
-            # speculative bookkeeping: the step count only advances after
-            # the ONE host read of the in-program finite flag, so a
-            # skipped step leaves every counter untouched. t/num_update
-            # are computed as _update_count WOULD leave them (counts are
-            # even here — the fused precondition above).
-            base = o._index_update_count.get(
-                self._indices[0], o.begin_num_update) \
-                if self._indices else 0
-            t = base + 1 if self._indices else 1
-            num_update = max(o.num_update, t)
-            lr = o.lr_scheduler(num_update) if o.lr_scheduler is not None \
-                else o.lr
+            if sched is not None:
+                # scheduler lr depends on the data-dependent step count:
+                # observe the flag per step (window forced to 1). t enters
+                # as the last APPLIED count; the program bumps it itself.
+                base = o._index_update_count.get(
+                    self._indices[0], o.begin_num_update) \
+                    if self._indices else 0
+                num_update = max(o.num_update, base + 1)
+                lr = float(sched(num_update))  # sync-ok: host scheduler scalar
+                wd = float(o.wd)  # sync-ok: host scalar
+                t_in, mask_in = base, 0
+            else:
+                lr, wd = self._host_hypers(o)
+                if self._t_dev is None:
+                    import jax.numpy as jnp
+
+                    base = o._index_update_count.get(
+                        self._indices[0], o.begin_num_update) \
+                        if self._indices else 0
+                    self._t_dev = jnp.int32(base)
+                    self._mask_dev = jnp.uint32(0)
+                t_in, mask_in = self._t_dev, self._mask_dev
         else:
             # host bookkeeping mirrors the eager order (_update_count then
             # _get_lr): the scheduler sees the post-bump num_update
             for i in self._indices:
                 o._update_count(i)
-            t = o._index_update_count[self._indices[0]] \
+            t_in = o._index_update_count[self._indices[0]] \
                 if self._indices else 1
-            lr = o.lr_scheduler(o.num_update) \
-                if o.lr_scheduler is not None else o.lr
-        wd = o.wd
+            if sched is not None:
+                lr = float(sched(o.num_update))  # sync-ok: host scheduler scalar
+                wd = float(o.wd)  # sync-ok: host scalar
+            else:
+                lr, wd = self._host_hypers(o)
         ws = tuple(self._all_params[n].data().data
                    for n in self._train_names)
         ss = tuple(tuple(l.data
@@ -340,14 +426,16 @@ class CachedTrainStep:
             # drawn lazily so mx.random.seed() between construction and
             # the first step still takes effect
             self._base_key = _random.new_key()
-        result = self._jit(
-            ws, ss, aux, x.data, y.data, self._base_key, t, float(lr),
-            float(wd), float(rescale))
-        _count_launch()
         if self._guard:
-            loss_vec, new_w, new_s, new_aux, outs, finite = result
+            (loss_vec, new_w, new_s, new_aux, outs, t_new,
+             mask_new) = self._jit(
+                ws, ss, aux, x.data, y.data, self._base_key, t_in,
+                mask_in, lr, wd, rescale)
         else:
-            loss_vec, new_w, new_s, new_aux, outs = result
+            loss_vec, new_w, new_s, new_aux, outs = self._jit(
+                ws, ss, aux, x.data, y.data, self._base_key, t_in, lr,
+                wd, rescale)
+        _count_launch()
         # rebind unconditionally: donation consumed the input buffers, and
         # on a skipped step the outputs ARE the (identity) old values
         for n, i, w2, s2 in zip(self._train_names, self._indices, new_w,
@@ -358,19 +446,19 @@ class CachedTrainStep:
         for n, v in zip(self._aux_names, new_aux):
             self._all_params[n].data()._set_data(v)
         if self._guard:
-            import numpy as _np
+            if sched is not None:
+                from ..ndarray.pending import PendingValue
 
-            ok = bool(_np.asarray(finite))  # the ONE host read
-            if ok:
-                for i in self._indices:
-                    o._update_count(i)
+                ok = (int(PendingValue(mask_new).get()) & 1) == 0
+                self._consume_flag(ok)
             else:
-                from .. import resilience
-                resilience.record_skipped_step()
-            scaler = getattr(tr, "_amp_scaler", None)
-            if scaler is not None:
-                # dynamic loss-scale backoff driven from the same flag
-                scaler.update_scale(not ok)
+                # deferred: the flag lands when the engine window retires
+                # this step's token (<= 1 host read per K steps)
+                self._t_dev, self._mask_dev = t_new, mask_new
+                self._stream.push(loss_vec, flags=mask_new)
+        else:
+            # no host-consumed outputs; the token still throttles dispatch
+            self._stream.push(loss_vec)
         loss = NDArray(loss_vec)
         if self._return_outputs:
             out_nds = [NDArray(o_) for o_ in outs]
@@ -407,8 +495,10 @@ class CachedTrainStep:
             # trainer.load_states swapped the optimizer object; the jit
             # closed over the old hyper-params — rebuild against the live
             # one so a resumed run stays fused with the right settings
+            self._reset_async()
             self._jit = None
             self._fallback_reason = None
+            self._hyper_cache = None
         if self._jit is None and self._fallback_reason is None:
             self._fallback_reason = self.eligible(tr, self._net)
             if self._fallback_reason is None:
@@ -442,6 +532,7 @@ class FusedApply:
     def __init__(self, optimizer, indices):
         self._opt = optimizer
         self._indices = list(indices)
+        self._hyper_cache = None  # (lr, wd, rescale) -> host floats
         upds = [_FusedUpdate._param_update(optimizer, i)
                 for i in self._indices]
 
@@ -480,16 +571,28 @@ class FusedApply:
         for i in self._indices:
             o._update_count(i)
         t = o._index_update_count[self._indices[0]] if self._indices else 1
-        lr = o.lr_scheduler(o.num_update) if o.lr_scheduler is not None \
-            else o.lr
-        wd = o.wd
+        if o.lr_scheduler is not None:
+            lr = float(o.lr_scheduler(o.num_update))  # sync-ok: host scheduler scalar
+            wd = float(o.wd)  # sync-ok: host scalar
+            rs = float(o.rescale_grad)  # sync-ok: host scalar
+        else:
+            # constant scheduler: hoist the per-step float() conversions
+            # off the dispatch hot path (cached until the user changes
+            # the hyper-params)
+            cache = self._hyper_cache
+            if cache is None or cache[0] != o.lr or cache[1] != o.wd or \
+                    cache[2] != o.rescale_grad:
+                cache = (o.lr, o.wd, o.rescale_grad,  # sync-ok: host scalars, cached
+                         float(o.lr), float(o.wd),  # sync-ok: host scalars, cached
+                         float(o.rescale_grad))  # sync-ok: host scalars, cached
+                self._hyper_cache = cache
+            lr, wd, rs = cache[3], cache[4], cache[5]
         ws = tuple(w.data for w in weights)
         gs = tuple(g.data for g in grads)
         ss = tuple(tuple(l.data
                          for l in _FusedUpdate._leaves(updater.states[i]))
                    for i in self._indices)
-        new_w, new_s = self._jit(ws, gs, ss, t, float(lr), float(wd),
-                                 float(o.rescale_grad))
+        new_w, new_s = self._jit(ws, gs, ss, t, lr, wd, rs)
         _count_launch()
         for w, i, w2, s2 in zip(weights, self._indices, new_w, new_s):
             w._set_data(w2)

@@ -82,6 +82,11 @@ class _FusedUpdate:
         self._indices = [i for i, p in enumerate(trainer._params)
                          if p.grad_req != "null"]
         self._upds = [self._param_update(o, i) for i in self._indices]
+        self._hyper_cache = None  # host floats, cached between steps
+        self._jit_guarded = None  # built on first guarded() call
+        self._stream = None       # engine.StepStream for deferred flags
+        self._t_dev = None        # device-carried step count (guard mode)
+        self._mask_dev = None
         upds = self._upds
 
         def step(ws, gs, ss, t, lr, wd, rescale):
@@ -198,13 +203,11 @@ class _FusedUpdate:
             return state
         return (state,)
 
-    def __call__(self, rescale):
-        """Run one fused update. Returns False (caller should fall back to
-        the eager path) if host-side invariants don't hold this step."""
-        tr = self._trainer
+    def _prepare(self, updater):
+        """Shared per-step invariants: grads/states present, counts even.
+        Returns False if the caller must fall back to the eager path."""
         o = self._opt
-        updater = tr._updaters[0]
-        params = tr._params
+        params = self._trainer._params
         for i in self._indices:
             p = params[i]
             if p._data is None or getattr(p._data, "_grad", None) is None:
@@ -217,7 +220,29 @@ class _FusedUpdate:
         # prior eager/kvstore path left counts uneven, stay eager
         counts = {o._index_update_count.get(i, o.begin_num_update)
                   for i in self._indices}
-        if len(counts) != 1:
+        return len(counts) == 1
+
+    def _host_hypers(self, o):
+        """(lr, wd) host floats with the constant-scheduler conversions
+        cached between steps (off the dispatch hot path)."""
+        cache = self._hyper_cache
+        if cache is None or cache[0] != o.lr or cache[1] != o.wd:
+            cache = (o.lr, o.wd, float(o.lr), float(o.wd))  # sync-ok: host scalars, cached
+            self._hyper_cache = cache
+        return cache[2], cache[3]
+
+    def __call__(self, rescale):
+        """Run one fused update. Returns False (caller should fall back to
+        the eager path) if host-side invariants don't hold this step."""
+        tr = self._trainer
+        o = self._opt
+        updater = tr._updaters[0]
+        params = tr._params
+        if self._t_dev is not None:
+            # a guarded (deferred-flag) run preceded this unguarded step:
+            # land its bookkeeping before advancing counts on host
+            self.flush_guarded()
+        if not self._prepare(updater):
             return False
 
         # host-side bookkeeping first, mirroring eager order (_update_count
@@ -225,22 +250,125 @@ class _FusedUpdate:
         for i in self._indices:
             o._update_count(i)
         t = o._index_update_count[self._indices[0]] if self._indices else 1
-        lr = o.lr_scheduler(o.num_update) if o.lr_scheduler is not None \
-            else o.lr
-        wd = o.wd
+        if o.lr_scheduler is not None:
+            lr = float(o.lr_scheduler(o.num_update))  # sync-ok: host scheduler scalar
+            wd = float(o.wd)  # sync-ok: host scalar
+        else:
+            lr, wd = self._host_hypers(o)
 
         ws = tuple(params[i].data().data for i in self._indices)
         gs = tuple(params[i].grad().data for i in self._indices)
         ss = tuple(tuple(l.data for l in self._leaves(updater.states[i]))
                    for i in self._indices)
-        new_w, new_s = self._jit(ws, gs, ss, t, float(lr), float(wd),
-                                 float(rescale))
+        new_w, new_s = self._jit(ws, gs, ss, t, lr, wd, rescale)
         from .. import profiler
-        profiler._launch_count[0] += 1
+        profiler.record_launch()
         for i, w2, s2 in zip(self._indices, new_w, new_s):
             params[i].data()._set_data(w2)
             for leaf, v in zip(self._leaves(updater.states[i]), s2):
                 leaf._set_data(v)
+        return True
+
+    # -- deferred non-finite guard (async dispatch) ------------------------
+    def _build_guarded(self):
+        """The same fused update with the resilience guard compiled IN:
+        a lax.cond makes the whole update the identity when any gradient
+        is non-finite, the step count rides the program as a device
+        scalar, and the flag lands in a carried bitmask consumed by the
+        engine's in-flight window — no per-step host read."""
+        import jax.numpy as jnp
+
+        upds = self._upds
+
+        def step(ws, gs, ss, t, mask, lr, wd, rescale):
+            finite = jnp.bool_(True)
+            for g in gs:
+                finite = jnp.logical_and(finite, jnp.isfinite(g).all())
+            t_upd = t + 1
+
+            def _apply(_):
+                out_w, out_s = [], []
+                for f, w, g, s in zip(upds, ws, gs, ss):
+                    w2, s2 = f(w, g, s, t_upd, lr, wd, rescale)
+                    out_w.append(w2)
+                    out_s.append(s2)
+                return tuple(out_w), tuple(out_s)
+
+            def _skip(_):
+                return tuple(ws), tuple(ss)
+
+            new_w, new_s = jax.lax.cond(finite, _apply, _skip, None)
+            t_new = t + jnp.where(finite, 1, 0)
+            mask_new = (mask << 1) | jnp.where(finite, 0, 1)
+            return new_w, new_s, t_new, mask_new
+
+        self._jit_guarded = jax.jit(step, donate_argnums=(0, 2))
+        from .. import engine
+        self._stream = engine.StepStream(name="trainer_step",
+                                         on_flags=self._on_flag)
+
+    def _on_flag(self, finite):
+        """Deferred bookkeeping for one retired step, in dispatch order
+        (the loss-scale wrapper drives its own scaler — not here)."""
+        if finite:
+            for i in self._indices:
+                self._opt._update_count(i)
+        else:
+            from .. import resilience
+            resilience.record_skipped_step()
+
+    def flush_guarded(self):
+        """Land every deferred flag and drop the device step count (the
+        next guarded step re-derives it from host counts)."""
+        if self._stream is not None and self._stream.pending:
+            self._stream.flush()
+        self._t_dev = None
+        self._mask_dev = None
+
+    @property
+    def pending(self):
+        return self._stream.pending if self._stream is not None else 0
+
+    def guarded(self, rescale):
+        """One fused update with the in-program non-finite guard,
+        dispatched asynchronously. Returns False when this step can't run
+        guarded-fused (caller falls back to the synchronous check)."""
+        o = self._opt
+        if o.lr_scheduler is not None:
+            # scheduler lr depends on the data-dependent step count — the
+            # synchronous guard path keeps exact lr semantics
+            return False
+        tr = self._trainer
+        updater = tr._updaters[0]
+        if not self._prepare(updater):
+            self.flush_guarded()
+            return False
+        params = tr._params
+        if self._jit_guarded is None:
+            self._build_guarded()
+        if self._t_dev is None:
+            import jax.numpy as jnp
+
+            base = o._index_update_count.get(
+                self._indices[0], o.begin_num_update) if self._indices \
+                else 0
+            self._t_dev = jnp.int32(base)
+            self._mask_dev = jnp.uint32(0)
+        lr, wd = self._host_hypers(o)
+        ws = tuple(params[i].data().data for i in self._indices)
+        gs = tuple(params[i].grad().data for i in self._indices)
+        ss = tuple(tuple(l.data for l in self._leaves(updater.states[i]))
+                   for i in self._indices)
+        new_w, new_s, t_new, mask_new = self._jit_guarded(
+            ws, gs, ss, self._t_dev, self._mask_dev, lr, wd, rescale)
+        from .. import profiler
+        profiler.record_launch()
+        for i, w2, s2 in zip(self._indices, new_w, new_s):
+            params[i].data()._set_data(w2)
+            for leaf, v in zip(self._leaves(updater.states[i]), s2):
+                leaf._set_data(v)
+        self._t_dev, self._mask_dev = t_new, mask_new
+        self._stream.push(mask_new, flags=mask_new)
         return True
 
 
@@ -265,7 +393,7 @@ class Trainer:
             self._params.append(param)
         self._compression_params = compression_params
         optimizer_params = optimizer_params or {}
-        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))  # sync-ok: construction-time host scalar
         self._init_optimizer(optimizer, optimizer_params)
         self._kvstore_params = {
             "kvstore": kvstore, "update_on_kvstore": update_on_kvstore}
@@ -386,21 +514,29 @@ class Trainer:
         """allreduce + optimizer update, scaled by 1/batch_size
         (ref: trainer.py — step). With ``MXT_SKIP_NONFINITE=1`` a batch
         whose gradients contain NaN/Inf is skipped wholesale — weights,
-        optimizer state, and update counts untouched (resilience.py)."""
+        optimizer state, and update counts untouched (resilience.py). On
+        the fused path the guard compiles INTO the launch and its flag is
+        observed deferred through the engine's in-flight window, so no
+        per-step host read throttles dispatch; the eager path keeps the
+        synchronous check (the skip decision gates the update itself)."""
         rescale_grad = self._scale / batch_size
         self._check_and_rescale_grad(rescale_grad)
         if not self._kv_initialized:
             self._init_kvstore()
         if self._params_to_init:
             self._init_params()
-        from .. import resilience
-        if resilience.skip_nonfinite_enabled() and \
-                self._grads_overflowed():
-            resilience.record_skipped_step()
-            return
         if self._fused is None:
             self._fused = _FusedUpdate(self) if _FusedUpdate.eligible(self) \
                 else False
+        from .. import resilience
+        if resilience.skip_nonfinite_enabled():
+            if self._fused and self._fused.guarded(rescale_grad):
+                return  # guard + update in one launch, flag deferred
+            if self._fused:
+                self._fused.flush_guarded()
+            if self._grads_overflowed():
+                resilience.record_skipped_step()
+                return
         if self._fused and self._fused(rescale_grad):
             return  # one donated launch covered reduce (identity) + update
         self._allreduce_grads()
@@ -493,6 +629,8 @@ class Trainer:
         if self._optimizer is None:
             raise MXNetError(
                 "Trainer has no optimizer — cannot save states")
+        from .. import engine
+        engine.wait_all()  # land deferred update counts before serializing
         if not self._kv_initialized:
             self._init_kvstore()
         if self._params_to_init:
@@ -511,6 +649,8 @@ class Trainer:
                 fout.write(self._updaters[0].get_states(dump_optimizer=True))
 
     def load_states(self, fname):
+        from .. import engine
+        engine.wait_all()  # drain in-flight steps before swapping state
         if not self._kv_initialized:
             self._init_kvstore()
         if self._params_to_init:

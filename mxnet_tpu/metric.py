@@ -2,8 +2,16 @@
 
 Same registry + API: ``create(name)``, ``EvalMetric.update(labels, preds)``,
 ``get() -> (name, value)``, ``CompositeEvalMetric``, custom fn via
-``np()``/``CustomMetric``. Computation happens on host after a sync — the
-reference does the same (metric.update calls asnumpy).
+``np()``/``CustomMetric``.
+
+The reference's ``metric.update`` calls ``asnumpy()`` — a full
+device→host round-trip per batch that stalls the async dispatch engine
+(engine.py). The common metrics (Accuracy, Loss, MAE, MSE/RMSE) therefore
+accumulate ON DEVICE when fed NDArrays: the per-batch statistic stays a
+jax scalar added into a running device sum, and ``get()`` performs the
+ONE host read (through the deferred-handle protocol, ndarray/pending.py).
+numpy inputs keep the host path, and metrics without a device
+implementation fall back to it unchanged.
 """
 from __future__ import annotations
 
@@ -15,6 +23,7 @@ import numpy as _np
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
+from .ndarray.pending import PendingValue
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "F1", "MCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
@@ -50,8 +59,14 @@ def create(metric, *args, **kwargs):
 
 def _as_np(x):
     if isinstance(x, NDArray):
-        return x.asnumpy()
-    return _np.asarray(x)
+        return x.asnumpy()  # sync-ok: host-path metrics funnel (per batch)
+    return _np.asarray(x)  # sync-ok: numpy input, no device transfer
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
 
 
 def check_label_shapes(labels, preds, shape=False):
@@ -71,6 +86,10 @@ class EvalMetric:
         self.output_names = output_names
         self.label_names = label_names
         self._kwargs = kwargs
+        # device-side accumulator: running jax-scalar sum (instance counts
+        # are static and stay host-side); ONE host read at get()
+        self._dev_sum = None
+        self._dev_inst = 0
         self.reset()
 
     def __str__(self):
@@ -102,8 +121,28 @@ class EvalMetric:
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        self._dev_sum = None
+        self._dev_inst = 0
+
+    # -- device-side accumulation (async engine support) -----------------
+    def _accum_device(self, value, n):
+        """Add one batch's statistic without a host read: ``value`` is a
+        jax scalar, ``n`` the (static) instance count it covers."""
+        self._dev_sum = value if self._dev_sum is None \
+            else self._dev_sum + value
+        self._dev_inst += n
+
+    def _drain_device(self):
+        """Fold the device accumulator into the host totals — the ONE
+        deferred read, at get() time."""
+        if self._dev_sum is not None:
+            self.sum_metric += float(PendingValue(self._dev_sum))
+            self.num_inst += self._dev_inst
+            self._dev_sum = None
+            self._dev_inst = 0
 
     def get(self):
+        self._drain_device()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, self.sum_metric / self.num_inst)
@@ -179,6 +218,18 @@ class Accuracy(EvalMetric):
             preds = [preds]
         check_label_shapes(labels, preds)
         for label, pred_label in zip(labels, preds):
+            if isinstance(label, NDArray) and isinstance(pred_label,
+                                                         NDArray):
+                # device path: correct-count stays a jax scalar, no host
+                # read until get() (same int math as the host path)
+                jnp = _jnp()
+                ld, pd = label.data, pred_label.data
+                if pd.shape != ld.shape:
+                    pd = jnp.argmax(pd, axis=self.axis)
+                correct = (pd.astype(jnp.int32).ravel() ==
+                           ld.astype(jnp.int32).ravel()).sum()
+                self._accum_device(correct, int(_np.prod(ld.shape)) or 1)
+                continue
             pred_label = _as_np(pred_label)
             label = _as_np(label)
             if pred_label.shape != label.shape:
@@ -375,6 +426,14 @@ class MAE(EvalMetric):
             preds = [preds]
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
+            if isinstance(label, NDArray) and isinstance(pred, NDArray):
+                ld, pd = label.data, pred.data
+                if ld.ndim == 1:
+                    ld = ld.reshape(-1, 1)
+                if pd.ndim == 1:
+                    pd = pd.reshape(-1, 1)
+                self._accum_device(_jnp().abs(ld - pd).mean(), 1)
+                continue
             label = _as_np(label)
             pred = _as_np(pred)
             if len(label.shape) == 1:
@@ -397,6 +456,14 @@ class MSE(EvalMetric):
             preds = [preds]
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
+            if isinstance(label, NDArray) and isinstance(pred, NDArray):
+                ld, pd = label.data, pred.data
+                if ld.ndim == 1:
+                    ld = ld.reshape(-1, 1)
+                if pd.ndim == 1:
+                    pd = pd.reshape(-1, 1)
+                self._accum_device(((ld - pd) ** 2.0).mean(), 1)
+                continue
             label = _as_np(label)
             pred = _as_np(pred)
             if len(label.shape) == 1:
@@ -413,6 +480,7 @@ class RMSE(MSE):
         super().__init__(name, output_names, label_names)
 
     def get(self):
+        self._drain_device()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, math.sqrt(self.sum_metric / self.num_inst))
@@ -479,6 +547,10 @@ class Loss(EvalMetric):
         if isinstance(preds, (NDArray, _np.ndarray)):
             preds = [preds]
         for pred in preds:
+            if isinstance(pred, NDArray):
+                # device path: per-batch sum stays a jax scalar
+                self._accum_device(pred.data.sum(), pred.size)
+                continue
             pred = _as_np(pred)
             self.sum_metric += float(pred.sum())
             self.num_inst += pred.size

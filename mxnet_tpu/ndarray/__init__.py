@@ -14,6 +14,7 @@ from .ndarray import (
     NDArray, array, empty, zeros, ones, full, arange, linspace, eye,
     concatenate, waitall, save, load, zeros_like, ones_like, moveaxis,
 )
+from .pending import PendingValue
 from ..ops import registry as _registry
 from ..ops.registry import apply_op as _apply_op
 

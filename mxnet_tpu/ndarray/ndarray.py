@@ -165,15 +165,19 @@ class NDArray:
     def __bool__(self):
         if self.size != 1:
             raise ValueError("ambiguous truth value of multi-element NDArray")
+        _note_host_sync()
         return bool(np.asarray(self.data))
 
     def __float__(self):
+        _note_host_sync()
         return float(np.asarray(self.data).reshape(())[()])
 
     def __int__(self):
+        _note_host_sync()
         return int(np.asarray(self.data).reshape(())[()])
 
     def __index__(self):
+        _note_host_sync()
         return int(np.asarray(self.data).reshape(())[()])
 
     def __iter__(self):
@@ -189,6 +193,7 @@ class NDArray:
     def asnumpy(self):
         """Blocking copy to host (ref: MXNDArraySyncCopyToCPU — the sync
         point where deferred errors surface)."""
+        _note_host_sync()
         return np.asarray(self.data)
 
     def asscalar(self):
@@ -533,6 +538,18 @@ def concatenate(arrays, axis=0):
 
 
 _sync_pick = None
+_record_host_sync = None
+
+
+def _note_host_sync():
+    """Bump the profiler's host_syncs counter (lazy import: profiler is
+    not yet importable while this module loads)."""
+    global _record_host_sync
+    if _record_host_sync is None:
+        from .. import profiler
+
+        _record_host_sync = profiler.record_host_sync
+    _record_host_sync()
 
 
 def _device_sync(d):
@@ -546,12 +563,19 @@ def _device_sync(d):
     if _sync_pick is None:
         _sync_pick = jax.jit(
             lambda x: jax.lax.slice(x.ravel(), (0,), (1,)))
-    np.asarray(_sync_pick(d))
+    _note_host_sync()
+    np.asarray(_sync_pick(d))  # sync-ok: wait_to_read's 1-element pick
 
 
 def waitall():
-    """Global sync barrier (ref: Engine::WaitForAll). XLA dispatch is
-    per-buffer; this blocks on an effects barrier."""
+    """Global sync barrier (ref: Engine::WaitForAll). Drains the async
+    engine's in-flight step window first — deferred guard flags and their
+    bookkeeping (update counts, loss-scale, skipped-step counter) land
+    before this returns, so tests and chaos_matrix.sh can rely on it as
+    a barrier — then blocks on XLA's effects barrier."""
+    from .. import engine
+
+    engine.wait_all()
     try:
         jax.effects_barrier()
     except Exception:

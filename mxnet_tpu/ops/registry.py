@@ -28,15 +28,17 @@ def _config():
     return config
 
 
-_launches = None  # profiler._launch_count, bound on first dispatch
+_launches = None  # profiler.record_launch, bound on first dispatch
 
 
 def _count_launch():
+    # thread-safe: op dispatch also happens on prefetcher/deferred-read
+    # threads, so the increment goes through the profiler's lock
     global _launches
     if _launches is None:
         from .. import profiler
-        _launches = profiler._launch_count
-    _launches[0] += 1
+        _launches = profiler.record_launch
+    _launches()
 
 __all__ = ["Op", "register", "get_op", "list_ops", "apply_op"]
 

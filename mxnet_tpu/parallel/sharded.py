@@ -385,7 +385,7 @@ class ShardedTrainStep:
             train_vals, states, aux_vals, self._shard_batch(x),
             self._shard_batch(y), self._ensure_key(), self._t_dev)
         from .. import profiler
-        profiler._launch_count[0] += 1
+        profiler.record_launch()
         for n, v in zip(self._train_names, new_train):
             self._all_params[n].data()._set_data(v)
         for n, s in zip(self._train_names, new_states):

@@ -15,6 +15,7 @@ Env autostart: ``MXT_PROFILER_AUTOSTART=1`` (ref MXNET_PROFILER_AUTOSTART).
 from __future__ import annotations
 
 import os
+import threading
 import time
 
 from .base import MXNetError
@@ -22,7 +23,8 @@ from .base import MXNetError
 __all__ = ["set_config", "set_state", "state", "start", "stop", "pause",
            "resume", "dump", "dumps", "Domain", "Task", "Frame", "Counter",
            "Marker", "record_launch", "launch_count", "reset_launch_count",
-           "counter_value"]
+           "counter_value", "record_host_sync", "host_sync_count",
+           "reset_host_sync_count", "set_gauge", "gauge_value"]
 
 _config = {
     "filename": "profile_output",
@@ -46,6 +48,17 @@ _counters = {}
 # Module's fused update) bumps this — ONE slot of mutable state so the hot
 # paths can increment without a function call into this module
 _launch_count = [0]
+# device->host reads performed by the framework (asnumpy/wait_to_read/
+# float() on device values, and the async engine's deferred flag reads):
+# each is a full tunnel round-trip, so host_syncs/step is the headline
+# async-dispatch health signal (a K-deep window should show <= 1/K)
+_host_syncs = [0]
+_gauges = {}
+# counters/gauges are bumped both from the dispatch thread and from
+# deferred-read callbacks (engine.StepStream retirement, DataLoader
+# workers), so every mutation goes through one lock — `x[0] += 1` is
+# three bytecodes and NOT atomic across threads
+_LOCK = threading.RLock()
 
 
 def record_launch(n=1):
@@ -54,7 +67,8 @@ def record_launch(n=1):
     costs ~3.4 ms on the axon tunnel (PERF.md §1.2), so this counter is
     the cheapest fusion-health signal: a fused train step should show
     exactly 1 per step."""
-    _launch_count[0] += n
+    with _LOCK:
+        _launch_count[0] += n
 
 
 def launch_count():
@@ -62,9 +76,38 @@ def launch_count():
 
 
 def reset_launch_count():
-    prev = _launch_count[0]
-    _launch_count[0] = 0
+    with _LOCK:
+        prev = _launch_count[0]
+        _launch_count[0] = 0
     return prev
+
+
+def record_host_sync(n=1):
+    """Count ``n`` device->host synchronizations (blocking reads)."""
+    with _LOCK:
+        _host_syncs[0] += n
+
+
+def host_sync_count():
+    return _host_syncs[0]
+
+
+def reset_host_sync_count():
+    with _LOCK:
+        prev = _host_syncs[0]
+        _host_syncs[0] = 0
+    return prev
+
+
+def set_gauge(name, value):
+    """Set a point-in-time gauge (e.g. engine's 'dispatch_depth' — the
+    number of fused steps currently in flight). Gauges show in dumps()."""
+    with _LOCK:
+        _gauges[name] = value
+
+
+def gauge_value(name, default=0):
+    return _gauges.get(name, default)
 
 
 def counter_value(name, default=0):
@@ -153,22 +196,29 @@ def dumps(reset=False):
                      % (name, cnt, tot * 1e3, mn * 1e3, mx * 1e3))
     for name in sorted(_counters):
         lines.append("    %-24s value=%s" % (name, _counters[name]))
+    for name in sorted(_gauges):
+        lines.append("    %-24s value=%s" % (name, _gauges[name]))
     lines.append("    %-24s value=%d" % ("xla_launches", _launch_count[0]))
+    lines.append("    %-24s value=%d" % ("host_syncs", _host_syncs[0]))
     if reset:
-        _agg.clear()
-        _counters.clear()
-        _launch_count[0] = 0
+        with _LOCK:
+            _agg.clear()
+            _counters.clear()
+            _gauges.clear()
+            _launch_count[0] = 0
+            _host_syncs[0] = 0
     return "\n".join(lines)
 
 
 def _record(name, dt):
     if _paused:
         return
-    ent = _agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
-    ent[0] += 1
-    ent[1] += dt
-    ent[2] = min(ent[2], dt)
-    ent[3] = max(ent[3], dt)
+    with _LOCK:
+        ent = _agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
+        ent[0] += 1
+        ent[1] += dt
+        ent[2] = min(ent[2], dt)
+        ent[3] = max(ent[3], dt)
 
 
 class Domain:
@@ -229,10 +279,12 @@ class Counter:
         _counters[self.name] = value
 
     def set_value(self, value):
-        _counters[self.name] = value
+        with _LOCK:
+            _counters[self.name] = value
 
     def increment(self, delta=1):
-        _counters[self.name] = _counters.get(self.name, 0) + delta
+        with _LOCK:
+            _counters[self.name] = _counters.get(self.name, 0) + delta
 
     def decrement(self, delta=1):
         self.increment(-delta)

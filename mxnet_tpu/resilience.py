@@ -326,6 +326,12 @@ def record_skipped_step(n=1):
 
 
 def skipped_step_count():
+    """Skipped steps so far. Reading the counter is a sync point: the
+    async engine's deferred guard flags are drained first, so the value
+    reflects every step DISPATCHED (not just observed) when called."""
+    from . import engine
+
+    engine.wait_all()
     from . import profiler
 
     return profiler.counter_value(_SKIP_COUNTER)
@@ -422,6 +428,13 @@ class CheckpointManager:
         (e.g. dataloader cursor). Returns the manifest path."""
         net = net if net is not None else self.net
         trainer = trainer if trainer is not None else self.trainer
+        # drain the async dispatch window: in-flight steps finish and
+        # their deferred bookkeeping (update counts, loss-scale, skip
+        # counter) lands, so the snapshot is internally consistent —
+        # weights, optimizer state, and counts all describe the same step
+        from . import engine
+
+        engine.wait_all()
         inj = _fault()
         tag = self._tag(step)
         files = {}
@@ -537,6 +550,11 @@ class CheckpointManager:
         cursor, or None when no valid checkpoint exists."""
         net = net if net is not None else self.net
         trainer = trainer if trainer is not None else self.trainer
+        # a live run resuming over itself must not race its own window:
+        # drain in-flight steps before overwriting params/opt state
+        from . import engine
+
+        engine.wait_all()
         entries = self.checkpoints()
         if not entries:
             return None

@@ -71,7 +71,9 @@ _loss_fn = mx.gluon.loss.L2Loss()
 def test_fused_guard_one_launch_and_nan_skip(monkeypatch):
     """Guard enabled: still EXACTLY one launch per step, and a NaN batch
     leaves weights + optimizer state bit-identical while bumping the
-    skipped-step counter and freezing the step count."""
+    skipped-step counter and freezing the step count. The guard flag is
+    now observed DEFERRED through the async engine window, so host
+    counters are asserted behind an nd.waitall() barrier."""
     monkeypatch.setenv("MXT_SKIP_NONFINITE", "1")
     net = _make_net()
     tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-2})
@@ -86,6 +88,7 @@ def test_fused_guard_one_launch_and_nan_skip(monkeypatch):
     step(*data[2]).wait_to_read()
     assert profiler.launch_count() - c0 == 1  # guard costs zero launches
 
+    nd.waitall()  # land deferred flags before sampling counters
     w0, s0 = _weights(net), _states(tr)
     n0 = tr._optimizer.num_update
     k0 = resilience.skipped_step_count()
@@ -99,11 +102,13 @@ def test_fused_guard_one_launch_and_nan_skip(monkeypatch):
     for i in s0:
         for a, b in zip(s0[i], s1[i]):
             np.testing.assert_array_equal(a, b)
+    nd.waitall()
     assert tr._optimizer.num_update == n0  # counter did not advance
     assert resilience.skipped_step_count() == k0 + 1
 
     # a clean step afterwards updates again
     step(*data[3])
+    nd.waitall()
     assert tr._optimizer.num_update == n0 + 1
 
 
@@ -118,6 +123,7 @@ def test_fused_guard_matches_eager_numerics(monkeypatch):
     step = tr_g.fuse_step(net_g, _loss_fn)
     for x, y in data:
         step(x, y)
+    nd.waitall()  # land deferred update counts
     assert step.fused and step._guard
 
     monkeypatch.delenv("MXT_SKIP_NONFINITE")
@@ -151,8 +157,10 @@ def test_fused_guard_drives_loss_scaler(monkeypatch):
     tr._amp_scaler = scaler
     step = tr.fuse_step(net, _loss_fn)
     step(*_batch(0))
+    nd.waitall()  # the scaler consumes flags from the trailing window
     assert scaler.loss_scale == 2.0 ** 10 and scaler._unskipped == 1
     step(*_batch(99, nan=True))
+    nd.waitall()
     assert scaler.loss_scale == 2.0 ** 9  # halved on overflow
     assert scaler._unskipped == 0
 
@@ -169,6 +177,7 @@ def test_eager_trainer_skip_nonfinite(monkeypatch, fused_trainer):
         loss = _loss_fn(net(x), y)
     loss.backward()
     tr.step(8)
+    nd.waitall()  # the fused guard defers its flag through the window
     w0, n0 = _weights(net), tr._optimizer.num_update
     k0 = resilience.skipped_step_count()
 
@@ -177,6 +186,7 @@ def test_eager_trainer_skip_nonfinite(monkeypatch, fused_trainer):
         loss = _loss_fn(net(bx), by)
     loss.backward()
     tr.step(8)  # grads are NaN: the whole update is skipped
+    nd.waitall()
     for k, v in _weights(net).items():
         np.testing.assert_array_equal(v, w0[k], err_msg=k)
     assert tr._optimizer.num_update == n0

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Static host-sync lint for the async dispatch hot path.
+
+The async engine (mxnet_tpu/engine.py) only pays off while the fused-step
+hot path performs NO device->host read outside the deferred-handle
+protocol (ndarray/pending.py — PendingValue) and the engine's token
+retirement. A single stray ``asnumpy()`` / ``np.asarray()`` / ``float()``
+on a device value re-synchronizes every step and silently undoes the
+pipelining — exactly the regression class this pass exists to catch.
+
+Mechanism: scan the hot-path modules line by line (skipping comments and
+docstrings) for sync-shaped constructs. Every INTENTIONAL sync point
+carries a ``sync-ok: <reason>`` marker comment on its line; anything
+unmarked fails the build. Runs standalone and from tier-1
+(tests/test_engine_async.py::test_static_host_sync_pass).
+
+Usage: python tools/check_host_syncs.py [repo_root]
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+# constructs that force (or usually force) a device->host transfer
+_ALL = [
+    r"\.asnumpy\(",
+    r"\.asscalar\(",
+    r"\bnp\.asarray\(",
+    r"\b_np\.asarray\(",
+    r"\bnumpy\.asarray\(",
+    r"\bfloat\(",
+    r"\.item\(",
+    r"block_until_ready",
+    r"\bjax\.device_get\b",
+]
+
+# hot-path modules -> the patterns scanned there. metric.py hosts the
+# legitimate numpy fallback path (host math on already-transferred
+# arrays), so only the transfer itself is policed there.
+SCAN = {
+    "mxnet_tpu/engine.py": _ALL,
+    "mxnet_tpu/gluon/train_step.py": _ALL,
+    "mxnet_tpu/gluon/trainer.py": _ALL,
+    "mxnet_tpu/ndarray/pending.py": _ALL,
+    "mxnet_tpu/metric.py": [r"\.asnumpy\(", r"\.asscalar\(",
+                            r"block_until_ready"],
+}
+
+_MARKER = "sync-ok"
+
+
+def _strip_docstrings(lines):
+    """Yield (lineno, line) for lines outside triple-quoted strings (a
+    coarse tracker — good enough for these modules' style)."""
+    in_doc = False
+    for i, line in enumerate(lines, 1):
+        quotes = line.count('"""') + line.count("'''")
+        if in_doc:
+            if quotes % 2 == 1:
+                in_doc = False
+            continue
+        if quotes % 2 == 1:
+            in_doc = True
+            continue
+        if quotes and quotes % 2 == 0:
+            continue  # one-line docstring
+        yield i, line
+
+
+def check(root):
+    """[(path, lineno, line)] of unmarked sync constructs."""
+    bad = []
+    for rel, patterns in sorted(SCAN.items()):
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            bad.append((rel, 0, "<hot-path module missing>"))
+            continue
+        regexes = [re.compile(p) for p in patterns]
+        with open(path) as f:
+            lines = f.read().splitlines()
+        for lineno, line in _strip_docstrings(lines):
+            code = line.split("#", 1)[0]
+            if not code.strip():
+                continue
+            if _MARKER in line:
+                continue
+            for rx in regexes:
+                if rx.search(code):
+                    bad.append((rel, lineno, line.strip()))
+                    break
+    return bad
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    bad = check(root)
+    if bad:
+        print("check_host_syncs: %d unmarked host-sync point(s) in the "
+              "async hot path:" % len(bad))
+        for rel, lineno, line in bad:
+            print("  %s:%d: %s" % (rel, lineno, line))
+        print("route the read through the deferred protocol "
+              "(ndarray/pending.py / engine.StepStream), or mark an "
+              "intentional sync with `# sync-ok: <reason>`.")
+        return 1
+    print("check_host_syncs: hot path clean (%d modules)" % len(SCAN))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
