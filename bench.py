@@ -43,7 +43,7 @@ _BUDGET = float(os.environ.get("BENCH_BUDGET", "1500"))
 # but CPU is the fallback path where the budget rarely binds
 _CONFIG_COST = {"resnet50": 420, "bert": 300, "lstm_ptb": 200,
                 "wide_deep": 200, "lenet": 150, "pipeline": 150,
-                "async_ab": 90}
+                "async_ab": 90, "telemetry_ab": 60}
 
 
 def _remaining():
@@ -733,12 +733,107 @@ def bench_async_ab(platform, dtype):
     return speedup, row
 
 
+def bench_telemetry_ab(platform, dtype):
+    """Telemetry overhead A/B (telemetry.py): the SAME fused Gluon step
+    run with the telemetry JSONL sink OFF and then ON. The registry's
+    histograms/spans are host-side wall-clock only, so the contract is
+    (a) IDENTICAL host_syncs_per_step both ways — telemetry adds zero
+    device reads to the hot path — and (b) <= ~3% step-time overhead
+    with the sink on (=~0 when disabled: the sink check is one dict
+    lookup). The row self-reports both so the driver can gate on them."""
+    import tempfile
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine, nd, profiler, telemetry
+    from mxnet_tpu.gluon import Trainer, nn
+
+    del dtype  # f32: the A/B isolates instrumentation, not math
+    batch = int(os.environ.get("BENCH_TAB_BATCH", "64"))
+    hidden = int(os.environ.get("BENCH_TAB_HIDDEN", "256"))
+    iters = int(os.environ.get("BENCH_TAB_ITERS", "40"))
+    warmup = int(os.environ.get("BENCH_TAB_WARMUP", "3"))
+    window = int(os.environ.get("BENCH_TAB_INFLIGHT", "4"))
+
+    jsonl = tempfile.mktemp(prefix="mxt_bench_telemetry_",
+                            suffix=".jsonl")
+    prev_sink = os.environ.get("MXT_TELEMETRY_JSONL")
+
+    def run(tag, sink_on):
+        if sink_on:
+            os.environ["MXT_TELEMETRY_JSONL"] = jsonl
+        else:
+            os.environ.pop("MXT_TELEMETRY_JSONL", None)
+        try:
+            mx.random.seed(0)
+            net = nn.Sequential(prefix="tab_%s_" % tag)
+            with net.name_scope():
+                net.add(nn.Dense(hidden, activation="relu"),
+                        nn.Dense(hidden, activation="relu"),
+                        nn.Dense(10))
+            net.initialize()
+            tr = Trainer(net.collect_params(), "adam",
+                         {"learning_rate": 1e-3})
+            step = tr.fuse_step(net,
+                                mx.gluon.loss.SoftmaxCrossEntropyLoss())
+            rng = np.random.RandomState(0)
+            x = nd.array(rng.uniform(-1, 1,
+                                     (batch, 32)).astype(np.float32))
+            y = nd.array(rng.randint(0, 10, (batch,)).astype(np.float32))
+            with engine.bulk(window):
+                for _ in range(warmup):
+                    step(x, y).wait_to_read()
+                t0 = time.perf_counter()
+                h0 = profiler.host_sync_count()
+                for _ in range(iters):
+                    step(x, y)
+                nd.waitall()
+                dt = time.perf_counter() - t0
+                syncs = profiler.host_sync_count() - h0
+            return dt / iters * 1e3, syncs / iters
+        finally:
+            if prev_sink is None:
+                os.environ.pop("MXT_TELEMETRY_JSONL", None)
+            else:
+                os.environ["MXT_TELEMETRY_JSONL"] = prev_sink
+
+    off_ms, off_sps = run("off", False)
+    on_ms, on_sps = run("on", True)
+    telemetry.flush()
+    try:
+        with open(jsonl) as f:
+            events = sum(1 for _ in f)
+        os.remove(jsonl)
+    except OSError:
+        events = 0
+
+    overhead = on_ms / off_ms if off_ms else 0.0
+    row = {
+        "config": "fused_step_telemetry_ab", "chips": 1,
+        "batch_size": batch, "dtype": "float32", "platform": platform,
+        "inflight_window": window,
+        "telemetry_off_step_time_ms": round(off_ms, 3),
+        "telemetry_on_step_time_ms": round(on_ms, 3),
+        "host_syncs_per_step_off": round(off_sps, 3),
+        "host_syncs_per_step_on": round(on_sps, 3),
+        "jsonl_events": events,
+        "images_or_tokens_per_sec_per_chip": round(
+            batch * 1e3 / on_ms, 2),
+        "mfu": None, "flops_per_sample": None,
+        "telemetry_overhead": round(overhead, 4),
+    }
+    _emit_jsonl(row)
+    return overhead, row
+
+
 def main():
     platform, note = _init_backend()
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     configs = os.environ.get(
         "BENCH_CONFIGS",
-        "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline,async_ab"
+        "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline,async_ab,"
+        "telemetry_ab"
     ).split(",")
 
     # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
@@ -757,13 +852,15 @@ def main():
                      bench_input_pipeline),
         "async_ab": ("async_dispatch_speedup", "x (sync/async step time)",
                      bench_async_ab),
+        "telemetry_ab": ("telemetry_overhead", "x (on/off step time)",
+                         bench_telemetry_ab),
     }
     headline = None
     errors = []
     skipped = []
     best_resnet = None
     for name in ("resnet50", "bert", "lstm_ptb", "wide_deep", "lenet",
-                 "pipeline", "async_ab"):
+                 "pipeline", "async_ab", "telemetry_ab"):
         if name not in configs:
             continue
         cost = float(os.environ.get("BENCH_COST_%s" % name.upper(),

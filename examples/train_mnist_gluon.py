@@ -35,6 +35,18 @@ reads (bit-exact numerics; metrics accumulate on device)::
 
 Pass --inflight K to set the window here (0 keeps the MXT_MAX_INFLIGHT
 default of 2; 1 forces synchronous per-step reads).
+
+Telemetry (telemetry.py): --telemetry turns on the JSONL event sink and
+the Prometheus endpoint, then prints how to watch the run live::
+
+    python examples/train_mnist_gluon.py --telemetry &
+    python tools/mxt_top.py --url http://127.0.0.1:9109   # live console
+    # or, offline: python tools/mxt_top.py --jsonl mnist_telemetry.jsonl
+
+The console shows steps/s, host_syncs/step (≤ 1/K when the async window
+is healthy), launches/step (1.0 = fully fused), dispatch depth, and the
+skipped-step counter — all without adding a single host sync to the
+training loop.
 """
 import argparse
 
@@ -105,7 +117,24 @@ def main():
                         "host runs up to K fused steps ahead, deferring "
                         "host reads; 0 = MXT_MAX_INFLIGHT default, "
                         "1 = synchronous")
+    p.add_argument("--telemetry", action="store_true",
+                   help="write telemetry JSONL (mnist_telemetry.jsonl), "
+                        "serve Prometheus metrics on 127.0.0.1:9109, and "
+                        "print the tools/mxt_top.py invocation to watch "
+                        "the run live")
     args = p.parse_args()
+
+    if args.telemetry:
+        os.environ.setdefault("MXT_TELEMETRY_JSONL",
+                              "mnist_telemetry.jsonl")
+        from mxnet_tpu import telemetry
+
+        srv = telemetry.start_http_server(
+            int(os.environ.get("MXT_TELEMETRY_PORT", "9109")))
+        print("telemetry: JSONL -> %s ; live console:\n"
+              "  python tools/mxt_top.py --url http://127.0.0.1:%d"
+              % (os.environ["MXT_TELEMETRY_JSONL"],
+                 srv.server_address[1]))
 
     mx.random.seed(42)
     net = lenet()

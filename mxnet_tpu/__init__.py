@@ -56,6 +56,8 @@ from . import profiler
 from . import monitor
 from . import image
 from . import config
+from . import telemetry
+telemetry._maybe_autostart()  # MXT_TELEMETRY_PORT exposition endpoint
 from . import resilience
 from . import membership
 from . import visualization
@@ -73,7 +75,7 @@ __all__ = [
     "sym", "Symbol", "module", "mod", "Module", "BucketingModule", "model",
     "save_checkpoint", "load_checkpoint", "profiler", "monitor",
     "operator", "image", "config", "amp", "contrib", "resilience",
-    "membership",
+    "membership", "telemetry",
     "SequentialModule", "visualization", "viz", "runtime", "util", "rnn",
     "attribute", "AttrScope", "name", "engine",
 ]

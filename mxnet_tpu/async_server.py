@@ -65,6 +65,7 @@ import struct
 import threading
 import time
 
+from . import telemetry
 from .base import MXNetError
 from .membership import (BarrierTimeout, MembershipTable, StaleWorkerError,
                          snapshot_checksums)
@@ -140,6 +141,11 @@ class _Channel:
         self._recv_dir = b"S" if direction == b"C" else b"C"
         self._send_seq = 0
         self._recv_seq = 0
+        # payload sizes of the newest frame each way: the telemetry RPC
+        # bytes histograms read these (the channel is the only place
+        # that knows the pickled size)
+        self.last_send_len = 0
+        self.last_recv_len = 0
 
     def _mac(self, direction, seq, payload):
         msg = self._nonce + direction + struct.pack("!Q", seq) + payload
@@ -147,6 +153,7 @@ class _Channel:
 
     def send(self, obj):
         payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        self.last_send_len = len(payload)
         if self._secret is not None:
             mac = self._mac(self._send_dir, self._send_seq, payload)
             self._send_seq += 1
@@ -157,6 +164,7 @@ class _Channel:
 
     def recv(self):
         (n,) = struct.unpack("!Q", _recv_exact(self._sock, 8))
+        self.last_recv_len = n
         if self._secret is not None:
             mac = _recv_exact(self._sock, _MAC_LEN)
             payload = _recv_exact(self._sock, n)
@@ -278,7 +286,13 @@ class AsyncParamServer:
                     # unauthenticated peer learns nothing); errors AFTER
                     # auth go back as ("err", ...) frames below
                     return
-                if len(frame) == 4:
+                trace = None
+                if len(frame) == 5:
+                    # traced frame: (trace_id, span_id, attempt) rides
+                    # the header so every push/pull/heartbeat/rendezvous
+                    # is correlatable with the worker that sent it
+                    op, key, payload, cred, trace = frame
+                elif len(frame) == 4:
                     # membership-credentialed frame (worker_id, generation)
                     op, key, payload, cred = frame
                 else:
@@ -287,6 +301,8 @@ class AsyncParamServer:
                     # the eager updater keys optimizer state and lr/wd
                     # multipliers by int for digit keys (kvstore.py push)
                     key = int(key)
+                nbytes = ch.last_recv_len
+                t0 = time.perf_counter()
                 try:
                     reply = self._handle(op, key, payload, cred)
                 except StaleWorkerError as e:
@@ -296,6 +312,11 @@ class AsyncParamServer:
                     reply = ("stale", str(e))
                 except BarrierTimeout as e:
                     reply = ("timeout", str(e))
+                telemetry.record_rpc(
+                    "server", op, seconds=time.perf_counter() - t0,
+                    nbytes=nbytes, trace=trace, key=key,
+                    status=reply[0] if isinstance(reply, tuple) and reply
+                    else "ok")
                 ch.send(reply)
         except (OSError, EOFError):
             # includes EBADF from close() tearing the socket out from
@@ -625,7 +646,16 @@ class AsyncClient:
         from .membership import StaleWorkerError
         from .resilience import KVStoreError
 
+        # one trace per logical request (the ambient trace_scope id when
+        # a caller installed one); each ATTEMPT gets its own span id and
+        # attempt number, so retries are visible server-side
+        trace_id = telemetry.current_trace_id() or telemetry.new_trace_id()
+        attempt_no = [-1]
+
         def attempt():
+            attempt_no[0] += 1
+            trace = (trace_id, telemetry.new_span_id(), attempt_no[0])
+            t0 = time.perf_counter()
             with self._lock:
                 if self._needs_resync and op in _FENCED_OPS:
                     raise KVStoreError(
@@ -640,17 +670,21 @@ class AsyncClient:
                 try:
                     # frame built per attempt so a resync hook's
                     # refreshed credentials apply to the retried send
-                    if self._cred is not None:
-                        self._ch.send((op, key, payload, self._cred))
-                    else:
-                        self._ch.send((op, key, payload))
-                    return self._ch.recv()
+                    self._ch.send((op, key, payload, self._cred, trace))
+                    reply = self._ch.recv()
+                    nbytes = self._ch.last_send_len
                 finally:
                     if deadline is not None:
                         try:
                             self._sock.settimeout(None)
                         except OSError:
                             pass
+            telemetry.record_rpc(
+                "client", op, seconds=time.perf_counter() - t0,
+                nbytes=nbytes, trace=trace, key=key,
+                status=reply[0] if isinstance(reply, tuple) and reply
+                else "ok")
+            return reply
 
         policy = None
         if deadline is not None:

@@ -54,7 +54,11 @@ class Speedometer:
     With ``jsonl`` set, every measurement also appends a structured row
     (the BASELINE.md harness requirement):
     ``{config, chips, batch_size, dtype,
-       images_or_tokens_per_sec_per_chip, epoch, batch}``.
+       images_or_tokens_per_sec_per_chip, epoch, batch}`` — plus the
+    async-health fields ``host_syncs_per_step``, ``launches_per_step``
+    (per-window deltas of the telemetry-registry counters, reset-aware)
+    and the live ``dispatch_depth`` gauge, so harness rows self-report
+    whether the fused/async path actually engaged.
     """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True,
@@ -70,9 +74,26 @@ class Speedometer:
         self.config = config
         self.dtype = dtype
         self.chips = max(1, int(chips))
+        self._counter_snap = None  # (host_syncs, launches) at window start
 
-    def _emit_jsonl(self, speed, param):
+    def _counter_deltas(self):
+        """(host_syncs, launches) accumulated since the last window,
+        tolerant of a profiler reset mid-window (a reset makes the
+        counters smaller than the snapshot — re-baseline at 0 instead of
+        reporting a negative rate)."""
+        from . import profiler
+
+        cur = (profiler.host_sync_count(), profiler.launch_count())
+        prev = self._counter_snap
+        self._counter_snap = cur
+        if prev is None:
+            return None
+        return tuple(c - p if c >= p else c for c, p in zip(cur, prev))
+
+    def _emit_jsonl(self, speed, param, deltas):
         import json
+
+        from . import profiler
 
         row = {
             "config": self.config or "unnamed",
@@ -82,7 +103,12 @@ class Speedometer:
             "images_or_tokens_per_sec_per_chip": round(speed / self.chips, 2),
             "epoch": getattr(param, "epoch", 0),
             "batch": getattr(param, "nbatch", 0),
+            "dispatch_depth": profiler.gauge_value("dispatch_depth"),
         }
+        if deltas is not None:
+            syncs, launches = deltas
+            row["host_syncs_per_step"] = round(syncs / self.frequent, 3)
+            row["launches_per_step"] = round(launches / self.frequent, 2)
         with open(self.jsonl, "a") as f:
             f.write(json.dumps(row) + "\n")
 
@@ -97,7 +123,7 @@ class Speedometer:
                     (time.time() - self.tic)
                 self.last_speed = speed
                 if self.jsonl:
-                    self._emit_jsonl(speed, param)
+                    self._emit_jsonl(speed, param, self._counter_deltas())
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
@@ -114,6 +140,7 @@ class Speedometer:
         else:
             self.init = True
             self.tic = time.time()
+            self._counter_deltas()  # baseline the async-health counters
 
 
 class ProgressBar:

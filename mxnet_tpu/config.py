@@ -168,6 +168,18 @@ _declare("MXT_KV_DEADLINE", float, 30.0,
          "Per-op deadline in seconds for kvstore network ops; exceeding "
          "it raises KVStoreError instead of hanging the worker.")
 
+_declare("MXT_TELEMETRY_JSONL", str, None,
+         "Path of the telemetry JSONL event/metric sink (telemetry.py): "
+         "step-phase spans, RPC spans, and epoch metric snapshots append "
+         "as JSON lines via a buffered writer thread; nd.waitall() and "
+         "the estimator's epoch end flush it. Unset disables the sink "
+         "(metrics registry stays live either way).")
+_declare("MXT_TELEMETRY_PORT", int, None,
+         "Serve telemetry.render_prometheus() on 127.0.0.1:<port> "
+         "(stdlib HTTP, daemon thread, loopback only). tools/mxt_top.py "
+         "tails it for a live console. Unset disables the endpoint; "
+         "0 picks a free port (telemetry.http_port() reports it).")
+
 _declare("MXT_AG_LEAN_TAPE", bool, False,
          "Skip storing per-node replay state (forward fn + primal "
          "inputs) on the autograd tape. Saves peak memory on very long "

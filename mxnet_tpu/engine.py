@@ -36,8 +36,10 @@ everything here and the profiler counters it bumps are lock-guarded.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import threading
+import time
 import weakref
 
 __all__ = ["bulk", "set_bulk_size", "max_inflight", "StepStream",
@@ -105,6 +107,12 @@ def _update_depth_gauge():
     profiler.set_gauge("dispatch_depth", inflight_depth())
 
 
+def _telemetry():
+    from . import telemetry
+
+    return telemetry
+
+
 class _Token:
     """One retirement point in a stream: a deferred host read covering
     every step dispatched since the previous token."""
@@ -135,6 +143,11 @@ class StepStream:
         self._window = []  # snapshot tokens not yet retired
         self._latest = None  # (sync_value, flags) of the newest push
         self._retire_lock = threading.RLock()
+        # host wall-clock of each dispatch, consumed oldest-first at
+        # retirement: the dispatch->retire latency histogram costs zero
+        # extra device reads (it is measured INSIDE the deferred read
+        # the engine already performs)
+        self._dispatch_ts = collections.deque()
         with _lock:
             _streams.add(self)
 
@@ -155,6 +168,9 @@ class StepStream:
         retire = []
         with _lock:
             self._dispatched += 1
+            self._dispatch_ts.append(time.perf_counter())
+            depth = self._dispatched - self._consumed
+            step_no = self._dispatched
             self._latest = (sync_value, flags)
             k = max_inflight()
             if self._dispatched - self._last_snap >= k:
@@ -168,6 +184,7 @@ class StepStream:
                 else:
                     while len(self._window) > 1:
                         retire.append(self._window.pop(0))
+        _telemetry().record_dispatch(self.name, step_no, depth)
         if retire:
             with self._retire_lock:
                 for tok in retire:
@@ -181,6 +198,14 @@ class StepStream:
         if n <= 0:
             return
         value = tok.pv.get()  # blocks until the covered steps finished
+        # dispatch->retire latency per covered step, clocked off the
+        # read that just happened (telemetry adds NO host sync here)
+        now = time.perf_counter()
+        tel = _telemetry()
+        for i in range(n):
+            ts = self._dispatch_ts.popleft() if self._dispatch_ts else now
+            tel.record_step_retired(self.name, tok.upto - n + 1 + i,
+                                    now - ts)
         if tok.has_flags and self._on_flags is not None:
             mask = int(value)
             for k in range(n - 1, -1, -1):  # oldest step first

@@ -284,6 +284,29 @@ class Estimator:
             else:
                 m.update([labels], [preds])
 
+    def _emit_epoch_telemetry(self, seconds):
+        """Registry + sink output at epoch end (after the dispatch
+        window drained, so counts describe every dispatched step): epoch
+        duration histogram, batch counter, epoch gauge, one metrics
+        snapshot row, and a sink flush — the JSONL file is durable at
+        every epoch boundary."""
+        from ... import telemetry
+
+        telemetry.histogram(
+            "mxt_estimator_epoch_seconds",
+            "Wall-clock seconds per Estimator.fit epoch "
+            "(train + validation).").observe(seconds)
+        telemetry.counter(
+            "mxt_estimator_batches_total",
+            "Batches trained by Estimator.fit.").inc(self.batch_idx + 1)
+        telemetry.gauge(
+            "mxt_estimator_epoch",
+            "Last completed Estimator.fit epoch.").set(self.epoch)
+        telemetry.emit_event("epoch_end", epoch=self.epoch,
+                             batches=self.batch_idx + 1,
+                             seconds=round(seconds, 6))
+        telemetry.flush(write_metrics=True)
+
     def evaluate(self, val_data):
         for m in self.val_metrics:
             m.reset()
@@ -309,6 +332,7 @@ class Estimator:
         try:
             for self.epoch in range(start, start + epochs):
                 epoch_trained = False
+                epoch_t0 = time.perf_counter()
                 for m in self.train_metrics:
                     m.reset()
                 fire("epoch_begin")
@@ -331,6 +355,11 @@ class Estimator:
                         lost = kv.lost_workers()
                         if lost > self.lost_workers:
                             self.lost_workers = lost
+                            from ... import telemetry
+                            telemetry.emit_event(
+                                "workers_lost", epoch=self.epoch,
+                                batch=self.batch_idx,
+                                lost_total=self.lost_workers)
                             fire("workers_lost")
                     fire("batch_end")
                     if batches is not None and self.batch_idx + 1 >= batches:
@@ -343,6 +372,8 @@ class Estimator:
                 if val_data is not None:
                     self.evaluate(val_data)
                 epoch_trained = True
+                self._emit_epoch_telemetry(
+                    time.perf_counter() - epoch_t0)
                 fire("epoch_end")
             self.epoch = start + epochs  # a second fit() resumes here
         except StopTraining as e:

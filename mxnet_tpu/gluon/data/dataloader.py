@@ -214,13 +214,36 @@ class DataLoader:
                 # already dispatched when the current step runs
                 base = _DevicePrefetcher(base, self._prefetch or 2,
                                          self._prefetch_to_device)
-            yield from base
-            return
-        base = self._iter_threads() if self._thread_pool \
-            else self._iter_processes()
-        if self._prefetch_to_device:
-            base = _DevicePrefetcher(base, 2, True)
-        yield from base
+        else:
+            base = self._iter_threads() if self._thread_pool \
+                else self._iter_processes()
+            if self._prefetch_to_device:
+                base = _DevicePrefetcher(base, 2, True)
+        return self._instrumented(base)
+
+    @staticmethod
+    def _instrumented(base):
+        """Clock how long the CONSUMER waits for each batch — the
+        'data_wait' phase of the step timeline (telemetry.py). With
+        healthy prefetch this is ~0; a feed-bound run shows it eating
+        the step budget. Host wall-clock only, no device reads."""
+        import time
+
+        from ... import telemetry
+
+        it = iter(base)
+        n = 0
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            n += 1
+            telemetry.record_phase("data_wait",
+                                   time.perf_counter() - t0,
+                                   stream="dataloader", step=n)
+            yield batch
 
     def _iter_threads(self):
         with concurrent.futures.ThreadPoolExecutor(

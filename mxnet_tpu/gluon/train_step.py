@@ -52,6 +52,7 @@ data-dependent step count, so guard + scheduler forces the window to 1
 """
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 
 import jax
@@ -362,6 +363,7 @@ class CachedTrainStep:
         """One fused launch, dispatched asynchronously. Returns None if
         host-side invariants don't hold this step (caller falls back to
         the eager loop)."""
+        _t0 = time.perf_counter()  # dispatch-phase span (host work only)
         tr = self._trainer
         o = tr._optimizer
         updater = tr._updaters[0]
@@ -459,6 +461,10 @@ class CachedTrainStep:
         else:
             # no host-consumed outputs; the token still throttles dispatch
             self._stream.push(loss_vec)
+        from .. import telemetry
+        telemetry.record_phase("dispatch", time.perf_counter() - _t0,
+                               stream="fused_step",
+                               step=self._stream._dispatched)
         loss = NDArray(loss_vec)
         if self._return_outputs:
             out_nds = [NDArray(o_) for o_ in outs]

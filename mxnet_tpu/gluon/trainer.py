@@ -10,6 +10,8 @@ dist types and server-side optimizers) behaves like the reference.
 """
 from __future__ import annotations
 
+import time
+
 import jax
 
 from ..base import MXNetError
@@ -256,6 +258,7 @@ class _FusedUpdate:
         else:
             lr, wd = self._host_hypers(o)
 
+        _t0 = time.perf_counter()
         ws = tuple(params[i].data().data for i in self._indices)
         gs = tuple(params[i].grad().data for i in self._indices)
         ss = tuple(tuple(l.data for l in self._leaves(updater.states[i]))
@@ -267,6 +270,9 @@ class _FusedUpdate:
             params[i].data()._set_data(w2)
             for leaf, v in zip(self._leaves(updater.states[i]), s2):
                 leaf._set_data(v)
+        from .. import telemetry
+        telemetry.record_phase("dispatch", time.perf_counter() - _t0,
+                               stream="trainer_step")
         return True
 
     # -- deferred non-finite guard (async dispatch) ------------------------
@@ -355,6 +361,7 @@ class _FusedUpdate:
             self._t_dev = jnp.int32(base)
             self._mask_dev = jnp.uint32(0)
         lr, wd = self._host_hypers(o)
+        _t0 = time.perf_counter()
         ws = tuple(params[i].data().data for i in self._indices)
         gs = tuple(params[i].grad().data for i in self._indices)
         ss = tuple(tuple(l.data for l in self._leaves(updater.states[i]))
@@ -369,6 +376,9 @@ class _FusedUpdate:
                 leaf._set_data(v)
         self._t_dev, self._mask_dev = t_new, mask_new
         self._stream.push(mask_new, flags=mask_new)
+        from .. import telemetry
+        telemetry.record_phase("dispatch", time.perf_counter() - _t0,
+                               stream="trainer_step")
         return True
 
 

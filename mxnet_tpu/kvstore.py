@@ -51,6 +51,7 @@ from .ndarray.ndarray import NDArray
 from .ndarray import ndarray as _nd
 from . import optimizer as opt
 from . import resilience
+from . import telemetry
 from .resilience import KVStoreError
 from .membership import StaleWorkerError
 
@@ -354,10 +355,14 @@ class KVStore:
         if self._async is not None:
             import numpy as np
 
-            for k, v in zip(keys, values):
-                arr = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
-                self._async.request("init", k, arr)  # first writer wins
-                self._shadow[k] = arr
+            # one trace for the whole (possibly multi-key) init — each
+            # key's RPC is a span of it (telemetry.record_rpc both ends)
+            with telemetry.trace_scope():
+                for k, v in zip(keys, values):
+                    arr = v.asnumpy() if hasattr(v, "asnumpy") \
+                        else np.asarray(v)
+                    self._async.request("init", k, arr)  # first writer wins
+                    self._shadow[k] = arr
             return
         for k, v in zip(keys, values):
             if k in self._store:
@@ -440,15 +445,16 @@ class KVStore:
             # hogwild: this worker's contribution goes straight to the
             # server (which applies it immediately) — no collective, no
             # barrier with other workers (ref: DataHandleEx async branch)
-            for k, v in zip(keys, values):
-                merged = self._merge(v)
-                merged = self._maybe_compress(k, merged)
-                arr = merged.asnumpy()
-                self._async.request("push", k, arr)
-                if self._updater is None:
-                    # no server-side optimizer: the push IS the new
-                    # weight (replace semantics) — keep the shadow live
-                    self._shadow[k] = arr
+            with telemetry.trace_scope():
+                for k, v in zip(keys, values):
+                    merged = self._merge(v)
+                    merged = self._maybe_compress(k, merged)
+                    arr = merged.asnumpy()
+                    self._async.request("push", k, arr)
+                    if self._updater is None:
+                        # no server-side optimizer: the push IS the new
+                        # weight (replace semantics) — keep the shadow live
+                        self._shadow[k] = arr
             return
         for k, v in zip(keys, values):
             merged = self._merge(v)
@@ -491,7 +497,8 @@ class KVStore:
         """Current value of a key: from the async server in hogwild mode,
         else the local store."""
         if self._async is not None:
-            arr = self._async.request("pull", k)
+            with telemetry.trace_scope():
+                arr = self._async.request("pull", k)
             self._shadow[k] = arr  # last observed weight (restart re-seed)
             return NDArray(arr)
         if k in self._store:

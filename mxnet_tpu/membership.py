@@ -137,8 +137,13 @@ class MembershipTable:
             self._next_gen += 1
             self._members[worker_id] = MemberInfo(worker_id, gen, now)
             self._epoch += 1
+            epoch = self._epoch
+            live = len(self._live_ids_locked())
             self._cond.notify_all()
-            return gen, self._epoch, rejoin
+        self._note_view_change(epoch, live,
+                               "rejoin" if rejoin else "register",
+                               worker_id=worker_id, generation=gen)
+        return gen, epoch, rejoin
 
     def deregister(self, worker_id, generation):
         """Graceful leave: removed from the view without counting as
@@ -212,10 +217,33 @@ class MembershipTable:
             if dead:
                 self._lost_total += len(dead)
                 self._epoch += 1
+                epoch = self._epoch
+                live = len(self._live_ids_locked())
                 self._cond.notify_all()
         if dead:
             record_lost_workers(len(dead))
+            self._note_view_change(epoch, live, "reaped",
+                                   workers=[m.worker_id for m in dead])
         return [m.worker_id for m in dead]
+
+    @staticmethod
+    def _note_view_change(epoch, live, event, **fields):
+        """Publish a membership view change to the telemetry layer:
+        epoch/live-member gauges, a per-event counter, and a JSONL
+        event — outside the condition lock (the sink enqueue must never
+        serialize against barrier/reduce waiters)."""
+        from . import telemetry
+
+        telemetry.gauge("mxt_membership_epoch",
+                        "Membership view version (bumped on every "
+                        "register/death/leave).").set(epoch)
+        telemetry.gauge("mxt_membership_live_workers",
+                        "Live registered workers.").set(live)
+        telemetry.counter("mxt_membership_events_total",
+                          "Membership view changes by kind.",
+                          ("event",)).labels(event).inc()
+        telemetry.emit_event("membership", event=event, epoch=epoch,
+                             live=live, **fields)
 
     # -- views -------------------------------------------------------------
     def _live_ids_locked(self):

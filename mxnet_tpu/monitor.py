@@ -11,6 +11,16 @@ Typical use (identical to the reference)::
         mon.tic()
         mod.forward_backward(batch)
         mon.toc_print()
+
+Host-sync posture: the default statistic (mean absolute value) is
+computed ON DEVICE per tap — ``stat_helper`` performs no read — and
+``toc()`` materializes every queued device scalar with ONE stacked
+transfer per collection batch, routed through
+``profiler.record_host_sync``. The reference read each tensor back
+eagerly (one blocking round-trip per tapped tensor per batch — hundreds
+of syncs per collected step on a deep net); here a collection costs one.
+A custom ``stat_func`` may still return host values (numpy) and behaves
+exactly as before.
 """
 from __future__ import annotations
 
@@ -19,7 +29,17 @@ import re
 
 import numpy as np
 
+from .ndarray.ndarray import NDArray
+
 __all__ = ["Monitor"]
+
+
+def _default_stat(arr):
+    """mean(|x|) as a DEVICE scalar — no host transfer here; toc()
+    batches the reads."""
+    import jax.numpy as jnp
+
+    return NDArray(jnp.abs(arr.data).mean())
 
 
 class Monitor:
@@ -27,14 +47,13 @@ class Monitor:
 
     Parameters mirror the reference: ``interval`` (batches between
     collections), ``stat_func`` (NDArray -> scalar/ndarray; default
-    mean(|x|)), ``pattern`` (regex on tap names), ``sort`` (sort taps by
-    name in toc output).
+    mean(|x|), computed on device), ``pattern`` (regex on tap names),
+    ``sort`` (sort taps by name in toc output).
     """
 
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
         if stat_func is None:
-            def stat_func(arr):
-                return np.abs(arr.asnumpy()).mean()
+            stat_func = _default_stat
         self.interval = int(interval)
         self.stat_func = stat_func
         self.re_prog = re.compile(pattern)
@@ -61,13 +80,38 @@ class Monitor:
             self.queue = []
             self.activated = True
 
+    def _materialize(self, queued):
+        """Resolve queued stats to host values with at most ONE device
+        read for every deferred scalar the default stat produced (plus
+        one per non-scalar custom stat)."""
+        from . import profiler
+
+        dev_idx = [i for i, (_, _, s) in enumerate(queued)
+                   if isinstance(s, NDArray) and
+                   getattr(s.data, "ndim", None) == 0]
+        out = list(queued)
+        if dev_idx:
+            import jax.numpy as jnp
+
+            stacked = jnp.stack([queued[i][2].data for i in dev_idx])
+            profiler.record_host_sync()
+            host = np.asarray(stacked)  # sync-ok: ONE batched read per tap batch
+            for j, i in enumerate(dev_idx):
+                step, name, _ = queued[i]
+                out[i] = (step, name, host[j])
+        for i, (step, name, s) in enumerate(out):
+            if isinstance(s, NDArray):  # non-scalar custom stat
+                # asnumpy records its own host_sync tick
+                out[i] = (step, name, s.asnumpy())  # sync-ok: custom non-scalar stat
+        return out
+
     def toc(self):
         """End collection; returns [(step, tap_name, stat), ...]."""
         if not self.activated:
             self.step += 1
             return []
         self.activated = False
-        res = list(self.queue)
+        res = self._materialize(self.queue)
         self.queue = []
         if self.sort:
             res.sort(key=lambda x: x[1])

@@ -572,7 +572,9 @@ def waitall():
     engine's in-flight step window first — deferred guard flags and their
     bookkeeping (update counts, loss-scale, skipped-step counter) land
     before this returns, so tests and chaos_matrix.sh can rely on it as
-    a barrier — then blocks on XLA's effects barrier."""
+    a barrier — then blocks on XLA's effects barrier. Also flushes the
+    telemetry JSONL sink: everything observed up to the barrier is on
+    disk when this returns."""
     from .. import engine
 
     engine.wait_all()
@@ -580,6 +582,9 @@ def waitall():
         jax.effects_barrier()
     except Exception:
         pass
+    from .. import telemetry
+
+    telemetry.flush()
 
 
 # --------------------------------------------------------------------------

@@ -41,24 +41,123 @@ _paused = False
 _trace_dir = None
 # aggregate table: name -> [count, total_sec, min_sec, max_sec]
 _agg = {}
-_counters = {}
-# compiled-program executions dispatched by the framework since the last
-# reset: every apply_op invoke, every backward vjp call, and every fused
-# jit step (trainer/_FusedUpdate, gluon CachedTrainStep, ShardedTrainStep,
-# Module's fused update) bumps this — ONE slot of mutable state so the hot
-# paths can increment without a function call into this module
-_launch_count = [0]
-# device->host reads performed by the framework (asnumpy/wait_to_read/
-# float() on device values, and the async engine's deferred flag reads):
-# each is a full tunnel round-trip, so host_syncs/step is the headline
-# async-dispatch health signal (a K-deep window should show <= 1/K)
-_host_syncs = [0]
-_gauges = {}
-# counters/gauges are bumped both from the dispatch thread and from
-# deferred-read callbacks (engine.StepStream retirement, DataLoader
-# workers), so every mutation goes through one lock — `x[0] += 1` is
-# three bytecodes and NOT atomic across threads
+# _LOCK guards _agg and the counter/gauge name maps below; the metric
+# VALUES themselves live in the telemetry registry (telemetry.py), whose
+# cells carry their own locks — counters/gauges are bumped both from the
+# dispatch thread and from deferred-read callbacks (engine.StepStream
+# retirement, DataLoader workers), so every mutation must be guarded
 _LOCK = threading.RLock()
+
+# raw profiler name -> sanitized telemetry metric name. The profiler's
+# counter/gauge storage moved into the typed telemetry registry; these
+# maps track which registry families the profiler owns so dumps() lists
+# them and dumps(reset=True) unregisters exactly them.
+_counter_names = {}
+_gauge_names = {}
+
+_MISSING = object()
+
+
+def _telemetry():
+    from . import telemetry
+
+    return telemetry
+
+
+class _MetricsView:
+    """Live read-only mapping over the profiler-owned slice of the
+    telemetry registry — back-compat for code that treated the old
+    ``_counters``/``_gauges`` dicts as the source of truth (membership's
+    and resilience's `name not in profiler._counters` recreation
+    checks)."""
+
+    def __init__(self, names):
+        self._names = names
+
+    def get(self, name, default=None):
+        metric = self._names.get(name)
+        if metric is None:
+            return default
+        fam = _telemetry().registry().get(metric)
+        if fam is None:
+            return default
+        v = fam.value
+        return int(v) if float(v).is_integer() else v
+
+    def __contains__(self, name):
+        return self.get(name, _MISSING) is not _MISSING
+
+    def __getitem__(self, name):
+        v = self.get(name, _MISSING)
+        if v is _MISSING:
+            raise KeyError(name)
+        return v
+
+    def __iter__(self):
+        return iter(list(self._names))
+
+    def __len__(self):
+        return len(self._names)
+
+    def clear(self):
+        reg = _telemetry().registry()
+        with _LOCK:
+            for metric in self._names.values():
+                reg.unregister(metric)
+            self._names.clear()
+
+
+_counters = _MetricsView(_counter_names)
+_gauges = _MetricsView(_gauge_names)
+
+
+def _counter_child(name):
+    """The registry cell behind a profiler counter (created on demand)."""
+    tel = _telemetry()
+    with _LOCK:
+        metric = _counter_names.get(name)
+        if metric is None:
+            metric = _counter_names[name] = tel.sanitize_metric_name(name)
+    return tel.registry().counter(
+        metric, "profiler counter %r" % name).default
+
+
+def _gauge_child(name):
+    tel = _telemetry()
+    with _LOCK:
+        metric = _gauge_names.get(name)
+        if metric is None:
+            metric = _gauge_names[name] = tel.sanitize_metric_name(name)
+    return tel.registry().gauge(
+        metric, "profiler gauge %r" % name).default
+
+
+# hot-path cells cached so record_launch/record_host_sync stay one lock
+# + one add (they run on every compiled dispatch / every deferred read)
+_launch_cell = None
+_sync_cell = None
+
+
+def _launch():
+    global _launch_cell
+    c = _launch_cell
+    if c is None:
+        c = _launch_cell = _telemetry().counter(
+            "mxt_xla_launches_total",
+            "Compiled-program executions (XLA launches) dispatched by "
+            "the framework.").default
+    return c
+
+
+def _syncs():
+    global _sync_cell
+    c = _sync_cell
+    if c is None:
+        c = _sync_cell = _telemetry().counter(
+            "mxt_host_syncs_total",
+            "Device->host synchronizations (blocking reads) performed "
+            "by the framework.").default
+    return c
 
 
 def record_launch(n=1):
@@ -67,43 +166,35 @@ def record_launch(n=1):
     costs ~3.4 ms on the axon tunnel (PERF.md §1.2), so this counter is
     the cheapest fusion-health signal: a fused train step should show
     exactly 1 per step."""
-    with _LOCK:
-        _launch_count[0] += n
+    _launch().inc(n)
 
 
 def launch_count():
-    return _launch_count[0]
+    return int(_launch().value)
 
 
 def reset_launch_count():
-    with _LOCK:
-        prev = _launch_count[0]
-        _launch_count[0] = 0
-    return prev
+    return int(_launch().reset())
 
 
 def record_host_sync(n=1):
     """Count ``n`` device->host synchronizations (blocking reads)."""
-    with _LOCK:
-        _host_syncs[0] += n
+    _syncs().inc(n)
 
 
 def host_sync_count():
-    return _host_syncs[0]
+    return int(_syncs().value)
 
 
 def reset_host_sync_count():
-    with _LOCK:
-        prev = _host_syncs[0]
-        _host_syncs[0] = 0
-    return prev
+    return int(_syncs().reset())
 
 
 def set_gauge(name, value):
     """Set a point-in-time gauge (e.g. engine's 'dispatch_depth' — the
-    number of fused steps currently in flight). Gauges show in dumps()."""
-    with _LOCK:
-        _gauges[name] = value
+    number of fused steps currently in flight). Gauges show in dumps()
+    and in telemetry.render_prometheus()."""
+    _gauge_child(name).set(value)
 
 
 def gauge_value(name, default=0):
@@ -186,27 +277,33 @@ def dump(finished=True):
 def dumps(reset=False):
     """Aggregate-stats table of user scopes (ref: MXAggregateProfileStatsPrint
     — device-op aggregates live in the Perfetto trace; this table covers
-    profiler.Task/Frame scopes and counters)."""
+    profiler.Task/Frame scopes and counters). Everything is snapshotted
+    under the lock BEFORE formatting — writer threads (deferred-read
+    callbacks, server connections) keep mutating while this renders."""
+    with _LOCK:
+        agg = {name: list(ent) for name, ent in _agg.items()}
+    counters = {name: _counters.get(name) for name in _counters}
+    gauges = {name: _gauges.get(name) for name in _gauges}
     lines = ["Profile Statistics:",
              "    %-24s %10s %14s %14s %14s"
              % ("Name", "Calls", "Total(ms)", "Min(ms)", "Max(ms)")]
-    for name in sorted(_agg):
-        cnt, tot, mn, mx = _agg[name]
+    for name in sorted(agg):
+        cnt, tot, mn, mx = agg[name]
         lines.append("    %-24s %10d %14.3f %14.3f %14.3f"
                      % (name, cnt, tot * 1e3, mn * 1e3, mx * 1e3))
-    for name in sorted(_counters):
-        lines.append("    %-24s value=%s" % (name, _counters[name]))
-    for name in sorted(_gauges):
-        lines.append("    %-24s value=%s" % (name, _gauges[name]))
-    lines.append("    %-24s value=%d" % ("xla_launches", _launch_count[0]))
-    lines.append("    %-24s value=%d" % ("host_syncs", _host_syncs[0]))
+    for name in sorted(counters):
+        lines.append("    %-24s value=%s" % (name, counters[name]))
+    for name in sorted(gauges):
+        lines.append("    %-24s value=%s" % (name, gauges[name]))
+    lines.append("    %-24s value=%d" % ("xla_launches", launch_count()))
+    lines.append("    %-24s value=%d" % ("host_syncs", host_sync_count()))
     if reset:
         with _LOCK:
             _agg.clear()
-            _counters.clear()
-            _gauges.clear()
-            _launch_count[0] = 0
-            _host_syncs[0] = 0
+        _counters.clear()
+        _gauges.clear()
+        reset_launch_count()
+        reset_host_sync_count()
     return "\n".join(lines)
 
 
@@ -272,19 +369,20 @@ class Frame(_Scope):
 
 
 class Counter:
-    """Named counter (ref: profiler.Counter)."""
+    """Named counter (ref: profiler.Counter). Backed by a telemetry
+    registry cell, so creation and every mutation are lock-guarded and
+    the value shows in telemetry.render_prometheus() too."""
 
     def __init__(self, domain, name, value=0):
         self.name = "%s::%s" % (domain.name, name) if domain else name
-        _counters[self.name] = value
+        self._cell = _counter_child(self.name)
+        self._cell.set(value)
 
     def set_value(self, value):
-        with _LOCK:
-            _counters[self.name] = value
+        self._cell.set(value)
 
     def increment(self, delta=1):
-        with _LOCK:
-            _counters[self.name] = _counters.get(self.name, 0) + delta
+        self._cell.inc(delta)
 
     def decrement(self, delta=1):
         self.increment(-delta)

@@ -258,6 +258,13 @@ def kv_retry(op, key, fn, reconnect=None, policy=None):
             return fn()
         except (ConnectionError, OSError) as e:
             attempt += 1
+            from . import telemetry
+
+            telemetry.counter(
+                "mxt_kvstore_retry_total",
+                "KVStore network-op retry attempts (connection-shaped "
+                "failures riding the backoff policy).",
+                ("op",)).labels(str(op)).inc()
             if attempt > policy.retries:
                 raise KVStoreError(
                     "kvstore %s(%r) failed after %d retries: %s"
@@ -435,6 +442,7 @@ class CheckpointManager:
         from . import engine
 
         engine.wait_all()
+        _save_t0 = time.perf_counter()
         inj = _fault()
         tag = self._tag(step)
         files = {}
@@ -480,6 +488,15 @@ class CheckpointManager:
         _dir_fsync(self.directory)
         inj.crash_point("rotate")
         self._rotate()
+        from . import telemetry
+
+        dt = time.perf_counter() - _save_t0
+        telemetry.histogram(
+            "mxt_checkpoint_save_seconds",
+            "Atomic checkpoint publish duration (payloads + manifest + "
+            "rotation; excludes the window drain).").observe(dt)
+        telemetry.emit_event("checkpoint_save", tag=tag, step=int(step),
+                             epoch=int(epoch), seconds=round(dt, 6))
         return manifest
 
     def _rotate(self):
@@ -555,6 +572,7 @@ class CheckpointManager:
         from . import engine
 
         engine.wait_all()
+        _restore_t0 = time.perf_counter()
         entries = self.checkpoints()
         if not entries:
             return None
@@ -578,6 +596,16 @@ class CheckpointManager:
             from . import random as _random
 
             _random.set_state(meta["prng"])
+        from . import telemetry
+
+        dt = time.perf_counter() - _restore_t0
+        telemetry.histogram(
+            "mxt_checkpoint_restore_seconds",
+            "Checkpoint validate + restore duration (params, optimizer "
+            "state, loss-scale, PRNG).").observe(dt)
+        telemetry.emit_event("checkpoint_restore", tag=tag,
+                             step=meta["step"], epoch=meta["epoch"],
+                             seconds=round(dt, 6))
         return ResumeState(epoch=meta["epoch"], step=meta["step"],
                            extra=meta.get("extra"), tag=tag,
                            manifest=manifest)

@@ -1,0 +1,825 @@
+"""Runtime telemetry — typed metrics registry, step-phase spans,
+distributed RPC tracing, and live export.
+
+PRs 1-4 made the hot path *opaque by design*: one fused XLA launch per
+step, a K-deep in-flight dispatch window, deferred host reads, and a
+membership/KVStore layer that retries, fences, and renormalizes silently.
+Understanding fused/compiled execution requires deliberate
+instrumentation of launch behavior and phase timing ("Operator Fusion in
+XLA: Analysis and Evaluation", PAPERS.md §fusion) — a handful of ad-hoc
+scalar counters cannot answer "where did this step's time go" or "which
+worker's RPC is slow". This module is the machine-readable layer under
+``mx.profiler``:
+
+1. **Typed metrics registry.** :class:`Counter` / :class:`Gauge` /
+   :class:`Histogram` families with labels, created through one
+   :class:`MetricsRegistry` (name-deduplicated, type-checked). Histograms
+   use fixed log-scale buckets, are lock-guarded (observations arrive
+   from the dispatch thread, deferred-read callbacks, and server
+   connection threads), and are mergeable across instances. The old
+   ``profiler._counters``/``_gauges`` dicts are now live views over this
+   registry — ``profiler.counter_value``/``set_gauge`` keep working as
+   shims.
+
+2. **Step-phase spans.** The fused train paths record a per-step
+   timeline — ``data_wait`` (DataLoader), ``dispatch`` (host work to
+   launch the fused program), ``in_flight``/``retire`` (engine.StepStream
+   token retirement) — as phase histograms plus optional JSONL span
+   events. Retirement latency is measured from the timestamps the engine
+   already keeps and lands inside the existing PendingValue
+   materialization, so telemetry adds ZERO host syncs to the hot path
+   (enforced statically by tools/check_host_syncs.py, which scans this
+   module too).
+
+3. **Distributed RPC tracing.** :func:`trace_scope` installs an ambient
+   ``trace_id``; every async-server frame carries
+   ``(trace_id, span_id, attempt)`` so a KVStore push/pull, membership
+   heartbeat/register, or elastic rendezvous is correlatable end-to-end.
+   Both sides record per-op latency/bytes/retry/fence metrics through
+   :func:`record_rpc` and append to a bounded in-memory span log
+   (:func:`rpc_spans`) plus the JSONL sink.
+
+4. **Export.** ``MXT_TELEMETRY_JSONL=path`` activates a buffered
+   JSONL event/metric sink (writer thread; ``flush()`` is called by
+   ``nd.waitall()`` and the estimator at epoch end).
+   :func:`render_prometheus` produces the text exposition format and
+   ``MXT_TELEMETRY_PORT`` serves it from a stdlib HTTP endpoint
+   (loopback-only — the async-server threat model applies to anything
+   that listens). ``tools/mxt_top.py`` tails either and renders a live
+   console.
+"""
+from __future__ import annotations
+
+import bisect
+import collections
+import json
+import os
+import queue
+import re
+import threading
+import time
+
+from .base import MXNetError
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
+    "counter", "gauge", "histogram", "render_prometheus",
+    "emit_event", "flush", "jsonl_path",
+    "record_phase", "record_dispatch", "record_step_retired",
+    "trace_scope", "current_trace_id", "new_trace_id", "new_span_id",
+    "record_rpc", "rpc_spans", "clear_rpc_spans",
+    "start_http_server", "http_port", "histogram_quantile",
+    "sanitize_metric_name",
+]
+
+
+# --------------------------------------------------------------------------
+# metric families
+# --------------------------------------------------------------------------
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_metric_name(name):
+    """Coerce an arbitrary string (e.g. a profiler counter name) into a
+    valid Prometheus metric name."""
+    name = _NAME_RE.sub("_", str(name))
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _fmt(v):
+    """Numeric rendering: integral values print without a decimal point
+    so counters read naturally ('value=3', not 'value=3.0')."""
+    s = "%.10g" % v
+    return s
+
+
+class _ScalarChild:
+    """One (labelset, value) cell of a Counter/Gauge family."""
+
+    __slots__ = ("_lock", "_v")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._v = 0.0
+
+    def inc(self, n=1):
+        with self._lock:
+            self._v += n
+
+    def dec(self, n=1):
+        self.inc(-n)
+
+    def set(self, v):
+        with self._lock:
+            self._v = v
+
+    def reset(self):
+        """Zero the cell; returns the previous value (the profiler's
+        reset_*_count shims ride this)."""
+        with self._lock:
+            prev, self._v = self._v, 0.0
+        return prev
+
+    @property
+    def value(self):
+        return self._v
+
+    def merge(self, other):
+        self.inc(other.value)
+
+
+class _HistChild:
+    """One labelset's bucket state: counts per bucket (+Inf last), sum,
+    total count. Lock-guarded — observations arrive from many threads."""
+
+    __slots__ = ("_lock", "_bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds):
+        self._lock = threading.Lock()
+        self._bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v):
+        i = bisect.bisect_left(self._bounds, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return {"buckets": tuple(self._bounds),
+                    "counts": list(self.counts),
+                    "sum": self.sum, "count": self.count}
+
+    def merge(self, other):
+        """Fold another child (or snapshot dict) with IDENTICAL buckets
+        into this one — the cross-instance aggregation primitive."""
+        snap = other.snapshot() if hasattr(other, "snapshot") else other
+        if tuple(snap["buckets"]) != tuple(self._bounds):
+            raise MXNetError(
+                "cannot merge histograms with different buckets")
+        with self._lock:
+            for i, c in enumerate(snap["counts"]):
+                self.counts[i] += c
+            self.sum += snap["sum"]
+            self.count += snap["count"]
+
+    def quantile(self, q):
+        return histogram_quantile(q, self._bounds, list(self.counts))
+
+
+def histogram_quantile(q, bounds, counts):
+    """Approximate quantile from per-bucket counts (``counts`` has one
+    extra +Inf cell). Returns the upper bound of the bucket the rank
+    falls in (log-scale buckets make this a <=4x estimate)."""
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= rank and c:
+            if i < len(bounds):
+                return bounds[i]
+            return bounds[-1] if bounds else 0.0
+    return bounds[-1] if bounds else 0.0
+
+
+class _Family:
+    """A named metric with a fixed label schema; children are
+    deduplicated per label-values tuple."""
+
+    kind = None
+
+    def __init__(self, name, help="", labelnames=()):
+        self.name = sanitize_metric_name(name)
+        self.help = str(help)
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children = {}
+        self._default = None
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def labels(self, *values, **kv):
+        """The child for one label-values set (created on first use,
+        the SAME object on every later call — label dedup)."""
+        if kv:
+            if values:
+                raise MXNetError("pass labels positionally or by name, "
+                                 "not both")
+            try:
+                values = tuple(str(kv.pop(k)) for k in self.labelnames)
+            except KeyError as e:
+                raise MXNetError("metric %s is missing label %s"
+                                 % (self.name, e)) from e
+            if kv:
+                raise MXNetError("metric %s has no label(s) %s"
+                                 % (self.name, sorted(kv)))
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise MXNetError(
+                "metric %s takes labels %s, got %d value(s)"
+                % (self.name, self.labelnames, len(values)))
+        with self._lock:
+            ch = self._children.get(values)
+            if ch is None:
+                ch = self._children[values] = self._make_child()
+            return ch
+
+    @property
+    def default(self):
+        """The no-labels child (only valid for an unlabeled family)."""
+        ch = self._default
+        if ch is None:
+            ch = self._default = self.labels()
+        return ch
+
+    def children(self):
+        with self._lock:
+            return dict(self._children)
+
+
+class Counter(_Family):
+    """Monotonically increasing count (``reset()`` exists only for the
+    profiler shims' reset semantics)."""
+
+    kind = "counter"
+
+    def _make_child(self):
+        return _ScalarChild()
+
+    def inc(self, n=1):
+        self.default.inc(n)
+
+    def reset(self):
+        return self.default.reset()
+
+    @property
+    def value(self):
+        return self.default.value
+
+
+class Gauge(_Family):
+    """Point-in-time value."""
+
+    kind = "gauge"
+
+    def _make_child(self):
+        return _ScalarChild()
+
+    def set(self, v):
+        self.default.set(v)
+
+    def inc(self, n=1):
+        self.default.inc(n)
+
+    def dec(self, n=1):
+        self.default.dec(n)
+
+    @property
+    def value(self):
+        return self.default.value
+
+
+# log-scale bounds covering 1 microsecond .. ~18 minutes in x4 steps —
+# wide enough for a host-side phase (~us), a fused step (~ms), an axon
+# tunnel RPC (~100ms), and a checkpoint/epoch (~minutes)
+DEFAULT_BUCKETS = tuple(1e-6 * 4.0 ** i for i in range(16))
+
+
+class Histogram(_Family):
+    """Fixed-bucket (log-scale by default) distribution."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(),
+                 buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        bounds = tuple(sorted(set(buckets)))
+        if not bounds:
+            raise MXNetError("histogram %s needs at least one bucket "
+                             "bound" % self.name)
+        self.buckets = bounds
+
+    def _make_child(self):
+        return _HistChild(self.buckets)
+
+    def observe(self, v):
+        self.default.observe(v)
+
+    def merge(self, other):
+        """Fold another family's children into this one (same buckets,
+        matching label schema)."""
+        if getattr(other, "buckets", None) != self.buckets:
+            raise MXNetError(
+                "cannot merge histograms with different buckets")
+        for values, child in other.children().items():
+            self.labels(*values).merge(child)
+
+    def snapshot(self):
+        return self.default.snapshot()
+
+    def quantile(self, q):
+        return self.default.quantile(q)
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    """Name-keyed collection of metric families. ``counter/gauge/
+    histogram`` are get-or-create: the same name returns the SAME family
+    (a kind or label-schema mismatch is a hard error, not a silent
+    second metric)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get_or_create(self, cls, name, help, labelnames, **kw):
+        name = sanitize_metric_name(name)
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if type(m) is not cls:
+                    raise MXNetError(
+                        "telemetry metric %r is already registered as a "
+                        "%s, not a %s" % (name, m.kind, cls.kind))
+                if m.labelnames != tuple(labelnames):
+                    raise MXNetError(
+                        "telemetry metric %r is already registered with "
+                        "labels %s" % (name, m.labelnames))
+                if kw.get("buckets") is not None and \
+                        tuple(sorted(set(kw["buckets"]))) != m.buckets:
+                    raise MXNetError(
+                        "telemetry histogram %r is already registered "
+                        "with different buckets" % name)
+                return m
+            m = cls(name, help, labelnames, **{k: v for k, v in kw.items()
+                                               if v is not None})
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help="", labelnames=()):
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()):
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(), buckets=None):
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name, default=None):
+        with self._lock:
+            return self._metrics.get(sanitize_metric_name(name), default)
+
+    def unregister(self, name):
+        """Drop a family (the profiler's dumps(reset=True) shim)."""
+        with self._lock:
+            self._metrics.pop(sanitize_metric_name(name), None)
+
+    def collect(self):
+        """[(family, {labelvalues: child})] sorted by name — one
+        consistent snapshot of the family LIST (children snapshot
+        individually under their own locks)."""
+        with self._lock:
+            fams = sorted(self._metrics.values(), key=lambda m: m.name)
+        return [(m, m.children()) for m in fams]
+
+    def snapshot_values(self):
+        """Compact {name: value | {'count','sum'}} dict (the JSONL
+        metrics row)."""
+        out = {}
+        for fam, children in self.collect():
+            for values, ch in sorted(children.items()):
+                key = fam.name if not values else \
+                    "%s{%s}" % (fam.name, ",".join(
+                        "%s=%s" % kv for kv in zip(fam.labelnames, values)))
+                if fam.kind == "histogram":
+                    snap = ch.snapshot()
+                    out[key] = {"count": snap["count"],
+                                "sum": round(snap["sum"], 9)}
+                else:
+                    out[key] = ch.value
+        return out
+
+    def render_prometheus(self):
+        """Text exposition format (the /metrics payload)."""
+        lines = []
+        for fam, children in self.collect():
+            if fam.help:
+                lines.append("# HELP %s %s"
+                             % (fam.name, fam.help.replace("\n", " ")))
+            lines.append("# TYPE %s %s" % (fam.name, fam.kind))
+            for values, ch in sorted(children.items()):
+                base = _label_str(fam.labelnames, values)
+                if fam.kind == "histogram":
+                    snap = ch.snapshot()
+                    cum = 0
+                    for bound, c in zip(snap["buckets"], snap["counts"]):
+                        cum += c
+                        lines.append("%s_bucket%s %d" % (
+                            fam.name,
+                            _label_str(fam.labelnames + ("le",),
+                                       values + (_fmt(bound),)), cum))
+                    lines.append("%s_bucket%s %d" % (
+                        fam.name,
+                        _label_str(fam.labelnames + ("le",),
+                                   values + ("+Inf",)), snap["count"]))
+                    lines.append("%s_sum%s %s" % (fam.name, base,
+                                                  _fmt(snap["sum"])))
+                    lines.append("%s_count%s %d" % (fam.name, base,
+                                                    snap["count"]))
+                else:
+                    lines.append("%s%s %s" % (fam.name, base,
+                                              _fmt(ch.value)))
+        return "\n".join(lines) + "\n"
+
+
+def _label_str(names, values):
+    if not names:
+        return ""
+    esc = [str(v).replace("\\", "\\\\").replace('"', '\\"')
+           .replace("\n", "\\n") for v in values]
+    return "{%s}" % ",".join('%s="%s"' % (n, v)
+                             for n, v in zip(names, esc))
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry():
+    """The process-default registry (what render_prometheus and the
+    profiler shims use)."""
+    return _REGISTRY
+
+
+def counter(name, help="", labelnames=()):
+    return _REGISTRY.counter(name, help, labelnames)
+
+
+def gauge(name, help="", labelnames=()):
+    return _REGISTRY.gauge(name, help, labelnames)
+
+
+def histogram(name, help="", labelnames=(), buckets=None):
+    return _REGISTRY.histogram(name, help, labelnames, buckets=buckets)
+
+
+def render_prometheus():
+    return _REGISTRY.render_prometheus()
+
+
+# --------------------------------------------------------------------------
+# JSONL event sink
+# --------------------------------------------------------------------------
+_STOP = object()
+
+
+class JsonlSink:
+    """Buffered JSONL writer: ``emit`` enqueues (never blocks the hot
+    path — overflow drops and counts), a daemon thread writes, and
+    ``flush`` round-trips a marker through the queue so everything
+    enqueued before it is durably on disk."""
+
+    def __init__(self, path):
+        self.path = path
+        self._q = queue.Queue(maxsize=100000)
+        self.dropped = 0
+        self._file = open(path, "a")
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="mxt-telemetry-jsonl")
+        self._thread.start()
+
+    def emit(self, row):
+        try:
+            self._q.put_nowait(row)
+        except queue.Full:
+            self.dropped += 1
+
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                self._file.flush()
+                return
+            if isinstance(item, threading.Event):
+                self._file.flush()
+                item.set()
+                continue
+            try:
+                self._file.write(json.dumps(item) + "\n")
+            except (TypeError, ValueError):
+                self.dropped += 1  # non-serializable row: drop, keep going
+
+    def flush(self, timeout=10.0):
+        """Block until every row enqueued before this call is written
+        and the file is flushed."""
+        if not self._thread.is_alive():
+            return
+        ev = threading.Event()
+        self._q.put(ev)
+        ev.wait(timeout)
+
+    def close(self):
+        self._q.put(_STOP)
+        self._thread.join(timeout=10.0)
+        try:
+            self._file.close()
+        except OSError:
+            pass
+
+
+_sink_lock = threading.Lock()
+_sink = None
+_sink_path = None
+
+
+def _active_sink():
+    """The JSONL sink for the CURRENT ``MXT_TELEMETRY_JSONL`` value —
+    re-reading the config each call keeps tests (monkeypatched env) and
+    long-lived processes honest; path changes swap the sink."""
+    global _sink, _sink_path
+    from . import config
+
+    path = config.get("MXT_TELEMETRY_JSONL")
+    if path == _sink_path:
+        return _sink
+    with _sink_lock:
+        if path != _sink_path:
+            old, _sink, _sink_path = _sink, None, path
+            if old is not None:
+                old.close()
+            if path:
+                _sink = JsonlSink(path)
+    return _sink
+
+
+def jsonl_path():
+    s = _active_sink()
+    return s.path if s is not None else None
+
+
+def emit_event(kind, **fields):
+    """Queue one JSONL event row (no-op without an active sink)."""
+    s = _active_sink()
+    if s is None:
+        return
+    row = {"ts": round(time.time(), 6), "kind": str(kind)}
+    row.update(fields)
+    s.emit(row)
+
+
+def flush(write_metrics=False):
+    """Flush the JSONL sink (called by ``nd.waitall()`` and the
+    estimator at epoch end). ``write_metrics=True`` also appends one
+    compact metrics-snapshot row before flushing."""
+    s = _active_sink()
+    if s is None:
+        return
+    if write_metrics:
+        s.emit({"ts": round(time.time(), 6), "kind": "metrics",
+                "data": _REGISTRY.snapshot_values()})
+    s.flush()
+
+
+# --------------------------------------------------------------------------
+# step-phase spans
+# --------------------------------------------------------------------------
+_phase_hist = None
+_latency_hist = None
+_depth_hist = None
+
+
+def record_phase(phase, seconds, stream=None, step=None):
+    """One step-phase observation: ``data_wait`` / ``dispatch`` /
+    ``in_flight`` / ``retire``. Lands in the
+    ``mxt_step_phase_seconds{phase=}`` histogram and (sink active) a
+    JSONL span event. Host-side wall clock only — never a device read."""
+    global _phase_hist
+    h = _phase_hist
+    if h is None:
+        h = _phase_hist = histogram(
+            "mxt_step_phase_seconds",
+            "Per-step phase timing: data_wait -> dispatch -> in_flight "
+            "-> retire.", ("phase",))
+    h.labels(phase).observe(seconds)
+    if _active_sink() is not None:
+        emit_event("span", name=str(phase), stream=stream, step=step,
+                   seconds=round(seconds, 9))
+
+
+def record_dispatch(stream, step, depth):
+    """Dispatch-depth occupancy at the moment a fused step was pushed
+    into the engine window."""
+    global _depth_hist
+    h = _depth_hist
+    if h is None:
+        h = _depth_hist = histogram(
+            "mxt_dispatch_depth_occupancy",
+            "In-flight fused steps at each dispatch (window occupancy).",
+            buckets=tuple(range(1, 17)))
+    h.observe(depth)
+    if _active_sink() is not None:
+        emit_event("span", name="dispatch", stream=stream, step=step,
+                   depth=depth)
+
+
+def record_step_retired(stream, step, latency_s):
+    """One fused step observed on host: dispatch->retire latency,
+    measured inside the engine's EXISTING deferred read (zero new
+    syncs). Exactly one of these per dispatched step."""
+    global _latency_hist
+    h = _latency_hist
+    if h is None:
+        h = _latency_hist = histogram(
+            "mxt_step_latency_seconds",
+            "Fused-step dispatch->retire latency (how long a step rode "
+            "the in-flight window).", ("stream",))
+    h.labels(stream).observe(latency_s)
+    record_phase("in_flight", latency_s, stream=stream, step=step)
+    if _active_sink() is not None:
+        emit_event("span", name="retire", stream=stream, step=step,
+                   latency_s=round(latency_s, 9))
+
+
+# --------------------------------------------------------------------------
+# distributed RPC tracing
+# --------------------------------------------------------------------------
+_trace = threading.local()
+
+
+def new_trace_id():
+    return os.urandom(8).hex()
+
+
+def new_span_id():
+    return os.urandom(4).hex()
+
+
+def current_trace_id():
+    return getattr(_trace, "tid", None)
+
+
+class trace_scope:
+    """Install an ambient trace id for the current thread; every
+    AsyncClient frame sent inside the scope carries it. Nested scopes
+    keep the outer id unless an explicit one is given — so one logical
+    op (a multi-key push) is one trace."""
+
+    def __init__(self, trace_id=None):
+        self._explicit = trace_id
+
+    def __enter__(self):
+        self._prev = current_trace_id()
+        tid = self._explicit or self._prev or new_trace_id()
+        _trace.tid = tid
+        return tid
+
+    def __exit__(self, *exc):
+        _trace.tid = self._prev
+        return False
+
+
+_RPC_SPAN_LOG = collections.deque(maxlen=1024)
+_rpc_hist = None
+_rpc_bytes = None
+_rpc_total = None
+_rpc_retries = None
+_rpc_fenced = None
+
+
+def record_rpc(side, op, seconds=None, nbytes=None, status="ok",
+               trace=None, key=None):
+    """One RPC observation from either endpoint. ``trace`` is the
+    ``(trace_id, span_id, attempt)`` tuple riding the frame (or None for
+    an untraced peer). Feeds the per-op latency/bytes/total/retry/fence
+    metrics, the bounded in-memory span log, and the JSONL sink."""
+    global _rpc_hist, _rpc_bytes, _rpc_total, _rpc_retries, _rpc_fenced
+    if _rpc_hist is None:
+        _rpc_hist = histogram(
+            "mxt_kvstore_rpc_latency_seconds",
+            "KVStore/membership RPC latency per op.", ("side", "op"))
+        _rpc_bytes = histogram(
+            "mxt_kvstore_rpc_bytes",
+            "KVStore/membership RPC payload bytes per op.",
+            ("side", "op"),
+            buckets=tuple(4.0 ** i for i in range(2, 16)))
+        _rpc_total = counter(
+            "mxt_kvstore_rpc_total",
+            "KVStore/membership RPCs by op and reply status.",
+            ("side", "op", "status"))
+        _rpc_retries = counter(
+            "mxt_kvstore_rpc_retries_total",
+            "RPC frames that were retry attempts (attempt > 0).",
+            ("side", "op"))
+        _rpc_fenced = counter(
+            "mxt_kvstore_fenced_frames_total",
+            "Frames refused by stale-worker fencing.", ("op",))
+    op = str(op)
+    side = str(side)
+    status = str(status)
+    if seconds is not None:
+        _rpc_hist.labels(side, op).observe(seconds)
+    if nbytes:
+        _rpc_bytes.labels(side, op).observe(nbytes)
+    _rpc_total.labels(side, op, status).inc()
+    trace_id, span_id, attempt = (trace or (None, None, 0))
+    if attempt:
+        _rpc_retries.labels(side, op).inc()
+    if status == "stale" and side == "server":
+        _rpc_fenced.labels(op).inc()
+    entry = {"ts": round(time.time(), 6), "side": side, "op": op,
+             "key": key, "status": status, "trace_id": trace_id,
+             "span_id": span_id, "attempt": attempt,
+             "latency_s": None if seconds is None else round(seconds, 9),
+             "bytes": nbytes}
+    _RPC_SPAN_LOG.append(entry)
+    if _active_sink() is not None:
+        s = _active_sink()
+        s.emit(dict(entry, kind="rpc_span"))
+
+
+def rpc_spans():
+    """The bounded in-memory RPC span log (newest last) — what the
+    trace-propagation test and mxt_top's JSONL mode read."""
+    return list(_RPC_SPAN_LOG)
+
+
+def clear_rpc_spans():
+    _RPC_SPAN_LOG.clear()
+
+
+# --------------------------------------------------------------------------
+# HTTP exposition endpoint
+# --------------------------------------------------------------------------
+_http_server = None
+_http_lock = threading.Lock()
+
+
+def start_http_server(port=None):
+    """Serve ``render_prometheus()`` on ``127.0.0.1:port`` from a daemon
+    thread (port 0 picks a free one; see :func:`http_port`). Loopback
+    only — the exposition is plain text but the listening posture
+    follows async_server.py's threat model."""
+    global _http_server
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = render_prometheus().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass  # metrics scrapes must not spam the training logs
+
+    with _http_lock:
+        if _http_server is not None:
+            return _http_server
+        if port is None:
+            from . import config
+
+            port = config.get("MXT_TELEMETRY_PORT")
+        if port is None:
+            raise MXNetError(
+                "no telemetry port: pass one or set MXT_TELEMETRY_PORT")
+        srv = ThreadingHTTPServer(("127.0.0.1", int(port)), _Handler)
+        srv.daemon_threads = True
+        threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="mxt-telemetry-http").start()
+        _http_server = srv
+    return srv
+
+
+def http_port():
+    """The bound exposition port, or None when no server is running."""
+    return None if _http_server is None else \
+        _http_server.server_address[1]
+
+
+def _maybe_autostart():
+    """Start the exposition endpoint when MXT_TELEMETRY_PORT is set
+    (called once at package import)."""
+    try:
+        from . import config
+
+        if config.get("MXT_TELEMETRY_PORT") is not None \
+                and _http_server is None:
+            start_http_server()
+    except Exception:
+        pass  # observability must never take the process down

@@ -37,12 +37,22 @@ _ALL = [
 
 # hot-path modules -> the patterns scanned there. metric.py hosts the
 # legitimate numpy fallback path (host math on already-transferred
-# arrays), so only the transfer itself is policed there.
+# arrays), so only the transfer itself is policed there; monitor.py's
+# one sanctioned read is the batched tap materialization in toc().
+# telemetry.py and the estimator event handlers run INSIDE the step/
+# epoch loops — an accidental device read there would silently undo the
+# async pipeline, so they are policed with the full pattern set.
+_TRANSFER = [r"\.asnumpy\(", r"\.asscalar\(", r"\bnp\.asarray\(",
+             r"block_until_ready"]
+
 SCAN = {
     "mxnet_tpu/engine.py": _ALL,
     "mxnet_tpu/gluon/train_step.py": _ALL,
     "mxnet_tpu/gluon/trainer.py": _ALL,
     "mxnet_tpu/ndarray/pending.py": _ALL,
+    "mxnet_tpu/telemetry.py": _ALL,
+    "mxnet_tpu/gluon/contrib/estimator.py": _ALL,
+    "mxnet_tpu/monitor.py": _TRANSFER,
     "mxnet_tpu/metric.py": [r"\.asnumpy\(", r"\.asscalar\(",
                             r"block_until_ready"],
 }

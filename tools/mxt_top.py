@@ -1,0 +1,270 @@
+#!/usr/bin/env python
+"""mxt_top — a curses-free live console over the telemetry subsystem.
+
+Tails either the Prometheus exposition endpoint
+(``MXT_TELEMETRY_PORT`` → ``--url http://127.0.0.1:PORT``) or the JSONL
+event sink (``MXT_TELEMETRY_JSONL`` → ``--jsonl path``) and renders the
+async-training health signals once per interval:
+
+    steps/s            retired fused steps (delta of step-latency count)
+    host_syncs/step    device->host reads per step (<= 1/K when healthy)
+    launches/step      compiled dispatches per step (1.0 = fully fused)
+    dispatch depth     in-flight fused steps right now
+    kv rpc p50/p99     server-side KVStore/membership RPC latency
+    workers live/lost  membership view
+    skipped steps      non-finite guard skips
+
+Usage::
+
+    python tools/mxt_top.py --url http://127.0.0.1:9109
+    python tools/mxt_top.py --jsonl telemetry.jsonl
+    python tools/mxt_top.py --url ... --once        # one frame, no clear
+
+Plain ANSI output (\\x1b[H\\x1b[J between frames) — works in any terminal
+and under ``watch``/``tee``; no curses, no dependencies.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+import urllib.request
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+([0-9eE+.\-]+|NaN|\+Inf)$')
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text):
+    """{(name, frozenset(label items)): value} from exposition text."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labels, value = m.groups()
+        lab = dict(_LABEL_RE.findall(labels)) if labels else {}
+        try:
+            v = float(value)
+        except ValueError:
+            v = float("inf") if value == "+Inf" else float("nan")
+        out[(name, frozenset(lab.items()))] = v
+    return out
+
+
+def metric_sum(samples, name, **match):
+    """Sum of every sample of ``name`` whose labels include ``match``."""
+    total, seen = 0.0, False
+    want = set(match.items())
+    for (n, lab), v in samples.items():
+        if n == name and want <= set(lab):
+            total += v
+            seen = True
+    return total if seen else None
+
+
+def histogram_quantiles(samples, name, qs, **match):
+    """Quantiles from ``name_bucket`` samples (cumulative counts summed
+    over every labelset matching ``match``)."""
+    want = set(match.items())
+    per_le = {}
+    for (n, lab), v in samples.items():
+        if n != name + "_bucket":
+            continue
+        lab = dict(lab)
+        le = lab.pop("le", None)
+        if le is None or not want <= set(lab.items()):
+            continue
+        bound = float("inf") if le == "+Inf" else float(le)
+        per_le[bound] = per_le.get(bound, 0.0) + v
+    if not per_le:
+        return [None] * len(qs)
+    bounds = sorted(per_le)
+    cum = [per_le[b] for b in bounds]
+    total = cum[-1]
+    if total <= 0:
+        return [None] * len(qs)
+    out = []
+    for q in qs:
+        rank = q * total
+        got = None
+        for b, c in zip(bounds, cum):
+            if c >= rank:
+                got = b if b != float("inf") else bounds[-2] \
+                    if len(bounds) > 1 else None
+                break
+        out.append(got)
+    return out
+
+
+def _fmt_s(v):
+    if v is None:
+        return "--"
+    if v < 1e-3:
+        return "%.0fus" % (v * 1e6)
+    if v < 1.0:
+        return "%.1fms" % (v * 1e3)
+    return "%.2fs" % v
+
+
+def _fmt(v, spec="%.2f"):
+    return "--" if v is None else spec % v
+
+
+class EndpointSource:
+    """Scrape --url (or MXT_TELEMETRY_PORT) once per frame."""
+
+    def __init__(self, url):
+        self.url = url if "://" in url else "http://" + url
+
+    def sample(self):
+        with urllib.request.urlopen(self.url, timeout=5) as r:
+            return parse_prometheus(r.read().decode("utf-8"))
+
+
+class JsonlSource:
+    """Tail --jsonl and rebuild the same sample dict from span/rpc/
+    metric rows (approximate: JSONL carries events, not the registry —
+    the latest 'metrics' snapshot row supplies gauge/counter values)."""
+
+    def __init__(self, path):
+        self.path = path
+        self._pos = 0
+        self._steps = 0
+        self._rpc_lat = []
+        self._metrics = {}
+
+    def sample(self):
+        try:
+            with open(self.path) as f:
+                f.seek(self._pos)
+                for line in f:
+                    self._pos = f.tell()
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue
+                    kind = row.get("kind")
+                    if kind == "span" and row.get("name") == "retire":
+                        self._steps += 1
+                    elif kind == "rpc_span" and \
+                            row.get("side") == "server" and \
+                            row.get("latency_s") is not None:
+                        self._rpc_lat.append(row["latency_s"])
+                        del self._rpc_lat[:-4096]
+                    elif kind == "metrics":
+                        self._metrics = row.get("data", {})
+        except OSError:
+            pass
+        samples = {("mxt_step_latency_seconds_count", frozenset()):
+                   float(self._steps)}
+        for key, v in self._metrics.items():
+            name = key.split("{", 1)[0]
+            if isinstance(v, dict):
+                continue
+            samples[(name, frozenset([("src", key)]))] = float(v)
+        if self._rpc_lat:
+            lat = sorted(self._rpc_lat)
+
+            def pick(q):
+                return lat[min(len(lat) - 1, int(q * len(lat)))]
+
+            samples[("_jsonl_rpc_p50", frozenset())] = pick(0.50)
+            samples[("_jsonl_rpc_p99", frozenset())] = pick(0.99)
+        return samples
+
+
+def render(samples, prev, dt):
+    def rate(name, **match):
+        cur = metric_sum(samples, name, **match)
+        old = metric_sum(prev, name, **match) if prev else None
+        if cur is None or old is None or dt <= 0:
+            return None, cur
+        return max(0.0, cur - old) / dt, cur
+
+    steps_rate, steps_total = rate("mxt_step_latency_seconds_count")
+    syncs_rate, _ = rate("mxt_host_syncs_total")
+    launch_rate, _ = rate("mxt_xla_launches_total")
+    per_step = lambda r: None if (r is None or not steps_rate) \
+        else r / steps_rate
+    depth = metric_sum(samples, "dispatch_depth")
+    p50, p99 = histogram_quantiles(
+        samples, "mxt_kvstore_rpc_latency_seconds", (0.50, 0.99),
+        side="server")
+    if p50 is None:
+        p50 = metric_sum(samples, "_jsonl_rpc_p50")
+        p99 = metric_sum(samples, "_jsonl_rpc_p99")
+    live = metric_sum(samples, "mxt_membership_live_workers")
+    lost = metric_sum(samples, "lost_workers")
+    skipped = metric_sum(samples, "skipped_nonfinite_steps")
+
+    lines = [
+        "mxt_top  %s" % time.strftime("%H:%M:%S"),
+        "-" * 46,
+        "  steps/s          %s   (total %s)"
+        % (_fmt(steps_rate), _fmt(steps_total, "%.0f")),
+        "  host_syncs/step  %s" % _fmt(per_step(syncs_rate), "%.3f"),
+        "  launches/step    %s" % _fmt(per_step(launch_rate), "%.2f"),
+        "  dispatch depth   %s" % _fmt(depth, "%.0f"),
+        "  kv rpc p50/p99   %s / %s" % (_fmt_s(p50), _fmt_s(p99)),
+        "  workers live     %s   lost %s"
+        % (_fmt(live, "%.0f"), _fmt(lost, "%.0f")),
+        "  skipped steps    %s" % _fmt(skipped, "%.0f"),
+    ]
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--url", default=None,
+                   help="Prometheus endpoint (default: "
+                        "http://127.0.0.1:$MXT_TELEMETRY_PORT)")
+    p.add_argument("--jsonl", default=None,
+                   help="tail a telemetry JSONL file instead")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (no screen clear)")
+    args = p.parse_args(argv)
+
+    if args.jsonl:
+        src = JsonlSource(args.jsonl)
+    else:
+        url = args.url
+        if url is None:
+            port = os.environ.get("MXT_TELEMETRY_PORT")
+            if not port:
+                p.error("give --url or --jsonl (or set "
+                        "MXT_TELEMETRY_PORT)")
+            url = "http://127.0.0.1:%s" % port
+        src = EndpointSource(url)
+
+    prev, t_prev = None, None
+    while True:
+        try:
+            samples = src.sample()
+        except OSError as e:
+            print("mxt_top: source unreachable: %s" % e, file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        now = time.monotonic()
+        frame = render(samples, prev, 0 if t_prev is None
+                       else now - t_prev)
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[H\x1b[J" + frame + "\n")
+        sys.stdout.flush()
+        prev, t_prev = samples, now
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
