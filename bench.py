@@ -43,7 +43,7 @@ _BUDGET = float(os.environ.get("BENCH_BUDGET", "1500"))
 # but CPU is the fallback path where the budget rarely binds
 _CONFIG_COST = {"resnet50": 420, "bert": 300, "lstm_ptb": 200,
                 "wide_deep": 200, "lenet": 150, "pipeline": 150,
-                "async_ab": 90, "telemetry_ab": 60}
+                "async_ab": 90, "telemetry_ab": 60, "cold_warm": 120}
 
 
 def _remaining():
@@ -144,11 +144,17 @@ def _timed_steps(step, x, y, iters, warmup):
     # fusion health visible per row (a fused step is exactly 1/step), and
     # the host-sync count makes ASYNC health visible: a K-deep engine
     # window shows <= 1/K framework reads per step.
-    from mxnet_tpu import profiler
+    from mxnet_tpu import profiler, tuning
 
     sync_every = int(os.environ.get("BENCH_SYNC_EVERY", "0"))  # 0 = window end
     if not sync_every and iters > 50:
         sync_every = 50  # bound the un-synced queue (tunnel-wedge guard)
+    # compile + tune-cache accounting spans warmup AND the timed window:
+    # the warmup steps are where a cold config pays its JIT, and the row
+    # must expose that cost (cold-vs-warm is invisible in step_time_ms —
+    # by the timed window everything is compiled either way)
+    c0 = tuning.compile_stats()
+    tc0 = _tune_cache_counts()
     loss = None
     for _ in range(warmup):
         loss = step(x, y)
@@ -161,17 +167,41 @@ def _timed_steps(step, x, y, iters, warmup):
         if sync_every and (i + 1) % sync_every == 0:
             loss.wait_to_read()
     loss.wait_to_read()
+    c1 = tuning.compile_stats()
+    tc1 = _tune_cache_counts()
+    extras = {
+        "compile_time_ms": round(
+            (c1["compile_seconds"] - c0["compile_seconds"]) * 1e3, 1),
+        "compiles": c1["compiles"] - c0["compiles"],
+        "tune_cache": {"hits": tc1[0] - tc0[0],
+                       "misses": tc1[1] - tc0[1]},
+    }
     return (time.perf_counter() - t0, profiler.launch_count() - l0,
-            profiler.host_sync_count() - h0)
+            profiler.host_sync_count() - h0, extras)
 
 
-def _step_stats(dt, launches, syncs, iters):
+def _tune_cache_counts():
+    """(hits, misses) of the tuning-table lookup counters."""
+    from mxnet_tpu import telemetry
+
+    reg = telemetry.registry()
+    out = []
+    for name in ("mxt_tune_cache_hits_total", "mxt_tune_cache_misses_total"):
+        fam = reg.get(name)
+        out.append(int(fam.value) if fam is not None else 0)
+    return tuple(out)
+
+
+def _step_stats(dt, launches, syncs, iters, extras=None):
     """The per-row fusion-health fields every _timed_steps config emits."""
-    return {
+    row = {
         "step_time_ms": round(dt / iters * 1e3, 3),
         "launches_per_step": round(launches / iters, 2),
         "host_syncs_per_step": round(syncs / iters, 3),
     }
+    if extras:
+        row.update(extras)
+    return row
 
 
 def _mfu(samples_per_sec, flops_per_sample, platform):
@@ -227,7 +257,7 @@ def bench_resnet50(platform, dtype, batch=None, remat="env"):
     x = x.astype(dtype)
     y = nd.array(rng.randint(0, 1000, (batch,)).astype(np.float32))
 
-    dt, launches, syncs = _timed_steps(step, x, y, iters, warmup)
+    dt, launches, syncs, extras = _timed_steps(step, x, y, iters, warmup)
     img_s = batch * iters / dt
 
     dump = os.environ.get("BENCH_DUMP_HLO")
@@ -256,7 +286,7 @@ def bench_resnet50(platform, dtype, batch=None, remat="env"):
         "images_or_tokens_per_sec_per_chip": round(img_s, 2),
         "mfu": _mfu(img_s, flops_per_img, platform), "platform": platform,
         "flops_per_sample": flops_per_img,
-        **_step_stats(dt, launches, syncs, iters),
+        **_step_stats(dt, launches, syncs, iters, extras),
     }
     _emit_jsonl(row)
     return img_s, row
@@ -357,7 +387,7 @@ def bench_bert_mlm(platform, dtype):
     else:
         sharded = step = make_sharded()
 
-    dt, launches, syncs = _timed_steps(step, x, y, iters, warmup)
+    dt, launches, syncs, extras = _timed_steps(step, x, y, iters, warmup)
     tok_s = batch * seq_len * iters / dt
 
     flops_per_tok = (sharded or make_sharded()).flops_per_step(x, y)
@@ -374,7 +404,7 @@ def bench_bert_mlm(platform, dtype):
         "images_or_tokens_per_sec_per_chip": round(tok_s, 2),
         "mfu": _mfu(tok_s, flops_per_tok, platform), "platform": platform,
         "flops_per_sample": flops_per_tok,
-        **_step_stats(dt, launches, syncs, iters),
+        **_step_stats(dt, launches, syncs, iters, extras),
     }
     _emit_jsonl(row)
     return tok_s, row
@@ -420,7 +450,7 @@ def bench_lenet_mnist(platform, dtype):
         net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
         {"learning_rate": 0.05, "momentum": 0.9})
 
-    dt, launches, syncs = _timed_steps(step, x, y, iters, warmup)
+    dt, launches, syncs, extras = _timed_steps(step, x, y, iters, warmup)
     img_s = batch * iters / dt
     flops = step.flops_per_step(x, y)
     if flops:
@@ -432,7 +462,7 @@ def bench_lenet_mnist(platform, dtype):
         "images_or_tokens_per_sec_per_chip": round(img_s, 2),
         "mfu": _mfu(img_s, flops, platform), "platform": platform,
         "flops_per_sample": flops,
-        **_step_stats(dt, launches, syncs, iters),
+        **_step_stats(dt, launches, syncs, iters, extras),
     }
     _emit_jsonl(row)
     return img_s, row
@@ -486,7 +516,7 @@ def bench_lstm_ptb(platform, dtype):
         net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
         {"learning_rate": 1.0})
 
-    dt, launches, syncs = _timed_steps(step, x, y, iters, warmup)
+    dt, launches, syncs, extras = _timed_steps(step, x, y, iters, warmup)
     tok_s = batch * seq_len * iters / dt
     flops_per_tok = step.flops_per_step(x, y)
     if flops_per_tok:
@@ -500,7 +530,7 @@ def bench_lstm_ptb(platform, dtype):
         "images_or_tokens_per_sec_per_chip": round(tok_s, 2),
         "mfu": _mfu(tok_s, flops_per_tok, platform), "platform": platform,
         "flops_per_sample": flops_per_tok,
-        **_step_stats(dt, launches, syncs, iters),
+        **_step_stats(dt, launches, syncs, iters, extras),
     }
     _emit_jsonl(row)
     return tok_s, row
@@ -559,7 +589,7 @@ def bench_wide_deep(platform, dtype):
         net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
         {"learning_rate": 1e-3})
 
-    dt, launches, syncs = _timed_steps(step, x, y, iters, warmup)
+    dt, launches, syncs, extras = _timed_steps(step, x, y, iters, warmup)
     samp_s = batch * iters / dt
     flops = step.flops_per_step(x, y)
     if flops:
@@ -579,7 +609,7 @@ def bench_wide_deep(platform, dtype):
         "mfu": _mfu(samp_s, flops, platform), "platform": platform,
         "flops_per_sample": flops,
         "embedding_bytes_per_sec": round(samp_s * emb_bytes_per_sample),
-        **_step_stats(dt, launches, syncs, iters),
+        **_step_stats(dt, launches, syncs, iters, extras),
     }
     _emit_jsonl(row)
     return samp_s, row
@@ -827,13 +857,127 @@ def bench_telemetry_ab(platform, dtype):
     return overhead, row
 
 
+_COLD_WARM_CODE = r"""
+import json, os, sys, time
+import jax
+jax.config.update("jax_platforms", os.environ["BENCH_CW_PLATFORM"])
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, tuning
+from mxnet_tpu.gluon import Trainer, nn
+
+mx.random.seed(0)
+net = nn.Sequential(prefix="cw_")
+with net.name_scope():
+    net.add(nn.Dense(128, activation="relu"), nn.Dense(10))
+net.initialize()
+tr = Trainer(net.collect_params(), "sgd",
+             {"learning_rate": 0.1, "momentum": 0.9})
+step = tr.fuse_step(net, mx.gluon.loss.SoftmaxCrossEntropyLoss())
+rng = np.random.RandomState(0)
+x = nd.array(rng.uniform(-1, 1, (32, 16)).astype(np.float32))
+y = nd.array(rng.randint(0, 10, (32,)).astype(np.float32))
+# BOTH legs AOT-warm-start so the code paths (and so the cache keys)
+# are identical: the cold leg pays full XLA here, the warm leg replays
+# deserializations from the shared on-disk cache
+w0 = tuning.compile_stats()
+t0 = time.perf_counter()
+step.aot_warmup(x, y)
+warmup_s = time.perf_counter() - t0
+w1 = tuning.compile_stats()
+pre = tuning.compile_stats()
+t0 = time.perf_counter()
+for _ in range(5):
+    step(x, y)
+nd.waitall()
+dt = time.perf_counter() - t0
+post = tuning.compile_stats()
+print("CWROW " + json.dumps({
+    "step_time_ms": dt / 5 * 1e3,
+    "warmup_ms": warmup_s * 1e3,
+    "warmup_compile_ms": (w1["compile_seconds"]
+                          - w0["compile_seconds"]) * 1e3,
+    "warmup_cache_misses": w1["cache_misses"] - w0["cache_misses"],
+    "hot_compiles": post["compiles"] - pre["compiles"],
+    "hot_compile_ms": (post["compile_seconds"]
+                       - pre["compile_seconds"]) * 1e3,
+    "hot_cache_misses": post["cache_misses"] - pre["cache_misses"],
+    "total_compile_ms": post["compile_seconds"] * 1e3,
+    "cache_hits": post["cache_hits"],
+    "cache_misses": post["cache_misses"]}))
+"""
+
+
+def bench_cold_warm(platform, dtype):
+    """Cold-vs-warm start A/B (tuning/): the SAME canonical fused-step
+    loop run in two fresh processes sharing one persistent compile cache
+    + tune table. Process 1 is the cold path (every XLA compile is a
+    cache miss, paid in-loop); process 2 AOT-warm-starts via
+    ``step.aot_warmup`` and must show ~0 hot-loop compile time and ZERO
+    hot-loop cache misses — the zero-JIT-resume acceptance, self-
+    reported per bench round."""
+    import tempfile
+
+    del dtype  # f32 — the A/B isolates compilation, not math
+    tmp = tempfile.mkdtemp(prefix="mxt_bench_coldwarm_")
+    env = dict(os.environ)
+    env.update({"MXT_COMPILE_CACHE_DIR": os.path.join(tmp, "xla"),
+                "MXT_TUNE_TABLE": os.path.join(tmp, "tune.json"),
+                "BENCH_CW_PLATFORM":
+                    "cpu" if platform == "cpu" else platform})
+
+    def run():
+        r = subprocess.run([sys.executable, "-c", _COLD_WARM_CODE],
+                           env=env, capture_output=True, text=True,
+                           timeout=600)
+        for line in r.stdout.splitlines():
+            if line.startswith("CWROW "):
+                return json.loads(line[len("CWROW "):])
+        raise RuntimeError("cold/warm subprocess produced no row: %s"
+                           % (r.stderr or r.stdout)[-400:])
+
+    cold = run()  # fresh cache: warmup + hot loop pay real XLA
+    warm = run()  # same code, warm cache: must show ~0 compile time
+    import shutil
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    cold_total = cold["warmup_compile_ms"] + cold["hot_compile_ms"]
+    warm_total = warm["warmup_compile_ms"] + warm["hot_compile_ms"]
+    ratio = cold_total / warm_total if warm_total else 0.0
+    row = {
+        "config": "cold_vs_warm_start", "chips": 1, "batch_size": 32,
+        "dtype": "float32", "platform": platform,
+        "cold_compile_ms": round(cold_total, 1),
+        "cold_warmup_ms": round(cold["warmup_ms"], 1),
+        "cold_cache_misses": cold["cache_misses"],
+        "warm_compile_ms": round(warm_total, 1),
+        "warm_warmup_ms": round(warm["warmup_ms"], 1),
+        "warm_hot_compile_ms": round(warm["hot_compile_ms"], 1),
+        "warm_hot_cache_misses": warm["hot_cache_misses"],
+        "warm_cache_misses": warm["cache_misses"],
+        "warm_cache_hits": warm["cache_hits"],
+        "cold_step_time_ms": round(cold["step_time_ms"], 3),
+        "warm_step_time_ms": round(warm["step_time_ms"], 3),
+        # the acceptance bit: a warm-started process's fused-step loop
+        # performs zero real JIT compiles (cache misses) on the hot path
+        "zero_jit_resume": warm["hot_cache_misses"] == 0,
+        "images_or_tokens_per_sec_per_chip": round(
+            32 * 1e3 / warm["step_time_ms"], 2) if warm["step_time_ms"]
+        else 0.0,
+        "mfu": None, "flops_per_sample": None,
+        "cold_warm_compile_ratio": round(ratio, 2),
+    }
+    _emit_jsonl(row)
+    return ratio, row
+
+
 def main():
     platform, note = _init_backend()
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     configs = os.environ.get(
         "BENCH_CONFIGS",
         "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline,async_ab,"
-        "telemetry_ab"
+        "telemetry_ab,cold_warm"
     ).split(",")
 
     # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
@@ -854,13 +998,15 @@ def main():
                      bench_async_ab),
         "telemetry_ab": ("telemetry_overhead", "x (on/off step time)",
                          bench_telemetry_ab),
+        "cold_warm": ("cold_warm_compile_ratio",
+                      "x (cold/warm compile time)", bench_cold_warm),
     }
     headline = None
     errors = []
     skipped = []
     best_resnet = None
     for name in ("resnet50", "bert", "lstm_ptb", "wide_deep", "lenet",
-                 "pipeline", "async_ab", "telemetry_ab"):
+                 "pipeline", "async_ab", "telemetry_ab", "cold_warm"):
         if name not in configs:
             continue
         cost = float(os.environ.get("BENCH_COST_%s" % name.upper(),

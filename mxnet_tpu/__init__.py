@@ -58,6 +58,9 @@ from . import image
 from . import config
 from . import telemetry
 telemetry._maybe_autostart()  # MXT_TELEMETRY_PORT exposition endpoint
+# compile observability (jax.monitoring listeners) + persistent compile
+# cache (MXT_COMPILE_CACHE_DIR) + the kernel tuning table
+from . import tuning
 from . import resilience
 from . import membership
 from . import visualization
@@ -75,7 +78,7 @@ __all__ = [
     "sym", "Symbol", "module", "mod", "Module", "BucketingModule", "model",
     "save_checkpoint", "load_checkpoint", "profiler", "monitor",
     "operator", "image", "config", "amp", "contrib", "resilience",
-    "membership", "telemetry",
+    "membership", "telemetry", "tuning",
     "SequentialModule", "visualization", "viz", "runtime", "util", "rnn",
     "attribute", "AttrScope", "name", "engine",
 ]

@@ -15,7 +15,8 @@ from collections import namedtuple
 
 from .base import MXNetError
 
-__all__ = ["get", "set_default", "describe", "variables", "naive_engine"]
+__all__ = ["get", "set_default", "describe", "variables", "naive_engine",
+           "is_set", "change_epoch"]
 
 _Var = namedtuple("_Var", ["name", "type", "default", "doc"])
 
@@ -88,10 +89,40 @@ _declare("MXT_KVSTORE_SECRET", str, None,
          "model.")
 
 _declare("MXT_FLASH_BLOCK_Q", int, 128,
-         "Flash-attention query block rows (read at import; A/B knob "
-         "for the chip runbook).")
+         "Flash-attention query block rows. Setting it (env or "
+         "set_default) pins ALL shapes to this block — the A/B knob for "
+         "the chip runbook; leave unset to let the tuning table pick a "
+         "shape-aware config per call (tuning/autotune.py). Re-read on "
+         "every kernel dispatch, so sweeps can change it without a "
+         "fresh process.")
 _declare("MXT_FLASH_BLOCK_K", int, 128,
-         "Flash-attention key/value block rows (read at import).")
+         "Flash-attention key/value block rows (same pinning/override "
+         "semantics as MXT_FLASH_BLOCK_Q).")
+
+_declare("MXT_TUNE_TABLE", str, None,
+         "Path of the persistent kernel-tuning table (tuning/table.py): "
+         "per-(op, shape-bucket, dtype, device) block configs, "
+         "XLA-vs-Pallas decisions, and recorded warmup shape "
+         "signatures, as versioned JSON. Unset keeps the table "
+         "in-memory only (decisions still cached for the process).")
+_declare("MXT_TUNE_MODE", str, "auto",
+         "Kernel autotuner policy (ref: MXNET_CUDNN_AUTOTUNE_DEFAULT): "
+         "'auto' = timed micro-benchmarks on a real TPU, deterministic "
+         "heuristic cost model elsewhere (CPU/CI); 'heuristic' = never "
+         "measure; 'measure' = measure even off-TPU (tests/sweeps); "
+         "'off' = bypass the tuning table entirely (legacy global "
+         "MXT_FLASH_BLOCK_* / MXT_BN_PALLAS behavior).")
+_declare("MXT_TUNE_ITERS", int, 10,
+         "Timing iterations per candidate config in the autotuner's "
+         "measurement loop.")
+
+_declare("MXT_COMPILE_CACHE_DIR", str, None,
+         "Directory for JAX's persistent compilation cache. When set, "
+         "every XLA compile is cached on disk keyed by program+config, "
+         "so a resumed trainer or fresh serving replica deserializes "
+         "instead of recompiling (PERF.md: 63 s of attention JIT on a "
+         "4-layer GPT until hand-caching). tuning.warmup() plus this "
+         "cache = zero hot-path JIT in a warm-started process.")
 
 _declare("MXT_BN_PALLAS", bool, False,
          "Use the fused Pallas BatchNorm backward on channel-last "
@@ -187,10 +218,29 @@ _declare("MXT_AG_LEAN_TAPE", bool, False,
          "their inputs, at the cost of grad(create_graph=True) raising.")
 
 _overrides = {}
+# bumped by set_default so value caches (e.g. the flash kernel's block
+# memo) can notice a config change without re-reading every variable
+_change_epoch = 0
 
 
 def variables():
     return dict(_REGISTRY)
+
+
+def change_epoch():
+    """Monotone counter bumped by every set_default call — cheap staleness
+    check for caches built over config values. Env-var mutations cannot be
+    observed this way; callers that must honor them re-read via get()."""
+    return _change_epoch
+
+
+def is_set(name):
+    """True when the variable has an explicit value (env var or
+    set_default override) rather than its declared default — how the
+    tuning layer tells 'user pinned this knob' from 'free to tune'."""
+    if name not in _REGISTRY:
+        raise MXNetError("unknown config variable %r" % (name,))
+    return name in os.environ or name in _overrides
 
 
 def _coerce(var, raw):
@@ -221,9 +271,11 @@ def get(name):
 
 def set_default(name, value):
     """Process-level override (below env in precedence)."""
+    global _change_epoch
     if name not in _REGISTRY:
         raise MXNetError("unknown config variable %r" % (name,))
     _overrides[name] = _coerce(_REGISTRY[name], value)
+    _change_epoch += 1
 
 
 def describe():

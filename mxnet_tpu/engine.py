@@ -236,8 +236,20 @@ class StepStream:
 
 def wait_all():
     """Drain every live stream's in-flight window (the host half of
-    ``Engine::WaitForAll``; ``mx.nd.waitall()`` calls this first)."""
+    ``Engine::WaitForAll``; ``mx.nd.waitall()`` calls this first). The
+    barrier is also the durability point for the kernel-tuning table:
+    decisions the autotuner recorded since the last save hit disk here,
+    so a process killed mid-epoch still leaves its tuning work behind
+    for the next one (the same contract waitall gives the telemetry
+    JSONL sink)."""
     with _lock:
         streams = list(_streams)
     for s in streams:
         s.flush()
+    try:
+        from . import tuning
+
+        if tuning.table().dirty:
+            tuning.save()
+    except Exception:  # noqa: BLE001 — tuning persistence is best-effort
+        pass

@@ -131,6 +131,7 @@ class CachedTrainStep:
         self._t_dev = None       # device-carried step count (guard mode)
         self._mask_dev = None    # device-carried flag bitmask (guard mode)
         self._hyper_cache = None  # (lr, wd, float(lr), float(wd))
+        self._sig_recorded = False  # (x, y) signature saved for warmup
 
     # -- introspection ---------------------------------------------------
     @property
@@ -317,10 +318,11 @@ class CachedTrainStep:
         # across steps (the static_alloc analog) and the Parameter
         # wrappers rebind to the outputs
         self._jit = jax.jit(step, donate_argnums=(0, 1, 2))
-        from .. import engine
+        from .. import engine, tuning
         self._stream = engine.StepStream(
             name="fused_step",
             on_flags=self._consume_flag if guard else None)
+        tuning.register_step(self)  # bare tuning.warmup() AOT-compiles us
 
     # -- per-step host path ------------------------------------------------
     def _consume_flag(self, finite):
@@ -359,11 +361,116 @@ class CachedTrainStep:
             self._hyper_cache = cache
         return cache[2], cache[3]
 
+    def _sig_entry(self):
+        """Tuning-table signature key for this step's net (stable across
+        processes: gluon name prefixes are deterministic)."""
+        return "fused_step:%s" % self._net.name
+
+    def _record_signature(self, x, y):
+        """Remember the batch signature so tuning.warmup() in a resumed
+        process can AOT-compile this exact program before the first real
+        step."""
+        if self._sig_recorded:
+            return
+        self._sig_recorded = True
+        try:
+            from .. import tuning
+
+            tuning.record_signature(self._sig_entry(), {
+                "x_shape": list(x.shape), "x_dtype": str(x.data.dtype),
+                "y_shape": list(y.shape), "y_dtype": str(y.data.dtype),
+                "guard": bool(self._guard)})
+        except Exception:  # noqa: BLE001 — bookkeeping must not fail a step
+            pass
+
+    def aot_warmup(self, x=None, y=None):
+        """AOT-lower-and-compile the fused step program without running
+        a step (donation makes execute-to-warm destructive — weights are
+        never touched). ``x``/``y`` give the batch signature explicitly;
+        omitted, the signatures a previous process recorded in the
+        tuning table are replayed. With ``MXT_COMPILE_CACHE_DIR`` set
+        the compile lands in (warm: replays from) the persistent cache,
+        so the first real step performs zero hot-path JIT. Returns the
+        number of programs compiled, or False if the step cannot build
+        (ineligible config / no recorded signature)."""
+        from .. import tuning
+
+        tr = self._trainer
+        if not tr._kv_initialized:
+            tr._init_kvstore()
+        if tr._params_to_init:
+            tr._init_params()
+        if x is not None:
+            if not isinstance(x, NDArray):
+                x = _nd.array(x)
+            if not isinstance(y, NDArray):
+                y = _nd.array(y)
+            specs = [{"x_shape": list(x.shape),
+                      "x_dtype": str(x.data.dtype),
+                      "y_shape": list(y.shape),
+                      "y_dtype": str(y.data.dtype)}]
+            # persist the signature: a bare tuning.warmup() (this
+            # process or the next one) can then replay this compile
+            tuning.record_signature(self._sig_entry(), specs[0])
+        else:
+            specs = tuning.signatures(self._sig_entry())
+        if not specs:
+            return False
+        if self._jit is None and self._fallback_reason is None:
+            self._fallback_reason = self.eligible(tr, self._net)
+            if self._fallback_reason is None:
+                spec = specs[0]
+                self._build(_nd.zeros(tuple(spec["x_shape"]),
+                                      dtype=spec["x_dtype"]))
+        if self._jit is None:
+            return False
+        o = tr._optimizer
+        updater = tr._updaters[0]
+        for n, i in zip(self._train_names, self._indices):
+            if i not in updater.states:
+                updater.states[i] = o.create_state_multi_precision(
+                    i, self._all_params[n].data())
+                updater.states_synced[i] = True
+
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        ws = tuple(sds(self._all_params[n].data().data)
+                   for n in self._train_names)
+        ss = tuple(tuple(sds(l.data)
+                         for l in _FusedUpdate._leaves(updater.states[i]))
+                   for i in self._indices)
+        aux = tuple(sds(self._all_params[n].data().data)
+                    for n in self._aux_names)
+        if self._base_key is None:
+            self._base_key = _random.new_key()
+        import jax.numpy as jnp
+
+        count = 0
+        for spec in specs:
+            xs = jax.ShapeDtypeStruct(tuple(spec["x_shape"]),
+                                      spec["x_dtype"])
+            ys = jax.ShapeDtypeStruct(tuple(spec["y_shape"]),
+                                      spec["y_dtype"])
+            # scalar args mirror the hot path's aval kinds (python
+            # int/float = weak-typed; guard t/mask are strong i32/u32)
+            # so the persistent-cache key matches the real dispatch
+            if self._guard:
+                self._jit.lower(ws, ss, aux, xs, ys, self._base_key,
+                                jnp.int32(0), jnp.uint32(0), 0.0, 0.0,
+                                1.0).compile()
+            else:
+                self._jit.lower(ws, ss, aux, xs, ys, self._base_key, 1,
+                                0.0, 0.0, 1.0).compile()
+            count += 1
+        return count
+
     def _fused_step(self, x, y, batch_size):
         """One fused launch, dispatched asynchronously. Returns None if
         host-side invariants don't hold this step (caller falls back to
         the eager loop)."""
         _t0 = time.perf_counter()  # dispatch-phase span (host work only)
+        self._record_signature(x, y)
         tr = self._trainer
         o = tr._optimizer
         updater = tr._updaters[0]

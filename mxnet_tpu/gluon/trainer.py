@@ -102,6 +102,8 @@ class _FusedUpdate:
         # weights + states donated: buffers are reused across steps and the
         # params' NDArray wrappers rebind to the outputs
         self._jit = jax.jit(step, donate_argnums=(0, 2))
+        from .. import tuning
+        tuning.register_step(self)  # bare tuning.warmup() AOT-compiles us
 
     @staticmethod
     def _param_update(o, index):
@@ -334,6 +336,54 @@ class _FusedUpdate:
     @property
     def pending(self):
         return self._stream.pending if self._stream is not None else 0
+
+    def aot_warmup(self):
+        """AOT-lower-and-compile the fused optimizer update (and the
+        guarded variant when ``MXT_SKIP_NONFINITE`` is on) from the live
+        parameter shapes — donation makes execute-to-warm destructive,
+        so this never touches a weight. With ``MXT_COMPILE_CACHE_DIR``
+        set the compiles land in (or replay from) the persistent cache;
+        the first real ``trainer.step`` then performs no hot-path JIT.
+        Returns the number of programs compiled, or False when the
+        parameters aren't initialized yet."""
+        import jax
+
+        from .. import config as _cfg
+
+        tr = self._trainer
+        o = self._opt
+        updater = tr._updaters[0]
+        params = tr._params
+        for i in self._indices:
+            if params[i]._data is None:
+                return False
+            if i not in updater.states:
+                updater.states[i] = o.create_state_multi_precision(
+                    i, params[i].data())
+                updater.states_synced[i] = True
+
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        ws = tuple(sds(params[i].data().data) for i in self._indices)
+        gs = ws  # gradient avals match the weights
+        ss = tuple(tuple(sds(l.data)
+                         for l in self._leaves(updater.states[i]))
+                   for i in self._indices)
+        # scalar args mirror the hot path's aval kinds exactly (python
+        # int/float here = weak-typed there) so the persistent-cache key
+        # matches the real dispatch
+        self._jit.lower(ws, gs, ss, 1, 0.0, 0.0, 1.0).compile()
+        count = 1
+        if _cfg.get("MXT_SKIP_NONFINITE"):
+            import jax.numpy as jnp
+
+            if self._jit_guarded is None:
+                self._build_guarded()
+            self._jit_guarded.lower(ws, gs, ss, jnp.int32(0),
+                                    jnp.uint32(0), 0.0, 0.0, 1.0).compile()
+            count += 1
+        return count
 
     def guarded(self, rescale):
         """One fused update with the in-program non-finite guard,

@@ -22,13 +22,11 @@ import numpy as np
 from ..base import MXNetError
 from .registry import register
 
-# block sizes come from the config registry (MXT_FLASH_BLOCK_Q/K),
-# read lazily on first kernel use and then cached — a bad value fails
-# the attention call with a typed error instead of breaking package
-# import, and config.set_default works until the first flash dispatch
+# block sizes come from the config registry (MXT_FLASH_BLOCK_Q/K) or,
+# when neither is pinned, from the per-shape tuning table
+# (tuning/autotune.py) — a bad value fails the attention call with a
+# typed error instead of breaking package import
 from .. import config as _config
-
-_blocks_cache = None
 
 
 def _block_cfg(name):
@@ -40,12 +38,38 @@ def _block_cfg(name):
 
 
 def default_blocks():
-    """(block_q, block_k) — cached after first use (stable jit keys)."""
-    global _blocks_cache
-    if _blocks_cache is None:
-        _blocks_cache = (_block_cfg("MXT_FLASH_BLOCK_Q"),
-                         _block_cfg("MXT_FLASH_BLOCK_K"))
-    return _blocks_cache
+    """(block_q, block_k) from MXT_FLASH_BLOCK_Q/K, re-read on every
+    call — the old first-use memo latched one value for the process
+    lifetime, so tests and tpu_watch sweeps could never change blocks
+    without a fresh interpreter. The values are plain ints, so jit keys
+    stay stable as long as the config does."""
+    return (_block_cfg("MXT_FLASH_BLOCK_Q"),
+            _block_cfg("MXT_FLASH_BLOCK_K"))
+
+
+def blocks_pinned():
+    """True when the user pinned the blocks (env var or set_default) —
+    the A/B-sweep override that bypasses the tuning table."""
+    return (_config.is_set("MXT_FLASH_BLOCK_Q")
+            or _config.is_set("MXT_FLASH_BLOCK_K"))
+
+
+def _tuned_config(q, k, v, bias, causal, sm_scale):
+    """Per-shape kernel decision: pinned blocks win (legacy/global
+    behavior), otherwise the tuning table answers — a table hit, or a
+    measured/heuristic autotune pass recorded under this shape bucket.
+    The returned dict carries the XLA-vs-Pallas choice per shape; the
+    device gate (_use_pallas) still applies on top."""
+    if str(_config.get("MXT_TUNE_MODE")).lower() == "off" \
+            or blocks_pinned():
+        bq, bk = default_blocks()
+        return {"backend": "pallas", "block_q": bq, "block_k": bk,
+                "source": "pinned"}
+    from .. import tuning
+
+    return tuning.resolve_attention(
+        q.shape, k.shape[2], str(q.dtype), causal,
+        arrays=(q, k, v, bias, sm_scale))
 _NEG_INF = -1e30
 _LSE_LANES = 128  # lane-pad for the lse output (TPU (8,128) tiling)
 
@@ -358,16 +382,40 @@ def _flash_core(q, k, v, bias, causal, sm_scale):
 
 
 def _flash_fwd(q, k, v, bias, causal, sm_scale):
+    _record_flash_signature(q, k, v, bias, causal, sm_scale)
     if not _kv_fits_vmem(k):
         out, lse = _attention_scan_fwd(q, k, v, bias, causal, sm_scale)
-    elif _use_pallas():
-        bq, bk = default_blocks()
-        out, lse = _flash_forward_pallas(
-            q, k, v, bias, causal, sm_scale, bq, bk, interpret=False)
     else:
-        out = _attention_reference(q, k, v, bias, causal, sm_scale)
-        lse = None
+        cfg = _tuned_config(q, k, v, bias, causal, sm_scale)
+        if cfg.get("backend") == "pallas" and _use_pallas():
+            out, lse = _flash_forward_pallas(
+                q, k, v, bias, causal, sm_scale,
+                int(cfg["block_q"]), int(cfg["block_k"]), interpret=False)
+        else:
+            # per-shape XLA choice (small shapes, or a tuned decision
+            # that XLA's fused reference wins here), and every non-TPU
+            # backend
+            out = _attention_reference(q, k, v, bias, causal, sm_scale)
+            lse = None
     return out, (q, k, v, bias, out, lse)
+
+
+def _record_flash_signature(q, k, v, bias, causal, sm_scale):
+    """Remember this dispatch's shape signature for tuning.warmup()'s
+    AOT replay (deduplicated in the table; a fresh serving replica
+    compiles these ahead of traffic)."""
+    try:
+        from .. import tuning
+
+        tuning.record_signature("flash_attention", {
+            "q_shape": list(q.shape), "k_shape": list(k.shape),
+            "v_shape": list(v.shape),
+            "bias_shape": None if bias is None else list(bias.shape),
+            "bias_dtype": None if bias is None else str(bias.dtype),
+            "dtype": str(q.dtype), "causal": bool(causal),
+            "sm_scale": float(sm_scale)})
+    except Exception:  # noqa: BLE001 — bookkeeping must not fail the op
+        pass
 
 
 _BWD_SCORE_BYTES = 256 * 1024 * 1024  # peak score-matrix budget in backward

@@ -73,14 +73,22 @@ def _dx_kernel(n_scale, x_ref, dy_ref, mean_ref, inv_ref, g_ref,
     dx_ref[...] = dx.astype(dx_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def bn_bwd_pallas(x2d, dy2d, mean, inv, g, interpret=False):
+@functools.partial(jax.jit, static_argnames=("interpret", "block_rows"))
+def bn_bwd_pallas(x2d, dy2d, mean, inv, g, interpret=False,
+                  block_rows=None):
     """Fused BN backward on (M, C) channel-last activations.
 
-    Returns (dx (M, C) in x's dtype, dg (C,) f32, db (C,) f32).
+    ``block_rows`` overrides the VMEM-budget heuristic with a tuned
+    value (tuning/autotune.py — must be a positive multiple of 8; the
+    kernel pads and row-masks the last block, so any legal value works
+    for any M). Returns (dx (M, C) in x's dtype, dg (C,) f32,
+    db (C,) f32).
     """
     m, c = x2d.shape
-    bm = _block_rows(c)
+    bm = int(block_rows) if block_rows else _block_rows(c)
+    if bm < 8 or bm % 8:
+        raise ValueError("block_rows must be a positive multiple of 8 "
+                         "(TPU sublane), got %d" % bm)
     grid = ((m + bm - 1) // bm,)
     mean_r = mean.reshape(1, c).astype(jnp.float32)
     inv_r = inv.reshape(1, c).astype(jnp.float32)
@@ -123,3 +131,35 @@ def enabled():
     # compiled Mosaic path needs a real TPU; CPU tests drive the kernel
     # directly with interpret=True instead
     return jax.default_backend() in ("tpu", "axon")
+
+
+def candidate():
+    """Cheap gate: could the compiled Mosaic path run at all here?
+    (Keeps the XLA backward from paying reshape/choice work on CPU.)"""
+    return _HAVE_PALLAS and jax.default_backend() in ("tpu", "axon")
+
+
+def choose(m, c, dtype, arrays=None):
+    """Per-shape routing decision for the channel-last BN backward —
+    the per-call replacement for the global ``MXT_BN_PALLAS`` switch.
+
+    Returns ``(use_pallas, block_rows)``. An explicit ``MXT_BN_PALLAS``
+    (env or set_default) keeps its global meaning for A/B sweeps;
+    otherwise the tuning table answers per shape bucket (heuristic
+    default: XLA — the fused kernel stays opt-in until a measured entry
+    says it wins here). ``arrays`` (concrete (x2d, dy2d, mean, inv, g))
+    lets an eager backward feed the autotuner's timed path on device.
+    """
+    from .. import config
+
+    if not _HAVE_PALLAS or jax.default_backend() not in ("tpu", "axon"):
+        return False, None
+    if config.is_set("MXT_BN_PALLAS") \
+            or str(config.get("MXT_TUNE_MODE")).lower() == "off":
+        return bool(config.get("MXT_BN_PALLAS")), None
+    from .. import tuning
+
+    ent = tuning.resolve_bn(m, c, str(dtype), arrays=arrays)
+    if ent.get("backend") == "pallas":
+        return True, int(ent.get("block_rows") or 0) or None
+    return False, None

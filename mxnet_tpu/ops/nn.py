@@ -300,6 +300,15 @@ def _bn_core_fwd(eps, red, x, g, b):
     # residuals are the bf16 input + per-channel stats — backward
     # recomputes x32/xhat on the fly, so no f32 activation tensor is ever
     # written to HBM (the main BN traffic saving vs autodiff)
+    try:
+        from .. import tuning
+
+        tuning.record_signature("batch_norm", {
+            "x_shape": list(x.shape), "dtype": str(x.dtype),
+            "g_shape": list(g.shape), "g_dtype": str(g.dtype),
+            "eps": float(eps), "red": list(red)})
+    except Exception:  # noqa: BLE001 — bookkeeping must not fail the op
+        pass
     return (out.astype(x.dtype), mean, var), (x, g, mean, inv)
 
 
@@ -314,12 +323,23 @@ def _bn_core_bwd(eps, red, res, cts):
         n *= x.shape[i]
     if ax == x.ndim - 1:  # channel-last (NHWC): the Pallas fast path
         from . import bn_pallas
-        if bn_pallas.enabled():
+        if bn_pallas.candidate():
             c = x.shape[ax]
-            dx2, dg, db = bn_pallas.bn_bwd_pallas(
-                x.reshape(-1, c), ct_out.reshape(-1, c), mean, inv, g)
-            return (dx2.reshape(x.shape), dg.astype(g.dtype),
-                    db.astype(g.dtype))
+            # per-shape choice (tuning table / MXT_BN_PALLAS override);
+            # an eager backward passes its concrete arrays so an
+            # on-device first call can feed the autotuner's timed path
+            x2d = x.reshape(-1, c)
+            dy2d = ct_out.reshape(-1, c)
+            arrays = None
+            if not isinstance(x, jax.core.Tracer):
+                arrays = (x2d, dy2d, mean, inv, g)
+            use_pallas, block_rows = bn_pallas.choose(n, c, x.dtype,
+                                                      arrays=arrays)
+            if use_pallas:
+                dx2, dg, db = bn_pallas.bn_bwd_pallas(
+                    x2d, dy2d, mean, inv, g, block_rows=block_rows)
+                return (dx2.reshape(x.shape), dg.astype(g.dtype),
+                        db.astype(g.dtype))
     dy = ct_out.astype(jnp.float32)
     xhat = (x.astype(jnp.float32) - mean.reshape(shape)) * inv.reshape(shape)
     db = jnp.sum(dy, axis=red)

@@ -24,7 +24,8 @@ __all__ = ["set_config", "set_state", "state", "start", "stop", "pause",
            "resume", "dump", "dumps", "Domain", "Task", "Frame", "Counter",
            "Marker", "record_launch", "launch_count", "reset_launch_count",
            "counter_value", "record_host_sync", "host_sync_count",
-           "reset_host_sync_count", "set_gauge", "gauge_value"]
+           "reset_host_sync_count", "set_gauge", "gauge_value",
+           "compile_count", "compile_seconds"]
 
 _config = {
     "filename": "profile_output",
@@ -190,6 +191,23 @@ def reset_host_sync_count():
     return int(_syncs().reset())
 
 
+def compile_count():
+    """XLA backend compiles this process has performed (incl. persistent-
+    cache deserializations — tuning.compile_stats() splits hits/misses).
+    Fed by the jax.monitoring listeners tuning/compile_cache.py installs
+    at import; the cheapest cold-vs-warm signal next to launch_count."""
+    from .tuning import compile_stats
+
+    return int(compile_stats()["compiles"])
+
+
+def compile_seconds():
+    """Total XLA backend-compile wall time (seconds) this process."""
+    from .tuning import compile_stats
+
+    return compile_stats()["compile_seconds"]
+
+
 def set_gauge(name, value):
     """Set a point-in-time gauge (e.g. engine's 'dispatch_depth' — the
     number of fused steps currently in flight). Gauges show in dumps()
@@ -297,6 +315,8 @@ def dumps(reset=False):
         lines.append("    %-24s value=%s" % (name, gauges[name]))
     lines.append("    %-24s value=%d" % ("xla_launches", launch_count()))
     lines.append("    %-24s value=%d" % ("host_syncs", host_sync_count()))
+    lines.append("    %-24s value=%d (%.3fs)"
+                 % ("xla_compiles", compile_count(), compile_seconds()))
     if reset:
         with _LOCK:
             _agg.clear()

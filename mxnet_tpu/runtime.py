@@ -64,6 +64,15 @@ def _detect():
         add("BN_PALLAS", bn_pallas.enabled())
     except Exception:  # noqa: BLE001
         add("BN_PALLAS", False)
+    try:
+        from . import tuning
+
+        # usable == decisions survive the process (a path is configured)
+        add("KERNEL_AUTOTUNE", tuning.table().path is not None)
+        add("COMPILE_CACHE", tuning.cache_dir() is not None)
+    except Exception:  # noqa: BLE001
+        add("KERNEL_AUTOTUNE", False)
+        add("COMPILE_CACHE", False)
     return feats
 
 

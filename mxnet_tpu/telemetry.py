@@ -66,6 +66,7 @@ __all__ = [
     "counter", "gauge", "histogram", "render_prometheus",
     "emit_event", "flush", "jsonl_path",
     "record_phase", "record_dispatch", "record_step_retired",
+    "record_compile", "record_compile_cache", "record_tune_lookup",
     "trace_scope", "current_trace_id", "new_trace_id", "new_span_id",
     "record_rpc", "rpc_spans", "clear_rpc_spans",
     "start_http_server", "http_port", "histogram_quantile",
@@ -651,6 +652,68 @@ def record_step_retired(stream, step, latency_s):
     if _active_sink() is not None:
         emit_event("span", name="retire", stream=stream, step=step,
                    latency_s=round(latency_s, 9))
+
+
+# --------------------------------------------------------------------------
+# compile + tuning observability (fed by tuning/compile_cache.py's
+# jax.monitoring listeners and tuning/table.py lookups)
+# --------------------------------------------------------------------------
+_compile_hist = None
+_compile_total = None
+_compile_cache_c = None
+_tune_cache_c = None
+
+
+def record_compile(phase, seconds):
+    """One XLA compilation-pipeline phase observation
+    (``trace``/``lower``/``compile``) — lands in
+    ``mxt_compile_seconds{phase=}``; the ``compile`` phase also bumps
+    ``mxt_compiles_total``. Cold-vs-warm cost in one histogram: a
+    persistent-cache hit still reports here, as a ~ms deserialization
+    instead of a full XLA run."""
+    global _compile_hist, _compile_total
+    if _compile_hist is None:
+        _compile_hist = histogram(
+            "mxt_compile_seconds",
+            "JIT pipeline time per phase: trace (python->jaxpr), lower "
+            "(jaxpr->StableHLO), compile (XLA backend, incl. persistent-"
+            "cache deserialization on hits).", ("phase",))
+        _compile_total = counter(
+            "mxt_compiles_total",
+            "Compiled-program builds dispatched to the XLA backend "
+            "(persistent-cache hits included; see "
+            "mxt_compile_cache_misses_total for true JIT compiles).")
+    _compile_hist.labels(phase).observe(seconds)
+    if phase == "compile":
+        _compile_total.inc()
+
+
+def record_compile_cache(hit):
+    """One persistent-compilation-cache outcome. A warm-started process
+    shows hits only; a hot loop showing misses is paying JIT on the
+    request path — the exact regression the warmup contract forbids."""
+    global _compile_cache_c
+    if _compile_cache_c is None:
+        _compile_cache_c = counter(
+            "mxt_compile_cache_total",
+            "Persistent compilation cache lookups by outcome.",
+            ("outcome",))
+    _compile_cache_c.labels("hit" if hit else "miss").inc()
+
+
+def record_tune_lookup(hit):
+    """One tuning-table lookup outcome (mxt_tune_cache_hits_total /
+    mxt_tune_cache_misses_total — a miss means the autotuner ran a
+    measurement or cost-model pass for a new shape bucket)."""
+    global _tune_cache_c
+    if _tune_cache_c is None:
+        _tune_cache_c = (
+            counter("mxt_tune_cache_hits_total",
+                    "Tuning-table lookups answered from the table."),
+            counter("mxt_tune_cache_misses_total",
+                    "Tuning-table lookups that fell through to "
+                    "measurement or the heuristic cost model."))
+    _tune_cache_c[0 if hit else 1].inc()
 
 
 # --------------------------------------------------------------------------

@@ -55,6 +55,14 @@ SCAN = {
     "mxnet_tpu/monitor.py": _TRANSFER,
     "mxnet_tpu/metric.py": [r"\.asnumpy\(", r"\.asscalar\(",
                             r"block_until_ready"],
+    # the tuning layer sits NEXT to the hot path: kernel-config lookups
+    # run inside dispatch, so any device read there must be an annotated
+    # autotuner measurement loop (never the per-call resolve path)
+    "mxnet_tpu/tuning/__init__.py": _ALL,
+    "mxnet_tpu/tuning/table.py": _ALL,
+    "mxnet_tpu/tuning/autotune.py": _ALL,
+    "mxnet_tpu/tuning/warmup.py": _ALL,
+    "mxnet_tpu/tuning/compile_cache.py": _ALL,
 }
 
 _MARKER = "sync-ok"

@@ -13,6 +13,9 @@ async-training health signals once per interval:
     kv rpc p50/p99     server-side KVStore/membership RPC latency
     workers live/lost  membership view
     skipped steps      non-finite guard skips
+    xla compiles       backend compiles + persistent-cache hit/miss
+                       (a warm-started replica shows hits only)
+    tune cache         kernel-autotuner table hit/miss
 
 Usage::
 
@@ -203,6 +206,14 @@ def render(samples, prev, dt):
     live = metric_sum(samples, "mxt_membership_live_workers")
     lost = metric_sum(samples, "lost_workers")
     skipped = metric_sum(samples, "skipped_nonfinite_steps")
+    compiles = metric_sum(samples, "mxt_compiles_total")
+    compile_s = metric_sum(samples, "mxt_compile_seconds_sum",
+                           phase="compile")
+    cc_hits = metric_sum(samples, "mxt_compile_cache_total", outcome="hit")
+    cc_miss = metric_sum(samples, "mxt_compile_cache_total",
+                         outcome="miss")
+    tune_hits = metric_sum(samples, "mxt_tune_cache_hits_total")
+    tune_miss = metric_sum(samples, "mxt_tune_cache_misses_total")
 
     lines = [
         "mxt_top  %s" % time.strftime("%H:%M:%S"),
@@ -216,6 +227,11 @@ def render(samples, prev, dt):
         "  workers live     %s   lost %s"
         % (_fmt(live, "%.0f"), _fmt(lost, "%.0f")),
         "  skipped steps    %s" % _fmt(skipped, "%.0f"),
+        "  xla compiles     %s   (%s)   cache %s/%s hit/miss"
+        % (_fmt(compiles, "%.0f"), _fmt_s(compile_s),
+           _fmt(cc_hits, "%.0f"), _fmt(cc_miss, "%.0f")),
+        "  tune cache       %s/%s hit/miss"
+        % (_fmt(tune_hits, "%.0f"), _fmt(tune_miss, "%.0f")),
     ]
     return "\n".join(lines)
 
