@@ -43,7 +43,8 @@ _BUDGET = float(os.environ.get("BENCH_BUDGET", "1500"))
 # but CPU is the fallback path where the budget rarely binds
 _CONFIG_COST = {"resnet50": 420, "bert": 300, "lstm_ptb": 200,
                 "wide_deep": 200, "lenet": 150, "pipeline": 150,
-                "async_ab": 90, "telemetry_ab": 60, "cold_warm": 120}
+                "async_ab": 90, "telemetry_ab": 60, "cold_warm": 120,
+                "serving": 150}
 
 
 def _remaining():
@@ -971,13 +972,120 @@ def bench_cold_warm(platform, dtype):
     return ratio, row
 
 
+def bench_serving(platform, dtype):
+    """Serving stack (mxnet_tpu/serving/): mixed-length synthetic
+    traffic through the paged-KV decode engine, once under the
+    continuous batcher (recompose every step) and once under the
+    static batcher (admission only at batch boundaries). Emits two
+    rows: `serving_decode` (continuous-mode tokens/s, request p50/p99,
+    KV-page occupancy) and the `serving_continuous_vs_static_ab` proof
+    row. Useful tokens only — idle static slots earn nothing, which is
+    exactly the measured difference."""
+    import numpy as np
+
+    from mxnet_tpu import profiler, serving
+
+    del dtype  # f32: the A/B isolates scheduling, not math throughput
+    slots = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
+    n_req = int(os.environ.get("BENCH_SERVING_REQUESTS", "24"))
+    layers, heads, hdim = 2, 4, 16
+    model = serving.TinyDecoder(vocab=512, num_layers=layers,
+                                num_heads=heads, head_dim=hdim,
+                                max_len=512)
+    params = model.init_params(0)
+
+    def make_requests():
+        rng = np.random.RandomState(7)
+        out = []
+        for _ in range(n_req):
+            plen = int(rng.randint(4, 97))
+            mnew = int(rng.randint(4, 49))
+            out.append(serving.Request(
+                rng.randint(1, 512, plen).tolist(),
+                max_new_tokens=mnew))
+        return out
+
+    def run(batcher_cls):
+        cache = serving.PagedKVCache(layers, heads, hdim, num_pages=512,
+                                     page_size=16)
+        eng = serving.DecodeEngine(model, params=params, slots=slots,
+                                   cache=cache, prefill_buckets=(64, 128),
+                                   max_context=256)
+        eng.aot_warmup()
+        # warm lap: absorb eager-glue compiles so the timed lap measures
+        # scheduling, not JIT
+        warm = batcher_cls(eng)
+        warm.submit(serving.Request([1, 2, 3], max_new_tokens=4))
+        warm.run()
+        sched = batcher_cls(eng)
+        for r in make_requests():
+            sched.submit(r)
+        peak_pages = 0
+        h0 = profiler.host_sync_count()
+        t0 = time.perf_counter()
+        while (sched._queue or sched._slot_req) and sched.steps < 20000:
+            sched.step()
+            peak_pages = max(peak_pages, cache.pages_in_use())
+        sched.drain()
+        dt = time.perf_counter() - t0
+        syncs = profiler.host_sync_count() - h0
+        done = [r for r in sched.completed if r.state == "completed"]
+        tokens = sum(len(r.output_tokens) for r in done)
+        lats = sorted(r.t_finish - r.t_submit for r in done
+                      if r.t_finish is not None)
+        pick = lambda q: lats[min(len(lats) - 1, int(q * len(lats)))] \
+            if lats else 0.0
+        return {
+            "tokens_per_sec": tokens / dt if dt else 0.0,
+            "completed": len(done), "steps": sched.steps,
+            "p50_ms": pick(0.50) * 1e3, "p99_ms": pick(0.99) * 1e3,
+            "peak_kv_pages": peak_pages,
+            "host_syncs_per_step": syncs / max(1, sched.steps),
+        }
+
+    cont = run(serving.ContinuousBatcher)
+    stat = run(serving.StaticBatcher)
+    speedup = cont["tokens_per_sec"] / stat["tokens_per_sec"] \
+        if stat["tokens_per_sec"] else 0.0
+
+    row = {
+        "config": "serving_decode", "chips": 1, "batch_size": slots,
+        "dtype": "float32", "platform": platform,
+        "requests": n_req,
+        "images_or_tokens_per_sec_per_chip": round(
+            cont["tokens_per_sec"], 2),
+        "request_p50_ms": round(cont["p50_ms"], 2),
+        "request_p99_ms": round(cont["p99_ms"], 2),
+        "peak_kv_pages": cont["peak_kv_pages"],
+        "host_syncs_per_step": round(cont["host_syncs_per_step"], 3),
+        "decode_steps": cont["steps"],
+        "mfu": None, "flops_per_sample": None,
+    }
+    _emit_jsonl(row)
+    row_ab = {
+        "config": "serving_continuous_vs_static_ab", "chips": 1,
+        "batch_size": slots, "dtype": "float32", "platform": platform,
+        "requests": n_req,
+        "continuous_tokens_per_sec": round(cont["tokens_per_sec"], 2),
+        "static_tokens_per_sec": round(stat["tokens_per_sec"], 2),
+        "continuous_steps": cont["steps"],
+        "static_steps": stat["steps"],
+        "images_or_tokens_per_sec_per_chip": round(
+            cont["tokens_per_sec"], 2),
+        "mfu": None, "flops_per_sample": None,
+        "continuous_speedup": round(speedup, 3),
+    }
+    _emit_jsonl(row_ab)
+    return speedup, row_ab
+
+
 def main():
     platform, note = _init_backend()
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     configs = os.environ.get(
         "BENCH_CONFIGS",
         "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline,async_ab,"
-        "telemetry_ab,cold_warm"
+        "telemetry_ab,cold_warm,serving"
     ).split(",")
 
     # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
@@ -1000,13 +1108,16 @@ def main():
                          bench_telemetry_ab),
         "cold_warm": ("cold_warm_compile_ratio",
                       "x (cold/warm compile time)", bench_cold_warm),
+        "serving": ("serving_continuous_vs_static",
+                    "x (continuous/static tokens/s)", bench_serving),
     }
     headline = None
     errors = []
     skipped = []
     best_resnet = None
     for name in ("resnet50", "bert", "lstm_ptb", "wide_deep", "lenet",
-                 "pipeline", "async_ab", "telemetry_ab", "cold_warm"):
+                 "pipeline", "async_ab", "telemetry_ab", "cold_warm",
+                 "serving"):
         if name not in configs:
             continue
         cost = float(os.environ.get("BENCH_COST_%s" % name.upper(),

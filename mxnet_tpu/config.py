@@ -211,6 +211,22 @@ _declare("MXT_TELEMETRY_PORT", int, None,
          "tails it for a live console. Unset disables the endpoint; "
          "0 picks a free port (telemetry.http_port() reports it).")
 
+_declare("MXT_PAGE_SIZE", int, 16,
+         "Tokens per KV-cache page in the serving stack "
+         "(serving/kv_cache.py). The ragged paged attention kernel "
+         "streams one page per grid step, so this is also its KV block "
+         "size; must be a multiple of 8 (TPU sublane).")
+_declare("MXT_SERVING_PAGES", int, 256,
+         "KV-cache pool size in pages preallocated per serving engine "
+         "(one extra scratch page is always added for masked writes of "
+         "inactive batch slots). HBM cost per layer is "
+         "2 * pages * page_size * heads * head_dim * itemsize.")
+_declare("MXT_SERVING_SLOTS", int, 8,
+         "Decode batch slots in the serving engine: the continuous "
+         "batcher recomposes requests into this fixed-shape batch every "
+         "step, so the decode program compiles once regardless of "
+         "traffic (inactive slots are masked, not reshaped away).")
+
 _declare("MXT_AG_LEAN_TAPE", bool, False,
          "Skip storing per-node replay state (forward fn + primal "
          "inputs) on the autograd tape. Saves peak memory on very long "

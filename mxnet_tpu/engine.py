@@ -11,12 +11,18 @@ host↔device round-trip and the host could never pipeline.
 
 This module is the missing host half:
 
-- :class:`StepStream` is the per-call-site dependency queue: every
-  dispatched fused step pushes a token; host-consumed scalars (the guard
-  flag mask, a throttle read of the loss) ride tokens as deferred
+- :class:`InflightWindow` is the per-call-site dependency queue: every
+  dispatched fused program pushes a token; host-consumed scalars (the
+  guard flag mask, a throttle read of the loss) ride tokens as deferred
   :class:`~mxnet_tpu.ndarray.pending.PendingValue` handles and are only
   materialized when the token *retires* — once the in-flight window is
-  full, or at an explicit barrier.
+  full, or at an explicit barrier. :class:`StepStream` is the training
+  face of the same window (PR 4's name, kept as an alias); the serving
+  decode stream (serving/engine.py) rides the SAME class with per-step
+  *values* instead of guard flags: each decode step stages its sampled
+  token ids, the window stacks a whole snapshot's worth into ONE device
+  array, and a single deferred read delivers K steps of tokens to the
+  scheduler — the decode hot loop never blocks on the device.
 - the window depth K comes from ``MXT_MAX_INFLIGHT`` (default 2), and
   :func:`bulk`/:func:`set_bulk_size` are now the REAL knob instead of
   no-op shims: ``with engine.bulk(1):`` forces synchronous per-step
@@ -42,8 +48,8 @@ import threading
 import time
 import weakref
 
-__all__ = ["bulk", "set_bulk_size", "max_inflight", "StepStream",
-           "wait_all", "inflight_depth"]
+__all__ = ["bulk", "set_bulk_size", "max_inflight", "InflightWindow",
+           "StepStream", "wait_all", "inflight_depth"]
 
 # flag bits a single snapshot read may cover: the mask is a uint32 riding
 # the fused program, and with snapshots every K pushes plus one token
@@ -117,30 +123,49 @@ class _Token:
     """One retirement point in a stream: a deferred host read covering
     every step dispatched since the previous token."""
 
-    __slots__ = ("pv", "has_flags", "upto")
+    __slots__ = ("pv", "has_flags", "upto", "nvalues")
 
-    def __init__(self, pv, has_flags, upto):
+    def __init__(self, pv, has_flags, upto, nvalues=0):
         self.pv = pv
         self.has_flags = has_flags
         self.upto = upto
+        self.nvalues = nvalues
 
 
-class StepStream:
+class InflightWindow:
     """The dependency queue for ONE dispatch site (a CachedTrainStep, a
-    guarded _FusedUpdate): ``push()`` records a dispatched launch, every
-    K-th push becomes a snapshot token carrying a deferred read, and
-    tokens retire oldest-first as the window slides. ``on_flags`` (if
-    given) receives one ``finite: bool`` per retired step, in dispatch
-    order — deferred bookkeeping (update counts, loss-scale, skipped-step
-    counter) lives in that callback."""
+    guarded _FusedUpdate, the serving decode stream): ``push()`` records
+    a dispatched launch, every K-th push becomes a snapshot token
+    carrying a deferred read, and tokens retire oldest-first as the
+    window slides.
 
-    def __init__(self, name="step", on_flags=None):
+    Two retirement payloads, one deferred read each:
+
+    - ``on_flags`` (training) receives one ``finite: bool`` per retired
+      step, in dispatch order, decoded from a device-carried guard
+      bitmask — deferred bookkeeping (update counts, loss-scale,
+      skipped-step counter) lives in that callback.
+    - ``on_values`` (serving) receives ``(step_no, host_row)`` per
+      retired step, in dispatch order. Each push stages its per-step
+      device value (e.g. the decode step's sampled token ids); at
+      snapshot time the window stacks the staged values into ONE device
+      array, so a single deferred transfer still retires a whole
+      window's worth of steps — host_syncs/step stays <= 1/K no matter
+      how much per-step data rides the window.
+
+    A single push may defer flags or a value, not both (the snapshot
+    carries exactly one deferred device source).
+    """
+
+    def __init__(self, name="step", on_flags=None, on_values=None):
         self.name = name
         self._on_flags = on_flags
+        self._on_values = on_values
         self._dispatched = 0
         self._consumed = 0
         self._last_snap = 0
         self._window = []  # snapshot tokens not yet retired
+        self._staged = []  # per-step device values since the last snapshot
         self._latest = None  # (sync_value, flags) of the newest push
         self._retire_lock = threading.RLock()
         # host wall-clock of each dispatch, consumed oldest-first at
@@ -156,15 +181,32 @@ class StepStream:
         """Steps dispatched but not yet observed on host."""
         return self._dispatched - self._consumed
 
-    def push(self, sync_value, flags=None):
-        """Record one dispatched fused step.
+    @staticmethod
+    def _stack(values):
+        """One device array from a snapshot's staged per-step values —
+        a pure device op (async dispatch), never a host transfer."""
+        import jax.numpy as jnp
+
+        raw = [getattr(v, "data", v) for v in values]
+        return jnp.stack(raw)
+
+    def push(self, sync_value, flags=None, value=None):
+        """Record one dispatched fused step; returns its step number.
 
         ``sync_value``: any device output of the step (used for the
         throttle read when there are no flags). ``flags``: the step's
         output guard bitmask (newest bit = this step), read deferred.
+        ``value``: a per-step device array staged for ``on_values``
+        delivery (every push in a stream must then carry one, and the
+        shapes must match so a snapshot can stack them).
         """
         from .ndarray.pending import PendingValue
 
+        if flags is not None and value is not None:
+            from .base import MXNetError
+
+            raise MXNetError("InflightWindow.push: a step may defer "
+                             "flags or a value, not both")
         retire = []
         with _lock:
             self._dispatched += 1
@@ -172,11 +214,19 @@ class StepStream:
             depth = self._dispatched - self._consumed
             step_no = self._dispatched
             self._latest = (sync_value, flags)
+            if value is not None:
+                self._staged.append(value)
             k = max_inflight()
             if self._dispatched - self._last_snap >= k:
-                src = flags if flags is not None else sync_value
-                tok = _Token(PendingValue(src), flags is not None,
-                             self._dispatched)
+                if self._staged:
+                    src = self._stack(self._staged)
+                    tok = _Token(PendingValue(src), False,
+                                 self._dispatched, len(self._staged))
+                    self._staged = []
+                else:
+                    src = flags if flags is not None else sync_value
+                    tok = _Token(PendingValue(src), flags is not None,
+                                 self._dispatched)
                 self._last_snap = self._dispatched
                 self._window.append(tok)
                 if k == 1:
@@ -190,6 +240,7 @@ class StepStream:
                 for tok in retire:
                     self._retire(tok)
         _update_depth_gauge()
+        return step_no
 
     def _retire(self, tok):
         """Materialize one token's deferred read and catch host-side
@@ -210,6 +261,10 @@ class StepStream:
             mask = int(value)
             for k in range(n - 1, -1, -1):  # oldest step first
                 self._on_flags((mask >> k) & 1 == 0)
+        if tok.nvalues and self._on_values is not None:
+            first = tok.upto - tok.nvalues + 1
+            for i in range(tok.nvalues):  # oldest step first
+                self._on_values(first + i, value[i])
         self._consumed = tok.upto
 
     def flush(self):
@@ -221,6 +276,7 @@ class StepStream:
         with self._retire_lock:
             with _lock:
                 tokens, self._window = self._window, []
+                staged, self._staged = self._staged, []
                 latest = self._latest
                 upto = self._dispatched
                 self._last_snap = upto
@@ -228,10 +284,20 @@ class StepStream:
                 self._retire(tok)
             if self._consumed < upto and latest is not None:
                 sync_value, flags = latest
-                src = flags if flags is not None else sync_value
-                self._retire(_Token(PendingValue(src), flags is not None,
-                                    upto))
+                if staged:
+                    self._retire(_Token(PendingValue(self._stack(staged)),
+                                        False, upto, len(staged)))
+                else:
+                    src = flags if flags is not None else sync_value
+                    self._retire(_Token(PendingValue(src),
+                                        flags is not None, upto))
         _update_depth_gauge()
+
+
+class StepStream(InflightWindow):
+    """The training face of :class:`InflightWindow` (PR 4's name):
+    CachedTrainStep / the guarded _FusedUpdate push fused train steps
+    and retire guard-flag bitmasks through ``on_flags``."""
 
 
 def wait_all():

@@ -42,7 +42,15 @@ def default_blocks():
     call — the old first-use memo latched one value for the process
     lifetime, so tests and tpu_watch sweeps could never change blocks
     without a fresh interpreter. The values are plain ints, so jit keys
-    stay stable as long as the config does."""
+    stay stable as long as the config does.
+
+    These blocks cover the *training/prefill* flash kernel only. Decode
+    shapes (one query token per sequence over a paged KV cache) resolve
+    through the tuning table's decode-shape buckets instead —
+    ``tuning.resolve_paged`` keys on (batch, heads, head_dim, page_size,
+    max-pages bucket) and picks a head-block config for
+    :func:`ragged_paged_attention`; MXT_FLASH_BLOCK_Q/K never apply
+    there (a Tq=1 query has no query block to tile)."""
     return (_block_cfg("MXT_FLASH_BLOCK_Q"),
             _block_cfg("MXT_FLASH_BLOCK_K"))
 
@@ -533,3 +541,213 @@ def make_padding_bias(valid_length, max_len=None, dtype="float32"):
     mask = idx < valid_length.astype(jnp.int32)[:, None]
     bias = jnp.where(mask, 0.0, _NEG_INF).astype(jnp.dtype(dtype))
     return bias[:, None, None, :]
+
+
+# ---------------------------------------------------------------------------
+# ragged / paged decode attention (serving; PAPERS.md arXiv 2604.15464)
+# ---------------------------------------------------------------------------
+def ragged_attention_reference(q, k, v, valid_length, sm_scale=None):
+    """Dense masked reference for ragged decode — the correctness oracle
+    for :func:`ragged_paged_attention`.
+
+    One query token per sequence attends its own prefix: ``q`` is
+    ``(B, H, D)`` (or ``(B, H, 1, D)``), ``k``/``v`` are dense
+    ``(B, H, Tmax, D)``, ``valid_length`` is ``(B,)`` — sequence ``b``
+    sees exactly keys ``[0, valid_length[b])``; everything after is
+    masked with the same -1e30 bias ``make_padding_bias`` produces, so
+    the paged kernel and this path share one masking definition."""
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, :, None, :]
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    bias = make_padding_bias(valid_length, max_len=k.shape[2],
+                             dtype="float32")
+    out = _attention_reference(q, k, v, bias, False, float(sm_scale))
+    return out[:, :, 0] if squeeze else out
+
+
+def _paged_gather_reference(q, k_pages, v_pages, page_table, context_lens,
+                            sm_scale):
+    """XLA path: gather the page table into dense K/V and run the masked
+    reference. Correct everywhere (the CPU/serving-test path) and the
+    per-shape alternative the tuning table may prefer on-chip for short
+    contexts, where one fused gather+softmax beats the kernel's
+    page-at-a-time grid."""
+    B = q.shape[0]
+    P, S, H, D = k_pages.shape
+    max_pages = page_table.shape[1]
+    kg = k_pages[page_table.reshape(-1)].reshape(B, max_pages, S, H, D)
+    vg = v_pages[page_table.reshape(-1)].reshape(B, max_pages, S, H, D)
+    k = jnp.transpose(kg.reshape(B, max_pages * S, H, D), (0, 2, 1, 3))
+    v = jnp.transpose(vg.reshape(B, max_pages * S, H, D), (0, 2, 1, 3))
+    return ragged_attention_reference(q, k, v, context_lens, sm_scale)
+
+
+def _paged_decode_kernel(pt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, page_size, block_h,
+                         sm_scale):
+    """One (sequence, head-block, page) grid step of the ragged paged
+    decode kernel. The page axis is the innermost (sequential) grid
+    dimension, so the online-softmax state rides VMEM scratch across a
+    sequence's pages — the flash recipe with the KV stream indirected
+    through the page table (pt_ref/cl_ref are scalar-prefetch refs; the
+    BlockSpec index map already used pt_ref to DMA this step's page)."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    npages = pl.num_programs(2)
+    sm_scale = jnp.float32(sm_scale)
+    neg_inf = jnp.float32(_NEG_INF)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, neg_inf, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    q = q_ref[0].astype(jnp.float32)          # (block_h, D)
+    k = k_ref[0].astype(jnp.float32)          # (page_size, block_h, D)
+    v = v_ref[0].astype(jnp.float32)
+    length = cl_ref[b]
+    # tokens this page covers; everything at/after the sequence length
+    # (ragged tail, pages past the last used one) masks to -inf
+    col = j * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (block_h, page_size), 1)
+    valid = col < length
+
+    # per-head matvecs, statically unrolled over the head block (the
+    # head-batched dot_general has no Mosaic lowering; block_h is the
+    # tuned unroll width)
+    rows = [jax.lax.dot_general(q[h:h + 1], k[:, h, :],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            for h in range(block_h)]
+    s = jnp.concatenate(rows, axis=0) * sm_scale   # (block_h, page_size)
+    s = jnp.where(valid, s, neg_inf)
+
+    m_prev = m_scr[...]                        # (block_h, LANES), lane-bcast
+    l_prev = l_scr[...]
+    m_new = jnp.maximum(m_prev, jnp.broadcast_to(
+        jnp.max(s, axis=1, keepdims=True), m_prev.shape))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, :1])
+    l_new = l_prev * alpha + jnp.broadcast_to(
+        jnp.sum(p, axis=1, keepdims=True), l_prev.shape)
+    pv_rows = [jax.lax.dot_general(p[h:h + 1], v[:, h, :],
+                                   (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+               for h in range(block_h)]
+    m_scr[...] = m_new
+    l_scr[...] = l_new
+    acc_scr[...] = acc_scr[...] * alpha[:, :1] \
+        + jnp.concatenate(pv_rows, axis=0)
+
+    @pl.when(j == npages - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[...][:, :1], jnp.float32(1e-30))
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def _paged_decode_pallas(q, k_pages, v_pages, page_table, context_lens,
+                         sm_scale, block_h, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, D = q.shape
+    P, S, Hk, Dk = k_pages.shape
+    max_pages = page_table.shape[1]
+    block_h = max(1, min(int(block_h), H))
+    while H % block_h:  # candidates are divisors; pinned values may not be
+        block_h -= 1
+    page_table = page_table.astype(jnp.int32)
+    context_lens = context_lens.astype(jnp.int32)
+
+    z = np.int32(0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H // block_h, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, block_h, D),
+                         lambda b, hb, j, pt, cl: (b, hb, z)),
+            # page indirection: the page table names which pool page this
+            # grid step streams in (a padded slot reads page 0, fully
+            # masked by the ragged length check)
+            pl.BlockSpec((1, S, block_h, D),
+                         lambda b, hb, j, pt, cl: (pt[b, j], z, hb, z)),
+            pl.BlockSpec((1, S, block_h, D),
+                         lambda b, hb, j, pt, cl: (pt[b, j], z, hb, z)),
+        ],
+        out_specs=pl.BlockSpec((1, block_h, D),
+                               lambda b, hb, j, pt, cl: (b, hb, z)),
+        scratch_shapes=[
+            pltpu.VMEM((block_h, _LSE_LANES), jnp.float32),
+            pltpu.VMEM((block_h, _LSE_LANES), jnp.float32),
+            pltpu.VMEM((block_h, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_decode_kernel, page_size=S,
+                               block_h=block_h, sm_scale=sm_scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=interpret,
+    )(page_table, context_lens, q, k_pages, v_pages)
+
+
+def _record_paged_signature(q, k_pages, page_table, sm_scale):
+    """Remember this decode dispatch's shape signature so a fresh
+    serving replica's tuning.warmup() can AOT-compile the paged
+    attention program before the first request lands."""
+    try:
+        from .. import tuning
+
+        tuning.record_signature("paged_attention", {
+            "q_shape": list(q.shape), "pool_shape": list(k_pages.shape),
+            "max_pages": int(page_table.shape[1]),
+            "dtype": str(q.dtype), "sm_scale": float(sm_scale)})
+    except Exception:  # noqa: BLE001 — bookkeeping must not fail the op
+        pass
+
+
+@register("ragged_paged_attention", differentiable=False)
+def ragged_paged_attention(query, k_pages, v_pages, page_table,
+                           context_lens, sm_scale=None, interpret=None):
+    """Decode-time attention over a paged KV cache — one query token per
+    sequence gathers its K/V prefix through a page table (PAPERS.md
+    arXiv 2604.15464; the serving sibling of :func:`flash_attention`).
+
+    ``query``: (B, H, D) — this step's single token per sequence.
+    ``k_pages``/``v_pages``: (num_pages, page_size, H, D) device pools.
+    ``page_table``: (B, max_pages) int32 — pool page ids per sequence,
+    in order; padded slots may repeat any valid page (they are masked).
+    ``context_lens``: (B,) int32 — tokens of live prefix per sequence
+    (ragged: any mix of lengths, including 1). Returns (B, H, D).
+
+    Backend choice and the head-block config come from the tuning table
+    (``tuning.resolve_paged``), exactly like the flash kernel's blocks;
+    ``interpret=True`` forces the Pallas kernel in interpret mode (the
+    CPU parity path tests use)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(query.shape[-1]))
+    sm_scale = float(sm_scale)
+    _record_paged_signature(query, k_pages, page_table, sm_scale)
+    from .. import tuning
+
+    cfg = tuning.resolve_paged(
+        query.shape, k_pages.shape[1], page_table.shape[1],
+        str(query.dtype))
+    if interpret:
+        return _paged_decode_pallas(query, k_pages, v_pages, page_table,
+                                    context_lens, sm_scale,
+                                    int(cfg.get("block_h", 1)),
+                                    interpret=True)
+    if cfg.get("backend") == "pallas" and _use_pallas():
+        return _paged_decode_pallas(query, k_pages, v_pages, page_table,
+                                    context_lens, sm_scale,
+                                    int(cfg.get("block_h", 1)),
+                                    interpret=False)
+    return _paged_gather_reference(query, k_pages, v_pages, page_table,
+                                   context_lens, sm_scale)

@@ -1,0 +1,48 @@
+"""Inference serving stack (ROADMAP direction 1 — the "millions of
+users" front).
+
+Everything before this PR served *training*; this package is the
+production-traffic half:
+
+- :mod:`~mxnet_tpu.serving.kv_cache` — :class:`PagedKVCache`: fixed-size
+  KV pages in a preallocated device pool, per-request page tables,
+  reservation-based admission, alloc/free/defrag.
+- :mod:`~mxnet_tpu.serving.engine` — :class:`DecodeEngine`: ONE donated
+  fixed-shape jit program per decode step (append K/V through the page
+  table, ragged paged attention, greedy sample), zero per-step host
+  syncs via ``engine.InflightWindow``, shape-bucketed prefill, and
+  ``aot_warmup()`` so a warm replica pays zero request-path JIT.
+- :mod:`~mxnet_tpu.serving.scheduler` — :class:`Request`,
+  :class:`ContinuousBatcher` (admission, per-request deadlines, batch
+  recomposition every step), and the :class:`StaticBatcher` A/B
+  baseline.
+- :mod:`~mxnet_tpu.serving.model` — the decode-model adapter protocol
+  and :class:`TinyDecoder`, the pure-JAX causal LM the tests, bench,
+  and examples drive.
+- :mod:`~mxnet_tpu.serving.metrics` — SLO metrics
+  (``mxt_serving_*``) through the PR-5 telemetry registry;
+  ``tools/mxt_top.py`` renders them live.
+
+Minimal use::
+
+    from mxnet_tpu import serving
+
+    model = serving.TinyDecoder(vocab=512, num_layers=2)
+    eng = serving.DecodeEngine(model, slots=8)
+    eng.aot_warmup()                      # or tuning.warmup()
+    sched = serving.ContinuousBatcher(eng)
+    sched.submit(serving.Request([17, 3, 99], max_new_tokens=32,
+                                 deadline=0.5))
+    for req in sched.run():
+        print(req.id, req.state, req.output_tokens)
+"""
+from __future__ import annotations
+
+from .engine import DecodeEngine
+from .kv_cache import PagedKVCache
+from .model import TinyDecoder
+from .scheduler import ContinuousBatcher, Request, StaticBatcher
+from . import metrics
+
+__all__ = ["DecodeEngine", "PagedKVCache", "TinyDecoder",
+           "ContinuousBatcher", "Request", "StaticBatcher", "metrics"]

@@ -1,0 +1,371 @@
+"""Decode engine — the AOT-warmed device half of the serving stack.
+
+One fixed-shape donated jit program is the whole per-token hot path:
+embed the batch's last tokens, project q/k/v per layer, append K/V into
+the paged pool (scatter through the page table), run ragged paged
+attention (ops/attention.py), sample greedily, return the next tokens —
+``(k_pages, v_pages, context_lens, tokens)`` are donated through the
+chain so the pool is appended in place at the XLA level.
+
+Batch recomposition never recompiles: the program is always
+``MXT_SERVING_SLOTS`` wide, inactive slots are masked (their KV writes
+land on the cache's scratch page, their sampled token is held), and
+joining/retiring a request is a handful of device ``.at[]`` edits on the
+slot state arrays — all async dispatch, no host reads.
+
+Host reads are the engine's whole game: the decode loop performs ZERO
+per-step syncs. Sampled token ids ride the PR-4 in-flight window
+(``engine.InflightWindow``) as staged per-step values — every K steps
+ONE deferred transfer delivers a (K, slots) block of tokens to the
+scheduler (``nd.PendingValue`` underneath), so host_syncs/step <= 1/K
+exactly like the training stream, and ``tools/check_host_syncs.py``
+lint-enforces it stays that way.
+
+Prefill runs per request through shape-bucketed jit programs (prompt
+padded to the bucket, ragged valid_length masks the tail), writes the
+prompt's K/V pages with a donated scatter, and seeds the slot with the
+first sampled token — returned to the scheduler as a PendingValue it
+materializes at the next retirement boundary (one amortized read per
+REQUEST, not per step).
+
+``aot_warmup()`` lowers-and-compiles the decode step, every prefill
+bucket, and the page-write programs from live shapes; the engine
+registers itself with ``tuning.register_step``, so a fresh replica's
+``tuning.warmup()`` (plus the persistent compile cache) pays zero
+request-path JIT — the PR-6 contract extended to serving.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .. import engine as _engine
+from ..base import MXNetError
+from . import metrics as _m
+from .kv_cache import PagedKVCache
+
+__all__ = ["DecodeEngine"]
+
+
+class DecodeEngine:
+    """Fixed-slot decode executor over a :class:`PagedKVCache`."""
+
+    def __init__(self, model, params=None, slots=None, cache=None,
+                 prefill_buckets=(64, 256), max_context=None, seed=0):
+        import jax
+        import jax.numpy as jnp
+
+        from .. import config, tuning
+
+        self.model = model
+        self.params = params if params is not None \
+            else model.init_params(seed)
+        self.slots = int(slots or config.get("MXT_SERVING_SLOTS"))
+        if self.slots < 1:
+            raise MXNetError("a decode engine needs at least one slot")
+        self.cache = cache or PagedKVCache(
+            model.num_layers, model.num_heads, model.head_dim)
+        S = self.cache.page_size
+        self.max_context = int(min(max_context or model.max_len,
+                                   model.max_len))
+        self.table_width = -(-self.max_context // S)
+
+        B = self.slots
+        scratch = self.cache.scratch_page
+        self._tokens = jnp.zeros((B,), jnp.int32)
+        self._ctx = jnp.zeros((B,), jnp.int32)
+        self._active = jnp.zeros((B,), jnp.int32)
+        self._pt = jnp.full((B, self.table_width), scratch, jnp.int32)
+        self._host_active = np.zeros(B, bool)
+        self._host_len = np.zeros(B, np.int64)
+        self._seq_of_slot = {}
+
+        # the K-deep deferred-read dispatch window (shared machinery
+        # with the training StepStream); per-step sampled tokens stage
+        # into it and retire as one stacked read per K steps
+        self.on_tokens = None  # scheduler callback: (step_no, row, meta)
+        self._inflight_meta = []  # per-push metadata, delivered in order
+        self.window = _engine.InflightWindow(
+            name="serving_decode", on_values=self._deliver)
+
+        # tokens (arg 4) is NOT donated: each step's sampled-token array
+        # is also staged in the in-flight window for the stacked
+        # deferred read, and donating it on the next step would delete
+        # a buffer the window still holds
+        self._jit_step = jax.jit(self._step_impl,
+                                 donate_argnums=(1, 2, 3))
+        self._buckets = sorted({self._round_bucket(b)
+                                for b in prefill_buckets})
+        self._prefill_fns = {}
+        self._write_fns = {}
+        tuning.register_step(self)
+
+    # -- shape bucketing --------------------------------------------------
+    def _round_bucket(self, n):
+        S = self.cache.page_size
+        n = max(int(n), 1)
+        return -(-(-(-n // 64) * 64) // S) * S
+
+    def _bucket_for(self, n):
+        """Smallest known prefill bucket covering ``n`` prompt tokens
+        (a new bucket is minted — and becomes warmable — when traffic
+        outgrows the configured ones)."""
+        for b in self._buckets:
+            if b >= n:
+                return b
+        b = self._round_bucket(n)
+        self._buckets = sorted(set(self._buckets) | {b})
+        return b
+
+    # -- the decode hot path ----------------------------------------------
+    def _step_impl(self, params, k_pages, v_pages, ctx, tokens,
+                   page_tables, active):
+        import jax.numpy as jnp
+
+        from ..ops import attention as A
+
+        model = self.model
+        S = self.cache.page_size
+        scratch = self.cache.scratch_page
+        actb = active.astype(bool)
+        pos = ctx  # each slot's next KV index (== its current length)
+        rows = jnp.arange(self.slots)
+        # inactive slots write their (ignored) K/V to the scratch page
+        page_idx = jnp.where(
+            actb,
+            page_tables[rows, jnp.clip(pos // S, 0, self.table_width - 1)],
+            scratch)
+        slot_idx = pos % S
+        newlens = ctx + active
+
+        h = model.embed(params, tokens,
+                        jnp.clip(pos, 0, model.max_len - 1))
+        for l in range(model.num_layers):
+            q, kn, vn = model.layer_qkv(params, l, h)  # (B, H, D) each
+            k_pages = k_pages.at[l, page_idx, slot_idx].set(
+                kn.astype(k_pages.dtype))
+            v_pages = v_pages.at[l, page_idx, slot_idx].set(
+                vn.astype(v_pages.dtype))
+            attn = A.ragged_paged_attention(
+                q, k_pages[l], v_pages[l], page_tables, newlens,
+                sm_scale=model.sm_scale)
+            h = model.layer_finish(params, l, h, attn)
+        logits = model.logits(params, h)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(actb, nxt, tokens)  # inactive slots hold
+        return k_pages, v_pages, newlens, nxt
+
+    def _ensure_pages(self, slots):
+        """Grow page tables for slots whose next token crosses into an
+        unallocated page (reservation-backed — cannot fail)."""
+        import jax.numpy as jnp
+
+        for s in slots:
+            seq = self._seq_of_slot[s]
+            if self.cache.alloc_for(seq, int(self._host_len[s]) + 1):
+                row = self.cache.page_table_row(seq, self.table_width)
+                self._pt = self._pt.at[s].set(jnp.asarray(row))
+
+    def decode_step(self, meta=None):
+        """Dispatch ONE decode step for every active slot; returns the
+        window step number (None when no slot is active). ``meta`` is
+        handed back untouched with this step's retired token row —
+        the scheduler's slot→request attribution, kept out of the
+        device program entirely."""
+        act = [s for s in range(self.slots) if self._host_active[s]]
+        if not act:
+            return None
+        self._ensure_pages(act)
+        self._inflight_meta.append(meta)
+        kp, vp, ctx, tok = self._jit_step(
+            self.params, self.cache.k_pages, self.cache.v_pages,
+            self._ctx, self._tokens, self._pt, self._active)
+        self.cache.swap(kp, vp)
+        self._ctx, self._tokens = ctx, tok
+        for s in act:
+            self._host_len[s] += 1
+        _m.tokens_total().inc(len(act))
+        _m.decode_batch_occupancy().observe(len(act))
+        return self.window.push(tok, value=tok)
+
+    def _deliver(self, step_no, row):
+        """InflightWindow retirement: one host row of sampled tokens per
+        step, oldest first — metadata pops in the same order pushes
+        appended it."""
+        meta = self._inflight_meta.pop(0) if self._inflight_meta else None
+        cb = self.on_tokens
+        if cb is not None:
+            cb(step_no, row, meta)
+
+    def flush(self):
+        """Drain the in-flight window (every dispatched step's tokens
+        delivered). The scheduler's barrier; nd.waitall() also reaches
+        it through engine.wait_all."""
+        self.window.flush()
+
+    # -- prefill ----------------------------------------------------------
+    def _prefill_impl(self, params, tokens, valid, *, bucket):
+        import jax.numpy as jnp
+
+        model = self.model
+        S = self.cache.page_size
+        nbp = bucket // S
+        ks, vs, logits = model.prefill(params, tokens, valid)
+        # (L, 1, H, T, D) -> page-shaped (L, nbp, S, H, D)
+        kr = jnp.transpose(ks[:, 0], (0, 2, 1, 3)).reshape(
+            model.num_layers, nbp, S, model.num_heads, model.head_dim)
+        vr = jnp.transpose(vs[:, 0], (0, 2, 1, 3)).reshape(
+            model.num_layers, nbp, S, model.num_heads, model.head_dim)
+        tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (1,)
+        return (kr.astype(self.cache.dtype), vr.astype(self.cache.dtype),
+                tok0)
+
+    def _prefill_fn(self, bucket):
+        import jax
+
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = self._prefill_fns[bucket] = jax.jit(
+                functools.partial(self._prefill_impl, bucket=bucket))
+        return fn
+
+    def _write_fn(self, nbp):
+        import jax
+
+        fn = self._write_fns.get(nbp)
+        if fn is None:
+            def write(kp, vp, kn, vn, ids):
+                return kp.at[:, ids].set(kn), vp.at[:, ids].set(vn)
+
+            fn = self._write_fns[nbp] = jax.jit(write,
+                                                donate_argnums=(0, 1))
+        return fn
+
+    def admit(self, slot, seq_id, prompt_tokens, max_new_tokens):
+        """Prefill a request into a free slot: reserve its worst-case
+        pages, run the bucketed prompt pass, scatter the prompt K/V into
+        the pool, and seed the slot with the first sampled token.
+
+        Returns a PendingValue of that first token — deferred like
+        everything else; the scheduler materializes it at a retirement
+        boundary (the prefill has certainly finished by then)."""
+        import jax.numpy as jnp
+
+        from ..ndarray.pending import PendingValue
+
+        if self._host_active[slot] or slot in self._seq_of_slot:
+            raise MXNetError("slot %d is occupied" % slot)
+        prompt = np.array(list(prompt_tokens), np.int32)
+        T = int(prompt.shape[0])
+        total = T + int(max_new_tokens)
+        if T < 1:
+            raise MXNetError("empty prompt")
+        if total > self.max_context:
+            raise MXNetError(
+                "prompt+max_new = %d exceeds the engine's max context %d"
+                % (total, self.max_context))
+        if not self.cache.reserve(seq_id, total):
+            raise MXNetError("KV pool too busy for sequence %r (check "
+                             "cache.can_reserve before admitting)"
+                             % (seq_id,))
+        bucket = self._bucket_for(T)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :T] = prompt
+        kpag, vpag, tok0 = self._prefill_fn(bucket)(
+            self.params, jnp.asarray(padded),
+            jnp.asarray(np.array([T], np.int32)))
+        self.cache.alloc_for(seq_id, T)
+        pages = self.cache.pages_of(seq_id)
+        nbp = bucket // self.cache.page_size
+        ids = np.full((nbp,), self.cache.scratch_page, np.int32)
+        ids[:len(pages)] = pages  # bucket tail pages scatter to scratch
+        kp, vp = self._write_fn(nbp)(
+            self.cache.k_pages, self.cache.v_pages, kpag, vpag,
+            jnp.asarray(ids))
+        self.cache.swap(kp, vp)
+
+        self._seq_of_slot[slot] = seq_id
+        self._host_active[slot] = True
+        self._host_len[slot] = T
+        self._pt = self._pt.at[slot].set(
+            jnp.asarray(self.cache.page_table_row(seq_id,
+                                                  self.table_width)))
+        self._tokens = self._tokens.at[slot].set(tok0[0])
+        self._ctx = self._ctx.at[slot].set(T)
+        self._active = self._active.at[slot].set(1)
+        _m.tokens_total().inc()  # the prefill-sampled first token
+        return PendingValue(tok0)
+
+    # -- recomposition ----------------------------------------------------
+    def deactivate(self, slot):
+        """Stop decoding a slot without releasing its pages (static
+        batching's idle state; also the first half of release)."""
+        if self._host_active[slot]:
+            self._host_active[slot] = False
+            self._active = self._active.at[slot].set(0)
+
+    def release(self, slot):
+        """Retire a slot: deactivate, free the sequence's pages and
+        reservation, and point its page-table row back at scratch.
+        In-flight steps still referencing the old pages read the old
+        pool *values* (dataflow), so this is safe mid-window."""
+        import jax.numpy as jnp
+
+        self.deactivate(slot)
+        seq = self._seq_of_slot.pop(slot, None)
+        if seq is not None:
+            self.cache.free(seq)
+        self._host_len[slot] = 0
+        self._pt = self._pt.at[slot].set(
+            jnp.full((self.table_width,), self.cache.scratch_page,
+                     jnp.int32))
+
+    def defrag(self):
+        """Compact the KV pool and re-emit live slots' page-table rows
+        against the moved page ids."""
+        import jax.numpy as jnp
+
+        moved = self.cache.defrag()
+        if moved:
+            for s, seq in self._seq_of_slot.items():
+                self._pt = self._pt.at[s].set(jnp.asarray(
+                    self.cache.page_table_row(seq, self.table_width)))
+        return moved
+
+    # -- AOT warm-start ---------------------------------------------------
+    def aot_warmup(self):
+        """Lower-and-compile every request-path program from live
+        shapes: the decode step, each prefill bucket, and the page-write
+        scatters. With MXT_COMPILE_CACHE_DIR set, a later replica
+        replays all of it from disk — zero JIT on the request path."""
+        import jax
+        import jax.numpy as jnp
+
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        pstruct = jax.tree_util.tree_map(sds, self.params)
+        n = 0
+        self._jit_step.lower(
+            pstruct, sds(self.cache.k_pages), sds(self.cache.v_pages),
+            sds(self._ctx), sds(self._tokens), sds(self._pt),
+            sds(self._active)).compile()
+        n += 1
+        L, H, D = (self.model.num_layers, self.model.num_heads,
+                   self.model.head_dim)
+        S = self.cache.page_size
+        for bucket in list(self._buckets):
+            nbp = bucket // S
+            self._prefill_fn(bucket).lower(
+                pstruct,
+                jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+                jax.ShapeDtypeStruct((1,), jnp.int32)).compile()
+            pool = jax.ShapeDtypeStruct(
+                (L, nbp, S, H, D), self.cache.dtype)
+            self._write_fn(nbp).lower(
+                sds(self.cache.k_pages), sds(self.cache.v_pages),
+                pool, pool,
+                jax.ShapeDtypeStruct((nbp,), jnp.int32)).compile()
+            n += 2
+        return n

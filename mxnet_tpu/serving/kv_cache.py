@@ -1,0 +1,228 @@
+"""Paged KV-cache manager — fixed-size KV pages in a preallocated
+device pool (the serving half of ROADMAP direction 1; the design is the
+paged-attention memory model of PAPERS.md arXiv 2604.15464).
+
+Why pages: a decode batch holds sequences of wildly different lengths,
+and a dense (B, H, Tmax, D) cache pays Tmax for every slot. Here the
+pool is ``(layers, pages + 1, page_size, heads, head_dim)`` per K and V,
+sequences own *page lists*, and the ragged paged attention kernel
+(ops/attention.py) streams exactly the pages a sequence uses. Slot
+reuse, mixed lengths, and request churn cost page-table edits, never
+pool reallocation or recompilation.
+
+Pool arrays are functional jax values: the decode step *donates* them
+through the jitted program (append-in-place at the XLA level), and the
+cache swaps in each step's output arrays. Host-side state is pure
+bookkeeping — free list, per-sequence page lists, reservations — and
+never reads the device (this module is on the check_host_syncs.py scan
+list).
+
+Admission control is worst-case reservation: :meth:`reserve` promises
+``ceil((prompt + max_new) / page_size)`` pages up front, so a running
+decode can never hit pool exhaustion mid-flight; pages are *allocated*
+lazily as the sequence actually crosses page boundaries, and
+:meth:`defrag` compacts live pages to the low end of the pool (pool
+shrink / DMA-locality maintenance).
+
+The extra page at index ``num_pages`` is the **scratch page**: masked
+writes of inactive batch slots and padded page-table entries route
+there, keeping the decode program's shapes fixed without conditional
+writes.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..base import MXNetError
+from . import metrics as _m
+
+__all__ = ["PagedKVCache"]
+
+
+def _config():
+    from .. import config
+
+    return config
+
+
+class PagedKVCache:
+    """One serving replica's KV page pool + page-table bookkeeping."""
+
+    def __init__(self, num_layers, num_heads, head_dim, num_pages=None,
+                 page_size=None, dtype="float32"):
+        import jax.numpy as jnp
+
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.page_size = int(page_size or _config().get("MXT_PAGE_SIZE"))
+        if self.page_size < 8 or self.page_size % 8:
+            raise MXNetError("MXT_PAGE_SIZE must be a positive multiple "
+                             "of 8 (TPU sublane), got %d" % self.page_size)
+        self.num_pages = int(num_pages
+                             or _config().get("MXT_SERVING_PAGES"))
+        if self.num_pages < 1:
+            raise MXNetError("a KV cache needs at least one page")
+        self.dtype = jnp.dtype(dtype)
+        shape = (self.num_layers, self.num_pages + 1, self.page_size,
+                 self.num_heads, self.head_dim)
+        self.k_pages = jnp.zeros(shape, self.dtype)
+        self.v_pages = jnp.zeros(shape, self.dtype)
+
+        self._lock = threading.Lock()
+        self._free = list(range(self.num_pages - 1, -1, -1))  # pop() = 0
+        self._pages = {}     # seq_id -> [page ids, in sequence order]
+        self._quota = {}     # seq_id -> reserved page count (total)
+        _m.kv_pages_total().set(self.num_pages)
+        self._publish()
+
+    # -- helpers ----------------------------------------------------------
+    @property
+    def scratch_page(self):
+        """Pool index of the masked-write scratch page."""
+        return self.num_pages
+
+    def pages_needed(self, ntokens):
+        return -(-int(ntokens) // self.page_size)
+
+    def _publish(self):
+        in_use = self.num_pages - len(self._free)
+        reserved = sum(self._quota.values()) - sum(
+            len(p) for p in self._pages.values())
+        _m.kv_pages_in_use().set(in_use)
+        _m.kv_pages_reserved().set(max(0, reserved))
+
+    # -- reservation + allocation ----------------------------------------
+    def available(self):
+        """Pages free AND unpromised — what admission may still reserve."""
+        with self._lock:
+            unallocated = sum(self._quota.values()) - sum(
+                len(p) for p in self._pages.values())
+            return len(self._free) - unallocated
+
+    def can_reserve(self, ntokens):
+        return self.pages_needed(ntokens) <= self.available()
+
+    def reserve(self, seq_id, ntokens):
+        """Promise ``ceil(ntokens / page_size)`` pages to ``seq_id``
+        (its lifetime worst case). False = pool too busy — the request
+        stays queued. A sequence reserves once."""
+        npages = self.pages_needed(ntokens)
+        if npages > self.num_pages:
+            raise MXNetError(
+                "request needs %d KV pages but the pool only has %d — "
+                "raise MXT_SERVING_PAGES or shorten prompt+max_new"
+                % (npages, self.num_pages))
+        if self.available() < npages:
+            return False
+        with self._lock:
+            if seq_id in self._quota:
+                raise MXNetError("sequence %r already holds a "
+                                 "reservation" % (seq_id,))
+            self._quota[seq_id] = npages
+            self._pages[seq_id] = []
+        self._publish()
+        return True
+
+    def alloc_page(self, seq_id):
+        """Materialize the next page of a reserved sequence; returns the
+        pool page id. Reservation-bounded, so this cannot fail mid-decode
+        (the admission check already paid for it)."""
+        with self._lock:
+            if seq_id not in self._quota:
+                raise MXNetError("sequence %r has no reservation"
+                                 % (seq_id,))
+            pages = self._pages[seq_id]
+            if len(pages) >= self._quota[seq_id]:
+                raise MXNetError(
+                    "sequence %r exceeded its %d-page reservation"
+                    % (seq_id, self._quota[seq_id]))
+            page = self._free.pop()
+            pages.append(page)
+        self._publish()
+        return page
+
+    def alloc_for(self, seq_id, ntokens):
+        """Allocate pages until ``ntokens`` positions are covered;
+        returns the new page ids (possibly empty)."""
+        new = []
+        while len(self.pages_of(seq_id)) < self.pages_needed(ntokens):
+            new.append(self.alloc_page(seq_id))
+        return new
+
+    def free(self, seq_id):
+        """Release a sequence: its pages return to the free list, its
+        reservation dissolves. In-flight decode steps that still read
+        the pages are safe — they consumed earlier pool *values*, and a
+        later prefill writing a recycled page produces a new value the
+        old steps never see (XLA dataflow, not aliasing)."""
+        with self._lock:
+            pages = self._pages.pop(seq_id, [])
+            self._quota.pop(seq_id, None)
+            self._free.extend(reversed(pages))
+        self._publish()
+        return len(pages)
+
+    def pages_of(self, seq_id):
+        with self._lock:
+            return list(self._pages.get(seq_id, ()))
+
+    def sequences(self):
+        with self._lock:
+            return sorted(self._pages)
+
+    def pages_in_use(self):
+        with self._lock:
+            return self.num_pages - len(self._free)
+
+    # -- device plumbing --------------------------------------------------
+    def swap(self, k_pages, v_pages):
+        """Adopt the pool arrays a donated decode/prefill program
+        returned (the old ones were its inputs and are now invalid)."""
+        self.k_pages = k_pages
+        self.v_pages = v_pages
+
+    def page_table_row(self, seq_id, width):
+        """(width,) int32 page-table row for a batch slot: the
+        sequence's pages in order, scratch-padded (a padded slot must
+        stay a *valid* pool index — the kernel reads it and masks)."""
+        pages = self.pages_of(seq_id)
+        if len(pages) > width:
+            raise MXNetError("sequence %r uses %d pages > table width %d"
+                             % (seq_id, len(pages), width))
+        row = np.full((width,), self.scratch_page, np.int32)
+        row[:len(pages)] = pages
+        return row
+
+    # -- defrag -----------------------------------------------------------
+    def defrag(self):
+        """Compact live pages to the low end of the pool: after churn
+        the free list is scattered and long-lived sequences pin high
+        page ids; compaction restores contiguity (DMA locality, and the
+        precondition for ever shrinking the pool). One gather/scatter
+        pair on device per pool; page tables on the NEXT decode step
+        pick up the moved ids (callers must re-emit device page-table
+        rows for live slots — serving.DecodeEngine.defrag does).
+
+        Returns the number of pages moved."""
+        with self._lock:
+            used = sorted(p for pages in self._pages.values()
+                          for p in pages)
+            mapping = {old: new for new, old in enumerate(used)
+                       if old != new}
+            if not mapping:
+                return 0
+            src = np.array(sorted(mapping), np.int32)
+            dst = np.array([mapping[s] for s in sorted(mapping)], np.int32)
+            self._pages = {
+                seq: [mapping.get(p, p) for p in pages]
+                for seq, pages in self._pages.items()}
+            self._free = list(range(self.num_pages - 1, len(used) - 1, -1))
+        # functional scatter: RHS gathers from the OLD array, so
+        # overlapping src/dst ranges cannot clobber each other
+        self.k_pages = self.k_pages.at[:, dst].set(self.k_pages[:, src])
+        self.v_pages = self.v_pages.at[:, dst].set(self.v_pages[:, src])
+        self._publish()
+        return len(src)

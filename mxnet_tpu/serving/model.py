@@ -1,0 +1,180 @@
+"""Decode-model adapter — what the serving engine needs from a model.
+
+The engine is model-agnostic: anything exposing the small surface below
+(:class:`TinyDecoder` is the canonical implementation and the
+test/bench/example workhorse) can serve through the paged KV cache:
+
+- ``embed(params, tokens, positions)`` — token + position embedding for
+  ONE token per sequence (decode) or a whole prompt (prefill);
+- ``layer_qkv(params, l, h)`` — layer ``l``'s pre-attention projection,
+  returning per-head q/k/v;
+- ``layer_finish(params, l, h, attn)`` — attention output projection,
+  residual, and the MLP for layer ``l``;
+- ``logits(params, h)`` — final norm + (tied) LM head;
+- ``prefill(params, tokens, valid_length)`` — the dense prompt pass:
+  per-layer K/V for every prompt position plus the last valid
+  position's logits. Prefill attention is causal+ragged DENSE
+  (the flash path's reference with a padding bias); decode attention is
+  the paged kernel — both mask with the same definition, which is what
+  the parity tests pin.
+
+Everything is pure JAX on pytrees of arrays (no gluon Blocks): the
+serving decode step must trace into ONE donated jit program, and
+parameter dicts keep that trivially true.
+
+:class:`TinyDecoder` is a standard pre-LN causal transformer LM (tied
+embeddings, GELU MLP). :meth:`reference_decode` greedy-decodes by
+re-running the dense prefill over the whole growing sequence each step —
+quadratic and cache-free on purpose: it is the end-to-end oracle the
+paged engine must reproduce token for token.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["TinyDecoder"]
+
+
+class TinyDecoder:
+    """A small pure-JAX causal transformer LM for the serving stack."""
+
+    def __init__(self, vocab=128, num_layers=2, num_heads=2, head_dim=16,
+                 ffn_hidden=None, max_len=1024):
+        self.vocab = int(vocab)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.model_dim = self.num_heads * self.head_dim
+        self.ffn_hidden = int(ffn_hidden or 4 * self.model_dim)
+        self.max_len = int(max_len)
+        self.sm_scale = 1.0 / math.sqrt(self.head_dim)
+
+    # -- parameters -------------------------------------------------------
+    def init_params(self, seed=0):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(seed)
+        m, f = self.model_dim, self.ffn_hidden
+
+        def w(*shape):
+            return jnp.asarray(
+                rng.normal(0.0, 0.02, shape).astype(np.float32))
+
+        p = {"wte": w(self.vocab, m), "wpe": w(self.max_len, m),
+             "lnf_g": jnp.ones((m,), jnp.float32),
+             "lnf_b": jnp.zeros((m,), jnp.float32)}
+        for l in range(self.num_layers):
+            p["ln1_%d_g" % l] = jnp.ones((m,), jnp.float32)
+            p["ln1_%d_b" % l] = jnp.zeros((m,), jnp.float32)
+            p["qkv_%d" % l] = w(m, 3 * m)
+            p["o_%d" % l] = w(m, m)
+            p["ln2_%d_g" % l] = jnp.ones((m,), jnp.float32)
+            p["ln2_%d_b" % l] = jnp.zeros((m,), jnp.float32)
+            p["fc1_%d" % l] = w(m, f)
+            p["fc2_%d" % l] = w(f, m)
+        return p
+
+    # -- shared layer math (identical trace for prefill and decode) -------
+    @staticmethod
+    def _ln(x, g, b):
+        import jax.numpy as jnp
+
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+        return (x - mu) * (1.0 / jnp.sqrt(var + 1e-5)) * g + b
+
+    def embed(self, params, tokens, positions):
+        """(..., ) int tokens/positions -> (..., M) hidden."""
+        return params["wte"][tokens] + params["wpe"][positions]
+
+    def layer_qkv(self, params, l, h):
+        """(..., M) hidden -> q, k, v each (..., H, D)."""
+        import jax.numpy as jnp
+
+        x = self._ln(h, params["ln1_%d_g" % l], params["ln1_%d_b" % l])
+        qkv = x @ params["qkv_%d" % l]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = q.shape[:-1] + (self.num_heads, self.head_dim)
+        return q.reshape(shape), k.reshape(shape), v.reshape(shape)
+
+    def layer_finish(self, params, l, h, attn):
+        """attn (..., H, D) -> next hidden (..., M)."""
+        import jax
+
+        m = self.model_dim
+        h = h + attn.reshape(attn.shape[:-2] + (m,)) @ params["o_%d" % l]
+        x = self._ln(h, params["ln2_%d_g" % l], params["ln2_%d_b" % l])
+        return h + jax.nn.gelu(x @ params["fc1_%d" % l]) \
+            @ params["fc2_%d" % l]
+
+    def logits(self, params, h):
+        return self._ln(h, params["lnf_g"], params["lnf_b"]) \
+            @ params["wte"].T
+
+    # -- dense prompt pass ------------------------------------------------
+    def prefill(self, params, tokens, valid_length):
+        """Dense causal+ragged prompt pass.
+
+        ``tokens``: (B, T) int32 (right-padded), ``valid_length``: (B,).
+        Returns ``(k, v, last_logits)`` with k/v ``(L, B, H, T, D)`` and
+        ``last_logits`` ``(B, vocab)`` taken at each sequence's last
+        valid position — the logits that sample generated token #1.
+        """
+        import jax.numpy as jnp
+
+        from ..ops import attention as A
+
+        B, T = tokens.shape
+        h = self.embed(params, tokens, jnp.arange(T)[None, :])
+        ks, vs = [], []
+        bias = A.make_padding_bias(valid_length, max_len=T,
+                                   dtype="float32")
+        for l in range(self.num_layers):
+            q, k, v = self.layer_qkv(params, l, h)      # (B, T, H, D)
+            qt = jnp.transpose(q, (0, 2, 1, 3))         # (B, H, T, D)
+            kt = jnp.transpose(k, (0, 2, 1, 3))
+            vt = jnp.transpose(v, (0, 2, 1, 3))
+            ks.append(kt)
+            vs.append(vt)
+            attn = A._attention_reference(qt, kt, vt, bias, True,
+                                          self.sm_scale)
+            h = self.layer_finish(params, l, h,
+                                  jnp.transpose(attn, (0, 2, 1, 3)))
+        last = jnp.clip(valid_length.astype(jnp.int32) - 1, 0, T - 1)
+        h_last = jnp.take_along_axis(
+            h, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        return (jnp.stack(ks), jnp.stack(vs),
+                self.logits(params, h_last))
+
+    # -- the cache-free oracle -------------------------------------------
+    def reference_decode(self, params, prompt, max_new_tokens,
+                         eos_id=None):
+        """Greedy-decode by re-running the DENSE prompt pass over the
+        whole growing sequence every step — no KV cache, no paging, no
+        deferred reads. Quadratic and slow by design: the independent
+        end-to-end oracle the paged serving engine must match token for
+        token. The growing sequence is right-padded to one fixed bucket
+        (valid_length masks the tail), so the whole loop traces a
+        single shape instead of one per length."""
+        import jax.numpy as jnp
+
+        import jax
+
+        toks = [int(t) for t in prompt]
+        out = []
+        bucket = -(-(len(toks) + int(max_new_tokens)) // 32) * 32
+        fwd = jax.jit(self.prefill)
+        for _ in range(int(max_new_tokens)):
+            arr = np.zeros((1, bucket), np.int32)
+            arr[0, :len(toks)] = toks
+            vl = jnp.asarray(np.array([len(toks)], np.int32))
+            _, _, logits = fwd(params, jnp.asarray(arr), vl)
+            # sync-ok: the oracle reads every step by definition
+            nxt = int(np.argmax(np.array(logits[0])))
+            out.append(nxt)
+            toks.append(nxt)
+            if eos_id is not None and nxt == int(eos_id):
+                break
+        return out

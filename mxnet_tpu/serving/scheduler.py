@@ -1,0 +1,288 @@
+"""Continuous-batching request scheduler — the admission/recomposition
+brain over :class:`~mxnet_tpu.serving.engine.DecodeEngine`.
+
+The reference framework's serving story was ``Module.forward`` on a
+padded batch: compose a batch, run it to the longest member's end, eat
+the padding. Continuous batching (the vLLM/Orca discipline) recomposes
+the batch at every decode step instead: finished requests retire
+immediately, queued requests join mid-flight through a prefill, and the
+fixed-slot decode program never idles a slot that traffic could fill.
+
+Host/device split: the scheduler is PURE host bookkeeping. It learns
+sampled tokens only when the engine's in-flight window retires them
+(K steps per deferred read), so its view lags the device by up to K
+steps — by design:
+
+- length-based completion (``max_new_tokens``) is host-arithmetic and
+  retires a slot the step its quota is dispatched (no lag);
+- EOS-based completion is observed at retirement, so up to K post-EOS
+  tokens are generated and discarded — the classic deferred-sync
+  trade, same as the training guard flags;
+- attribution is exact regardless of lag: every dispatched step carries
+  its (slot → request) composition as window metadata, so a token row
+  retiring after the slot was recomposed still lands on the right
+  request.
+
+Deadlines: a request carries an optional SLO budget (seconds from
+``submit``); the scheduler evicts blown requests — queued or running —
+frees their pages, and counts them in
+``mxt_serving_requests_total{outcome="evicted"}``.
+
+:class:`StaticBatcher` is the A/B baseline bench.py measures against:
+same engine, same requests, but admission only at batch boundaries —
+every slot waits for the batch's longest member, which is exactly the
+waste continuous batching deletes.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import time
+
+from ..base import MXNetError
+from . import metrics as _m
+
+__all__ = ["Request", "ContinuousBatcher", "StaticBatcher"]
+
+_req_ids = itertools.count()
+
+
+class Request:
+    """One generation request: a prompt, a token budget, an optional
+    deadline, and the output/latency record the scheduler fills in."""
+
+    def __init__(self, prompt, max_new_tokens=16, deadline=None,
+                 eos_id=None, request_id=None):
+        self.prompt = [int(t) for t in prompt]
+        if not self.prompt:
+            raise MXNetError("Request needs a non-empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        self.deadline = None if deadline is None \
+            else float(deadline)  # sync-ok: host float, not a device read
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.id = request_id if request_id is not None \
+            else "req-%d" % next(_req_ids)
+        self.output_tokens = []
+        self.state = "created"  # queued|running|completed|evicted|rejected
+        self.t_submit = self.t_admit = self.t_first = self.t_finish = None
+        self._dispatched = 0   # tokens generated-or-in-flight (incl. #1)
+        self._first_pv = None  # deferred first token from prefill
+        self._eos = False
+        self._finalized = False
+
+    @property
+    def done(self):
+        return self.state in ("completed", "evicted", "rejected")
+
+    def _take_first(self, now):
+        """Materialize the prefill's deferred first token (idempotent;
+        one amortized host read per request). Stamps the prefill phase:
+        submit-side wall clock to first-token availability."""
+        pv, self._first_pv = self._first_pv, None
+        if pv is None:
+            return
+        tok = int(pv.get().reshape(-1)[0])
+        if self.t_first is None:
+            self.t_first = now
+            if self.t_admit is not None:
+                _m.request_latency().labels("prefill").observe(
+                    max(0.0, now - self.t_admit))
+        self._record(tok, now)
+
+    def _record(self, tok, now):
+        """One observed output token (post-EOS and over-budget tokens —
+        dispatch lag artifacts — are discarded)."""
+        if self.done and self.state != "completed":
+            return
+        if self._eos or len(self.output_tokens) >= self.max_new_tokens:
+            return
+        self.output_tokens.append(int(tok))
+        if self.t_first is None:
+            self.t_first = now
+        if self.eos_id is not None and int(tok) == self.eos_id:
+            self._eos = True
+        if self._eos or len(self.output_tokens) >= self.max_new_tokens:
+            self.state = "completed"
+            self.t_finish = now
+
+
+class ContinuousBatcher:
+    """Admission queue + per-step batch recomposition over one engine."""
+
+    def __init__(self, engine, now_fn=time.monotonic):
+        self.engine = engine
+        engine.on_tokens = self._on_tokens
+        self._queue = collections.deque()
+        self._slot_req = {}  # slot -> Request currently OWNING the slot
+        self._now = now_fn
+        self.steps = 0
+        self.completed = []  # terminal requests, in finalization order
+
+    # -- intake -----------------------------------------------------------
+    def submit(self, request):
+        """Queue a request (returns it). Requests that can NEVER fit —
+        prompt+budget over the engine's context or the whole pool — are
+        rejected immediately rather than deadlocking the queue."""
+        request.t_submit = self._now()
+        total = len(request.prompt) + request.max_new_tokens
+        cache = self.engine.cache
+        if total > self.engine.max_context \
+                or cache.pages_needed(total) > cache.num_pages:
+            request.state = "rejected"
+            self._finalize(request, "rejected")
+            return request
+        request.state = "queued"
+        self._queue.append(request)
+        _m.queue_depth().set(len(self._queue))
+        return request
+
+    # -- the per-step recomposition loop ----------------------------------
+    def step(self):
+        """One scheduler tick: evict blown deadlines, retire finished
+        slots, admit what fits, dispatch one decode step. Returns True
+        while there is (or was) work."""
+        now = self._now()
+        self.steps += 1
+        self._evict_deadlines(now)
+        self._reap_finished(now)
+        self._admit(now)
+        meta = tuple((s, r) for s, r in sorted(self._slot_req.items())
+                     if not r.done and r._dispatched < r.max_new_tokens)
+        if meta:
+            self.engine.decode_step(meta=meta)
+            for _, r in meta:
+                r._dispatched += 1
+        return bool(meta or self._queue or self._slot_req)
+
+    def run(self, max_steps=100000):
+        """Drive until the queue and every slot drain (or the step
+        bound trips); flushes the window and returns ``completed``."""
+        while (self._queue or self._slot_req) \
+                and self.steps < int(max_steps):
+            self.step()
+        self.drain()
+        return self.completed
+
+    def drain(self):
+        """Barrier: retire every in-flight step, materialize pending
+        first tokens, finalize what completed."""
+        self.engine.flush()
+        now = self._now()
+        for r in list(self._slot_req.values()):
+            r._take_first(now)
+        self._reap_finished(now)
+
+    # -- internals --------------------------------------------------------
+    def _free_slots(self):
+        return [s for s in range(self.engine.slots)
+                if s not in self._slot_req]
+
+    def _evict_deadlines(self, now):
+        for slot, req in list(self._slot_req.items()):
+            if req.deadline is not None and not req.done \
+                    and now - req.t_submit > req.deadline:
+                req.state = "evicted"
+                req.t_finish = now
+                self.engine.release(slot)
+                del self._slot_req[slot]
+                self._finalize(req, "evicted")
+        kept = collections.deque()
+        while self._queue:
+            req = self._queue.popleft()
+            if req.deadline is not None \
+                    and now - req.t_submit > req.deadline:
+                req.state = "evicted"
+                req.t_finish = now
+                self._finalize(req, "evicted")
+            else:
+                kept.append(req)
+        self._queue = kept
+        _m.queue_depth().set(len(self._queue))
+        _m.active_requests().set(len(self._slot_req))
+
+    def _reap_finished(self, now):
+        """Release slots whose request finished — by observed completion
+        (EOS) or by dispatch quota (every budgeted token is at least in
+        flight; the remaining rows attribute through step metadata)."""
+        for slot, req in list(self._slot_req.items()):
+            if req.done or req._dispatched >= req.max_new_tokens:
+                req._take_first(now)  # covers max_new_tokens == 1
+                self.engine.release(slot)
+                del self._slot_req[slot]
+                if req.done:
+                    self._finalize(req, req.state)
+                # else: quota dispatched, tail tokens still in flight —
+                # completion lands via step metadata at retirement
+        _m.active_requests().set(len(self._slot_req))
+
+    def _admit(self, now):
+        while self._queue and self._free_slots():
+            req = self._queue[0]
+            total = len(req.prompt) + req.max_new_tokens
+            if not self.engine.cache.can_reserve(total):
+                break  # pages busy; retiring traffic will free them
+            self._queue.popleft()
+            slot = self._free_slots()[0]
+            req.t_admit = now
+            _m.request_latency().labels("queue").observe(
+                max(0.0, now - req.t_submit))
+            req._first_pv = self.engine.admit(
+                slot, req.id, req.prompt, req.max_new_tokens)
+            req.state = "running"
+            req._dispatched = 1  # the prefill-sampled token
+            self._slot_req[slot] = req
+        _m.queue_depth().set(len(self._queue))
+        _m.active_requests().set(len(self._slot_req))
+
+    def _on_tokens(self, step_no, row, meta):
+        """Engine retirement callback: one host token row + the step's
+        composition metadata. Runs inside the window's deferred read —
+        records only; slot recomposition stays in step()."""
+        del step_no
+        now = self._now()
+        for slot, req in (meta or ()):
+            req._take_first(now)
+            was_done = req.done
+            req._record(int(row[slot]), now)
+            if req.state == "completed" and not was_done:
+                self._finalize(req, "completed")
+
+    def _finalize(self, req, outcome):
+        if req._finalized:
+            return
+        req._finalized = True
+        _m.requests_total().labels(outcome).inc()
+        if outcome == "completed" and req.t_first is not None \
+                and req.t_finish is not None:
+            _m.request_latency().labels("decode").observe(
+                max(0.0, req.t_finish - req.t_first))
+        self.completed.append(req)
+
+
+class StaticBatcher(ContinuousBatcher):
+    """The padded-batch baseline: admission happens ONLY at batch
+    boundaries. A batch of mixed-length requests runs until its longest
+    member finishes; short members' slots sit deactivated (no useful
+    work, pages still held) — the cost continuous batching removes.
+    Same engine, same requests, same metrics: bench.py's A/B."""
+
+    def _admit(self, now):
+        if self._slot_req:
+            return  # batch in flight: the door is closed
+        super()._admit(now)
+
+    def _reap_finished(self, now):
+        items = list(self._slot_req.items())
+        if not items:
+            return
+        finished = []
+        for slot, req in items:
+            if req.done or req._dispatched >= req.max_new_tokens:
+                self.engine.deactivate(slot)  # idle, not released
+                finished.append((slot, req))
+        if len(finished) == len(items):  # batch boundary: release all
+            super()._reap_finished(now)
+        else:
+            _m.active_requests().set(len(self._slot_req))

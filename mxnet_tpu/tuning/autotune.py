@@ -155,6 +155,75 @@ def measure_attention(q, k, v, bias, causal, sm_scale, interpret=False,
 
 
 # --------------------------------------------------------------------------
+# ragged paged attention (decode)
+# --------------------------------------------------------------------------
+def paged_candidates(heads, head_dim, page_size, dtype):
+    """Legal head-block widths for the paged decode kernel: divisors of
+    the head count (the kernel statically unrolls per-head matvecs over
+    the block), VMEM-bounded by one page of K+V per head in the block
+    plus the f32 softmax state."""
+    isz = _itemsize(dtype)
+    out = []
+    for bh in (1, 2, 4, 8, 16, 32):
+        if bh > heads or heads % bh:
+            continue
+        vmem = (2 * page_size * bh * head_dim + bh * head_dim) * isz \
+            + bh * (page_size + 2 * _LANE + head_dim) * 4
+        if vmem > _VMEM_BUDGET:
+            continue
+        out.append(bh)
+    return out or [1]
+
+
+def paged_cost(heads, head_dim, page_size, max_pages, bh):
+    """Deterministic relative cost of one head-block width. Decode is
+    grid-overhead dominated (every grid step moves one page and does a
+    handful of matvecs), so wider head blocks amortize steps — charged
+    against the unrolled-code/VMEM pressure of very wide blocks."""
+    steps = (heads // bh) * max_pages
+    work = steps * (8.0 + 0.002 * bh * page_size * head_dim)
+    if bh > 8:
+        work *= 1.0 + (bh - 8) / 32.0  # unroll bloat past one sublane tile
+    return work
+
+
+def heuristic_paged(q_shape, page_size, max_pages, dtype):
+    """Cost-model argmin head block + backend choice for one decode
+    shape. Short contexts (a page or two) lose the kernel's grid setup
+    to XLA's fused gather+softmax; past that the paged kernel avoids
+    materializing the gathered (B, T, H, D) stream every step."""
+    _, h, d = q_shape
+    best, best_cost = None, math.inf
+    for bh in paged_candidates(h, d, page_size, dtype):
+        c = paged_cost(h, d, page_size, max_pages, bh)
+        if c < best_cost:
+            best, best_cost = bh, c
+    backend = "pallas" if page_size * max_pages >= 256 else "xla"
+    return {"backend": backend, "block_h": best, "source": "heuristic",
+            "score": round(best_cost, 3)}
+
+
+def resolve_paged(q_shape, page_size, max_pages, dtype):
+    """The per-call decision the paged decode kernel consumes: table
+    hit, else the cost model, recorded under the decode-shape bucket.
+    Decode dispatches happen inside the jitted serving step (tracers —
+    nothing to time), so unlike the flash kernel there is no inline
+    measurement path: measured entries arrive via offline sweeps writing
+    the table, and are never downgraded by this heuristic re-record.
+    ``MXT_TUNE_MODE=off`` bypasses the table (pure cost model), matching
+    the flash kernel's legacy-global semantics."""
+    if _mode() == "off":
+        return heuristic_paged(q_shape, page_size, max_pages, dtype)
+    tab = _table_mod.table()
+    key = _table_mod.paged_key(q_shape, page_size, max_pages, dtype)
+    ent = tab.lookup(key)
+    if ent is not None:
+        return ent
+    return tab.record(key, heuristic_paged(q_shape, page_size, max_pages,
+                                           dtype))
+
+
+# --------------------------------------------------------------------------
 # BN backward
 # --------------------------------------------------------------------------
 def bn_candidates(m, c):

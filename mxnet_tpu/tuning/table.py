@@ -91,6 +91,17 @@ def bn_key(m, c, dtype, kind=None):
                                      kind or device_kind())
 
 
+def paged_key(q_shape, page_size, max_pages, dtype, kind=None):
+    """Decode-shape bucket for the ragged paged attention kernel: batch
+    slots round to the next power of two, the page-table width (context
+    capacity) likewise — a serving engine growing a sequence page by
+    page must not churn new table entries every page."""
+    b, h, d = q_shape
+    return "paged|b%d|h%d|d%d|s%d|p%d|%s|%s" % (
+        bucket_rows(b), int(h), int(d), int(page_size),
+        bucket_rows(max_pages), str(dtype), kind or device_kind())
+
+
 class TuneTable:
     """One process's view of the tuning table: entries + signatures,
     loaded from ``path`` when it exists (corrupted/stale files are

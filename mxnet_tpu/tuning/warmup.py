@@ -114,6 +114,30 @@ def _warm_bn(spec):
     return "batch_norm"
 
 
+def _warm_paged(spec):
+    """AOT-compile the ragged paged attention decode program for one
+    recorded signature (both the jitted dispatch a serving step traces
+    through and the standalone op a request-path eval would hit)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import attention as A
+
+    sm_scale = float(spec["sm_scale"])  # sync-ok: host float from JSON
+    q = _sds(spec["q_shape"], spec["dtype"])
+    kp = _sds(spec["pool_shape"], spec["dtype"])
+    vp = _sds(spec["pool_shape"], spec["dtype"])
+    pt = _sds((spec["q_shape"][0], spec["max_pages"]), jnp.int32)
+    cl = _sds((spec["q_shape"][0],), jnp.int32)
+
+    def fwd(q_, kp_, vp_, pt_, cl_):
+        return A.ragged_paged_attention(q_, kp_, vp_, pt_, cl_,
+                                        sm_scale=sm_scale)
+
+    jax.jit(fwd).lower(q, kp, vp, pt, cl).compile()
+    return "paged_attention"
+
+
 def warmup(steps=(), kernels=True, include_live=True):
     """AOT-lower-and-compile the canonical entry points from recorded
     shape signatures.
@@ -134,7 +158,8 @@ def warmup(steps=(), kernels=True, include_live=True):
     warmed, errors = [], []
     if kernels:
         for kind, fn in (("flash_attention", _warm_flash),
-                         ("batch_norm", _warm_bn)):
+                         ("batch_norm", _warm_bn),
+                         ("paged_attention", _warm_paged)):
             for spec in signatures(kind):
                 try:
                     warmed.append(fn(spec))

@@ -34,6 +34,9 @@ EXAMPLES = {
     "examples/long_context_gpt.py": [
         "--devices", "4", "--seq-len", "64", "--steps", "1",
         "--batch-size", "1"],
+    "examples/serve_bert.py": [
+        "--requests", "3", "--slots", "2", "--pages", "128",
+        "--layers", "1", "--head-dim", "16", "--max-new", "12"],
 }
 
 

@@ -63,6 +63,19 @@ SCAN = {
     "mxnet_tpu/tuning/autotune.py": _ALL,
     "mxnet_tpu/tuning/warmup.py": _ALL,
     "mxnet_tpu/tuning/compile_cache.py": _ALL,
+    # the serving decode loop IS a hot path with an SLO: scheduler ticks
+    # and cache bookkeeping run between every decode dispatch, so one
+    # stray read there re-synchronizes every token of every request.
+    # Tokens/flags leave the device ONLY through the InflightWindow's
+    # deferred protocol (one stacked read per K steps) and the
+    # per-request prefill PendingValue. model.py's reference_decode is
+    # the parity oracle and marks its per-step read sync-ok.
+    "mxnet_tpu/serving/__init__.py": _ALL,
+    "mxnet_tpu/serving/engine.py": _ALL,
+    "mxnet_tpu/serving/scheduler.py": _ALL,
+    "mxnet_tpu/serving/kv_cache.py": _ALL,
+    "mxnet_tpu/serving/model.py": _ALL,
+    "mxnet_tpu/serving/metrics.py": _ALL,
 }
 
 _MARKER = "sync-ok"

@@ -17,6 +17,13 @@ async-training health signals once per interval:
                        (a warm-started replica shows hits only)
     tune cache         kernel-autotuner table hit/miss
 
+and, when the process serves (mxnet_tpu/serving/ metrics present):
+
+    serving tok/s      generated tokens per second
+    queue depth        requests waiting for a batch slot (+ active/evicted)
+    request p50/p99    decode-phase request latency quantiles
+    kv pages           paged KV-cache occupancy vs pool capacity
+
 Usage::
 
     python tools/mxt_top.py --url http://127.0.0.1:9109
@@ -215,6 +222,19 @@ def render(samples, prev, dt):
     tune_hits = metric_sum(samples, "mxt_tune_cache_hits_total")
     tune_miss = metric_sum(samples, "mxt_tune_cache_misses_total")
 
+    # serving section (mxnet_tpu/serving/): only rendered when the
+    # process has served — a pure trainer shows no serving noise
+    tok_rate, tok_total = rate("mxt_serving_tokens_total")
+    srv_queue = metric_sum(samples, "mxt_serving_queue_depth")
+    srv_active = metric_sum(samples, "mxt_serving_active_requests")
+    srv_p50, srv_p99 = histogram_quantiles(
+        samples, "mxt_serving_request_latency_seconds", (0.50, 0.99),
+        phase="decode")
+    pages_used = metric_sum(samples, "mxt_serving_kv_pages_in_use")
+    pages_total = metric_sum(samples, "mxt_serving_kv_pages_total")
+    evicted = metric_sum(samples, "mxt_serving_requests_total",
+                         outcome="evicted")
+
     lines = [
         "mxt_top  %s" % time.strftime("%H:%M:%S"),
         "-" * 46,
@@ -233,6 +253,19 @@ def render(samples, prev, dt):
         "  tune cache       %s/%s hit/miss"
         % (_fmt(tune_hits, "%.0f"), _fmt(tune_miss, "%.0f")),
     ]
+    if tok_total is not None:
+        lines += [
+            "-" * 46,
+            "  serving tok/s    %s   (total %s)"
+            % (_fmt(tok_rate), _fmt(tok_total, "%.0f")),
+            "  queue depth      %s   active %s   evicted %s"
+            % (_fmt(srv_queue, "%.0f"), _fmt(srv_active, "%.0f"),
+               _fmt(evicted, "%.0f")),
+            "  request p50/p99  %s / %s (decode)"
+            % (_fmt_s(srv_p50), _fmt_s(srv_p99)),
+            "  kv pages         %s / %s in use"
+            % (_fmt(pages_used, "%.0f"), _fmt(pages_total, "%.0f")),
+        ]
     return "\n".join(lines)
 
 
