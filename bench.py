@@ -44,7 +44,7 @@ _BUDGET = float(os.environ.get("BENCH_BUDGET", "1500"))
 _CONFIG_COST = {"resnet50": 420, "bert": 300, "lstm_ptb": 200,
                 "wide_deep": 200, "lenet": 150, "pipeline": 150,
                 "async_ab": 90, "telemetry_ab": 60, "cold_warm": 120,
-                "serving": 150}
+                "serving": 150, "zero_stage": 90}
 
 
 def _remaining():
@@ -972,6 +972,114 @@ def bench_cold_warm(platform, dtype):
     return ratio, row
 
 
+def _zero_stage_measure():
+    """The zero_stage_ab measurement body: the SAME 3-layer MLP sharded
+    step at ZeRO stages 0-3 on the CURRENT jax backend (the caller is
+    responsible for putting it on an 8-device mesh — bench_zero_stages
+    shells into a subprocess with a forced CPU mesh; the tier-1 smoke
+    test, already on that mesh, calls this in-process)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.gluon import nn
+
+    batch = int(os.environ.get("BENCH_ZERO_BATCH", "64"))
+    hidden = int(os.environ.get("BENCH_ZERO_HIDDEN", "512"))
+    iters = int(os.environ.get("BENCH_ZERO_ITERS", "10"))
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (batch, 64)).astype(np.float32)
+    y = rng.randint(0, 8, (batch,)).astype(np.float32)
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    out = {"batch": batch, "hidden": hidden}
+    losses = {}
+    for stage in (0, 1, 2, 3):
+        mx.random.seed(7)
+        net = nn.HybridSequential(prefix="z%d_" % stage)
+        with net.name_scope():
+            net.add(nn.Dense(hidden, activation="relu", in_units=64),
+                    nn.Dense(hidden, activation="relu", in_units=hidden),
+                    nn.Dense(8, in_units=hidden))
+        net.initialize()
+        mesh = parallel.make_mesh(axis_names=("data",))
+        step = parallel.ShardedTrainStep(net, loss_fn, "adam",
+                                         {"learning_rate": 1e-3},
+                                         mesh=mesh, zero_stage=stage)
+        loss = step(nd.array(x), nd.array(y))
+        loss.wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(nd.array(x), nd.array(y))
+        loss.wait_to_read()
+        dt = (time.perf_counter() - t0) / iters
+        b = step.per_device_bytes()
+        out["z%d" % stage] = {
+            "step_time_ms": round(dt * 1e3, 3),
+            "opt_bytes_per_device": b["opt_state_bytes"],
+            "param_bytes_per_device": b["param_bytes"]}
+        losses["z%d" % stage] = round(float(loss.asscalar()), 7)
+    out["losses"] = losses
+    return out
+
+
+_ZERO_STAGE_CODE = r'''
+import json, os, sys
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8").strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["MXT_BENCH_DIR"])
+import bench
+print("ZROW " + json.dumps(bench._zero_stage_measure()))
+'''
+
+
+def bench_zero_stages(platform, dtype, _data=None):
+    """ZeRO weight-update-sharding A/B (parallel/sharded.py, arXiv
+    2004.13336): the SAME 3-layer MLP fused SPMD step on the 8-device
+    CPU mesh at ZeRO stages 0/1/2/3. The contract: identical losses at
+    every stage (layout, never math), per-device OPTIMIZER-STATE bytes
+    shrink ~dp× from stage 1 on (reduce-scatter + sharded update from
+    stage 2), and per-device PARAM bytes shrink ~dp× at stage 3
+    (FSDP-style storage). Runs in a subprocess so the forced 8-device
+    CPU mesh never disturbs the parent's backend (which may hold the
+    axon tunnel)."""
+    del dtype  # f32 — the A/B isolates memory/layout, not math throughput
+    data = _data  # tests (already on the 8-dev mesh) measure in-process
+    if data is None:
+        env = dict(os.environ)
+        env["MXT_BENCH_DIR"] = os.path.dirname(os.path.abspath(__file__))
+        r = subprocess.run([sys.executable, "-c", _ZERO_STAGE_CODE],
+                           env=env, capture_output=True, text=True,
+                           timeout=600)
+        for line in r.stdout.splitlines():
+            if line.startswith("ZROW "):
+                data = json.loads(line[len("ZROW "):])
+        if data is None:
+            raise RuntimeError("zero-stage subprocess produced no row: %s"
+                               % (r.stderr or r.stdout)[-400:])
+    shrink_opt = data["z0"]["opt_bytes_per_device"] / max(
+        1, data["z2"]["opt_bytes_per_device"])
+    shrink_par = data["z0"]["param_bytes_per_device"] / max(
+        1, data["z3"]["param_bytes_per_device"])
+    row = {
+        "config": "zero_stage_ab", "chips": 8,
+        "batch_size": data["batch"], "dtype": "float32",
+        "platform": "cpu",  # always the virtual CPU mesh (subprocess)
+        "stages": {k: data[k] for k in ("z0", "z1", "z2", "z3")},
+        "losses_equal": len(set(data["losses"].values())) == 1,
+        "opt_bytes_shrink_z2": round(shrink_opt, 2),
+        "param_bytes_shrink_z3": round(shrink_par, 2),
+        "step_time_ms": data["z2"]["step_time_ms"],
+        "images_or_tokens_per_sec_per_chip": round(
+            data["batch"] * 1e3 / data["z2"]["step_time_ms"] / 8, 2)
+        if data["z2"]["step_time_ms"] else 0.0,
+        "mfu": None, "flops_per_sample": None,
+    }
+    _emit_jsonl(row)
+    return shrink_opt, row
+
+
 def bench_serving(platform, dtype):
     """Serving stack (mxnet_tpu/serving/): mixed-length synthetic
     traffic through the paged-KV decode engine, once under the
@@ -1085,7 +1193,7 @@ def main():
     configs = os.environ.get(
         "BENCH_CONFIGS",
         "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline,async_ab,"
-        "telemetry_ab,cold_warm,serving"
+        "telemetry_ab,cold_warm,serving,zero_stage"
     ).split(",")
 
     # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
@@ -1110,6 +1218,9 @@ def main():
                       "x (cold/warm compile time)", bench_cold_warm),
         "serving": ("serving_continuous_vs_static",
                     "x (continuous/static tokens/s)", bench_serving),
+        "zero_stage": ("zero_opt_bytes_shrink",
+                       "x (replicated/ZeRO-2 opt bytes per device)",
+                       bench_zero_stages),
     }
     headline = None
     errors = []
@@ -1117,7 +1228,7 @@ def main():
     best_resnet = None
     for name in ("resnet50", "bert", "lstm_ptb", "wide_deep", "lenet",
                  "pipeline", "async_ab", "telemetry_ab", "cold_warm",
-                 "serving"):
+                 "serving", "zero_stage"):
         if name not in configs:
             continue
         cost = float(os.environ.get("BENCH_COST_%s" % name.upper(),

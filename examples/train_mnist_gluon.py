@@ -71,14 +71,23 @@ from mxnet_tpu import autograd, nd
 from mxnet_tpu.gluon import nn
 
 
-def lenet():
+def lenet(pad_to=None):
+    """Classic widths by default; ``pad_to=dp`` rounds each layer width
+    up to a multiple of dp so ZeRO's dim-0 sharding applies to every
+    tensor (the classic 20/50/500 widths don't divide an 8-way data
+    axis, which would silently leave everything replicated)."""
+    def w(units):
+        if not pad_to or pad_to <= 1:
+            return units
+        return ((units + pad_to - 1) // pad_to) * pad_to
+
     net = nn.HybridSequential()
-    net.add(nn.Conv2D(20, kernel_size=5, activation="tanh"),
+    net.add(nn.Conv2D(w(20), kernel_size=5, activation="tanh"),
             nn.MaxPool2D(2, 2),
-            nn.Conv2D(50, kernel_size=5, activation="tanh"),
+            nn.Conv2D(w(50), kernel_size=5, activation="tanh"),
             nn.MaxPool2D(2, 2),
             nn.Flatten(),
-            nn.Dense(500, activation="tanh"),
+            nn.Dense(w(500), activation="tanh"),
             nn.Dense(10))
     return net
 
@@ -135,6 +144,18 @@ def main():
                         "batch (tuning.warmup). With MXT_COMPILE_CACHE_DIR "
                         "set, a second run replays every compile from the "
                         "persistent cache — zero JIT in the epoch loop")
+    p.add_argument("--sharded", action="store_true",
+                   help="train under parallel.ShardedTrainStep on a "
+                        "device mesh (GSPMD data parallel; honors "
+                        "MXT_MESH_SHAPE from tools/launch.py --mesh). "
+                        "The batch size must divide the data axis")
+    p.add_argument("--zero-stage", type=int, default=None,
+                   choices=(0, 1, 2, 3),
+                   help="with --sharded: ZeRO weight-update sharding "
+                        "stage (1 shards optimizer states over the data "
+                        "axis, 2 adds gradient reduce-scatter + sharded "
+                        "updates, 3 shards the params FSDP-style); "
+                        "default MXT_ZERO_STAGE or 0")
     args = p.parse_args()
 
     if args.telemetry:
@@ -150,13 +171,50 @@ def main():
                  srv.server_address[1]))
 
     mx.random.seed(42)
-    net = lenet()
+    if args.sharded:
+        import jax
+
+        net = lenet(pad_to=len(jax.devices()))
+    else:
+        net = lenet()
     net.initialize(init=mx.init.Xavier())
-    if args.hybridize:
+    if args.hybridize and not args.sharded:
         net.hybridize()  # whole net -> one XLA program
 
     x, y = load_data(args)
     train_iter = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True)
+
+    if args.sharded:
+        # GSPMD scale-out: ONE sharded program over the mesh — the same
+        # script runs 1 CPU device, the 8-device test mesh, or an
+        # N-host pod (tools/launch.py --mesh 16,2 --zero-stage 2 sets
+        # MXT_MESH_SHAPE/MXT_ZERO_STAGE; make_mesh() reads them)
+        from mxnet_tpu import parallel
+
+        net(nd.zeros((2, 1, 28, 28)))  # resolve deferred shapes
+        mesh = parallel.make_mesh() if os.environ.get("MXT_MESH_SHAPE") \
+            else parallel.make_mesh(axis_names=("data",))
+        loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+        sstep = parallel.ShardedTrainStep(
+            net, loss_fn, "sgd",
+            {"learning_rate": args.lr, "momentum": 0.9}, mesh=mesh,
+            zero_stage=args.zero_stage)
+        b = sstep.per_device_bytes()
+        print("sharded: mesh %s, ZeRO stage %d, per-device bytes "
+              "params=%d opt=%d" % (dict(mesh.shape), sstep.zero_stage,
+                                    b["param_bytes"],
+                                    b["opt_state_bytes"]))
+        for epoch in range(args.epochs):
+            train_iter.reset()
+            losses = []
+            for batch in train_iter:
+                loss = sstep(batch.data[0], batch.label[0])
+                losses.append(loss)
+            nd.waitall()
+            print("epoch %d: mean loss %.4f"
+                  % (epoch, float(np.mean([float(l.asscalar())
+                                           for l in losses]))))
+        return
 
     trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
                                {"learning_rate": args.lr, "momentum": 0.9})

@@ -171,6 +171,23 @@ _declare("MXT_ELASTIC", bool, False,
          "sync mode DEGRADES over survivors when a worker dies instead "
          "of hanging in a collective. Opt-in: the collective path is "
          "faster but cannot drop a dead peer.")
+_declare("MXT_MESH_SHAPE", str, None,
+         "Comma-separated global mesh shape for no-arg "
+         "parallel.make_mesh() calls (e.g. '16,2'; one -1 wildcard "
+         "allowed). Exported per worker by tools/launch.py --mesh so "
+         "the same training script scales from 1 host to N without "
+         "code changes.")
+_declare("MXT_MESH_AXES", str, None,
+         "Comma-separated mesh axis names paired with MXT_MESH_SHAPE "
+         "(default: 'data,model' truncated to the shape's rank). Set "
+         "by tools/launch.py --mesh-axes.")
+_declare("MXT_ZERO_STAGE", int, None,
+         "Default ZeRO weight-update sharding stage (0-3) for "
+         "parallel.ShardedTrainStep when the constructor doesn't pass "
+         "zero_stage (arXiv:2004.13336: 1 shards optimizer states over "
+         "the data axis, 2 adds gradient reduce-scatter + sharded "
+         "updates, 3 shards the params themselves FSDP-style). "
+         "Exported by tools/launch.py --zero-stage.")
 _declare("MXT_HEARTBEAT_INTERVAL", float, 2.0,
          "Seconds between membership heartbeats (membership.py; ref: "
          "ps-lite Van's heartbeat timer).")

@@ -175,6 +175,20 @@ class CachedTrainStep:
         trainable = {n for n, p in net_params.items()
                      if p.grad_req != "null"}
         for name, p in net_params.items():
+            # mesh-sharded buffers (parallel.ShardedTrainStep placed them
+            # with a multi-device NamedSharding) must not be DONATED into
+            # this single-device program: XLA would silently gather them
+            # back to one device and the next sharded step would pay a
+            # full re-placement — the two step builders own disjoint nets
+            d = p._data
+            if d is not None:
+                sh = getattr(d.data, "sharding", None)
+                if sh is not None and len(getattr(sh, "device_set",
+                                                  ())) > 1:
+                    return "parameter %s is mesh-sharded (%d devices) — " \
+                        "parallel.ShardedTrainStep owns sharded nets" \
+                        % (name, len(sh.device_set))
+        for name, p in net_params.items():
             if p.grad_req == "null":
                 continue
             if p.grad_req != "write":

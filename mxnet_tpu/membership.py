@@ -122,6 +122,7 @@ class MembershipTable:
         self._barrier_last = {}  # tag base -> last released numeric seq
         self._reduces = {}      # (key, seq) -> in-flight round entry
         self._reduce_last = {}  # key -> (seq, sum, wids) last released
+        self._death_listeners = []  # fn(worker_ids) on reap (see below)
 
     # -- registration ------------------------------------------------------
     def register(self, worker_id, now=None):
@@ -224,7 +225,26 @@ class MembershipTable:
             record_lost_workers(len(dead))
             self._note_view_change(epoch, live, "reaped",
                                    workers=[m.worker_id for m in dead])
+            # death listeners run OUTSIDE the lock on the reaper's
+            # thread: the elastic reshard controller
+            # (parallel/reshard.py) records the loss here and reshapes
+            # the mesh at the training loop's next drain point. A
+            # listener failure must never kill the reaper.
+            ids = [m.worker_id for m in dead]
+            for fn in list(self._death_listeners):
+                try:
+                    fn(list(ids))
+                except Exception:  # noqa: BLE001 — listener isolation
+                    pass
         return [m.worker_id for m in dead]
+
+    def add_death_listener(self, fn):
+        """Register ``fn(worker_ids)`` to run whenever :meth:`reap`
+        declares workers dead (after fencing + telemetry, outside the
+        condition lock). This is the hook that fuses the elasticity
+        layer with the GSPMD path: survivors reshard the mesh in place
+        instead of restarting (parallel.ElasticReshardController)."""
+        self._death_listeners.append(fn)
 
     @staticmethod
     def _note_view_change(epoch, live, event, **fields):

@@ -8,13 +8,20 @@ translating worker/server push/pull. The KVStore API survives as a facade
 
 - mesh.py: mesh construction + distributed init (multi-host)
 - sharded.py: sharded training-step builder over Gluon blocks
-  (data/tensor parallel via PartitionSpec rules)
+  (data/tensor parallel via PartitionSpec rules; ZeRO-1/2/3
+  weight-update sharding over the data axis)
+- reshard.py: elastic in-place mesh resharding when membership fences
+  a dead host (CheckpointManager shards as the transfer format)
 """
 from .mesh import (
     make_mesh, data_parallel_mesh, init_distributed, local_device_count,
 )
 from .sharded import (
     ShardedTrainStep, shard_params, sharding_rule, allreduce_across_processes,
+)
+from .reshard import (
+    ElasticReshardController, HostDeviceMap, plan_survivor_mesh,
+    reshard_step,
 )
 from .sequence import (current_sequence_scope, ring_attention,
                        sequence_scope, ulysses_attention)
@@ -23,7 +30,9 @@ from .moe import moe_apply, stack_expert_params, switch_load_balance_loss
 
 __all__ = ["make_mesh", "data_parallel_mesh", "init_distributed",
            "local_device_count", "ShardedTrainStep", "shard_params",
-           "sharding_rule", "allreduce_across_processes", "ring_attention",
+           "sharding_rule", "allreduce_across_processes",
+           "ElasticReshardController", "HostDeviceMap",
+           "plan_survivor_mesh", "reshard_step", "ring_attention",
            "ulysses_attention", "pipeline_apply", "stack_stage_params",
            "moe_apply", "stack_expert_params",
            "switch_load_balance_loss", "sequence_scope",

@@ -3,6 +3,18 @@
 Replaces the reference's cluster topology layer (ref: ps-lite Postoffice
 membership + tools/launch.py tracker): on TPU the "cluster" is a slice, and
 jax.distributed.initialize + a Mesh over all devices is the whole story.
+
+Multi-host flow (the GSPMD scale-out contract — one script, any size):
+
+    tools/launch.py -n 16 --launcher ssh -H hosts \\
+        --mesh 64,2 --zero-stage 2 python train.py
+
+Each worker process gets ``MXT_COORDINATOR`` / ``MXT_NUM_WORKERS`` /
+``MXT_WORKER_ID`` (consumed by :func:`init_distributed`) plus
+``MXT_MESH_SHAPE`` / ``MXT_MESH_AXES`` / ``MXT_ZERO_STAGE`` — so
+``train.py`` calls ``parallel.make_mesh()`` with NO arguments and gets
+the launch-line mesh over the GLOBAL device list, whether that is 8
+virtual CPU devices in one process or a pod slice across 16 hosts.
 """
 from __future__ import annotations
 
@@ -27,7 +39,9 @@ def init_distributed(coordinator_address=None, num_processes=None,
     ps-lite — here a single coordinator handshake).
 
     No-arg form reads the MXT_* env set by tools/launch.py, falling back
-    to the standard JAX env (or cloud TPU metadata)."""
+    to the standard JAX env (or cloud TPU metadata). After this returns,
+    ``jax.devices()`` is the GLOBAL device list and :func:`make_mesh`
+    builds process-spanning meshes over it."""
     import os
 
     if coordinator_address is None:
@@ -47,15 +61,39 @@ def make_mesh(shape=None, axis_names=("data", "model"), devices=None):
     """Build a Mesh over the (global) device list.
 
     ``shape`` of -1 entries auto-fills like reshape; default puts every
-    device on the data axis. On a pod slice the device order from
-    jax.devices() is ICI-contiguous, so adjacent mesh coordinates ride ICI
-    rather than DCN — keep the fastest-varying axis the model axis.
+    device on the data axis. With no ``shape``, ``MXT_MESH_SHAPE`` (and
+    optionally ``MXT_MESH_AXES``) is consulted first — tools/launch.py
+    exports it per worker from its ``--mesh`` flag, so the same training
+    script scales from 1 host to N by changing only the launch line.
+
+    On a pod slice the device order from jax.devices() is ICI-contiguous,
+    so adjacent mesh coordinates ride ICI rather than DCN — keep the
+    fastest-varying axis the model axis.
     """
+    if shape is None:
+        from .. import config
+
+        spec = config.get("MXT_MESH_SHAPE")
+        if spec:
+            shape = tuple(int(s) for s in str(spec).split(",") if s)
+            axes = config.get("MXT_MESH_AXES")
+            if axes:
+                axis_names = tuple(a.strip() for a in str(axes).split(",")
+                                   if a.strip())
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if shape is None:
         shape = (n,) + (1,) * (len(axis_names) - 1)
     shape = list(shape)
+    if len(shape) != len(axis_names):
+        if len(shape) < len(axis_names):
+            axis_names = tuple(axis_names)[:len(shape)]
+        else:
+            raise MXNetError(
+                "mesh shape %s has %d axes but axis_names=%s names %d "
+                "(set MXT_MESH_AXES alongside MXT_MESH_SHAPE)"
+                % (tuple(shape), len(shape), tuple(axis_names),
+                   len(axis_names)))
     if shape.count(-1) > 1:
         raise MXNetError("at most one mesh axis may be -1")
     if -1 in shape:

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from .sequence import shard_map  # version-compat resolved alias
+
 from ..base import MXNetError
 
 __all__ = ["moe_apply", "stack_expert_params", "switch_load_balance_loss"]
@@ -103,7 +105,7 @@ def moe_apply(expert_fn, expert_params, gate_w, x, mesh, axis="expert",
         return (combined[None], gates[None],
                 dispatch.sum(-1)[None])  # lead axis for out_specs
 
-    sm = jax.shard_map(
+    sm = shard_map(
         per_device, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(axis),
                                          expert_params), P(), P(axis)),

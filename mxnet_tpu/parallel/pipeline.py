@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from .sequence import shard_map  # version-compat resolved alias
+
 from ..base import MXNetError
 
 __all__ = ["pipeline_apply", "stack_stage_params"]
@@ -87,8 +89,12 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis="pipe",
         out0 = jnp.zeros((m, mb) + xs.shape[1:], xs.dtype)
         # the loop makes the carry device-varying (ppermute); mark the
         # replicated zeros accordingly so scan's carry types line up
-        h0 = jax.lax.pcast(h0, (axis,), to="varying")
-        out0 = jax.lax.pcast(out0, (axis,), to="varying")
+        # (jax builds without lax.pcast track varying-ness implicitly —
+        # the no-op fallback keeps the schedule identical)
+        _pcast = getattr(jax.lax, "pcast", None)
+        if _pcast is not None:
+            h0 = _pcast(h0, (axis,), to="varying")
+            out0 = _pcast(out0, (axis,), to="varying")
 
         def tick(carry, t):
             h, outs = carry
@@ -98,8 +104,15 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis="pipe",
                 h, axis, [(i, (i + 1) % n_stages)
                           for i in range(n_stages)])
             feed_t = jnp.clip(t, 0, m - 1)
+            # one-hot select of microbatch feed_t (not x_mb[feed_t]): the
+            # gather's transpose is a scatter/DUS that miscompiles under
+            # spmd-partitioning on some backends (s64/s32 index compare);
+            # the masked sum's VJP is a broadcast multiply instead
+            feed_mask = (jnp.arange(m) == feed_t).reshape(
+                (m,) + (1,) * (x_mb.ndim - 1))
+            x_t = jnp.sum(jnp.where(feed_mask, x_mb, 0.0), axis=0)
             inp = jnp.where(stage == 0,
-                            jnp.where(t < m, x_mb[feed_t], 0.0),
+                            jnp.where(t < m, x_t, 0.0),
                             recv)
             h2 = stage_fn(params, inp)
             # last stage finishes microbatch t-(S-1) at tick t; masked
@@ -107,23 +120,44 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis="pipe",
             # types uniform)
             slot = t - (n_stages - 1)
             write = (stage == n_stages - 1) & (slot >= 0)
-            updated = jax.lax.dynamic_update_slice(
-                outs, h2[None].astype(outs.dtype),
-                (jnp.clip(slot, 0, m - 1),) + (0,) * (outs.ndim - 1))
-            outs = jnp.where(write, updated, outs)
+            # one-hot masked write instead of dynamic_update_slice: the
+            # DUS transpose under spmd-partitioning miscompiles on some
+            # backends (s64/s32 index compare); the where-form is the
+            # same masked store and keeps varying-axis types uniform
+            onehot = jnp.arange(m) == jnp.clip(slot, 0, m - 1)
+            mask = (onehot & write).reshape((m,) + (1,) * (outs.ndim - 1))
+            outs = jnp.where(mask, h2[None].astype(outs.dtype), outs)
             return (h2, outs), None
 
-        (h, outs), _ = jax.lax.scan(
-            tick, (h0, out0), jnp.arange(m + n_stages - 1))
-        del h
+        # python-unrolled ticks (the ring-attention treatment): a
+        # lax.scan here stacks its carries with dynamic_update_slice,
+        # which miscompiles under spmd-partitioning on some backends —
+        # and the tick count m + S - 1 is small, so XLA still pipelines
+        # the unrolled ppermutes against the stage matmuls
+        carry = (h0, out0)
+        for t in range(m + n_stages - 1):
+            carry, _ = tick(carry, jnp.asarray(t, jnp.int32))
+        outs = carry[1]
         return outs.reshape((b,) + xs.shape[1:])[None]  # (1, B, ...)
 
     spec_params = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
-    sm = jax.shard_map(
+    sm = shard_map(
         per_device, mesh=mesh,
         in_specs=(spec_params, P()), out_specs=P(axis))
-    stacked = sm(stage_params, x)          # (S, B, ...) — one real row
-    return stacked[-1]                      # the last stage's output
+    # jit the schedule: eager shard_map dispatches the unrolled tick
+    # body primitive-by-primitive through the mesh machinery (~100ms
+    # per collective on the CPU mesh — 15s for an 8×8 schedule); one
+    # compiled program runs it in milliseconds. Under an outer jit this
+    # inlines.
+    stacked = jax.jit(sm)(stage_params, x)  # (S, B, ...) — one real row
+    # the last stage's output, WITHOUT stacked[-1]: that slice's
+    # transpose is a dynamic_update_slice along the pipe-partitioned
+    # dim, which miscompiles under spmd-partitioning on some backends
+    # (s64 index vs s32 partition offset); the masked sum transposes to
+    # a plain select
+    last = jnp.arange(stacked.shape[0]) == stacked.shape[0] - 1
+    mask = last.reshape((-1,) + (1,) * (stacked.ndim - 1))
+    return jnp.sum(jnp.where(mask, stacked, 0.0), axis=0)
 
 
 def pipeline_utilization(num_stages, num_microbatches):

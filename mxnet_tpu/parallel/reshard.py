@@ -1,0 +1,281 @@
+"""Elastic mesh resharding — survivors reshape the mesh in place.
+
+The PR 3 membership layer turns a dead host into a *fenced* host: its
+generation is refused, reductions release over survivors. Before this
+module, keeping full-efficiency GSPMD training after that still meant a
+full-job restart on the smaller world (or renormalized degraded math).
+Here the survivors instead:
+
+1. **drain** the in-flight dispatch window (``engine.wait_all`` — the
+   same coherence point checkpoints use),
+2. **spill** params + optimizer state through
+   ``resilience.CheckpointManager`` — the CRC-manifested atomic shard
+   format. The checkpoint IS the transfer format: this path exercises
+   exactly the bytes a from-checkpoint restart would read, which is why
+   the acceptance test can demand bit-exact equality between an in-place
+   reshard and a fresh restart on the same survivor mesh,
+3. **rebind** the ``ShardedTrainStep`` onto the survivor mesh
+   (``rebind_mesh``: dp shrinks, tp is preserved; ZeRO eligibility is
+   re-decided for the new dp) and restore the spilled values onto the
+   new layout, and
+4. **AOT-warm** the resharded-shape step (``tuning.warmup``) so the next
+   training step pays no JIT.
+
+No renormalization, no restart, zero lost steps. Reshard events flow
+through the telemetry registry (``mxt_reshard_events_total``,
+``mxt_reshard_seconds``, the mesh-shape / per-device-bytes gauges the
+step re-publishes) and render in ``tools/mxt_top.py``'s mesh section.
+
+Wiring to membership: :class:`ElasticReshardController` listens for the
+reaper's death events (``MembershipTable.add_death_listener``) or polls a
+worker-side membership view, and performs the reshard at the training
+loop's next ``maybe_reshard()`` call — the loop owns the drain point, so
+a reap can never rip the mesh out from under a mid-flight dispatch.
+"""
+from __future__ import annotations
+
+import shutil
+import tempfile
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ..base import MXNetError
+
+__all__ = ["HostDeviceMap", "plan_survivor_mesh", "reshard_step",
+           "ElasticReshardController"]
+
+
+class HostDeviceMap:
+    """worker_id -> device slice of the mesh's device list.
+
+    On a real multi-host pod (:meth:`from_processes`) host *i* owns its
+    process-local devices. On the single-process 8-device CPU harness the
+    global device list is split into ``num_hosts`` contiguous slices —
+    matching ``make_mesh``'s ICI-order reshape, so a surviving slice
+    keeps its tensor-parallel neighbors."""
+
+    def __init__(self, num_hosts, devices=None):
+        devices = list(devices if devices is not None else jax.devices())
+        num_hosts = int(num_hosts)
+        if num_hosts <= 0 or len(devices) % num_hosts:
+            raise MXNetError(
+                "cannot split %d devices across %d hosts evenly"
+                % (len(devices), num_hosts))
+        per = len(devices) // num_hosts
+        self._slices = OrderedDict(
+            (i, devices[i * per:(i + 1) * per]) for i in range(num_hosts))
+
+    @classmethod
+    def from_mesh(cls, mesh, num_hosts):
+        """Slice the MESH's flattened device order (not jax.devices()):
+        survivor meshes built from these slices preserve the original
+        axis adjacency."""
+        return cls(num_hosts, list(mesh.devices.reshape(-1)))
+
+    @classmethod
+    def from_processes(cls):
+        """Real multi-host: one slot per JAX process, its local devices."""
+        m = cls.__new__(cls)
+        m._slices = OrderedDict()
+        for d in sorted(jax.devices(),
+                        key=lambda d: (d.process_index, d.id)):
+            m._slices.setdefault(d.process_index, []).append(d)
+        return m
+
+    @property
+    def num_hosts(self):
+        return len(self._slices)
+
+    def devices_for_survivors(self, lost):
+        """Surviving devices in slice order. Unknown worker ids (e.g. a
+        worker beyond this map's world) are ignored — membership may
+        track more processes than hold mesh devices."""
+        lost = {int(w) for w in lost}
+        out = [d for i, devs in self._slices.items()
+               if i not in lost for d in devs]
+        if not out:
+            raise MXNetError(
+                "every mesh host is lost (%s) — nothing to reshard onto"
+                % sorted(lost))
+        return out
+
+
+def plan_survivor_mesh(mesh, lost_workers, host_map, data_axis="data"):
+    """The survivor mesh after ``lost_workers`` die: every non-data axis
+    keeps its extent (tensor-parallel groups stay intact — their
+    collectives were laid out for ICI adjacency), the data axis absorbs
+    the loss. Raises typed when the surviving device count can't keep
+    the non-data axes whole. Returns None when nothing changes."""
+    devices = host_map.devices_for_survivors(lost_workers)
+    if len(devices) == mesh.devices.size:
+        return None
+    other = 1
+    for ax in mesh.axis_names:
+        if ax != data_axis:
+            other *= mesh.shape[ax]
+    if data_axis not in mesh.shape:
+        raise MXNetError("mesh %s has no %r axis to shrink"
+                         % (dict(mesh.shape), data_axis))
+    if len(devices) % other:
+        raise MXNetError(
+            "%d surviving devices cannot keep the non-%s axes (extent %d) "
+            "whole — survivors don't form a rectangular mesh"
+            % (len(devices), data_axis, other))
+    new_dp = len(devices) // other
+    shape = tuple(new_dp if ax == data_axis else mesh.shape[ax]
+                  for ax in mesh.axis_names)
+    return Mesh(np.array(devices).reshape(shape), mesh.axis_names)
+
+
+def reshard_step(step, new_mesh, spill_dir=None, warm=True):
+    """Reshard a live ShardedTrainStep onto ``new_mesh`` in place:
+    drain -> CheckpointManager spill -> rebind -> restore -> AOT warm.
+
+    ``spill_dir``: where the transfer-format checkpoint lands (kept for
+    the caller — e.g. as the restart point the acceptance test compares
+    against); default is a temp dir removed after the reshard.
+    Returns the reshard event dict (also emitted to telemetry)."""
+    from .. import engine, telemetry
+    from ..resilience import CheckpointManager
+
+    # survivors drain the in-flight window first: every dispatched step
+    # retires and its deferred bookkeeping lands before the mesh moves
+    engine.wait_all()
+    t0 = time.perf_counter()
+    old_shape = {str(k): int(v) for k, v in step.mesh.shape.items()}
+    tmp = None
+    directory = spill_dir
+    if directory is None:
+        tmp = tempfile.mkdtemp(prefix="mxt_reshard_")
+        directory = tmp
+    cursor = step.step_count  # sync-ok: control-plane cursor read
+    mgr = CheckpointManager(directory, net=step.block, trainer=step,
+                            prefix="reshard", keep_last=1)
+    mgr.save(step=cursor)
+    try:
+        # transfer=False: values ride the spill, not device-to-device
+        # copies — the old mesh's hosts may be dead and their buffers
+        # unreachable on a real pod
+        step.rebind_mesh(new_mesh, transfer=False)
+        restored = mgr.resume()
+        if restored is None:
+            raise MXNetError(
+                "reshard spill under %r did not validate — params/state "
+                "were NOT moved; the step still targets the new mesh but "
+                "holds the old placement" % directory)
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+    warm_summary = None
+    if warm:
+        from .. import tuning
+
+        # AOT-warm the resharded-shape program so the next training step
+        # pays zero JIT (warmup also persists the tuning table)
+        warm_summary = tuning.warmup(steps=[step], kernels=False,
+                                     include_live=False, reason="reshard")
+    dt = time.perf_counter() - t0
+    telemetry.counter(
+        "mxt_reshard_events_total",
+        "In-place elastic mesh reshards (dead host absorbed without a "
+        "job restart).").inc()
+    telemetry.histogram(
+        "mxt_reshard_seconds",
+        "Drain + spill + rebind + restore (+ AOT warm) duration of one "
+        "elastic reshard.").observe(dt)
+    event = {
+        "old_shape": old_shape,
+        "new_shape": {str(k): int(v) for k, v in new_mesh.shape.items()},
+        "devices": int(new_mesh.devices.size),
+        "step": cursor,
+        "seconds": round(dt, 6),
+        "warm_compiles": (warm_summary or {}).get("compiles"),
+    }
+    telemetry.emit_event("reshard", **event)
+    return event
+
+
+class ElasticReshardController:
+    """Bridges membership death events to in-place mesh resharding.
+
+    Attach to the server-side :class:`membership.MembershipTable` (the
+    reaper thread invokes our listener when it fences workers), or feed
+    worker-side ``members()`` views through :meth:`poll_view`. Deaths
+    are only RECORDED on the notifying thread; the reshard itself runs
+    in :meth:`maybe_reshard`, called by the training loop between steps
+    — the loop owns the drain point.
+
+    Usage::
+
+        ctrl = ElasticReshardController(step, HostDeviceMap.from_mesh(
+            step.mesh, num_hosts=4)).attach(table)
+        for x, y in batches:          # batch size must divide every dp
+            ctrl.maybe_reshard()      # no-op until the reaper fences
+            loss = step(x, y)
+    """
+
+    def __init__(self, step, host_map, data_axis="data", spill_dir=None,
+                 warm=True):
+        self.step = step
+        self.host_map = host_map
+        self.data_axis = data_axis
+        self.spill_dir = spill_dir
+        self.warm = warm
+        self.events = []
+        self._pending = set()
+        self._lost = set()
+        self._lock = threading.Lock()
+
+    def attach(self, table):
+        """Subscribe to a MembershipTable's reaper."""
+        table.add_death_listener(self.notice_deaths)
+        return self
+
+    def notice_deaths(self, worker_ids):
+        """Record newly-fenced workers (any thread; reshard is deferred
+        to maybe_reshard on the training loop)."""
+        with self._lock:
+            self._pending.update(int(w) for w in worker_ids)
+            self._pending -= self._lost
+
+    def poll_view(self, view):
+        """Worker-side alternative to attach(): feed a membership view
+        (``MembershipTable.view()`` / ``WorkerMembership.members()``)."""
+        self.notice_deaths(view.get("dead", {}).keys())
+
+    @property
+    def pending(self):
+        with self._lock:
+            return set(self._pending)
+
+    def maybe_reshard(self):
+        """Reshard now if deaths are pending. Returns the reshard event
+        (with the cumulative ``lost_workers``) or None. Call between
+        steps; raises typed when survivors can't form a rectangular
+        mesh (caller decides: wait for more deaths, or restart)."""
+        with self._lock:
+            if not self._pending:
+                return None
+            batch = set(self._pending)
+            lost = self._lost | batch
+        new_mesh = plan_survivor_mesh(self.step.mesh, lost, self.host_map,
+                                      data_axis=self.data_axis)
+        if new_mesh is None:
+            with self._lock:
+                self._lost |= batch
+                self._pending -= batch
+            return None
+        event = reshard_step(self.step, new_mesh,
+                             spill_dir=self.spill_dir, warm=self.warm)
+        with self._lock:
+            self._lost |= batch
+            self._pending -= batch
+            event["lost_workers"] = sorted(self._lost)
+        self.events.append(event)
+        return event

@@ -1,4 +1,5 @@
-"""Sharded training step over a Gluon block — SPMD data/tensor parallel.
+"""Sharded training step over a Gluon block — SPMD data/tensor parallel
+with ZeRO weight-update sharding and elastic mesh rebinding.
 
 This is the TPU-native core that replaces the reference's entire
 DataParallelExecutorGroup + KVStore push/pull machinery
@@ -12,9 +13,38 @@ Params live as jax arrays placed with NamedSharding; PartitionSpec rules
 (pure data parallel). Aux states (BatchNorm running stats) are carried as
 non-differentiated inputs and returned updated — the same rebind-capture
 protocol as CachedOp (gluon/block.py — _build_cached).
+
+The sharding annotations are END-TO-END (the SNIPPETS "8 chips to
+6000-chip superclusters without changing application code" pattern): the
+batch is pinned to the data axis and the loss to replicated INSIDE the
+program, params/states carry explicit NamedSharding placements, and the
+mesh itself may span processes (parallel.init_distributed + a launch-line
+``--mesh``) — the training script is identical at 1 host and at N.
+
+ZeRO weight-update sharding (Xu et al., arXiv:2004.13336) is a stage
+ladder over the data axis, ``zero_stage=``:
+
+====== ===================================================================
+stage  per-device effect (eligible params: dim 0 divides dp, not already
+       tensor-parallel-sharded by a rule)
+====== ===================================================================
+0      pure data parallel — everything replicated (the baseline).
+1      optimizer states shard dim-0 over the data axis (~dp× less state
+       memory); gradients still all-reduce replicated.
+2      + gradients are pinned to the update sharding, so GSPMD fuses the
+       dp all-reduce into reduce-scatter and each replica updates only
+       its slice (the paper's full weight-update sharding; the legacy
+       ``shard_update=True`` flag maps here).
+3      + the params THEMSELVES live dim-0-sharded (~dp× less param
+       memory); GSPMD all-gathers at use in the forward, FSDP-style.
+====== ===================================================================
+
+Every stage is numerically exact vs. stage 0 — only layout and collective
+choice change, never the math (tests assert <=1e-6 over 5 steps).
 """
 from __future__ import annotations
 
+import json
 import re
 from collections import OrderedDict
 
@@ -48,13 +78,35 @@ def _spec_for(name, rules):
     return P()  # replicated
 
 
-def shard_params(params, mesh, rules=None):
-    """Place Parameter buffers on the mesh per the rules (replicated unless
-    a rule names a tensor-parallel layout)."""
+def shard_params(params, mesh, rules=None, shardings=None):
+    """Place Parameter buffers on the mesh per the rules (replicated
+    unless a rule names a tensor-parallel layout), or per an explicit
+    ``shardings`` {name: NamedSharding} map.
+
+    Placements are BATCHED into one ``jax.device_put`` call and arrays
+    whose layout already matches are skipped entirely — a resume or
+    reshard pass over a mostly-placed model moves only what changed
+    instead of blocking on a fresh transfer of every buffer (the old
+    one-device_put-per-param loop re-transferred everything).
+    Returns the number of arrays actually moved."""
+    names, vals, targets = [], [], []
     for name, p in params.items():
-        spec = _spec_for(name, rules)
-        sharded = jax.device_put(p.data().data, NamedSharding(mesh, spec))
-        p.data()._set_data(sharded)
+        if shardings is not None:
+            target = shardings[name]
+        else:
+            target = NamedSharding(mesh, _spec_for(name, rules))
+        d = p.data().data
+        cur = getattr(d, "sharding", None)
+        if cur is not None and cur.is_equivalent_to(target, d.ndim):
+            continue
+        names.append(name)
+        vals.append(d)
+        targets.append(target)
+    if not names:
+        return 0
+    for name, v in zip(names, jax.device_put(vals, targets)):
+        params[name].data()._set_data(v)
+    return len(names)
 
 
 def _make_opt_update(optimizer, optimizer_params):
@@ -122,33 +174,41 @@ class ShardedTrainStep:
         mesh = parallel.make_mesh((dp, tp), ("data", "model"))
         step = ShardedTrainStep(net, loss_fn, "sgd",
                                 {"learning_rate": 0.1}, mesh=mesh,
+                                zero_stage=2,
                                 rules=sharding_rule((r"dense\\d+_weight",
                                                      P("model", None))))
         loss = step(x_batch, y_batch)   # params update in place
 
     The batch is sharded along the mesh's data axis; XLA emits the grad
     psum over that axis (data parallel) and whatever collectives the rules
-    imply (tensor parallel).
+    imply (tensor parallel). ``zero_stage`` (0-3, module docstring) shards
+    the weight update itself; the legacy ``shard_update=True`` maps to
+    stage 2.
+
+    The step also slots into the resilience/elasticity stack: it speaks
+    the CheckpointManager ``trainer`` protocol (:meth:`save_states` /
+    :meth:`load_states` restore onto the step's CURRENT mesh, whatever
+    its shape), registers with ``tuning`` for AOT warm-start
+    (:meth:`aot_warmup`), and can be re-homed onto a survivor mesh in
+    place via :meth:`rebind_mesh` (parallel/reshard.py drives this when
+    the membership reaper fences a host).
     """
 
     def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, rules=None, data_axis="data", remat=None,
-                 shard_update=False):
+                 shard_update=False, zero_stage=None):
         """remat: None (save all intermediates — XLA default), "full"
         (recompute the whole forward in backward; ~1/3 more FLOPs for far
         less saved-activation HBM traffic — the jax.checkpoint analog of
         the reference's mirror/memonger), or any name from
         jax.checkpoint_policies (e.g. "dots_saveable").
 
-        shard_update: ZeRO-1-style cross-replica weight-update sharding
-        (Xu et al., arXiv:2004.13336 — a capability the reference never
-        had): optimizer states shard dim-0 over the data axis and the
-        update math runs sharded, turning the gradient all-reduce into
-        reduce-scatter + sharded update + weight all-gather (same
-        communication volume, but optimizer state memory and update HBM
-        traffic divide by the dp degree). Params whose dim 0 doesn't
-        divide the data axis (or that rules already shard) stay
-        replicated, per the paper's fallback."""
+        zero_stage: cross-replica weight-update sharding stage (0-3, see
+        the module docstring); defaults to ``MXT_ZERO_STAGE`` (0 when
+        unset). Params whose dim 0 doesn't divide the data axis (or that
+        rules already shard) stay replicated at every stage, per the
+        paper's fallback. ``shard_update=True`` is the legacy spelling
+        of stage 2."""
         self.block = block
         self.loss_fn = loss_fn
         if remat not in (None, "full") and \
@@ -159,8 +219,21 @@ class ShardedTrainStep:
                 "unknown remat %r — use None, 'full', or one of %s"
                 % (remat, valid))
         self._remat = remat
+        if zero_stage is None:
+            if shard_update:
+                zero_stage = 2
+            else:
+                from .. import config
+
+                zero_stage = int(config.get("MXT_ZERO_STAGE") or 0)
+        zero_stage = int(zero_stage)
+        if not 0 <= zero_stage <= 3:
+            raise MXNetError(
+                "zero_stage must be 0..3 (got %r)" % (zero_stage,))
+        self.zero_stage = zero_stage
         self.mesh = mesh or make_mesh(axis_names=(data_axis,))
         self.data_axis = data_axis
+        self._rules = rules
         self._all_params = OrderedDict(
             sorted(block.collect_params().items()))
         for name, p in self._all_params.items():
@@ -172,28 +245,15 @@ class ShardedTrainStep:
                              if p.grad_req != "null"]
         self._aux_names = [n for n, p in self._all_params.items()
                            if p.grad_req == "null"]
-        shard_params(self._all_params, self.mesh, rules)
         self._init_s, self._update = _make_opt_update(
             optimizer, optimizer_params)
-        # ZeRO-1 (shard_update): pick the update sharding per param —
-        # dim 0 over the data axis where it divides and isn't already
-        # mesh-sharded — BEFORE creating states, so sharded states are
-        # materialized directly at 1/dp size (a replicated-then-reshard
-        # init would peak at the full footprint per device, exactly the
-        # memory ZeRO-1 exists to avoid)
-        self._zero_shardings = {n: None for n in self._train_names}
-        if shard_update:
-            dp = self.mesh.shape[self.data_axis]
-            for n in self._train_names:
-                d = self._all_params[n].data().data
-                cur = getattr(getattr(d, "sharding", None), "spec",
-                              P()) or P()
-                cur = tuple(cur) + (None,) * (d.ndim - len(tuple(cur)))
-                if (d.ndim == 0 or d.shape[0] % dp != 0
-                        or any(s is not None for s in cur)):
-                    continue
-                self._zero_shardings[n] = NamedSharding(
-                    self.mesh, P(self.data_axis, *cur[1:]))
+        # derive placement + ZeRO shardings BEFORE creating states, so
+        # sharded states are materialized directly at 1/dp size (a
+        # replicated-then-reshard init would peak at the full footprint
+        # per device, exactly the memory ZeRO exists to avoid)
+        self._compute_shardings()
+        shard_params(self._all_params, self.mesh,
+                     shardings=self._param_shardings)
         self._states = {}
         for n in self._train_names:
             d = self._all_params[n].data().data
@@ -215,7 +275,45 @@ class ShardedTrainStep:
         self._t_dev = jnp.zeros((), jnp.int32)
         self._batch_cache = {}
         self._aot_compiled = {}  # (x sig, y sig) -> compiled (see _compile)
+        self._last_sig = None
         self._jit = self._build()
+        from .. import tuning
+
+        tuning.register_step(self)  # tuning.warmup() AOT-compiles us
+        self._publish_mesh_telemetry()
+
+    # ------------------------------------------------------------------
+    # sharding derivation
+    # ------------------------------------------------------------------
+    def _compute_shardings(self):
+        """(Re)derive per-parameter storage + ZeRO update shardings for
+        the CURRENT mesh and stage. Called at build and again by
+        rebind_mesh: a survivor mesh changes dp, so eligibility (dim-0
+        divisibility) must be re-decided, never copied."""
+        dp = self.mesh.shape[self.data_axis]
+        train = set(self._train_names)
+        self._param_shardings = {}
+        self._zero_shardings = {n: None for n in self._train_names}
+        for n, p in self._all_params.items():
+            d = p.data().data
+            spec = _spec_for(n, self._rules)
+            padded = tuple(spec) + (None,) * (d.ndim - len(tuple(spec)))
+            zspec = None
+            if (self.zero_stage >= 1 and n in train and d.ndim >= 1
+                    and d.shape[0] % dp == 0
+                    and not any(s is not None for s in padded)):
+                zspec = P(self.data_axis, *padded[1:])
+                self._zero_shardings[n] = NamedSharding(self.mesh, zspec)
+            # ZeRO-3: the param ITSELF lives dim-0-sharded; GSPMD
+            # all-gathers at use (FSDP-style). Stages 0-2 store per the
+            # tensor-parallel rule (replicated by default).
+            pspec = zspec if (self.zero_stage >= 3 and zspec is not None) \
+                else spec
+            self._param_shardings[n] = NamedSharding(self.mesh, pspec)
+
+    def _batch_sharding(self, ndim):
+        return NamedSharding(
+            self.mesh, P(self.data_axis, *([None] * (ndim - 1))))
 
     # ------------------------------------------------------------------
     def _pure_loss(self, train_vals, aux_vals, x, y, key):
@@ -250,27 +348,49 @@ class ShardedTrainStep:
     def _build(self):
         loss_fn = self._loss_for_grad()
         zero = [self._zero_shardings[n] for n in self._train_names]
-        wshard = [self._all_params[n].data().data.sharding
-                  for n in self._train_names]
+        wshard = [self._param_shardings[n] for n in self._train_names]
+        ashard = [self._param_shardings[n] for n in self._aux_names]
+        stage = self.zero_stage
+        replicated = NamedSharding(self.mesh, P())
 
         def step(train_vals, states, aux_vals, x, y, base_key, t):
+            # explicit end-to-end annotations (the GSPMD scale-out
+            # contract): batch pinned to the data axis, loss replicated,
+            # INSIDE the program — the same step placed on a 1-host or
+            # an N-host mesh lays out identically with no script change.
+            x = jax.lax.with_sharding_constraint(
+                x, self._batch_sharding(x.ndim))
+            y = jax.lax.with_sharding_constraint(
+                y, self._batch_sharding(y.ndim))
             # RNG key and step count are derived ON DEVICE from the carried
             # t — one launch per step, no per-step host->device transfers.
             t = t + 1
             key = jax.random.fold_in(base_key, t)
             (loss, new_aux), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(train_vals, aux_vals, x, y, key)
+            loss = jax.lax.with_sharding_constraint(loss, replicated)
+            # aux (BN running stats) pinned to their STORAGE sharding:
+            # without this, ZeRO's sharded states pressure the GSPMD
+            # solver into dim-0-sharding the aux outputs too, and the
+            # layout change after step 1 forces a silent recompile
+            new_aux = tuple(
+                jax.lax.with_sharding_constraint(a, sh)
+                for a, sh in zip(new_aux, ashard))
             new_train = []
             new_states = []
             for w, g, s, z, ws in zip(train_vals, grads, states, zero,
                                       wshard):
-                if z is not None:
-                    # ZeRO-1: constrain the grad to the update sharding
-                    # (GSPMD fuses the dp all-reduce into reduce-scatter),
-                    # run the update on shards, all-gather the weight back
+                if z is not None and stage >= 2:
+                    # ZeRO-2/3: pin the grad to the update sharding —
+                    # GSPMD fuses the dp all-reduce into reduce-scatter
+                    # and each replica updates only its slice
                     g = jax.lax.with_sharding_constraint(g, z)
                 w2, s2 = self._update(w, g, s, t)
                 if z is not None:
+                    # ZeRO-1+: optimizer state stays sharded across the
+                    # update; the weight returns to its STORAGE sharding
+                    # (all-gather under stages 1/2, stays dim-0-sharded
+                    # under ZeRO-3 where ws == z)
                     s2 = tuple(
                         jax.lax.with_sharding_constraint(si, z)
                         for si in s2)
@@ -287,8 +407,7 @@ class ShardedTrainStep:
     # ------------------------------------------------------------------
     def _shard_batch(self, arr):
         data = arr.data if isinstance(arr, NDArray) else jnp.asarray(arr)
-        spec = P(self.data_axis, *([None] * (data.ndim - 1)))
-        sharding = NamedSharding(self.mesh, spec)
+        sharding = self._batch_sharding(data.ndim)
         if getattr(data, "sharding", None) == sharding:
             return data
         # memoize by source buffer: train loops pass the same batch array
@@ -299,7 +418,14 @@ class ShardedTrainStep:
         cached = self._batch_cache.get(id(data))
         if cached is not None and cached[0] is data:
             return cached[1]
-        out = jax.device_put(data, sharding)
+        if jax.process_count() > 1:
+            # multi-host: every process holds its LOCAL slice of the
+            # global batch; assemble the global array with no cross-host
+            # transfer (each host feeds its own devices)
+            out = jax.make_array_from_process_local_data(
+                sharding, np.asarray(data))  # sync-ok: local batch is host data
+        else:
+            out = jax.device_put(data, sharding)
         while len(self._batch_cache) >= 2:
             self._batch_cache.pop(next(iter(self._batch_cache)))
         self._batch_cache[id(data)] = (data, out)
@@ -341,20 +467,61 @@ class ShardedTrainStep:
             train_vals, states, aux_vals, self._shard_batch(x),
             self._shard_batch(y), self._ensure_key(), self._t_dev)
 
+    @staticmethod
+    def _sig(a):
+        d = a.data if isinstance(a, NDArray) else a
+        return tuple(d.shape), str(d.dtype)
+
     def _compile(self, x, y, lowered=None):
         """AOT-compiled step, memoized per input signature so
         flops_per_step + dump_hlo share ONE compile (ResNet-50 compiles
         are minutes on the tunnel). Pass ``lowered`` to reuse an
         already-lowered module instead of tracing again."""
-        def sig(a):
-            d = a.data if isinstance(a, NDArray) else a
-            return tuple(d.shape), str(d.dtype)
-
-        key = (sig(x), sig(y))
+        key = (self._sig(x), self._sig(y))
         if key not in self._aot_compiled:
             self._aot_compiled[key] = \
                 (lowered or self._lower(x, y)).compile()
         return self._aot_compiled[key]
+
+    def aot_warmup(self):
+        """AOT-lower-and-compile the donated step program from the live
+        parameter shapes + the last seen batch signature (falling back to
+        the tuning table's recorded ``sharded_step`` signatures), so a
+        resumed — or freshly RESHARDED — step pays its XLA compile here
+        instead of inside the next training step. With a persistent
+        compile cache the traced call then replays as a cache hit.
+        Returns False when no batch signature is known yet."""
+        sig = self._last_sig
+        if sig is None:
+            from .. import tuning
+
+            dp = self.mesh.shape[self.data_axis]
+            # only signatures whose batch divides THIS mesh's data axis
+            # (the table may carry shapes recorded on another mesh)
+            recorded = [s for s in tuning.signatures("sharded_step")
+                        if s.get("x_shape") and s["x_shape"][0] % dp == 0]
+            if not recorded:
+                return False
+            spec = recorded[-1]
+            sig = ((tuple(spec["x_shape"]), spec["x_dtype"]),
+                   (tuple(spec["y_shape"]), spec["y_dtype"]))
+        (xs, xd), (ys, yd) = sig
+
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                        sharding=a.sharding)
+
+        train_vals, states, aux_vals = self._gather()
+        lowered = self._jit.lower(
+            jax.tree.map(sds, train_vals), jax.tree.map(sds, states),
+            jax.tree.map(sds, aux_vals),
+            jax.ShapeDtypeStruct(xs, xd,
+                                 sharding=self._batch_sharding(len(xs))),
+            jax.ShapeDtypeStruct(ys, yd,
+                                 sharding=self._batch_sharding(len(ys))),
+            self._ensure_key(), self._t_dev)
+        self._aot_compiled[sig] = lowered.compile()
+        return True
 
     def flops_per_step(self, x, y):
         """Total FLOPs of one compiled step per XLA cost analysis, or None
@@ -369,7 +536,7 @@ class ShardedTrainStep:
                 cost = self._compile(x, y, lowered=lowered).cost_analysis()
             if isinstance(cost, (list, tuple)):
                 cost = cost[0] if cost else {}
-            flops = float(cost.get("flops", 0.0)) if cost else 0.0
+            flops = float(cost.get("flops", 0.0)) if cost else 0.0  # sync-ok: host cost dict
             return flops or None
         except Exception:  # noqa: BLE001 — cost analysis is best-effort
             return None
@@ -380,6 +547,16 @@ class ShardedTrainStep:
         return self._base_key
 
     def __call__(self, x, y):
+        sig = (self._sig(x), self._sig(y))
+        if sig != self._last_sig:
+            self._last_sig = sig
+            from .. import tuning
+
+            # recorded signature -> a NEW process (warm resume) can AOT-
+            # compile this step before its first batch ever arrives
+            tuning.record_signature("sharded_step", {
+                "x_shape": list(sig[0][0]), "x_dtype": sig[0][1],
+                "y_shape": list(sig[1][0]), "y_dtype": sig[1][1]})
         train_vals, states, aux_vals = self._gather()
         loss, new_train, new_states, new_aux, self._t_dev = self._jit(
             train_vals, states, aux_vals, self._shard_batch(x),
@@ -393,6 +570,158 @@ class ShardedTrainStep:
         for n, v in zip(self._aux_names, new_aux):
             self._all_params[n].data()._set_data(v)
         return NDArray(loss)
+
+    # ------------------------------------------------------------------
+    # memory accounting + telemetry
+    # ------------------------------------------------------------------
+    @property
+    def step_count(self):
+        """Completed optimizer steps. A host read of the carried device
+        counter — a control-plane cursor for checkpoints/reshards, never
+        read in the hot loop."""
+        return int(self._t_dev)  # sync-ok: rare control-plane cursor read
+
+    def per_device_bytes(self):
+        """Bytes ONE device holds: ``{'param_bytes', 'opt_state_bytes'}``.
+        Replicated tensors count full size per device; ZeRO/tp-sharded
+        tensors count only the local shard — the quantity the ZeRO
+        ladder shrinks ~dp× (bench's zero_stage_ab row asserts it)."""
+        def dev0(a):
+            return a.addressable_shards[0].data.nbytes
+
+        params = sum(dev0(self._all_params[n].data().data)
+                     for n in self._all_params)
+        opt = sum(dev0(s) for n in self._train_names
+                  for s in self._states[n])
+        return {"param_bytes": int(params), "opt_state_bytes": int(opt)}
+
+    def _publish_mesh_telemetry(self):
+        """Mesh-shape / ZeRO / per-device-bytes gauges. mxt_top's mesh
+        section renders only when these exist; reshards re-publish."""
+        from .. import telemetry
+
+        telemetry.gauge(
+            "mxt_mesh_devices",
+            "Devices in the active training mesh.").set(
+                int(self.mesh.devices.size))
+        ax = telemetry.gauge("mxt_mesh_axis_size",
+                             "Mesh extent per named axis.", ("axis",))
+        for name, size in self.mesh.shape.items():
+            ax.labels(str(name)).set(int(size))
+        telemetry.gauge(
+            "mxt_zero_stage",
+            "Active ZeRO weight-update sharding stage (0-3)."
+        ).set(self.zero_stage)
+        b = self.per_device_bytes()
+        telemetry.gauge(
+            "mxt_per_device_param_bytes",
+            "Model parameter bytes held by ONE device (shrinks ~dp× "
+            "under ZeRO-3).").set(b["param_bytes"])
+        telemetry.gauge(
+            "mxt_per_device_opt_bytes",
+            "Optimizer-state bytes held by ONE device (shrinks ~dp× "
+            "under ZeRO-1/2/3).").set(b["opt_state_bytes"])
+
+    # ------------------------------------------------------------------
+    # checkpoint protocol (CheckpointManager's `trainer` slot) + reshard
+    # ------------------------------------------------------------------
+    def save_states(self, fname):
+        """Optimizer states + step cursor + PRNG base key, in the
+        CheckpointManager writer protocol (one path argument): a
+        ShardedTrainStep slots straight into ``CheckpointManager`` as
+        its ``trainer``, so sharded runs checkpoint through the same
+        CRC-manifested atomic machinery as eager ones. Shards are
+        gathered to host numpy — the checkpoint IS the cross-mesh
+        transfer format the elastic reshard path rides."""
+        arrays = {}
+        for n in self._train_names:
+            for i, s in enumerate(self._states[n]):
+                arrays["s:%d:%s" % (i, n)] = np.asarray(s)  # sync-ok: checkpoint spill
+        if self._base_key is not None:
+            arrays["base_key"] = np.asarray(  # sync-ok: control-plane key snapshot
+                jax.random.key_data(self._base_key))
+        meta = {"t": self.step_count, "zero_stage": self.zero_stage,
+                "mesh": {str(k): int(v)
+                         for k, v in self.mesh.shape.items()}}
+        arrays["__meta__"] = np.frombuffer(
+            json.dumps(meta).encode("utf-8"), dtype=np.uint8)
+        # open file handle: np.savez(path) appends .npz, which would
+        # break CheckpointManager's tmp -> os.replace publish
+        with open(fname, "wb") as f:
+            np.savez(f, **arrays)
+
+    def load_states(self, fname):
+        """Inverse of :meth:`save_states` onto the CURRENT mesh: every
+        state shard is re-placed per THIS step's (possibly different)
+        dp×tp layout — a checkpoint written on an 8-device mesh restores
+        onto a 6-device survivor mesh with no renormalization. Params
+        (which CheckpointManager reloads just before this, replicated on
+        the default device) are re-placed too; already-correct buffers
+        are skipped."""
+        with open(fname, "rb") as f:
+            data = np.load(f)
+            blob = {k: data[k] for k in data.files}
+        meta = json.loads(blob.pop("__meta__").tobytes().decode("utf-8"))
+        key_data = blob.pop("base_key", None)
+        per = {n: {} for n in self._train_names}
+        for k, v in blob.items():
+            _, i, n = k.split(":", 2)
+            if n not in per:
+                raise MXNetError(
+                    "sharded state checkpoint names unknown parameter %r"
+                    % n)
+            per[n][int(i)] = v
+        replicated = NamedSharding(self.mesh, P())
+        for n in self._train_names:
+            vals = [per[n][i] for i in sorted(per[n])]
+            if not vals:
+                self._states[n] = ()
+                continue
+            z = self._zero_shardings[n] or replicated
+            self._states[n] = tuple(jax.device_put(vals, [z] * len(vals)))
+        if key_data is not None:
+            self._base_key = jax.random.wrap_key_data(
+                jnp.asarray(key_data))
+        self._t_dev = jax.device_put(
+            jnp.asarray(int(meta["t"]), jnp.int32), replicated)
+        shard_params(self._all_params, self.mesh,
+                     shardings=self._param_shardings)
+        self._batch_cache.clear()
+        self._publish_mesh_telemetry()
+
+    def rebind_mesh(self, new_mesh, transfer=True):
+        """Re-home this step on a different mesh in place (the elastic
+        reshard primitive). Recomputes every sharding for the new dp×tp
+        shape (ZeRO eligibility is re-decided for the new dp), rebuilds
+        the donated step program, and — with ``transfer=True`` — moves
+        live params/optimizer state device-to-device. ``transfer=False``
+        leaves value movement to a CheckpointManager restore: the spill
+        path reshard.reshard_step uses when the old mesh's hosts may be
+        dead (their buffers unreachable)."""
+        if new_mesh.axis_names != self.mesh.axis_names:
+            raise MXNetError(
+                "rebind_mesh must keep the axis names (%s -> %s)"
+                % (self.mesh.axis_names, new_mesh.axis_names))
+        self.mesh = new_mesh
+        self._compute_shardings()
+        replicated = NamedSharding(self.mesh, P())
+        if transfer:
+            shard_params(self._all_params, self.mesh,
+                         shardings=self._param_shardings)
+            for n in self._train_names:
+                ss = list(self._states[n])
+                if ss:
+                    z = self._zero_shardings[n] or replicated
+                    self._states[n] = tuple(
+                        jax.device_put(ss, [z] * len(ss)))
+            self._t_dev = jax.device_put(self._t_dev, replicated)
+            if self._base_key is not None:
+                self._base_key = jax.device_put(self._base_key, replicated)
+        self._batch_cache.clear()
+        self._aot_compiled.clear()
+        self._jit = self._build()
+        self._publish_mesh_telemetry()
+        return self
 
 
 def allreduce_across_processes(value):
@@ -414,7 +743,7 @@ def allreduce_across_processes(value):
     # array, and letting it flow into single-device NDArray ops trips
     # "Cannot reshard an input that is not fully addressable" — a host
     # copy re-enters as a plain process-local array
-    out = jnp.asarray(np.asarray(gathered).sum(axis=0))
+    out = jnp.asarray(np.asarray(gathered).sum(axis=0))  # sync-ok: host re-entry
     if sparse_stype is not None:
         from ..sparse import cast_storage
         return cast_storage(NDArray(out), sparse_stype)

@@ -138,14 +138,18 @@ def _warm_paged(spec):
     return "paged_attention"
 
 
-def warmup(steps=(), kernels=True, include_live=True):
+def warmup(steps=(), kernels=True, include_live=True, reason=None):
     """AOT-lower-and-compile the canonical entry points from recorded
     shape signatures.
 
-    ``steps``: fused entry points (CachedTrainStep / _FusedUpdate —
-    anything with ``aot_warmup()``) to compile in addition to every
-    live registered one (``include_live=False`` restricts to ``steps``).
-    ``kernels=False`` skips the library-kernel (flash/BN) signatures.
+    ``steps``: fused entry points (CachedTrainStep / _FusedUpdate /
+    parallel.ShardedTrainStep — anything with ``aot_warmup()``) to
+    compile in addition to every live registered one
+    (``include_live=False`` restricts to ``steps``). ``kernels=False``
+    skips the library-kernel (flash/BN) signatures. ``reason`` tags the
+    emitted telemetry event — the elastic reshard path passes
+    ``reason="reshard"`` so warm-compiles triggered by a mesh change are
+    distinguishable from resume warm-starts in the JSONL stream.
 
     Returns a summary dict: entries warmed, compiles performed, compile
     seconds, cache hits/misses — on a warm persistent cache the same
@@ -189,6 +193,8 @@ def warmup(steps=(), kernels=True, include_live=True):
         "cache_misses": after["cache_misses"] - before["cache_misses"],
         "cache_dir": compile_cache.cache_dir(),
     }
+    if reason is not None:
+        summary["reason"] = str(reason)
     tel = _telemetry()
     tel.histogram(
         "mxt_warmup_seconds",

@@ -266,7 +266,7 @@ def test_ring_attention_backward_memory_is_o_t_over_n():
 
     out_specs = [spec] * 4 + [P(None, None, "sp")]  # q,k,v,out + lse
     shapes = jax.eval_shape(
-        jax.shard_map(fwd_residuals, mesh=mesh,
+        seq.shard_map(fwd_residuals, mesh=mesh,
                       in_specs=(spec, spec, spec), out_specs=out_specs),
         *[jax.ShapeDtypeStruct((B, H, T, D), jnp.float32)] * 3)
     total = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))

@@ -63,6 +63,15 @@ SCAN = {
     "mxnet_tpu/tuning/autotune.py": _ALL,
     "mxnet_tpu/tuning/warmup.py": _ALL,
     "mxnet_tpu/tuning/compile_cache.py": _ALL,
+    # the GSPMD sharded-step layer: the step itself is ONE launch with
+    # zero reads, so any sync here is control-plane by construction —
+    # mesh setup, checkpoint spill/restore for the elastic reshard
+    # transfer format, cross-process reduce re-entry, and rare cursor
+    # reads. Each carries its sync-ok justification; an UNMARKED read
+    # would mean the per-step path started syncing.
+    "mxnet_tpu/parallel/mesh.py": _ALL,
+    "mxnet_tpu/parallel/sharded.py": _ALL,
+    "mxnet_tpu/parallel/reshard.py": _ALL,
     # the serving decode loop IS a hot path with an SLO: scheduler ticks
     # and cache bookkeeping run between every decode dispatch, so one
     # stray read there re-synchronizes every token of every request.

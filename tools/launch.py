@@ -20,7 +20,14 @@ the coordinator) and launches one worker per host:
     python tools/launch.py -n 4 --launcher ssh -H hosts python my_train.py
 
 Workers read MXT_COORDINATOR / MXT_NUM_WORKERS / MXT_WORKER_ID (set
-here) via ``mxnet_tpu.parallel.init_distributed()``.
+here) via ``mxnet_tpu.parallel.init_distributed()``. ``--mesh dp,tp``
+(+ optional ``--mesh-axes`` / ``--zero-stage``) exports
+MXT_MESH_SHAPE / MXT_MESH_AXES / MXT_ZERO_STAGE so a no-arg
+``parallel.make_mesh()`` + ``ShardedTrainStep`` training script scales
+from one host to N by changing only this launch line:
+
+    python tools/launch.py -n 16 --launcher ssh -H hosts \\
+        --mesh 64,2 --zero-stage 2 python train.py
 
 ``--respawn`` (local launcher) supervises the workers: a crashed one is
 restarted with its original rank/env so it rejoins the kvstore
@@ -44,7 +51,7 @@ def _free_port():
     return port
 
 
-def _worker_env(base, coordinator, n, i):
+def _worker_env(base, coordinator, n, i, extra=None):
     env = dict(base)
     env["MXT_COORDINATOR"] = coordinator
     env["MXT_NUM_WORKERS"] = str(n)
@@ -53,10 +60,28 @@ def _worker_env(base, coordinator, n, i):
     env["DMLC_NUM_WORKER"] = str(n)
     env["DMLC_WORKER_ID"] = str(i)
     env["DMLC_ROLE"] = "worker"
+    if extra:
+        env.update(extra)
     return env
 
 
-def launch_local(n, command, respawn=False, max_restarts=2):
+def _mesh_env(args):
+    """MXT_MESH_SHAPE / MXT_MESH_AXES / MXT_ZERO_STAGE from the launch
+    line: workers' no-arg parallel.make_mesh() and ShardedTrainStep
+    calls pick these up, so the SAME training script runs a 1-host dev
+    mesh and an N-host pod mesh with no code change (the GSPMD
+    scale-out contract)."""
+    extra = {}
+    if getattr(args, "mesh", None):
+        extra["MXT_MESH_SHAPE"] = args.mesh
+    if getattr(args, "mesh_axes", None):
+        extra["MXT_MESH_AXES"] = args.mesh_axes
+    if getattr(args, "zero_stage", None) is not None:
+        extra["MXT_ZERO_STAGE"] = str(args.zero_stage)
+    return extra
+
+
+def launch_local(n, command, respawn=False, max_restarts=2, extra_env=None):
     """Start n local workers. With ``respawn`` the launcher supervises
     them: a worker that exits non-zero (crash, SIGKILL) is restarted
     with its ORIGINAL rank/env — same MXT_WORKER_ID, same coordinator,
@@ -66,7 +91,8 @@ def launch_local(n, command, respawn=False, max_restarts=2):
     import time
 
     coordinator = "127.0.0.1:%d" % _free_port()
-    envs = [_worker_env(os.environ, coordinator, n, i) for i in range(n)]
+    envs = [_worker_env(os.environ, coordinator, n, i, extra_env)
+            for i in range(n)]
     procs = [subprocess.Popen(command, env=envs[i]) for i in range(n)]
     if not respawn:
         rc = 0
@@ -97,7 +123,7 @@ def launch_local(n, command, respawn=False, max_restarts=2):
     return next((rc for rc in final if rc), 0)
 
 
-def launch_ssh(n, hostfile, command):
+def launch_ssh(n, hostfile, command, extra_env=None):
     with open(hostfile) as f:
         hosts = [h.strip() for h in f if h.strip()
                  and not h.startswith("#")]
@@ -106,7 +132,7 @@ def launch_ssh(n, hostfile, command):
     coordinator = "%s:%d" % (hosts[0], 9378)
     procs = []
     for i in range(n):
-        env = _worker_env({}, coordinator, n, i)
+        env = _worker_env({}, coordinator, n, i, extra_env)
         envs = " ".join("%s=%s" % kv for kv in env.items())
         remote = "cd %s && %s %s" % (os.getcwd(), envs,
                                      " ".join(command))
@@ -136,19 +162,34 @@ def main():
                          "only)")
     ap.add_argument("--max-restarts", type=int, default=2,
                     help="per-worker restart budget under --respawn")
+    ap.add_argument("--mesh", default=None,
+                    help="global mesh shape exported as MXT_MESH_SHAPE "
+                         "(e.g. '16,2'; one -1 wildcard allowed) — "
+                         "workers' no-arg parallel.make_mesh() builds "
+                         "this mesh over the GLOBAL device list")
+    ap.add_argument("--mesh-axes", default=None,
+                    help="axis names paired with --mesh (exported as "
+                         "MXT_MESH_AXES; default data,model)")
+    ap.add_argument("--zero-stage", type=int, default=None,
+                    choices=(0, 1, 2, 3),
+                    help="default ZeRO weight-update sharding stage for "
+                         "ShardedTrainStep (exported as MXT_ZERO_STAGE)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
         ap.error("no command to launch")
+    extra = _mesh_env(args)
     if args.launcher == "local":
         return launch_local(args.num_workers, args.command,
                             respawn=args.respawn,
-                            max_restarts=args.max_restarts)
+                            max_restarts=args.max_restarts,
+                            extra_env=extra)
     if args.respawn:
         ap.error("--respawn supports the local launcher only")
     if not args.hostfile:
         ap.error("ssh launcher requires -H hostfile")
-    return launch_ssh(args.num_workers, args.hostfile, args.command)
+    return launch_ssh(args.num_workers, args.hostfile, args.command,
+                      extra_env=extra)
 
 
 if __name__ == "__main__":

@@ -17,6 +17,13 @@ async-training health signals once per interval:
                        (a warm-started replica shows hits only)
     tune cache         kernel-autotuner table hit/miss
 
+and, when a GSPMD sharded step is live (mesh gauges present):
+
+    mesh               device count, per-axis extents, ZeRO stage
+    per-dev bytes      param/optimizer bytes held by ONE device (the
+                       memory the ZeRO-1/2/3 ladder shrinks ~dp×)
+    reshards           in-place elastic mesh reshards so far
+
 and, when the process serves (mxnet_tpu/serving/ metrics present):
 
     serving tok/s      generated tokens per second
@@ -127,6 +134,16 @@ def _fmt(v, spec="%.2f"):
     return "--" if v is None else spec % v
 
 
+def _fmt_b(v):
+    """Human bytes for the per-device param/opt gauges."""
+    if v is None:
+        return "--"
+    for unit in ("B", "KB", "MB", "GB"):
+        if v < 1024 or unit == "GB":
+            return ("%.0f%s" if unit == "B" else "%.1f%s") % (v, unit)
+        v /= 1024.0
+
+
 class EndpointSource:
     """Scrape --url (or MXT_TELEMETRY_PORT) once per frame."""
 
@@ -154,7 +171,14 @@ class JsonlSource:
         try:
             with open(self.path) as f:
                 f.seek(self._pos)
-                for line in f:
+                # readline(), not `for line in f`: tell() inside file
+                # iteration raises OSError in text mode, which the
+                # except below used to swallow — --jsonl mode silently
+                # dropped every row
+                while True:
+                    line = f.readline()
+                    if not line:
+                        break
                     self._pos = f.tell()
                     try:
                         row = json.loads(line)
@@ -175,10 +199,17 @@ class JsonlSource:
         samples = {("mxt_step_latency_seconds_count", frozenset()):
                    float(self._steps)}
         for key, v in self._metrics.items():
-            name = key.split("{", 1)[0]
+            name, _, labpart = key.partition("{")
             if isinstance(v, dict):
                 continue
-            samples[(name, frozenset([("src", key)]))] = float(v)
+            # snapshot keys carry unquoted labels (name{axis=data}):
+            # surface them as real labels so label-matched sections
+            # (mesh axes) render in --jsonl mode too; `src` keeps every
+            # labelset distinct
+            lab = [("src", key)]
+            if labpart:
+                lab += re.findall(r'(\w+)=([^,}]+)', labpart)
+            samples[(name, frozenset(lab))] = float(v)
         if self._rpc_lat:
             lat = sorted(self._rpc_lat)
 
@@ -222,6 +253,21 @@ def render(samples, prev, dt):
     tune_hits = metric_sum(samples, "mxt_tune_cache_hits_total")
     tune_miss = metric_sum(samples, "mxt_tune_cache_misses_total")
 
+    # mesh / GSPMD section (mxnet_tpu/parallel/): only rendered when a
+    # ShardedTrainStep has published its mesh gauges — a single-device
+    # trainer or a pure server shows no mesh noise
+    mesh_dev = metric_sum(samples, "mxt_mesh_devices")
+    zero_stage = metric_sum(samples, "mxt_zero_stage")
+    mesh_pbytes = metric_sum(samples, "mxt_per_device_param_bytes")
+    mesh_obytes = metric_sum(samples, "mxt_per_device_opt_bytes")
+    reshards = metric_sum(samples, "mxt_reshard_events_total")
+    mesh_axes = []
+    for (n, lab), v in sorted(samples.items()):
+        if n == "mxt_mesh_axis_size":
+            d = dict(lab)
+            if "axis" in d:
+                mesh_axes.append("%s=%d" % (d["axis"], int(v)))
+
     # serving section (mxnet_tpu/serving/): only rendered when the
     # process has served — a pure trainer shows no serving noise
     tok_rate, tok_total = rate("mxt_serving_tokens_total")
@@ -253,6 +299,17 @@ def render(samples, prev, dt):
         "  tune cache       %s/%s hit/miss"
         % (_fmt(tune_hits, "%.0f"), _fmt(tune_miss, "%.0f")),
     ]
+    if mesh_dev is not None:
+        lines += [
+            "-" * 46,
+            "  mesh             %s dev   %s   zero=%s"
+            % (_fmt(mesh_dev, "%.0f"),
+               " ".join(mesh_axes) if mesh_axes else "--",
+               _fmt(zero_stage, "%.0f")),
+            "  per-dev bytes    params %s   opt %s"
+            % (_fmt_b(mesh_pbytes), _fmt_b(mesh_obytes)),
+            "  reshards         %s" % _fmt(reshards, "%.0f"),
+        ]
     if tok_total is not None:
         lines += [
             "-" * 46,
