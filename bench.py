@@ -43,8 +43,8 @@ _BUDGET = float(os.environ.get("BENCH_BUDGET", "1500"))
 # but CPU is the fallback path where the budget rarely binds
 _CONFIG_COST = {"resnet50": 420, "bert": 300, "lstm_ptb": 200,
                 "wide_deep": 200, "lenet": 150, "pipeline": 150,
-                "async_ab": 90, "telemetry_ab": 60, "cold_warm": 120,
-                "serving": 150, "zero_stage": 90}
+                "async_ab": 90, "telemetry_ab": 60, "diag_ab": 60,
+                "cold_warm": 120, "serving": 150, "zero_stage": 90}
 
 
 def _remaining():
@@ -858,6 +858,92 @@ def bench_telemetry_ab(platform, dtype):
     return overhead, row
 
 
+def bench_diagnostics_ab(platform, dtype):
+    """Diagnostics overhead A/B (diagnostics.py): the SAME fused Gluon
+    step run with the diagnostics layer disarmed (no flight-recorder
+    tap, no watchdog) and then fully armed (flight recorder + watchdog
+    daemon in report mode + HBM ledger, which is always on). The
+    contract mirrors the telemetry A/B: (a) IDENTICAL host_syncs_per_step
+    both ways — the watchdog observes heartbeat counters and the ledger
+    observes shape metadata, so diagnostics add ZERO device reads to the
+    hot path — and (b) step-time overhead within noise. The row
+    self-reports both so the driver can gate on them."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import diagnostics, engine, nd, profiler
+    from mxnet_tpu.gluon import Trainer, nn
+
+    del dtype  # f32: the A/B isolates instrumentation, not math
+    batch = int(os.environ.get("BENCH_DAB_BATCH", "64"))
+    hidden = int(os.environ.get("BENCH_DAB_HIDDEN", "256"))
+    iters = int(os.environ.get("BENCH_DAB_ITERS", "40"))
+    warmup = int(os.environ.get("BENCH_DAB_WARMUP", "3"))
+    window = int(os.environ.get("BENCH_DAB_INFLIGHT", "4"))
+
+    def run(tag, armed):
+        if armed:
+            # recorder tap + watchdog thread (timeout far above any
+            # real step so it never fires mid-bench)
+            diagnostics.enable(timeout=3600.0, action="report",
+                               handlers=False)
+        else:
+            diagnostics.disable()
+        try:
+            mx.random.seed(0)
+            net = nn.Sequential(prefix="dab_%s_" % tag)
+            with net.name_scope():
+                net.add(nn.Dense(hidden, activation="relu"),
+                        nn.Dense(hidden, activation="relu"),
+                        nn.Dense(10))
+            net.initialize()
+            tr = Trainer(net.collect_params(), "adam",
+                         {"learning_rate": 1e-3})
+            step = tr.fuse_step(net,
+                                mx.gluon.loss.SoftmaxCrossEntropyLoss())
+            rng = np.random.RandomState(0)
+            x = nd.array(rng.uniform(-1, 1,
+                                     (batch, 32)).astype(np.float32))
+            y = nd.array(rng.randint(0, 10, (batch,)).astype(np.float32))
+            with engine.bulk(window):
+                for _ in range(warmup):
+                    step(x, y).wait_to_read()
+                t0 = time.perf_counter()
+                h0 = profiler.host_sync_count()
+                for _ in range(iters):
+                    step(x, y)
+                nd.waitall()
+                dt = time.perf_counter() - t0
+                syncs = profiler.host_sync_count() - h0
+            return dt / iters * 1e3, syncs / iters
+        finally:
+            diagnostics.disable()
+
+    off_ms, off_sps = run("off", False)
+    on_ms, on_sps = run("on", True)
+    ring_events = len(diagnostics.recorder())
+    diagnostics.disable()
+
+    overhead = on_ms / off_ms if off_ms else 0.0
+    row = {
+        "config": "diagnostics_overhead_ab", "chips": 1,
+        "batch_size": batch, "dtype": "float32", "platform": platform,
+        "inflight_window": window,
+        "diagnostics_off_step_time_ms": round(off_ms, 3),
+        "diagnostics_on_step_time_ms": round(on_ms, 3),
+        "host_syncs_per_step_off": round(off_sps, 3),
+        "host_syncs_per_step_on": round(on_sps, 3),
+        "flight_recorder_events": ring_events,
+        "hbm_pools": sorted(diagnostics.ledger().snapshot()),
+        "images_or_tokens_per_sec_per_chip": round(
+            batch * 1e3 / on_ms, 2),
+        "mfu": None, "flops_per_sample": None,
+        "diagnostics_overhead": round(overhead, 4),
+    }
+    _emit_jsonl(row)
+    return overhead, row
+
+
 _COLD_WARM_CODE = r"""
 import json, os, sys, time
 import jax
@@ -1193,7 +1279,7 @@ def main():
     configs = os.environ.get(
         "BENCH_CONFIGS",
         "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline,async_ab,"
-        "telemetry_ab,cold_warm,serving,zero_stage"
+        "telemetry_ab,diag_ab,cold_warm,serving,zero_stage"
     ).split(",")
 
     # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
@@ -1214,6 +1300,8 @@ def main():
                      bench_async_ab),
         "telemetry_ab": ("telemetry_overhead", "x (on/off step time)",
                          bench_telemetry_ab),
+        "diag_ab": ("diagnostics_overhead", "x (on/off step time)",
+                    bench_diagnostics_ab),
         "cold_warm": ("cold_warm_compile_ratio",
                       "x (cold/warm compile time)", bench_cold_warm),
         "serving": ("serving_continuous_vs_static",
@@ -1227,8 +1315,8 @@ def main():
     skipped = []
     best_resnet = None
     for name in ("resnet50", "bert", "lstm_ptb", "wide_deep", "lenet",
-                 "pipeline", "async_ab", "telemetry_ab", "cold_warm",
-                 "serving", "zero_stage"):
+                 "pipeline", "async_ab", "telemetry_ab", "diag_ab",
+                 "cold_warm", "serving", "zero_stage"):
         if name not in configs:
             continue
         cost = float(os.environ.get("BENCH_COST_%s" % name.upper(),

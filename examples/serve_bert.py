@@ -86,10 +86,27 @@ def main():
     p.add_argument("--head-dim", type=int, default=32)
     p.add_argument("--max-new", type=int, default=48,
                    help="upper bound of the random decode budgets")
+    p.add_argument("--watchdog", type=float, nargs="?", const=30.0,
+                   default=None, metavar="SECONDS",
+                   help="arm the diagnostics layer (flight recorder + "
+                        "post-mortem handlers) with a hang watchdog "
+                        "over the decode loop: no token retirement for "
+                        "SECONDS (default 30) with work outstanding "
+                        "dumps an mxt-postmortem-*.json; "
+                        "MXT_WATCHDOG_ACTION=abort makes the replica "
+                        "die typed so a supervisor respawns it")
     args = p.parse_args()
 
     if args.telemetry:
         os.environ["MXT_TELEMETRY_JSONL"] = args.telemetry
+
+    if args.watchdog is not None:
+        from mxnet_tpu import config, diagnostics
+
+        diagnostics.enable(timeout=args.watchdog)
+        print("watchdog: armed (%.0fs, action=%s); post-mortems -> %s"
+              % (args.watchdog, config.get("MXT_WATCHDOG_ACTION"),
+                 config.get("MXT_POSTMORTEM_DIR")))
 
     from mxnet_tpu import nd, serving
 

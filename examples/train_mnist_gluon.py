@@ -156,7 +156,24 @@ def main():
                         "axis, 2 adds gradient reduce-scatter + sharded "
                         "updates, 3 shards the params FSDP-style); "
                         "default MXT_ZERO_STAGE or 0")
+    p.add_argument("--watchdog", type=float, nargs="?", const=30.0,
+                   default=None, metavar="SECONDS",
+                   help="arm the diagnostics layer (flight recorder + "
+                        "post-mortem handlers) with a hang watchdog: no "
+                        "training progress for SECONDS (default 30) "
+                        "dumps thread stacks + the flight-recorder tail "
+                        "to an mxt-postmortem-*.json; "
+                        "MXT_WATCHDOG_ACTION=abort turns a hang into a "
+                        "typed, respawnable death")
     args = p.parse_args()
+
+    if args.watchdog is not None:
+        from mxnet_tpu import diagnostics
+
+        diagnostics.enable(timeout=args.watchdog)
+        print("watchdog: armed (%.0fs, action=%s); post-mortems -> %s"
+              % (args.watchdog, mx.config.get("MXT_WATCHDOG_ACTION"),
+                 mx.config.get("MXT_POSTMORTEM_DIR")))
 
     if args.telemetry:
         os.environ.setdefault("MXT_TELEMETRY_JSONL",

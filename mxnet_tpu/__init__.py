@@ -58,6 +58,9 @@ from . import image
 from . import config
 from . import telemetry
 telemetry._maybe_autostart()  # MXT_TELEMETRY_PORT exposition endpoint
+from . import diagnostics
+diagnostics._maybe_autostart()  # flight recorder tap (+ watchdog when
+#                                 MXT_WATCHDOG_TIMEOUT is set)
 # compile observability (jax.monitoring listeners) + persistent compile
 # cache (MXT_COMPILE_CACHE_DIR) + the kernel tuning table
 from . import tuning
@@ -78,7 +81,7 @@ __all__ = [
     "sym", "Symbol", "module", "mod", "Module", "BucketingModule", "model",
     "save_checkpoint", "load_checkpoint", "profiler", "monitor",
     "operator", "image", "config", "amp", "contrib", "resilience",
-    "membership", "telemetry", "tuning",
+    "membership", "telemetry", "tuning", "diagnostics",
     "SequentialModule", "visualization", "viz", "runtime", "util", "rnn",
     "attribute", "AttrScope", "name", "engine",
 ]

@@ -690,8 +690,15 @@ class AsyncClient:
         if deadline is not None:
             policy = resilience.RetryPolicy.from_config()
             policy.deadline = float(deadline)
-        status, result = resilience.kv_retry(
-            op, key, attempt, reconnect=self._reconnect, policy=policy)
+        # the hang watchdog observes RPC completions: a request blocked
+        # past MXT_WATCHDOG_TIMEOUT shows as kvstore_rpc pending > 0
+        # with a frozen completion counter (pure host bookkeeping)
+        from . import diagnostics
+
+        with diagnostics.pending_scope("kvstore_rpc"):
+            status, result = resilience.kv_retry(
+                op, key, attempt, reconnect=self._reconnect, policy=policy)
+        diagnostics.progress("kvstore_rpc")
         if status == "stale":
             raise StaleWorkerError(result)
         if status == "timeout":

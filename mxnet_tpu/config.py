@@ -244,6 +244,38 @@ _declare("MXT_SERVING_SLOTS", int, 8,
          "step, so the decode program compiles once regardless of "
          "traffic (inactive slots are masked, not reshaped away).")
 
+_declare("MXT_WATCHDOG_TIMEOUT", float, None,
+         "Hang-watchdog stall threshold in seconds (diagnostics.py): a "
+         "progress source (engine window retires, KVStore RPC "
+         "completions, membership heartbeats, the serving decode loop) "
+         "with outstanding work and no counter movement for this long "
+         "triggers a stall report (thread stacks + in-flight window "
+         "state + flight-recorder tail + post-mortem file). Unset "
+         "disables the watchdog; setting it also arms the post-mortem "
+         "handlers at import.")
+_declare("MXT_WATCHDOG_ACTION", str, "report",
+         "What a watchdog stall does: 'report' keeps the process alive "
+         "and re-reports every timeout window; 'abort' dumps the "
+         "post-mortem then exits with diagnostics.WATCHDOG_EXIT_CODE "
+         "(134) so tools/launch.py --respawn or the membership reaper "
+         "can respawn the worker — a typed death instead of a silent "
+         "hang.")
+_declare("MXT_WATCHDOG_INTERVAL", float, None,
+         "Watchdog check period in seconds (default: timeout/4, floor "
+         "50 ms). Checks read host heartbeat counters only — never a "
+         "device value.")
+_declare("MXT_POSTMORTEM_DIR", str, ".",
+         "Directory where diagnostics post-mortems "
+         "(mxt-postmortem-<ts>.json: flight-recorder ring, thread "
+         "stacks, window state, HBM ledger, goodput, config + metrics "
+         "snapshots) are written on fatal signal, unhandled exception, "
+         "watchdog stall, OOM, or demand.")
+_declare("MXT_FLIGHT_RECORDER_SIZE", int, 2048,
+         "Bounded ring capacity (events) of the diagnostics flight "
+         "recorder. Every telemetry event — step spans, RPC spans, "
+         "membership/reshard/checkpoint events — lands here; the tail "
+         "rides every post-mortem and /debug/flightrecorder.")
+
 _declare("MXT_AG_LEAN_TAPE", bool, False,
          "Skip storing per-node replay state (forward fn + primal "
          "inputs) on the autograd tape. Saves peak memory on very long "

@@ -1,0 +1,929 @@
+"""Flight recorder & diagnostics — the black-box layer over the
+fused/async stack.
+
+PR 5 answered "what is happening" (metrics registry, phase spans, RPC
+tracing, ``mxt_top``). This module answers the post-incident questions a
+multi-host pod (PR 8) and a serving fleet (PR 7) actually raise: *why is
+this replica wedged*, *where did HBM go*, and *how much wall-clock was
+productive* — without a human attached, and without adding a single
+device read to the hot path. Four parts:
+
+1. **Flight recorder.** A bounded ring of structured events — step
+   dispatch/retire spans, RPC spans, membership epoch changes,
+   reshard/checkpoint/eviction events — tapped straight off
+   ``telemetry.emit_event`` (one tap feeds every existing event source;
+   the sources did not have to change). :func:`dump_postmortem` writes
+   ``mxt-postmortem-<ts>.json`` with the ring tail, every Python
+   thread's stack, the engine's in-flight window state, the HBM ledger,
+   the goodput ledger, a config snapshot, and a metrics snapshot — on
+   fatal signal (SIGTERM/SIGABRT, plus ``faulthandler`` for hard
+   crashes), on an unhandled exception (``sys.excepthook`` + the serve
+   loop's catch), and on demand (``/debug/flightrecorder``).
+
+2. **Hang watchdog.** Subsystems that make progress bump a *host
+   counter* (:func:`progress`) and declare how much work is outstanding
+   (:func:`register_source` / :func:`pending_scope`): engine window
+   retires, KVStore RPC completions, membership heartbeats, the serving
+   decode loop. A daemon thread (:class:`Watchdog`) watches ONLY those
+   counters — never a device value — and when a source with outstanding
+   work stops moving for ``MXT_WATCHDOG_TIMEOUT`` seconds it dumps
+   thread stacks + window state + the recorder tail, bumps
+   ``mxt_watchdog_stalls_total{source}``, and per
+   ``MXT_WATCHDOG_ACTION=report|abort`` keeps reporting or exits with
+   :data:`WATCHDOG_EXIT_CODE` so ``tools/launch.py --respawn`` (or the
+   membership reaper) turns today's silent ``worker_freeze`` hang into
+   a typed, diagnosable, respawnable death. ``check(now=...)`` takes an
+   explicit clock so tests never sleep.
+
+3. **HBM ledger.** Allocation sites register device bytes per pool —
+   ``params``, ``optimizer``, ``kv_cache``, ``inflight_window``,
+   ``prefetch`` — via :func:`hbm_set`/:func:`hbm_release` (pure host
+   arithmetic on shape metadata; ``.nbytes`` never touches the device).
+   Exported as ``mxt_hbm_bytes{pool}`` gauges with
+   ``mxt_hbm_peak_bytes{pool}`` watermarks, reconciled against
+   ``device.memory_stats()`` where the backend provides it
+   (:func:`reconcile`), and snapshotted into every post-mortem.
+   :func:`reraise_if_oom` catches ``RESOURCE_EXHAUSTED`` at the
+   step/decode dispatch sites and re-raises annotated with the ledger —
+   an OOM names the pool that ate the HBM instead of a bare XLA error.
+
+4. **Goodput ledger + on-demand profiler.** Lost wall-clock is
+   accounted by cause — ``compile``, ``checkpoint``, ``reshard``,
+   ``stall``, ``data_wait`` — into ``mxt_lost_seconds_total{cause}``
+   and ``mxt_goodput_ratio`` (productive fraction of elapsed time).
+   ``/debug/trace?ms=N`` runs a programmatic ``jax.profiler`` capture
+   and returns the trace archive, so the staged TPU runbook can pull
+   per-program time/fusion attribution (PAPERS.md arXiv 2301.13062)
+   from a live replica remotely; ``/debug/stacks``, ``/debug/memory``
+   and ``/debug/flightrecorder`` ride the same telemetry endpoint.
+
+Everything here observes host state the subsystems already maintain;
+``tools/check_host_syncs.py`` scans this module, and the one deliberate
+sync (draining the window inside the OOM post-mortem, where the hot
+path is already dead) is ``sync-ok``-annotated.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+
+from .base import MXNetError
+
+__all__ = [
+    "FlightRecorder", "recorder", "record_event",
+    "Watchdog", "watchdog", "enable", "disable", "enabled",
+    "progress", "register_source", "unregister_source", "pending_scope",
+    "progress_counts", "WATCHDOG_EXIT_CODE",
+    "HBMLedger", "ledger", "hbm_set", "hbm_release", "reconcile",
+    "reraise_if_oom",
+    "record_lost", "goodput_snapshot", "reset_goodput",
+    "dump_postmortem", "maybe_postmortem", "install_handlers",
+    "thread_stacks", "capture_trace", "handle_debug",
+]
+
+# 128 + SIGABRT: the typed watchdog death. tools/launch.py --respawn
+# recognizes it and logs the restart as a watchdog abort.
+WATCHDOG_EXIT_CODE = 134
+
+
+def _config():
+    from . import config
+
+    return config
+
+
+def _telemetry():
+    from . import telemetry
+
+    return telemetry
+
+
+# --------------------------------------------------------------------------
+# flight recorder
+# --------------------------------------------------------------------------
+class FlightRecorder:
+    """Bounded ring of structured events (newest last). Appends are a
+    deque push under one lock — cheap enough to ride every telemetry
+    event including the per-step spans."""
+
+    def __init__(self, size=None):
+        if size is None:
+            size = int(_config().get("MXT_FLIGHT_RECORDER_SIZE"))
+        if size < 1:
+            raise MXNetError("flight recorder needs at least one slot")
+        self.size = size
+        self._lock = threading.Lock()
+        self._ring = collections.deque(maxlen=size)
+        self.recorded = 0  # total ever recorded (ring may have dropped)
+
+    def record(self, kind, **fields):
+        row = {"ts": round(time.time(), 6), "kind": str(kind)}
+        row.update(fields)
+        self.record_row(row)
+        return row
+
+    def record_row(self, row):
+        """Append one pre-built event row (the telemetry tap's entry)."""
+        with self._lock:
+            self._ring.append(row)
+            self.recorded += 1
+
+    def events(self, last=None):
+        """The ring contents, oldest first (``last`` trims to the tail)."""
+        with self._lock:
+            out = list(self._ring)
+        return out if last is None else out[-int(last):]
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._ring)
+
+
+_state_lock = threading.Lock()
+_recorder = None
+_tap_installed = False
+
+
+def recorder():
+    """The process flight recorder (created + tapped into telemetry on
+    first use; ``mxnet_tpu`` imports this module so it is always live)."""
+    global _recorder, _tap_installed
+    if _recorder is None:
+        with _state_lock:
+            if _recorder is None:
+                _recorder = FlightRecorder()
+    if not _tap_installed:
+        with _state_lock:
+            if not _tap_installed:
+                _telemetry().add_event_tap(_event_tap)
+                _tap_installed = True
+    return _recorder
+
+
+def record_event(kind, **fields):
+    """One structured flight-recorder event (also forwarded to the
+    telemetry JSONL sink when one is active)."""
+    _telemetry().emit_event(kind, **fields)  # the tap lands it in the ring
+
+
+def _event_tap(row):
+    """telemetry.emit_event tap: every event row — spans, RPC spans,
+    membership/reshard/checkpoint events — lands in the ring; a few
+    kinds also feed the goodput ledger."""
+    rec = _recorder
+    if rec is not None:
+        rec.record_row(row)
+    kind = row.get("kind")
+    if kind == "span" and row.get("name") == "data_wait":
+        _add_lost("data_wait", row.get("seconds") or 0.0)
+    elif kind == "compile":
+        _add_lost("compile", row.get("seconds") or 0.0)
+
+
+# --------------------------------------------------------------------------
+# progress sources (what the watchdog observes)
+# --------------------------------------------------------------------------
+_progress = {}        # source -> monotone host counter
+_pending_fns = {}     # source -> callable() -> outstanding work (or None)
+_pending_counts = collections.defaultdict(int)  # pending_scope bookkeeping
+
+
+def progress(name):
+    """Bump a source's progress heartbeat. Called from hot paths (engine
+    retires, RPC completions, decode ticks) — one dict write, no lock:
+    a racy lost increment still moves the counter, which is all the
+    watchdog compares."""
+    _progress[name] = _progress.get(name, 0) + 1
+
+
+def register_source(name, pending_fn=None):
+    """Declare a watchdog-observed source. ``pending_fn`` returns how
+    much work is outstanding (0/None = idle, never stalled); it must be
+    pure host bookkeeping — the watchdog calls it off-thread."""
+    _progress.setdefault(name, 0)
+    _pending_fns[name] = pending_fn
+
+
+def unregister_source(name):
+    _pending_fns.pop(name, None)
+    _progress.pop(name, None)
+
+
+@contextlib.contextmanager
+def pending_scope(name):
+    """Mark one unit of outstanding work for ``name`` (auto-registers
+    the source over the scope counter): a blocked RPC inside the scope
+    shows pending > 0 with a frozen counter — exactly a stall."""
+    if name not in _pending_fns:
+        register_source(
+            name, pending_fn=lambda n=name: _pending_counts[n])
+    _pending_counts[name] += 1
+    try:
+        yield
+    finally:
+        _pending_counts[name] -= 1
+
+
+def progress_counts():
+    """{source: (counter, pending)} — the watchdog's whole world view
+    (also what post-mortems snapshot)."""
+    out = {}
+    for name, fn in list(_pending_fns.items()):
+        try:
+            pend = fn() if fn is not None else None
+        except Exception:  # noqa: BLE001 — a dying source must not lie
+            pend = None
+        out[name] = (_progress.get(name, 0), pend)
+    return out
+
+
+# --------------------------------------------------------------------------
+# hang watchdog
+# --------------------------------------------------------------------------
+def thread_stacks():
+    """{thread name (id): [stack lines]} for every live Python thread —
+    the stall report's core payload."""
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    return {
+        "%s (%d)" % (names.get(ident, "?"), ident):
+            [ln.rstrip("\n") for ln in traceback.format_stack(frame)]
+        for ident, frame in frames.items()}
+
+
+def _window_states():
+    from . import engine
+
+    try:
+        return engine.window_states()
+    except Exception:  # noqa: BLE001 — diagnostics never takes a process down
+        return []
+
+
+class Watchdog:
+    """Daemon-thread hang detector over the progress sources.
+
+    A source stalls when it has outstanding work (``pending_fn() > 0``)
+    and its progress counter has not moved for ``timeout`` seconds. The
+    check reads host counters only — by construction it can never add a
+    device sync, and a wedged device shows up as frozen *retire*
+    counters with a non-empty window. ``clock`` is injectable and
+    :meth:`check` takes an explicit ``now`` so tests drive stall
+    detection with a fake clock, zero sleeps."""
+
+    def __init__(self, timeout=None, action=None, interval=None,
+                 clock=time.monotonic, dump=True):
+        cfg = _config()
+        if timeout is None:
+            timeout = cfg.get("MXT_WATCHDOG_TIMEOUT")
+        if timeout is None or float(timeout) <= 0:  # sync-ok: host config scalar
+            raise MXNetError(
+                "Watchdog needs a positive timeout (pass one or set "
+                "MXT_WATCHDOG_TIMEOUT)")
+        self.timeout = float(timeout)  # sync-ok: host config scalar
+        self.action = str(action or cfg.get("MXT_WATCHDOG_ACTION")).lower()
+        if self.action not in ("report", "abort"):
+            raise MXNetError("MXT_WATCHDOG_ACTION must be 'report' or "
+                             "'abort', got %r" % self.action)
+        if interval is None:
+            interval = cfg.get("MXT_WATCHDOG_INTERVAL")
+        if interval is None:
+            interval = max(0.05, self.timeout / 4.0)
+        self.interval = float(interval)  # sync-ok: host config scalar
+        self._clock = clock
+        self._dump = dump
+        self._seen = {}       # source -> (count, ts of last movement)
+        self._reported = {}   # source -> ts of last stall report
+        self._stall_accounted = set()  # sources already in the goodput ledger
+        self._thread = None
+        self._stop = threading.Event()
+        self.stall_reports = []  # report dicts, newest last (tests read)
+
+    # -- detection --------------------------------------------------------
+    def check(self, now=None):
+        """One watchdog pass; returns the sources found stalled (and
+        reports each at most once per timeout window)."""
+        now = self._clock() if now is None else now
+        if _trace_lock.locked():
+            # a profiler capture is a KNOWN global pause (tracing +
+            # serialization stall every loop): re-arm instead of
+            # reporting — in abort mode a stall here would kill a
+            # healthy replica for being profiled
+            for name, (count, _) in progress_counts().items():
+                self._seen[name] = (count, now)
+            return []
+        stalled = []
+        for name, (count, pend) in progress_counts().items():
+            seen = self._seen.get(name)
+            if seen is None or seen[0] != count:
+                self._seen[name] = (count, now)
+                continue
+            if not pend:  # idle (or unknown-idle): nothing owed, re-arm
+                self._seen[name] = (count, now)
+                continue
+            stalled_for = now - seen[1]
+            if stalled_for < self.timeout:
+                continue
+            stalled.append(name)
+            last = self._reported.get(name)
+            if last is None or now - last >= self.timeout:
+                self._reported[name] = now
+                self._report(name, stalled_for, count, pend, now)
+        return stalled
+
+    def _report(self, source, stalled_for, count, pend, now):
+        report = {
+            "source": source, "stalled_for_s": round(stalled_for, 3),
+            "progress_count": count, "pending": pend,
+            "action": self.action,
+            "threads": thread_stacks(),
+            "windows": _window_states(),
+            "flight_recorder_tail": recorder().events(last=64),
+        }
+        self.stall_reports.append(report)
+        tel = _telemetry()
+        tel.counter(
+            "mxt_watchdog_stalls_total",
+            "Hang-watchdog stall reports by progress source.",
+            ("source",)).labels(source).inc()
+        # first report charges the whole stall so far; repeat reports
+        # charge only the window since the last one (no double count)
+        record_lost("stall", stalled_for
+                    if source not in self._stall_accounted
+                    else self.timeout)
+        self._stall_accounted.add(source)
+        record_event("watchdog_stall", source=source,
+                     stalled_for_s=round(stalled_for, 3),
+                     pending=pend, action=self.action)
+        sys.stderr.write(
+            "\n=== mxt watchdog: source %r made no progress for %.1fs "
+            "(pending=%s, action=%s) ===\n%s\n"
+            % (source, stalled_for, pend, self.action,
+               "\n".join("--- %s ---\n%s" % (t, "\n".join(stack))
+                         for t, stack in report["threads"].items())))
+        sys.stderr.flush()
+        path = None
+        if self._dump:
+            try:
+                path = dump_postmortem(reason="watchdog:%s" % source,
+                                       extra={"stall": {
+                                           k: v for k, v in report.items()
+                                           if k != "threads"}})
+                sys.stderr.write("mxt watchdog: post-mortem -> %s\n" % path)
+                sys.stderr.flush()
+            except Exception:  # noqa: BLE001 — report even if the dump fails
+                pass
+        if self.action == "abort":
+            sys.stderr.write(
+                "mxt watchdog: aborting (exit %d) so the launcher/"
+                "membership reaper can respawn this worker\n"
+                % WATCHDOG_EXIT_CODE)
+            sys.stderr.flush()
+            os._exit(WATCHDOG_EXIT_CODE)
+        return report
+
+    # -- the daemon thread ------------------------------------------------
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="mxt-watchdog")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.check()
+            except Exception:  # noqa: BLE001 — the watchdog must survive
+                pass
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+_watchdog = None
+
+
+def watchdog():
+    """The running process watchdog, or None."""
+    return _watchdog
+
+
+def enable(timeout=None, action=None, interval=None, handlers=True):
+    """Arm the diagnostics layer: flight recorder tap, post-mortem
+    handlers (signals + excepthook), and — when a timeout is available —
+    the watchdog daemon thread. Returns the watchdog (or None when no
+    timeout is configured; recorder + handlers still arm)."""
+    global _watchdog, _armed
+    recorder()
+    _armed = True
+    if handlers:
+        install_handlers()
+    if _watchdog is None:
+        try:
+            _watchdog = Watchdog(timeout=timeout, action=action,
+                                 interval=interval)
+        except MXNetError:
+            if timeout is not None:
+                raise
+            return None  # no MXT_WATCHDOG_TIMEOUT: recorder-only mode
+        _watchdog.start()
+    return _watchdog
+
+
+def disable():
+    """Disarm: stop the watchdog and detach the telemetry tap (the
+    bench A/B's 'off' leg; handlers stay — uninstalling signal handlers
+    mid-run is riskier than keeping them)."""
+    global _watchdog, _tap_installed, _armed
+    _armed = False
+    if _watchdog is not None:
+        _watchdog.stop()
+        _watchdog = None
+    if _tap_installed:
+        _telemetry().remove_event_tap(_event_tap)
+        _tap_installed = False
+
+
+def enabled():
+    return _armed
+
+
+_armed = False
+
+
+# --------------------------------------------------------------------------
+# HBM ledger
+# --------------------------------------------------------------------------
+class HBMLedger:
+    """Per-pool device-byte accounting. Allocation sites call
+    :meth:`set`/:meth:`release` with byte counts they compute from shape
+    metadata (``.nbytes`` — never a device read); totals and peak
+    watermarks export as ``mxt_hbm_bytes{pool}`` /
+    ``mxt_hbm_peak_bytes{pool}``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pools = {}   # pool -> {key: nbytes}
+        self._peaks = {}   # pool -> peak total bytes
+        self._bytes_g = None
+        self._peak_g = None
+
+    def _gauges(self):
+        if self._bytes_g is None:
+            tel = _telemetry()
+            self._bytes_g = tel.gauge(
+                "mxt_hbm_bytes",
+                "Device bytes accounted per subsystem pool (params, "
+                "optimizer, kv_cache, inflight_window, prefetch).",
+                ("pool",))
+            self._peak_g = tel.gauge(
+                "mxt_hbm_peak_bytes",
+                "Peak watermark of mxt_hbm_bytes per pool.", ("pool",))
+        return self._bytes_g, self._peak_g
+
+    def set(self, pool, key, nbytes):
+        """Install/replace one named allocation in a pool (idempotent —
+        re-registering a site replaces its old size)."""
+        pool, key = str(pool), str(key)
+        with self._lock:
+            entries = self._pools.setdefault(pool, {})
+            entries[key] = int(nbytes)
+            total = sum(entries.values())
+            peak = max(self._peaks.get(pool, 0), total)
+            self._peaks[pool] = peak
+        bg, pg = self._gauges()
+        bg.labels(pool).set(total)
+        pg.labels(pool).set(peak)
+        return total
+
+    def release(self, pool, key):
+        """Drop one named allocation; returns the bytes released."""
+        pool, key = str(pool), str(key)
+        with self._lock:
+            entries = self._pools.get(pool)
+            if not entries:
+                return 0
+            freed = entries.pop(key, 0)
+            total = sum(entries.values())
+        bg, _ = self._gauges()
+        bg.labels(pool).set(total)
+        return freed
+
+    def pool_bytes(self, pool):
+        with self._lock:
+            return sum(self._pools.get(str(pool), {}).values())
+
+    def total_bytes(self):
+        with self._lock:
+            return sum(sum(e.values()) for e in self._pools.values())
+
+    def snapshot(self):
+        """{pool: {bytes, peak_bytes, entries}} — the post-mortem and
+        /debug/memory payload."""
+        with self._lock:
+            return {
+                pool: {"bytes": sum(entries.values()),
+                       "peak_bytes": self._peaks.get(pool, 0),
+                       "entries": dict(entries)}
+                for pool, entries in sorted(self._pools.items())}
+
+    def reconcile(self, tolerance=0.25):
+        """Ledger total vs the backend's view. Where the device reports
+        ``memory_stats()`` (TPU/GPU), ``delta_bytes`` is device minus
+        ledger and ``within_tolerance`` flags drift beyond
+        ``tolerance`` × device bytes (unaccounted allocations — a pool
+        someone forgot to register). CPU backends report no stats;
+        reconciliation then degrades to ledger-only (``delta_bytes``
+        None, trivially within tolerance)."""
+        ledger_total = self.total_bytes()
+        device_bytes = None
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+            if stats:
+                device_bytes = int(stats.get("bytes_in_use", 0)) or None
+        except Exception:  # noqa: BLE001 — reconciliation is best-effort
+            device_bytes = None
+        out = {"ledger_bytes": ledger_total,
+               "device_bytes_in_use": device_bytes,
+               "delta_bytes": None, "within_tolerance": True}
+        if device_bytes:
+            out["delta_bytes"] = device_bytes - ledger_total
+            out["within_tolerance"] = \
+                abs(out["delta_bytes"]) <= tolerance * device_bytes
+        return out
+
+
+_ledger = None
+
+
+def ledger():
+    global _ledger
+    if _ledger is None:
+        with _state_lock:
+            if _ledger is None:
+                _ledger = HBMLedger()
+    return _ledger
+
+
+def hbm_set(pool, key, nbytes):
+    return ledger().set(pool, key, nbytes)
+
+
+def hbm_release(pool, key):
+    return ledger().release(pool, key)
+
+
+def reconcile(tolerance=0.25):
+    return ledger().reconcile(tolerance)
+
+
+def _is_oom(exc):
+    msg = str(exc)
+    return "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
+
+
+def reraise_if_oom(exc, site):
+    """Called from the step/decode dispatch ``except`` blocks: when the
+    error is an allocation failure, re-raise it annotated with the HBM
+    ledger snapshot (and leave a post-mortem); any other error returns
+    so the caller re-raises the original."""
+    if not _is_oom(exc):
+        return
+    from . import engine
+
+    try:
+        # the hot path is already dead; drain the window so the ledger
+        # and in-flight state in the report describe a settled process
+        engine.wait_all()  # sync-ok: OOM post-mortem drain (cold path)
+    except Exception:  # noqa: BLE001 — the original OOM must still surface
+        pass
+    snap = ledger().snapshot()
+    recon = reconcile()
+    record_event("oom", site=str(site), error=str(exc)[:500],
+                 hbm={p: v["bytes"] for p, v in snap.items()})
+    path = None
+    if _armed:
+        try:
+            path = dump_postmortem(reason="oom:%s" % site)
+        except Exception:  # noqa: BLE001
+            pass
+    pools = ", ".join("%s=%d (peak %d)"
+                      % (p, v["bytes"], v["peak_bytes"])
+                      for p, v in snap.items()) or "<no pools registered>"
+    raise MXNetError(
+        "allocation failure at %s: %s\nHBM ledger: %s\n"
+        "device bytes_in_use: %s%s"
+        % (site, exc, pools, recon["device_bytes_in_use"],
+           "\npost-mortem: %s" % path if path else "")) from exc
+
+
+# --------------------------------------------------------------------------
+# goodput ledger
+# --------------------------------------------------------------------------
+_goodput_lock = threading.Lock()
+_lost = collections.defaultdict(float)  # cause -> seconds
+_goodput_start = time.monotonic()
+_lost_counter = None
+_ratio_gauge = None
+
+
+def _add_lost(cause, seconds):
+    global _lost_counter
+    seconds = float(seconds)  # sync-ok: host wall-clock scalar
+    if seconds <= 0:
+        return
+    with _goodput_lock:
+        _lost[str(cause)] += seconds
+    if _lost_counter is None:
+        _lost_counter = _telemetry().counter(
+            "mxt_lost_seconds_total",
+            "Wall-clock lost to non-productive causes (compile, "
+            "checkpoint, reshard, stall, data_wait).", ("cause",))
+    _lost_counter.labels(str(cause)).inc(seconds)
+
+
+def record_lost(cause, seconds):
+    """Account ``seconds`` of lost wall-clock to ``cause`` and refresh
+    ``mxt_goodput_ratio``."""
+    _add_lost(cause, seconds)
+    goodput_snapshot()
+
+
+def reset_goodput(start=None):
+    """Zero the ledger (tests; a new epoch of accounting). ``start``
+    overrides the productive-time epoch for fake-clock arithmetic."""
+    global _goodput_start
+    with _goodput_lock:
+        _lost.clear()
+        _goodput_start = time.monotonic() if start is None \
+            else float(start)  # sync-ok: host clock scalar
+
+
+def goodput_snapshot(now=None):
+    """{elapsed_s, lost_s, lost_by_cause, goodput_ratio} — elapsed since
+    the accounting epoch, lost summed by cause, ratio = productive /
+    elapsed. Also publishes the ``mxt_goodput_ratio`` gauge."""
+    global _ratio_gauge
+    now = time.monotonic() if now is None else float(now)  # sync-ok: host clock
+    with _goodput_lock:
+        lost_by = dict(_lost)
+        elapsed = max(0.0, now - _goodput_start)
+    lost = sum(lost_by.values())
+    ratio = 1.0 if elapsed <= 0 else max(0.0, (elapsed - lost) / elapsed)
+    if _ratio_gauge is None:
+        _ratio_gauge = _telemetry().gauge(
+            "mxt_goodput_ratio",
+            "Productive fraction of wall-clock since the accounting "
+            "epoch (1 - lost/elapsed).")
+    _ratio_gauge.set(round(ratio, 6))
+    return {"elapsed_s": elapsed, "lost_s": lost,
+            "lost_by_cause": lost_by, "goodput_ratio": ratio}
+
+
+# --------------------------------------------------------------------------
+# post-mortem
+# --------------------------------------------------------------------------
+def _config_snapshot():
+    cfg = _config()
+    out = {}
+    for name in sorted(cfg.variables()):
+        try:
+            out[name] = cfg.get(name)
+        except Exception:  # noqa: BLE001
+            out[name] = "<unreadable>"
+    return out
+
+
+def dump_postmortem(reason="on_demand", extra=None, directory=None):
+    """Write ``mxt-postmortem-<ts>.json`` (ring tail + thread stacks +
+    window state + HBM ledger + goodput + config + metrics snapshot)
+    into ``MXT_POSTMORTEM_DIR``; returns the path."""
+    directory = directory or _config().get("MXT_POSTMORTEM_DIR") or "."
+    os.makedirs(directory, exist_ok=True)
+    ts = time.time()
+    doc = {
+        "reason": str(reason),
+        "ts": round(ts, 6),
+        "pid": os.getpid(),
+        "argv": list(sys.argv),
+        "events": recorder().events(),
+        "threads": thread_stacks(),
+        "windows": _window_states(),
+        "hbm": ledger().snapshot(),
+        "hbm_reconcile": reconcile(),
+        "goodput": goodput_snapshot(),
+        "progress_sources": {k: {"count": c, "pending": p}
+                             for k, (c, p) in progress_counts().items()},
+        "config": _config_snapshot(),
+    }
+    try:
+        doc["metrics"] = _telemetry().registry().snapshot_values()
+    except Exception:  # noqa: BLE001 — a torn registry must not stop the dump
+        doc["metrics"] = {}
+    if extra is not None:
+        doc["extra"] = extra
+    name = "mxt-postmortem-%s-%d.json" % (
+        time.strftime("%Y%m%d-%H%M%S", time.localtime(ts)),
+        int((ts % 1) * 1e6))
+    path = os.path.join(directory, name)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True, default=str)
+        f.flush()
+    os.replace(tmp, path)
+    tel = _telemetry()
+    tel.counter(
+        "mxt_postmortems_total",
+        "Post-mortem dumps by trigger.",
+        ("trigger",)).labels(str(reason).split(":", 1)[0]).inc()
+    return path
+
+
+def maybe_postmortem(reason, extra=None):
+    """Post-mortem only when the diagnostics layer is armed (so a bare
+    library user's exception doesn't litter files); returns the path or
+    None."""
+    if not _armed:
+        return None
+    try:
+        return dump_postmortem(reason=reason, extra=extra)
+    except Exception:  # noqa: BLE001 — diagnostics never masks the real error
+        return None
+
+
+_handlers_installed = False
+_prev_excepthook = None
+
+
+def install_handlers():
+    """Fatal-path capture: ``faulthandler`` for hard crashes, Python
+    handlers for SIGTERM/SIGABRT (dump, then die with the conventional
+    code), and a ``sys.excepthook`` wrapper for unhandled exceptions.
+    Idempotent; main-thread only for the signal half."""
+    global _handlers_installed, _prev_excepthook
+    if _handlers_installed:
+        return
+    _handlers_installed = True
+    import faulthandler
+    import signal
+
+    try:
+        faulthandler.enable()
+    except Exception:  # noqa: BLE001 — stderr may be closed under a harness
+        pass
+
+    def _sig_handler(signum, frame):
+        del frame
+        try:
+            dump_postmortem(reason="signal:%d" % signum)
+        except Exception:  # noqa: BLE001
+            pass
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+    if threading.current_thread() is threading.main_thread():
+        for sig in (signal.SIGTERM, signal.SIGABRT):
+            try:
+                signal.signal(sig, _sig_handler)
+            except (ValueError, OSError):
+                pass
+
+    prev = sys.excepthook
+
+    def _excepthook(etype, value, tb):
+        try:
+            dump_postmortem(reason="unhandled:%s" % etype.__name__)
+        except Exception:  # noqa: BLE001
+            pass
+        prev(etype, value, tb)
+
+    _prev_excepthook = prev
+    sys.excepthook = _excepthook
+
+
+# --------------------------------------------------------------------------
+# on-demand profiler capture
+# --------------------------------------------------------------------------
+_trace_lock = threading.Lock()
+
+
+def capture_trace(ms=500, logdir=None):
+    """Programmatic ``jax.profiler`` capture: trace for ``ms``
+    milliseconds, then return ``(archive_path, archive_bytes)`` of the
+    zipped trace directory — what ``/debug/trace?ms=N`` serves, so the
+    TPU runbook can pull fusion/time attribution off a live replica.
+
+    The whole capture (trace + serialization, which on a busy CPU
+    fused loop can dwarf the window — keep ``ms`` small there) is
+    accounted as ``profile`` lost time, and the watchdog suspends
+    stall checks while it runs."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    if not _trace_lock.acquire(blocking=False):
+        raise MXNetError("a profiler capture is already in progress")
+    try:
+        # bound the window: tracing a busy jit loop emits events FAST
+        # (a 100 ms capture of the CPU fused-step loop is ~10s of MB)
+        ms = min(max(0.0, float(ms)), 60_000.0)  # sync-ok: host scalar
+        _t0 = time.monotonic()
+        workdir = logdir or tempfile.mkdtemp(prefix="mxt-trace-")
+        jax.profiler.start_trace(workdir)
+        try:
+            time.sleep(ms / 1e3)  # sync-ok: requested capture window
+        finally:
+            jax.profiler.stop_trace()
+        archive = shutil.make_archive(workdir, "zip", workdir)
+        with open(archive, "rb") as f:
+            data = f.read()
+        if logdir is None:
+            # transient capture: nothing may linger in the tempdir —
+            # the archive BYTES are the product
+            shutil.rmtree(workdir, ignore_errors=True)
+            try:
+                os.remove(archive)
+            except OSError:
+                pass
+        record_event("profiler_capture", ms=ms,
+                     archive_bytes=len(data))
+        record_lost("profile", time.monotonic() - _t0)
+        return archive, data
+    finally:
+        _trace_lock.release()
+
+
+# --------------------------------------------------------------------------
+# /debug/* routes (dispatched by telemetry's HTTP endpoint)
+# --------------------------------------------------------------------------
+def handle_debug(path, query=""):
+    """(status, content_type, body_bytes) for one /debug/* request."""
+    from urllib.parse import parse_qs
+
+    params = {k: v[-1] for k, v in parse_qs(query).items()}
+    if path == "/debug/stacks":
+        body = "\n".join(
+            "--- %s ---\n%s" % (name, "\n".join(stack))
+            for name, stack in sorted(thread_stacks().items()))
+        return 200, "text/plain; charset=utf-8", body.encode("utf-8")
+    if path == "/debug/memory":
+        doc = {"hbm": ledger().snapshot(), "reconcile": reconcile(),
+               "goodput": goodput_snapshot()}
+        return (200, "application/json",
+                json.dumps(doc, indent=1, default=str).encode("utf-8"))
+    if path == "/debug/flightrecorder":
+        doc = {"size": recorder().size, "recorded": recorder().recorded,
+               "events": recorder().events(),
+               "windows": _window_states(),
+               "progress_sources": {
+                   k: {"count": c, "pending": p}
+                   for k, (c, p) in progress_counts().items()}}
+        return (200, "application/json",
+                json.dumps(doc, indent=1, default=str).encode("utf-8"))
+    if path == "/debug/postmortem":
+        try:
+            out = dump_postmortem(reason="debug_route")
+        except Exception as e:  # noqa: BLE001 — report, don't crash the server
+            return (500, "text/plain; charset=utf-8",
+                    ("postmortem failed: %s" % e).encode("utf-8"))
+        return (200, "application/json",
+                json.dumps({"path": out}).encode("utf-8"))
+    if path == "/debug/trace":
+        try:
+            ms = float(params.get("ms", 500))  # sync-ok: query param
+            _, data = capture_trace(ms=ms)
+        except Exception as e:  # noqa: BLE001 — busy/unsupported backends
+            return (503, "text/plain; charset=utf-8",
+                    ("trace capture failed: %s" % e).encode("utf-8"))
+        return 200, "application/zip", data
+    return 404, "text/plain; charset=utf-8", b"unknown debug route"
+
+
+def _maybe_autostart():
+    """Arm the recorder tap at package import; start the watchdog (and
+    the fatal-path handlers) when MXT_WATCHDOG_TIMEOUT is set."""
+    try:
+        recorder()
+        if _config().get("MXT_WATCHDOG_TIMEOUT") is not None:
+            enable()
+    except Exception:  # noqa: BLE001 — observability must never block import
+        pass

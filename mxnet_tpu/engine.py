@@ -49,7 +49,7 @@ import time
 import weakref
 
 __all__ = ["bulk", "set_bulk_size", "max_inflight", "InflightWindow",
-           "StepStream", "wait_all", "inflight_depth"]
+           "StepStream", "wait_all", "inflight_depth", "window_states"]
 
 # flag bits a single snapshot read may cover: the mask is a uint32 riding
 # the fused program, and with snapshots every K pushes plus one token
@@ -119,17 +119,42 @@ def _telemetry():
     return telemetry
 
 
+def _diag():
+    from . import diagnostics
+
+    return diagnostics
+
+
+def window_states():
+    """[{name, dispatched, consumed, pending, staged, held_bytes}] for
+    every live stream — what the hang watchdog's stall report and the
+    post-mortem dump snapshot (pure host bookkeeping)."""
+    with _lock:
+        streams = list(_streams)
+    return [{"name": s.name, "dispatched": s._dispatched,
+             "consumed": s._consumed, "pending": s.pending,
+             "staged": len(s._staged), "held_bytes": s._held_bytes}
+            for s in streams]
+
+
+def _nbytes(v):
+    """Host-side byte count of a device value (shape metadata only —
+    reading ``.nbytes`` never transfers)."""
+    return int(getattr(getattr(v, "data", v), "nbytes", 0) or 0)
+
+
 class _Token:
     """One retirement point in a stream: a deferred host read covering
     every step dispatched since the previous token."""
 
-    __slots__ = ("pv", "has_flags", "upto", "nvalues")
+    __slots__ = ("pv", "has_flags", "upto", "nvalues", "nbytes")
 
-    def __init__(self, pv, has_flags, upto, nvalues=0):
+    def __init__(self, pv, has_flags, upto, nvalues=0, nbytes=0):
         self.pv = pv
         self.has_flags = has_flags
         self.upto = upto
         self.nvalues = nvalues
+        self.nbytes = nbytes
 
 
 class InflightWindow:
@@ -173,8 +198,14 @@ class InflightWindow:
         # extra device reads (it is measured INSIDE the deferred read
         # the engine already performs)
         self._dispatch_ts = collections.deque()
+        # bytes the window itself retains (staged per-step values +
+        # snapshot token sources) — the 'inflight_window' HBM pool
+        self._held_bytes = 0
         with _lock:
             _streams.add(self)
+        # the watchdog observes window retires: pending work with a
+        # frozen retire counter == a wedged device or a dead pipeline
+        _diag().register_source("engine_retire", pending_fn=inflight_depth)
 
     @property
     def pending(self):
@@ -216,17 +247,23 @@ class InflightWindow:
             self._latest = (sync_value, flags)
             if value is not None:
                 self._staged.append(value)
+                self._held_bytes += _nbytes(value)
             k = max_inflight()
             if self._dispatched - self._last_snap >= k:
                 if self._staged:
                     src = self._stack(self._staged)
+                    # staged bytes were counted per push; the token
+                    # inherits them so retirement releases the total
                     tok = _Token(PendingValue(src), False,
-                                 self._dispatched, len(self._staged))
+                                 self._dispatched, len(self._staged),
+                                 nbytes=sum(_nbytes(v)
+                                            for v in self._staged))
                     self._staged = []
                 else:
                     src = flags if flags is not None else sync_value
                     tok = _Token(PendingValue(src), flags is not None,
-                                 self._dispatched)
+                                 self._dispatched, nbytes=_nbytes(src))
+                    self._held_bytes += tok.nbytes
                 self._last_snap = self._dispatched
                 self._window.append(tok)
                 if k == 1:
@@ -235,12 +272,19 @@ class InflightWindow:
                     while len(self._window) > 1:
                         retire.append(self._window.pop(0))
         _telemetry().record_dispatch(self.name, step_no, depth)
+        self._publish_held()
         if retire:
             with self._retire_lock:
                 for tok in retire:
                     self._retire(tok)
         _update_depth_gauge()
         return step_no
+
+    def _publish_held(self):
+        """Export the window's retained bytes as the 'inflight_window'
+        HBM-ledger pool (host arithmetic on shape metadata)."""
+        _diag().hbm_set("inflight_window", self.name,
+                        max(0, self._held_bytes))
 
     def _retire(self, tok):
         """Materialize one token's deferred read and catch host-side
@@ -249,6 +293,13 @@ class InflightWindow:
         if n <= 0:
             return
         value = tok.pv.get()  # blocks until the covered steps finished
+        with _lock:
+            self._held_bytes -= tok.nbytes
+        # retires are the engine's watchdog heartbeat: a frozen counter
+        # with a non-empty window means the device stopped answering
+        diag = _diag()
+        diag.progress("engine_retire")
+        self._publish_held()
         # dispatch->retire latency per covered step, clocked off the
         # read that just happened (telemetry adds NO host sync here)
         now = time.perf_counter()
@@ -285,8 +336,12 @@ class InflightWindow:
             if self._consumed < upto and latest is not None:
                 sync_value, flags = latest
                 if staged:
+                    # staged bytes entered the ledger at push time; the
+                    # synthesized token carries them out at retirement
                     self._retire(_Token(PendingValue(self._stack(staged)),
-                                        False, upto, len(staged)))
+                                        False, upto, len(staged),
+                                        nbytes=sum(_nbytes(v)
+                                                   for v in staged)))
                 else:
                     src = flags if flags is not None else sync_value
                     self._retire(_Token(PendingValue(src),

@@ -67,13 +67,33 @@ class _DevicePrefetcher:
     """Double-buffer: keep ``depth`` batches materialized ahead of the
     consumer, issuing each one's ``device_put`` as soon as it is pulled —
     so batch N+1's host→device transfer overlaps the step running on
-    batch N. Order-preserving; purely a scheduling wrapper."""
+    batch N. Order-preserving; purely a scheduling wrapper. The buffered
+    batches' bytes register in the diagnostics HBM ledger ('prefetch'
+    pool — shape metadata, never a device read)."""
 
     def __init__(self, it, depth=2, to_device=True):
         self._it = iter(it)
         self._depth = max(1, depth)
         self._to_device = to_device
         self._buf = collections.deque()
+        self._key = "prefetcher-%x" % id(self)
+
+    @staticmethod
+    def _batch_nbytes(batch):
+        if isinstance(batch, (list, tuple)):
+            return sum(_DevicePrefetcher._batch_nbytes(b) for b in batch)
+        if isinstance(batch, dict):
+            return sum(_DevicePrefetcher._batch_nbytes(b)
+                       for b in batch.values())
+        return int(getattr(getattr(batch, "data", batch), "nbytes", 0)
+                   or 0)
+
+    def _publish(self):
+        from ... import diagnostics
+
+        diagnostics.hbm_set(
+            "prefetch", self._key,
+            sum(self._batch_nbytes(b) for b in self._buf))
 
     def _pull(self):
         if self._it is None:
@@ -88,12 +108,19 @@ class _DevicePrefetcher:
         self._buf.append(batch)
 
     def __iter__(self):
-        while len(self._buf) < self._depth and self._it is not None:
-            self._pull()
-        while self._buf:
-            batch = self._buf.popleft()
-            self._pull()  # refill BEFORE yielding: next H2D is in flight
-            yield batch
+        from ... import diagnostics
+
+        try:
+            while len(self._buf) < self._depth and self._it is not None:
+                self._pull()
+            self._publish()
+            while self._buf:
+                batch = self._buf.popleft()
+                self._pull()  # refill BEFORE yielding: next H2D in flight
+                self._publish()
+                yield batch
+        finally:
+            diagnostics.hbm_release("prefetch", self._key)
 
 
 def _np_batchify(data):

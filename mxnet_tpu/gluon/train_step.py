@@ -132,6 +132,7 @@ class CachedTrainStep:
         self._mask_dev = None    # device-carried flag bitmask (guard mode)
         self._hyper_cache = None  # (lr, wd, float(lr), float(wd))
         self._sig_recorded = False  # (x, y) signature saved for warmup
+        self._hbm_published = False  # params/opt bytes in the HBM ledger
 
     # -- introspection ---------------------------------------------------
     @property
@@ -479,6 +480,27 @@ class CachedTrainStep:
             count += 1
         return count
 
+    def _publish_hbm(self, updater):
+        """Register this step's device working set in the diagnostics
+        HBM ledger (once; host arithmetic on shape metadata only): the
+        params pool (trainable + aux) and the optimizer-state pool."""
+        if self._hbm_published:
+            return
+        self._hbm_published = True
+        try:
+            from .. import diagnostics
+
+            params = sum(self._all_params[n].data().data.nbytes
+                         for n in self._all_params)
+            opt = sum(l.data.nbytes
+                      for i in self._indices
+                      for l in _FusedUpdate._leaves(updater.states[i]))
+            key = self._sig_entry()
+            diagnostics.hbm_set("params", key, params)
+            diagnostics.hbm_set("optimizer", key, opt)
+        except Exception:  # noqa: BLE001 — accounting must not fail a step
+            pass
+
     def _fused_step(self, x, y, batch_size):
         """One fused launch, dispatched asynchronously. Returns None if
         host-side invariants don't hold this step (caller falls back to
@@ -493,6 +515,7 @@ class CachedTrainStep:
                 updater.states[i] = o.create_state_multi_precision(
                     i, self._all_params[n].data())
                 updater.states_synced[i] = True
+        self._publish_hbm(updater)
         # the fused program uses ONE step count for every parameter; if a
         # prior eager/kvstore path left counts uneven, stay eager
         counts = {o._index_update_count.get(i, o.begin_num_update)
@@ -549,15 +572,21 @@ class CachedTrainStep:
             # drawn lazily so mx.random.seed() between construction and
             # the first step still takes effect
             self._base_key = _random.new_key()
-        if self._guard:
-            (loss_vec, new_w, new_s, new_aux, outs, t_new,
-             mask_new) = self._jit(
-                ws, ss, aux, x.data, y.data, self._base_key, t_in,
-                mask_in, lr, wd, rescale)
-        else:
-            loss_vec, new_w, new_s, new_aux, outs = self._jit(
-                ws, ss, aux, x.data, y.data, self._base_key, t_in, lr,
-                wd, rescale)
+        try:
+            if self._guard:
+                (loss_vec, new_w, new_s, new_aux, outs, t_new,
+                 mask_new) = self._jit(
+                    ws, ss, aux, x.data, y.data, self._base_key, t_in,
+                    mask_in, lr, wd, rescale)
+            else:
+                loss_vec, new_w, new_s, new_aux, outs = self._jit(
+                    ws, ss, aux, x.data, y.data, self._base_key, t_in, lr,
+                    wd, rescale)
+        except Exception as e:  # noqa: BLE001 — OOM gets the HBM ledger
+            from .. import diagnostics
+
+            diagnostics.reraise_if_oom(e, "fused_step")
+            raise
         _count_launch()
         # rebind unconditionally: donation consumed the input buffers, and
         # on a skipped step the outputs ARE the (identity) old values

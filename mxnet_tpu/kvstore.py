@@ -199,6 +199,13 @@ class KVStore:
         (set_optimizer is only shipped once at store creation)."""
         if self._member is None:
             return
+        from . import diagnostics
+
+        # server restarts are prime post-mortem material: the flight
+        # recorder shows the resync in the run-up to any later incident
+        diagnostics.record_event(
+            "kv_server_restart_resync", worker=self._member.worker_id,
+            shadow_keys=len(self._shadow))
         self._member.re_register()
         client.set_credentials(self._member.worker_id,
                                self._member.generation)

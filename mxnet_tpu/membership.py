@@ -480,6 +480,7 @@ class WorkerMembership:
         self._stop = threading.Event()
         self._thread = None
         self._beats = 0
+        self._beat_source = "membership_beat_w%d" % self.worker_id
 
     def _rendezvous_client(self):
         if self._rdv is None:
@@ -527,6 +528,18 @@ class WorkerMembership:
         if self._thread is not None and self._thread.is_alive():
             return self
         self._stop.clear()
+        # the hang watchdog observes the beat loop: a worker_freeze
+        # zombie (beats silently stop, process lives) shows as pending=1
+        # with a frozen counter — the exact silent hang this source
+        # exists to type. A fenced worker stops beating DELIBERATELY
+        # (typed, observable via StaleWorkerError), so it reads idle.
+        from . import diagnostics
+
+        self._beat_source = "membership_beat_w%d" % self.worker_id
+        diagnostics.register_source(
+            self._beat_source,
+            pending_fn=lambda: 0 if (self._stop.is_set() or self.fenced)
+            else 1)
         self._thread = threading.Thread(
             target=self._beat_loop, daemon=True,
             name="kv-heartbeat-w%d" % self.worker_id)
@@ -539,9 +552,10 @@ class WorkerMembership:
         return float(config.get("MXT_HEARTBEAT_INTERVAL"))
 
     def _beat_loop(self):
-        from . import resilience
+        from . import diagnostics, resilience
 
         while not self._stop.wait(self._interval()):
+            diagnostics.progress(self._beat_source)
             inj = resilience.fault_point()
             frz = inj.rule("worker_freeze")
             if frz is not None \
@@ -632,6 +646,10 @@ class WorkerMembership:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+        if getattr(self, "_beat_source", None) is not None:
+            from . import diagnostics
+
+            diagnostics.unregister_source(self._beat_source)
         if deregister and self.generation is not None and not self.fenced:
             try:
                 self._ctl.request(

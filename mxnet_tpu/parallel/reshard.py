@@ -189,6 +189,11 @@ def reshard_step(step, new_mesh, spill_dir=None, warm=True):
         "mxt_reshard_seconds",
         "Drain + spill + rebind + restore (+ AOT warm) duration of one "
         "elastic reshard.").observe(dt)
+    from .. import diagnostics
+
+    # the whole reshard is lost wall-clock in the goodput ledger (the
+    # event row lands in the flight recorder via the emit_event tap)
+    diagnostics.record_lost("reshard", dt)
     event = {
         "old_shape": old_shape,
         "new_shape": {str(k): int(v) for k, v in new_mesh.shape.items()},

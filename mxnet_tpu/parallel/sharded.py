@@ -621,6 +621,13 @@ class ShardedTrainStep:
             "mxt_per_device_opt_bytes",
             "Optimizer-state bytes held by ONE device (shrinks ~dp× "
             "under ZeRO-1/2/3).").set(b["opt_state_bytes"])
+        from .. import diagnostics
+
+        # the HBM ledger tracks ONE device's working set (that is what
+        # an OOM post-mortem must explain); reshards re-publish
+        diagnostics.hbm_set("params", "sharded_step", b["param_bytes"])
+        diagnostics.hbm_set("optimizer", "sharded_step",
+                            b["opt_state_bytes"])
 
     # ------------------------------------------------------------------
     # checkpoint protocol (CheckpointManager's `trainer` slot) + reshard

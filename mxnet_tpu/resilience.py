@@ -236,6 +236,19 @@ class RetryPolicy:
         return d * (1.0 + self.jitter * _pyrandom.random())
 
 
+def _record_kv_death(op, key, why, exc):
+    """Flight-recorder event for a permanently failed kvstore op — the
+    post-mortem names the RPC that killed the run."""
+    try:
+        from . import diagnostics
+
+        diagnostics.record_event("kv_retry_exhausted", op=str(op),
+                                 key=str(key), why=why,
+                                 error=str(exc)[:200])
+    except Exception:  # noqa: BLE001 — diagnostics never masks the error
+        pass
+
+
 def kv_retry(op, key, fn, reconnect=None, policy=None):
     """Run kvstore op ``fn`` under the retry policy with fault injection.
 
@@ -266,11 +279,13 @@ def kv_retry(op, key, fn, reconnect=None, policy=None):
                 "failures riding the backoff policy).",
                 ("op",)).labels(str(op)).inc()
             if attempt > policy.retries:
+                _record_kv_death(op, key, "retries_exhausted", e)
                 raise KVStoreError(
                     "kvstore %s(%r) failed after %d retries: %s"
                     % (op, key, policy.retries, e)) from e
             d = policy.delay(attempt)
             if time.monotonic() + d > deadline_ts:
+                _record_kv_death(op, key, "deadline", e)
                 raise KVStoreError(
                     "kvstore %s(%r) exceeded its %.1fs deadline "
                     "(attempt %d): %s"
@@ -283,6 +298,7 @@ def kv_retry(op, key, fn, reconnect=None, policy=None):
                     # the transport cannot come back — the server is
                     # truly gone; fail cleanly rather than spinning out
                     # the remaining budget
+                    _record_kv_death(op, key, "reconnect_failed", re)
                     raise KVStoreError(
                         "kvstore %s(%r): reconnect failed, server "
                         "unreachable: %s" % (op, key, re)) from re
@@ -497,6 +513,9 @@ class CheckpointManager:
             "rotation; excludes the window drain).").observe(dt)
         telemetry.emit_event("checkpoint_save", tag=tag, step=int(step),
                              epoch=int(epoch), seconds=round(dt, 6))
+        from . import diagnostics
+
+        diagnostics.record_lost("checkpoint", dt)
         return manifest
 
     def _rotate(self):
@@ -606,6 +625,9 @@ class CheckpointManager:
         telemetry.emit_event("checkpoint_restore", tag=tag,
                              step=meta["step"], epoch=meta["epoch"],
                              seconds=round(dt, 6))
+        from . import diagnostics
+
+        diagnostics.record_lost("checkpoint", dt)
         return ResumeState(epoch=meta["epoch"], step=meta["step"],
                            extra=meta.get("extra"), tag=tag,
                            manifest=manifest)

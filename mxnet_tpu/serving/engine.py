@@ -100,6 +100,15 @@ class DecodeEngine:
         self._prefill_fns = {}
         self._write_fns = {}
         tuning.register_step(self)
+        # diagnostics HBM ledger: the replica's weights (the KV pool
+        # registers itself in PagedKVCache). Host arithmetic on shape
+        # metadata only — never a device read.
+        from .. import diagnostics
+
+        diagnostics.hbm_set(
+            "params", "decode_engine",
+            sum(l.nbytes for l in jax.tree_util.tree_leaves(self.params)
+                if hasattr(l, "nbytes")))
 
     # -- shape bucketing --------------------------------------------------
     def _round_bucket(self, n):
@@ -178,9 +187,16 @@ class DecodeEngine:
             return None
         self._ensure_pages(act)
         self._inflight_meta.append(meta)
-        kp, vp, ctx, tok = self._jit_step(
-            self.params, self.cache.k_pages, self.cache.v_pages,
-            self._ctx, self._tokens, self._pt, self._active)
+        try:
+            kp, vp, ctx, tok = self._jit_step(
+                self.params, self.cache.k_pages, self.cache.v_pages,
+                self._ctx, self._tokens, self._pt, self._active)
+        except Exception as e:  # noqa: BLE001 — OOM gets the HBM ledger
+            from .. import diagnostics
+
+            self._inflight_meta.pop()
+            diagnostics.reraise_if_oom(e, "serving_decode")
+            raise
         self.cache.swap(kp, vp)
         self._ctx, self._tokens = ctx, tok
         for s in act:
@@ -272,9 +288,16 @@ class DecodeEngine:
         bucket = self._bucket_for(T)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :T] = prompt
-        kpag, vpag, tok0 = self._prefill_fn(bucket)(
-            self.params, jnp.asarray(padded),
-            jnp.asarray(np.array([T], np.int32)))
+        try:
+            kpag, vpag, tok0 = self._prefill_fn(bucket)(
+                self.params, jnp.asarray(padded),
+                jnp.asarray(np.array([T], np.int32)))
+        except Exception as e:  # noqa: BLE001 — OOM gets the HBM ledger
+            from .. import diagnostics
+
+            self.cache.free(seq_id)  # release the admission reservation
+            diagnostics.reraise_if_oom(e, "serving_prefill")
+            raise
         self.cache.alloc_for(seq_id, T)
         pages = self.cache.pages_of(seq_id)
         nbp = bucket // self.cache.page_size

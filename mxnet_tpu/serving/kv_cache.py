@@ -76,6 +76,12 @@ class PagedKVCache:
         self._pages = {}     # seq_id -> [page ids, in sequence order]
         self._quota = {}     # seq_id -> reserved page count (total)
         _m.kv_pages_total().set(self.num_pages)
+        # diagnostics HBM ledger: the whole preallocated K+V pool
+        # (scratch page included) — .nbytes is shape metadata, no read
+        from .. import diagnostics
+
+        diagnostics.hbm_set("kv_cache", "pool",
+                            self.k_pages.nbytes + self.v_pages.nbytes)
         self._publish()
 
     # -- helpers ----------------------------------------------------------

@@ -119,6 +119,16 @@ class ContinuousBatcher:
         self._now = now_fn
         self.steps = 0
         self.completed = []  # terminal requests, in finalization order
+        # the hang watchdog observes decode progress: token retirements
+        # bump the counter (in _on_tokens), outstanding work is queued +
+        # running requests — a wedged decode (or a page leak starving
+        # admission forever) shows as pending>0 with a frozen counter
+        from .. import diagnostics
+
+        diagnostics.register_source(
+            "serving_decode",
+            pending_fn=lambda: len(self._queue) + len(self._slot_req))
+        self._diag = diagnostics
 
     # -- intake -----------------------------------------------------------
     def submit(self, request):
@@ -158,11 +168,18 @@ class ContinuousBatcher:
 
     def run(self, max_steps=100000):
         """Drive until the queue and every slot drain (or the step
-        bound trips); flushes the window and returns ``completed``."""
-        while (self._queue or self._slot_req) \
-                and self.steps < int(max_steps):
-            self.step()
-        self.drain()
+        bound trips); flushes the window and returns ``completed``. An
+        unhandled exception in the serve loop leaves a diagnostics
+        post-mortem (when the layer is armed) before propagating."""
+        try:
+            while (self._queue or self._slot_req) \
+                    and self.steps < int(max_steps):
+                self.step()
+            self.drain()
+        except Exception as e:  # noqa: BLE001 — dump, then propagate
+            self._diag.maybe_postmortem(
+                "serve_loop:%s" % type(e).__name__)
+            raise
         return self.completed
 
     def drain(self):
@@ -241,6 +258,7 @@ class ContinuousBatcher:
         composition metadata. Runs inside the window's deferred read —
         records only; slot recomposition stays in step()."""
         del step_no
+        self._diag.progress("serving_decode")
         now = self._now()
         for slot, req in (meta or ()):
             req._take_first(now)
@@ -254,6 +272,14 @@ class ContinuousBatcher:
             return
         req._finalized = True
         _m.requests_total().labels(outcome).inc()
+        if outcome in ("evicted", "rejected"):
+            # SLO misses ride the flight recorder: a post-mortem shows
+            # WHICH requests were shed in the run-up to an incident
+            self._diag.record_event(
+                "request_" + outcome, request_id=req.id,
+                prompt_tokens=len(req.prompt),
+                max_new_tokens=req.max_new_tokens,
+                deadline=req.deadline)
         if outcome == "completed" and req.t_first is not None \
                 and req.t_finish is not None:
             _m.request_latency().labels("decode").observe(

@@ -65,6 +65,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
     "counter", "gauge", "histogram", "render_prometheus",
     "emit_event", "flush", "jsonl_path",
+    "add_event_tap", "remove_event_tap",
     "record_phase", "record_dispatch", "record_step_retired",
     "record_compile", "record_compile_cache", "record_tune_lookup",
     "trace_scope", "current_trace_id", "new_trace_id", "new_span_id",
@@ -571,14 +572,50 @@ def jsonl_path():
     return s.path if s is not None else None
 
 
-def emit_event(kind, **fields):
-    """Queue one JSONL event row (no-op without an active sink)."""
+# event taps: callables fed every event row BEFORE the JSONL sink —
+# the diagnostics flight recorder rides one, so every existing event
+# source (spans, RPC spans, membership/reshard/checkpoint events) lands
+# in the post-mortem ring without any source changing. Taps are host
+# bookkeeping and must never raise into the emitter.
+_event_taps = []
+
+
+def add_event_tap(fn):
+    if fn not in _event_taps:
+        _event_taps.append(fn)
+
+
+def remove_event_tap(fn):
+    try:
+        _event_taps.remove(fn)
+    except ValueError:
+        pass
+
+
+def _events_active():
+    """True when building an event row has a consumer (sink or tap)."""
+    return _event_taps or _active_sink() is not None
+
+
+def _dispatch_row(row):
+    for fn in list(_event_taps):
+        try:
+            fn(row)
+        except Exception:  # noqa: BLE001 — a broken tap must not stop events
+            pass
     s = _active_sink()
-    if s is None:
+    if s is not None:
+        s.emit(row)
+
+
+def emit_event(kind, **fields):
+    """Queue one event row to the taps + JSONL sink (no-op when neither
+    is active)."""
+    if not _events_active():
         return
     row = {"ts": round(time.time(), 6), "kind": str(kind)}
     row.update(fields)
-    s.emit(row)
+    _dispatch_row(row)
 
 
 def flush(write_metrics=False):
@@ -615,7 +652,7 @@ def record_phase(phase, seconds, stream=None, step=None):
             "Per-step phase timing: data_wait -> dispatch -> in_flight "
             "-> retire.", ("phase",))
     h.labels(phase).observe(seconds)
-    if _active_sink() is not None:
+    if _events_active():
         emit_event("span", name=str(phase), stream=stream, step=step,
                    seconds=round(seconds, 9))
 
@@ -631,7 +668,7 @@ def record_dispatch(stream, step, depth):
             "In-flight fused steps at each dispatch (window occupancy).",
             buckets=tuple(range(1, 17)))
     h.observe(depth)
-    if _active_sink() is not None:
+    if _events_active():
         emit_event("span", name="dispatch", stream=stream, step=step,
                    depth=depth)
 
@@ -649,7 +686,7 @@ def record_step_retired(stream, step, latency_s):
             "the in-flight window).", ("stream",))
     h.labels(stream).observe(latency_s)
     record_phase("in_flight", latency_s, stream=stream, step=step)
-    if _active_sink() is not None:
+    if _events_active():
         emit_event("span", name="retire", stream=stream, step=step,
                    latency_s=round(latency_s, 9))
 
@@ -686,6 +723,11 @@ def record_compile(phase, seconds):
     _compile_hist.labels(phase).observe(seconds)
     if phase == "compile":
         _compile_total.inc()
+    # compile time is lost wall-clock: the diagnostics goodput ledger
+    # (and the flight recorder) consume this via the event taps
+    if _events_active():
+        emit_event("compile", phase=str(phase),
+                   seconds=round(seconds, 9))
 
 
 def record_compile_cache(hit):
@@ -808,9 +850,8 @@ def record_rpc(side, op, seconds=None, nbytes=None, status="ok",
              "latency_s": None if seconds is None else round(seconds, 9),
              "bytes": nbytes}
     _RPC_SPAN_LOG.append(entry)
-    if _active_sink() is not None:
-        s = _active_sink()
-        s.emit(dict(entry, kind="rpc_span"))
+    if _events_active():
+        _dispatch_row(dict(entry, kind="rpc_span"))
 
 
 def rpc_spans():
@@ -840,13 +881,32 @@ def start_http_server(port=None):
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):
-            body = render_prometheus().encode("utf-8")
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            path, _, query = self.path.partition("?")
+            if path.startswith("/debug/"):
+                # diagnostics debug routes (stacks / memory /
+                # flightrecorder / trace) ride the same endpoint so one
+                # scrape target serves both metrics and post-mortems
+                try:
+                    from . import diagnostics
+
+                    status, ctype, body = diagnostics.handle_debug(
+                        path, query)
+                except Exception as e:  # noqa: BLE001 — a debug route
+                    # must never take the exposition endpoint down
+                    status, ctype = 500, "text/plain; charset=utf-8"
+                    body = ("debug route error: %s" % e).encode("utf-8")
+            else:
+                status = 200
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                body = render_prometheus().encode("utf-8")
+            try:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client hung up mid-transfer (big trace bodies)
 
         def log_message(self, *args):
             pass  # metrics scrapes must not spam the training logs

@@ -36,5 +36,28 @@ for seed in "${SEEDS[@]}"; do
     fi
 done
 
+# -- watchdog sweep ---------------------------------------------------------
+# Seeded worker_freeze / kv_drop rules must end in a TYPED outcome now
+# (diagnostics.py): a watchdog stall report with a parsed post-mortem
+# file, and under MXT_WATCHDOG_ACTION=abort a WATCHDOG_EXIT_CODE death
+# that tools/launch.py --respawn restarts — the chaos-marked tests in
+# tests/test_diagnostics.py assert all of it, so the outer `timeout`
+# is only the backstop, not the detector.
+for seed in "${SEEDS[@]}"; do
+    echo "== watchdog sweep: MXT_CHAOS_SEED=$seed (cell timeout ${CELL_TIMEOUT}s)"
+    timeout -k 10 "$CELL_TIMEOUT" env JAX_PLATFORMS=cpu \
+        MXT_CHAOS_SEED="$seed" \
+        python -m pytest tests/test_diagnostics.py -q -m "chaos and not slow" \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    rc=$?
+    if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+        echo "!! HANG: watchdog sweep seed=$seed exceeded ${CELL_TIMEOUT}s" >&2
+        fail=1
+    elif [ "$rc" -ne 0 ]; then
+        echo "!! FAIL: watchdog sweep seed=$seed rc=$rc" >&2
+        fail=1
+    fi
+done
+
 [ "$fail" -eq 0 ] && echo "chaos matrix: all seeds clean"
 exit "$fail"

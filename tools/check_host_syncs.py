@@ -47,6 +47,12 @@ _TRANSFER = [r"\.asnumpy\(", r"\.asscalar\(", r"\bnp\.asarray\(",
 
 SCAN = {
     "mxnet_tpu/engine.py": _ALL,
+    # diagnostics hooks ride INSIDE the hot paths (window pushes/retires,
+    # decode ticks, RPC completions): the watchdog observes host
+    # heartbeat counters and the HBM ledger observes shape metadata —
+    # never device values. The ONE deliberate sync is the OOM handler's
+    # window drain (the hot path is already dead there), sync-ok marked.
+    "mxnet_tpu/diagnostics.py": _ALL,
     "mxnet_tpu/gluon/train_step.py": _ALL,
     "mxnet_tpu/gluon/trainer.py": _ALL,
     "mxnet_tpu/ndarray/pending.py": _ALL,

@@ -42,6 +42,11 @@ import socket
 import subprocess
 import sys
 
+# mxnet_tpu.diagnostics.WATCHDOG_EXIT_CODE (kept in sync; not imported
+# so the launcher stays dependency-free): a worker that died this way
+# was aborted by its hang watchdog after dumping a post-mortem.
+WATCHDOG_EXIT_CODE = 134
+
 
 def _free_port():
     s = socket.socket()
@@ -78,6 +83,13 @@ def _mesh_env(args):
         extra["MXT_MESH_AXES"] = args.mesh_axes
     if getattr(args, "zero_stage", None) is not None:
         extra["MXT_ZERO_STAGE"] = str(args.zero_stage)
+    if getattr(args, "watchdog", None) is not None:
+        # arm every worker's hang watchdog (diagnostics.py) from the
+        # launch line: a silent worker_freeze becomes a stall report,
+        # and with abort + --respawn a typed death the launcher heals
+        extra["MXT_WATCHDOG_TIMEOUT"] = str(args.watchdog)
+        if getattr(args, "watchdog_action", None):
+            extra["MXT_WATCHDOG_ACTION"] = args.watchdog_action
     return extra
 
 
@@ -111,10 +123,12 @@ def launch_local(n, command, respawn=False, max_restarts=2, extra_env=None):
                 final[i] = 0
             elif restarts[i] < max_restarts:
                 restarts[i] += 1
+                why = " (watchdog abort — see its mxt-postmortem-*.json)" \
+                    if rc == WATCHDOG_EXIT_CODE else ""
                 sys.stderr.write(
-                    "launch: worker %d exited rc=%d — respawning with "
+                    "launch: worker %d exited rc=%d%s — respawning with "
                     "original rank/env (%d/%d)\n"
-                    % (i, rc, restarts[i], max_restarts))
+                    % (i, rc, why, restarts[i], max_restarts))
                 sys.stderr.flush()
                 procs[i] = subprocess.Popen(command, env=envs[i])
             else:
@@ -174,6 +188,15 @@ def main():
                     choices=(0, 1, 2, 3),
                     help="default ZeRO weight-update sharding stage for "
                          "ShardedTrainStep (exported as MXT_ZERO_STAGE)")
+    ap.add_argument("--watchdog", type=float, default=None,
+                    help="arm each worker's hang watchdog: seconds "
+                         "without progress before a stall report "
+                         "(exported as MXT_WATCHDOG_TIMEOUT)")
+    ap.add_argument("--watchdog-action", choices=("report", "abort"),
+                    default=None,
+                    help="stall response (exported as "
+                         "MXT_WATCHDOG_ACTION): 'abort' + --respawn "
+                         "turns a hang into a respawned worker")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:

@@ -31,6 +31,16 @@ and, when the process serves (mxnet_tpu/serving/ metrics present):
     request p50/p99    decode-phase request latency quantiles
     kv pages           paged KV-cache occupancy vs pool capacity
 
+and, when the diagnostics layer publishes (mxnet_tpu/diagnostics.py):
+
+    hbm <pool>         per-subsystem device bytes (params / optimizer /
+                       kv_cache / inflight_window / prefetch) + peak
+                       watermark — the HBM ledger
+    goodput            productive fraction of wall-clock, with the top
+                       lost-time causes (compile/checkpoint/reshard/
+                       stall/data_wait)
+    watchdog stalls    hang-watchdog stall reports so far
+
 Usage::
 
     python tools/mxt_top.py --url http://127.0.0.1:9109
@@ -268,6 +278,28 @@ def render(samples, prev, dt):
             if "axis" in d:
                 mesh_axes.append("%s=%d" % (d["axis"], int(v)))
 
+    # diagnostics section (mxnet_tpu/diagnostics.py): only rendered
+    # when the HBM ledger / goodput ledger have published — a process
+    # without the diagnostics layer shows no memory/goodput noise
+    hbm_pools = {}
+    hbm_peaks = {}
+    for (n, lab), v in sorted(samples.items()):
+        d = dict(lab)
+        if "pool" in d:
+            if n == "mxt_hbm_bytes":
+                hbm_pools[d["pool"]] = v
+            elif n == "mxt_hbm_peak_bytes":
+                hbm_peaks[d["pool"]] = v
+    goodput = metric_sum(samples, "mxt_goodput_ratio")
+    lost_causes = []
+    for (n, lab), v in samples.items():
+        if n == "mxt_lost_seconds_total":
+            d = dict(lab)
+            if "cause" in d and v > 0:
+                lost_causes.append((v, d["cause"]))
+    lost_causes.sort(reverse=True)
+    stalls = metric_sum(samples, "mxt_watchdog_stalls_total")
+
     # serving section (mxnet_tpu/serving/): only rendered when the
     # process has served — a pure trainer shows no serving noise
     tok_rate, tok_total = rate("mxt_serving_tokens_total")
@@ -310,6 +342,20 @@ def render(samples, prev, dt):
             % (_fmt_b(mesh_pbytes), _fmt_b(mesh_obytes)),
             "  reshards         %s" % _fmt(reshards, "%.0f"),
         ]
+    if hbm_pools or goodput is not None:
+        lines.append("-" * 46)
+        for pool in sorted(hbm_pools):
+            lines.append(
+                "  hbm %-12s %s   (peak %s)"
+                % (pool, _fmt_b(hbm_pools[pool]),
+                   _fmt_b(hbm_peaks.get(pool))))
+        if goodput is not None:
+            top = ", ".join("%s %s" % (c, _fmt_s(v))
+                            for v, c in lost_causes[:3]) or "none"
+            lines.append("  goodput          %s   lost: %s"
+                         % (_fmt(goodput, "%.3f"), top))
+        if stalls:
+            lines.append("  watchdog stalls  %s" % _fmt(stalls, "%.0f"))
     if tok_total is not None:
         lines += [
             "-" * 46,
