@@ -44,7 +44,8 @@ _BUDGET = float(os.environ.get("BENCH_BUDGET", "1500"))
 _CONFIG_COST = {"resnet50": 420, "bert": 300, "lstm_ptb": 200,
                 "wide_deep": 200, "lenet": 150, "pipeline": 150,
                 "async_ab": 90, "telemetry_ab": 60, "diag_ab": 60,
-                "cold_warm": 120, "serving": 150, "zero_stage": 90}
+                "cold_warm": 120, "serving": 150, "zero_stage": 90,
+                "embedding_ab": 90}
 
 
 def _remaining():
@@ -995,6 +996,103 @@ print("CWROW " + json.dumps({
 """
 
 
+def bench_embedding_ab(platform, dtype):
+    """embedding_server_ab (embedding/): the SAME zipf-skewed
+    pull/push row traffic driven against an in-process sharded
+    embedding fleet of 1 and then 2 servers. Reports
+    `embedding_bytes_per_sec` (the PERF.md r5 device-side metric, here
+    measured over the fleet transport), the hot-row cache hit ratio,
+    and RPCs/step — the scaling claim is bytes/sec increasing with
+    server count (each server applies its shard's sparse updates on its
+    own connection thread, so the fan-out overlaps)."""
+    import numpy as np
+
+    from mxnet_tpu import embedding, telemetry
+    from mxnet_tpu import optimizer as opt
+
+    del dtype  # row traffic is f32: the A/B isolates fleet scaling
+    small = platform == "cpu"
+    vocab = int(os.environ.get("BENCH_EMB_VOCAB",
+                               "50000" if small else "500000"))
+    dim = int(os.environ.get("BENCH_EMB_DIM", "64"))
+    batch = int(os.environ.get("BENCH_EMB_BATCH",
+                               "4096" if small else "16384"))
+    iters = int(os.environ.get("BENCH_EMB_ITERS", "8" if small else "20"))
+    warmup = int(os.environ.get("BENCH_EMB_WARMUP", "2"))
+    cache_rows = int(os.environ.get("BENCH_EMB_CACHE", "8192"))
+
+    def counter_total(name):
+        fam = telemetry.registry().get(name)
+        if fam is None:
+            return 0.0
+        return float(sum(ch.value for ch in fam.children().values()))
+
+    def run(n_servers):
+        fleet, handles = embedding.local_fleet(n_servers, worker_id=0)
+        tbl = embedding.ShardedEmbedding(
+            fleet, "bench_emb_%d" % n_servers, (vocab, dim),
+            cache_rows=cache_rows)
+        # lazy init: rows materialize server-side on first touch — the
+        # full table never exists on this worker (the >=10x-HBM shape)
+        tbl.init_lazy(seed=0, scale=0.01)
+        fleet.set_optimizer(opt.create("sgd", learning_rate=0.1))
+        rng = np.random.RandomState(0)
+
+        def sample():
+            # zipf-skewed ids: a hot set the cache can hold plus a
+            # long cold tail that keeps the fleet busy
+            return (rng.zipf(1.2, size=batch) % vocab).astype(np.int64)
+
+        try:
+            for _ in range(warmup):
+                ids = sample()
+                rows = tbl.pull(ids)
+                tbl.push(ids, rows * 0.01)
+            b0 = counter_total("mxt_embedding_bytes_total")
+            r0 = counter_total("mxt_embedding_rpcs_total")
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                ids = sample()
+                rows = tbl.pull(ids)
+                tbl.push(ids, rows * 0.01)
+            dt = time.perf_counter() - t0
+            nbytes = counter_total("mxt_embedding_bytes_total") - b0
+            rpcs = counter_total("mxt_embedding_rpcs_total") - r0
+            return {
+                "bytes_per_sec": nbytes / dt if dt else 0.0,
+                "samples_per_sec": batch * iters / dt if dt else 0.0,
+                "rpcs_per_step": rpcs / (2.0 * iters),  # pull+push = 1 step
+                "hit_ratio": tbl.cache.hit_ratio,
+            }
+        finally:
+            tbl.close()
+            fleet.close()
+            # non-coordinator servers first (deregister needs server 0)
+            for h in reversed(handles):
+                h.close()
+
+    one = run(1)
+    two = run(2)
+    scaling = two["bytes_per_sec"] / one["bytes_per_sec"] \
+        if one["bytes_per_sec"] else 0.0
+    row = {
+        "config": "embedding_server_ab", "chips": 0, "batch_size": batch,
+        "dtype": "float32", "platform": platform, "mfu": None,
+        "vocab": vocab, "embed_dim": dim, "cache_rows": cache_rows,
+        "embedding_bytes_per_sec": round(two["bytes_per_sec"]),
+        "embedding_bytes_per_sec_1srv": round(one["bytes_per_sec"]),
+        "embedding_bytes_per_sec_2srv": round(two["bytes_per_sec"]),
+        "server_scaling_x": round(scaling, 3),
+        "cache_hit_ratio_1srv": round(one["hit_ratio"], 4),
+        "cache_hit_ratio_2srv": round(two["hit_ratio"], 4),
+        "rpcs_per_step_1srv": round(one["rpcs_per_step"], 2),
+        "rpcs_per_step_2srv": round(two["rpcs_per_step"], 2),
+        "samples_per_sec_2srv": round(two["samples_per_sec"], 1),
+    }
+    _emit_jsonl(row)
+    return scaling, row
+
+
 def bench_cold_warm(platform, dtype):
     """Cold-vs-warm start A/B (tuning/): the SAME canonical fused-step
     loop run in two fresh processes sharing one persistent compile cache
@@ -1279,7 +1377,7 @@ def main():
     configs = os.environ.get(
         "BENCH_CONFIGS",
         "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline,async_ab,"
-        "telemetry_ab,diag_ab,cold_warm,serving,zero_stage"
+        "telemetry_ab,diag_ab,cold_warm,serving,zero_stage,embedding_ab"
     ).split(",")
 
     # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
@@ -1309,6 +1407,9 @@ def main():
         "zero_stage": ("zero_opt_bytes_shrink",
                        "x (replicated/ZeRO-2 opt bytes per device)",
                        bench_zero_stages),
+        "embedding_ab": ("embedding_server_scaling",
+                         "x (2srv/1srv embedding bytes/sec)",
+                         bench_embedding_ab),
     }
     headline = None
     errors = []
@@ -1316,7 +1417,7 @@ def main():
     best_resnet = None
     for name in ("resnet50", "bert", "lstm_ptb", "wide_deep", "lenet",
                  "pipeline", "async_ab", "telemetry_ab", "diag_ab",
-                 "cold_warm", "serving", "zero_stage"):
+                 "cold_warm", "serving", "zero_stage", "embedding_ab"):
         if name not in configs:
             continue
         cost = float(os.environ.get("BENCH_COST_%s" % name.upper(),

@@ -1,0 +1,100 @@
+"""Wide&Deep CTR on the distributed sparse embedding parameter server
+(embedding/; ref: example/sparse/wide_deep/train.py + the ps-lite
+dist embedding recipe).
+
+The embedding towers declare ``sparse_grad=True`` and the Trainer runs
+with ``kvstore='dist_embedding'``: the tables shard across an embedding
+server fleet by consistent hashing, each step pushes ONLY the batch's
+gradient rows (applied server-side with the sparse optimizer) and pulls
+ONLY those rows back through the hot-row device cache, while the dense
+MLP towers keep the local fused update. Synthetic clicks keep it
+runnable anywhere.
+
+Run:
+    python examples/train_wide_deep.py --iters 20
+    python examples/train_wide_deep.py --embedding-servers 2 --telemetry
+    # live console, in another terminal:
+    #   python tools/mxt_top.py --jsonl wide_deep_telemetry.jsonl --once
+"""
+import argparse
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, nd
+from mxnet_tpu.gluon import model_zoo
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=1024)
+    p.add_argument("--wide-vocab", type=int, default=100000)
+    p.add_argument("--deep-vocab", type=int, default=10000)
+    p.add_argument("--embedding-servers", type=int, default=0,
+                   help="size of the in-process sharded embedding fleet; "
+                        "0 keeps the single-process local kvstore "
+                        "(MXT_EMBEDDING_SERVERS connects to a running "
+                        "fleet instead)")
+    p.add_argument("--cache-rows", type=int, default=4096,
+                   help="hot-row device cache capacity per table")
+    p.add_argument("--telemetry", action="store_true",
+                   help="write telemetry JSONL "
+                        "(wide_deep_telemetry.jsonl) for tools/mxt_top.py")
+    args = p.parse_args()
+
+    if args.telemetry:
+        os.environ.setdefault("MXT_TELEMETRY_JSONL",
+                              "wide_deep_telemetry.jsonl")
+    kvstore = "local"
+    if args.embedding_servers > 0 or config.get("MXT_EMBEDDING_SERVERS"):
+        kvstore = "dist_embedding"
+        if args.embedding_servers > 0:
+            config.set_default("MXT_EMBEDDING_LOCAL_SERVERS",
+                               args.embedding_servers)
+        config.set_default("MXT_EMBEDDING_CACHE_ROWS", args.cache_rows)
+
+    mx.random.seed(0)
+    net = model_zoo.wide_deep(
+        wide_vocab=args.wide_vocab, deep_vocab=args.deep_vocab,
+        embed_dim=16, hidden=(64, 32), classes=2, sparse_grad=True)
+    net.initialize()
+
+    rng = np.random.RandomState(0)
+    n_wide, n_deep = 8, 4
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 1e-3}, kvstore=kvstore)
+    metric = mx.metric.Accuracy()
+    for i in range(args.iters):
+        xw = nd.array(rng.randint(0, args.wide_vocab,
+                                  (args.batch_size, n_wide)).astype("f4"))
+        xd = nd.array(rng.randint(0, args.deep_vocab,
+                                  (args.batch_size, n_deep)).astype("f4"))
+        y = nd.array(rng.randint(0, 2, (args.batch_size,)).astype("f4"))
+        with mx.autograd.record():
+            out = net(xw, xd)
+            loss = loss_fn(out, y).mean()
+        loss.backward()
+        trainer.step(args.batch_size)
+        metric.update([y], [out])
+        if (i + 1) % 5 == 0:
+            print("iter %d loss %.4f acc %.4f"
+                  % (i + 1, float(loss.asnumpy()), metric.get()[1]))
+    kv = trainer._kvstore
+    if kv is not None and kv.type == "dist_embedding":
+        for key, tbl in kv._emb_tables.items():
+            if tbl.cache is not None:
+                print("table %s: cache hit ratio %.3f, %d rows resident"
+                      % (key, tbl.cache.hit_ratio, len(tbl.cache)))
+        kv.close()
+
+
+if __name__ == "__main__":
+    main()

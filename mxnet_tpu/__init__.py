@@ -66,6 +66,7 @@ diagnostics._maybe_autostart()  # flight recorder tap (+ watchdog when
 from . import tuning
 from . import resilience
 from . import membership
+from . import embedding
 from . import visualization
 from . import visualization as viz
 from . import amp

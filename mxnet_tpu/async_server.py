@@ -107,7 +107,12 @@ _BOOT_ID_LEN = 8
 # so a restarted-but-unregistered worker cannot corrupt weights. 'reset'
 # is exempt: it is the coordinated whole-world restart issued from inside
 # KVStore.create() before the new world's members have registered.
-_FENCED_OPS = frozenset(("init", "push", "set_optimizer", "set_states"))
+# The emb_* entries extend the fence to row-granular sparse pushes on
+# the embedding store (embedding/store.py) — a fenced zombie's delayed
+# gradient ROWS are refused exactly like its dense frames.
+_FENCED_OPS = frozenset(("init", "push", "set_optimizer", "set_states",
+                         "emb_init", "emb_init_lazy", "emb_load",
+                         "emb_push", "emb_set_optimizer"))
 
 
 def _shared_secret():
@@ -203,6 +208,7 @@ class AsyncParamServer:
         self._secret = _shared_secret()  # auth mode fixed at bind time
         self._store = {}     # key -> np.ndarray (the weight)
         self._updater = None
+        self.embedding = None  # EmbeddingStore (attach_embedding)
         self._mutate = threading.Lock()  # ps-lite customer-thread analog
         self._conns = set()  # live client sockets, torn down by close()
         self._conns_lock = threading.Lock()
@@ -335,6 +341,13 @@ class AsyncParamServer:
             with self._conns_lock:
                 self._conns.discard(conn)
 
+    def attach_embedding(self, store):
+        """Host a sharded embedding table store on this server: every
+        ``emb_*`` frame dispatches to it (embedding/store.py), under the
+        same membership credential fencing as the dense ops."""
+        self.embedding = store
+        return store
+
     def _fencing_active(self):
         from . import config
 
@@ -422,10 +435,23 @@ class AsyncParamServer:
                     return ("err", "no server-side optimizer")
                 self._updater.set_states(payload)
             return ("ok", None)
+        # -- sharded embedding store (embedding/store.py) -----------------
+        elif op.startswith("emb_"):
+            if self.embedding is None:
+                return ("err", "this server hosts no embedding store "
+                               "(attach_embedding / kvstore_server)")
+            # credential fencing already ran above; the store adds the
+            # row-granular ring-epoch fence for mutations
+            return self.embedding.handle(op, key, payload)
         # -- membership ops (ref: ps-lite Van ADD_NODE/HEARTBEAT) --------
         elif op == "register":
-            worker_id, want_snapshot = payload
-            gen, epoch, rejoin = self.membership.register(worker_id)
+            meta = None
+            if len(payload) == 3:
+                worker_id, want_snapshot, meta = payload
+            else:
+                worker_id, want_snapshot = payload
+            gen, epoch, rejoin = self.membership.register(worker_id,
+                                                          meta=meta)
             from . import resilience
 
             inj = resilience.fault_point()

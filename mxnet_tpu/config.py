@@ -282,6 +282,22 @@ _declare("MXT_AG_LEAN_TAPE", bool, False,
          "eager recordings whose ops' vjp residuals don't already retain "
          "their inputs, at the cost of grad(create_graph=True) raising.")
 
+_declare("MXT_EMBEDDING_SERVERS", str, None,
+         "Comma-separated host:port list of a running sharded-embedding "
+         "server fleet (embedding/). When unset, kvstore 'dist_embedding' "
+         "spins MXT_EMBEDDING_LOCAL_SERVERS in-process servers instead.")
+_declare("MXT_EMBEDDING_LOCAL_SERVERS", int, 1,
+         "Size of the in-process embedding server fleet started by "
+         "kvstore 'dist_embedding' when MXT_EMBEDDING_SERVERS is unset.")
+_declare("MXT_EMBEDDING_CACHE_ROWS", int, 4096,
+         "Hot-row device cache capacity (rows per embedding table) for "
+         "the sharded embedding client; 0 disables the cache "
+         "(every lookup goes to the fleet).")
+_declare("MXT_EMBEDDING_SNAPSHOT_DIR", str, None,
+         "Directory where embedding servers persist their shard "
+         "(rows + optimizer state, CRC-manifested) and restore it from "
+         "on restart.")
+
 _overrides = {}
 # bumped by set_default so value caches (e.g. the flash kernel's block
 # memo) can notice a config change without re-reading every variable

@@ -490,7 +490,8 @@ class HBMLedger:
             self._bytes_g = tel.gauge(
                 "mxt_hbm_bytes",
                 "Device bytes accounted per subsystem pool (params, "
-                "optimizer, kv_cache, inflight_window, prefetch).",
+                "optimizer, kv_cache, inflight_window, prefetch, "
+                "hot_row_cache).",
                 ("pool",))
             self._peak_g = tel.gauge(
                 "mxt_hbm_peak_bytes",

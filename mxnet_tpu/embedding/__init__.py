@@ -1,0 +1,28 @@
+"""Distributed sparse embedding parameter server (ROADMAP item 4).
+
+The rec-sys scenario: terabyte-class ``row_sparse`` embedding tables
+that cannot be replicated into device HBM. Tables shard across a server
+fleet by consistent hashing over the membership view (hashing.py),
+workers pull only the rows a batch touches through a hot-row device
+cache (cache.py) and push only gradient rows — applied server-side with
+the real sparse optimizers (store.py, sparse.py kernels) — batched to
+at most one RPC per server per op (client.py). Fencing extends PR 3's
+monotone-generation design to row-granular sparse pushes, plus a ring-
+epoch fence adopted when rows migrate in a reshard.
+
+Gluon front door: ``gluon.nn.Embedding(sparse_grad=True)`` +
+``gluon.Trainer(kvstore='dist_embedding')`` — the dense towers keep the
+fused one-launch step; embedding lookups/updates flow through this
+package (kvstore.py, gluon/trainer.py).
+"""
+from .hashing import HashRing, stable_hash
+from .cache import HotRowCache
+from .store import EmbeddingStore
+from .client import (EmbeddingFleet, ShardedEmbedding,
+                     LocalEmbeddingServer, local_fleet, start_local_server)
+
+__all__ = [
+    "HashRing", "stable_hash", "HotRowCache", "EmbeddingStore",
+    "EmbeddingFleet", "ShardedEmbedding", "LocalEmbeddingServer",
+    "local_fleet", "start_local_server",
+]

@@ -1,0 +1,191 @@
+"""Hot-row device cache for sharded embedding tables.
+
+An LRU cache of embedding rows resident in device HBM: the full table
+lives sharded across the server fleet (terabyte-class in the rec-sys
+scenario), and the TPU holds only the working set. Reads are
+read-through (a miss batch is fetched from the owning servers and
+inserted); pushes write back the server-updated row values so the next
+lookup of a just-trained row is a device-side hit instead of a refetch.
+
+Accounting: the backing buffer registers as the ``hot_row_cache`` pool
+in the diagnostics HBM ledger (sized from shape metadata — never a
+device read), and every lookup feeds
+``mxt_embedding_cache_{hits,misses,evictions}_total`` plus the
+``mxt_embedding_cache_hit_ratio`` / ``mxt_embedding_rows_resident``
+gauges that `mxt_top`'s embedding section renders.
+
+Host-side bookkeeping (id->slot map, LRU order) is pure metadata; row
+VALUES move only device-to-device (`buf[slots]`, `.at[slots].set`).
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["HotRowCache"]
+
+_POOL = "hot_row_cache"
+
+
+def _metrics():
+    from .. import telemetry
+
+    hits = telemetry.counter(
+        "mxt_embedding_cache_hits_total",
+        "Hot-row cache lookups served from device HBM.", ("table",))
+    misses = telemetry.counter(
+        "mxt_embedding_cache_misses_total",
+        "Hot-row cache lookups that went to the server fleet.",
+        ("table",))
+    evict = telemetry.counter(
+        "mxt_embedding_cache_evictions_total",
+        "Rows evicted from the hot-row cache (LRU).", ("table",))
+    ratio = telemetry.gauge(
+        "mxt_embedding_cache_hit_ratio",
+        "Lifetime hot-row cache hit ratio per table.", ("table",))
+    resident = telemetry.gauge(
+        "mxt_embedding_rows_resident",
+        "Embedding rows currently resident in the device cache.",
+        ("table",))
+    return hits, misses, evict, ratio, resident
+
+
+class HotRowCache:
+    """Fixed-capacity LRU over one table's rows, backed by a single
+    preallocated ``(capacity, dim)`` device buffer."""
+
+    def __init__(self, name, capacity, dim, dtype="float32"):
+        import jax.numpy as jnp
+
+        if capacity < 1:
+            raise MXNetError("hot-row cache capacity must be >= 1")
+        self.name = str(name)
+        self.capacity = int(capacity)
+        self.dim = int(dim)
+        self._buf = jnp.zeros((self.capacity, self.dim), dtype=dtype)
+        self._slot = {}              # row_id -> slot
+        self._lru = OrderedDict()    # row_id -> None, oldest first
+        self._free = list(range(self.capacity))
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        (self._c_hits, self._c_miss, self._c_evict,
+         self._g_ratio, self._g_resident) = _metrics()
+        from .. import diagnostics
+
+        diagnostics.hbm_set(_POOL, self.name,
+                            self.capacity * self.dim
+                            * np.dtype(dtype).itemsize)
+
+    # -- bookkeeping -------------------------------------------------------
+    def __len__(self):
+        with self._lock:
+            return len(self._slot)
+
+    @property
+    def hit_ratio(self):
+        total = self._hits + self._misses
+        return self._hits / total if total else 0.0
+
+    def _publish(self):
+        self._g_ratio.labels(self.name).set(self.hit_ratio)
+        self._g_resident.labels(self.name).set(len(self._slot))
+
+    # -- lookup / fill -----------------------------------------------------
+    def lookup(self, row_ids):
+        """Split a unique-id batch into hits and misses.
+
+        Returns ``(hit_pos, hit_slots, miss_pos)`` — positions index
+        into ``row_ids``; ``hit_slots`` are rows of the device buffer
+        (gather with :meth:`gather`). Hits refresh LRU recency."""
+        hit_pos, hit_slots, miss_pos = [], [], []
+        with self._lock:
+            for pos, rid in enumerate(np.asarray(row_ids,  # sync-ok: host id metadata (cache keys, not device values)
+                                                 dtype=np.int64).ravel()):
+                rid = int(rid)
+                slot = self._slot.get(rid)
+                if slot is None:
+                    miss_pos.append(pos)
+                else:
+                    hit_pos.append(pos)
+                    hit_slots.append(slot)
+                    self._lru.move_to_end(rid)
+            self._hits += len(hit_pos)
+            self._misses += len(miss_pos)
+        if hit_pos:
+            self._c_hits.labels(self.name).inc(len(hit_pos))
+        if miss_pos:
+            self._c_miss.labels(self.name).inc(len(miss_pos))
+        self._publish()
+        return (np.asarray(hit_pos, dtype=np.int64),  # sync-ok: host position metadata
+                np.asarray(hit_slots, dtype=np.int64),  # sync-ok: host slot metadata
+                np.asarray(miss_pos, dtype=np.int64))  # sync-ok: host position metadata
+
+    def gather(self, slots):
+        """Device gather of cached rows (no host transfer)."""
+        import jax.numpy as jnp
+
+        return self._buf[jnp.asarray(np.asarray(slots, dtype=np.int64))]  # sync-ok: slot indices are host metadata; the gather itself stays on device
+
+    def insert(self, row_ids, rows):
+        """Install rows (device or host values) for the given unique ids,
+        evicting LRU rows when capacity binds. Also the write-back path:
+        a pushed row's server-updated value lands here so the next
+        lookup hits."""
+        import jax.numpy as jnp
+
+        ids = [int(r) for r in np.asarray(row_ids, dtype=np.int64).ravel()]  # sync-ok: host id metadata (cache keys)
+        if not ids:
+            return
+        if len(ids) > self.capacity:
+            # keep only the tail (the most recent capacity-many ids):
+            # inserting more than capacity would immediately self-evict
+            rows = rows[len(ids) - self.capacity:]
+            ids = ids[len(ids) - self.capacity:]
+        evicted = 0
+        slots = []
+        with self._lock:
+            for rid in ids:
+                slot = self._slot.get(rid)
+                if slot is None:
+                    if self._free:
+                        slot = self._free.pop()
+                    else:
+                        old, _ = self._lru.popitem(last=False)
+                        slot = self._slot.pop(old)
+                        evicted += 1
+                    self._slot[rid] = slot
+                self._lru[rid] = None
+                self._lru.move_to_end(rid)
+                slots.append(slot)
+        self._buf = self._buf.at[jnp.asarray(slots)].set(
+            jnp.asarray(rows, dtype=self._buf.dtype))
+        if evicted:
+            self._c_evict.labels(self.name).inc(evicted)
+        self._publish()
+
+    def invalidate(self, row_ids=None):
+        """Drop rows (all rows when ``row_ids`` is None) — the fallback
+        when a push cannot write back (e.g. the server reply carried no
+        updated values)."""
+        with self._lock:
+            if row_ids is None:
+                self._slot.clear()
+                self._lru.clear()
+                self._free = list(range(self.capacity))
+            else:
+                for rid in np.asarray(row_ids, dtype=np.int64).ravel():  # sync-ok: host id metadata (cache keys)
+                    slot = self._slot.pop(int(rid), None)
+                    if slot is not None:
+                        self._lru.pop(int(rid), None)
+                        self._free.append(slot)
+        self._publish()
+
+    def close(self):
+        from .. import diagnostics
+
+        diagnostics.hbm_release(_POOL, self.name)

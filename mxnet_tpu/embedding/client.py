@@ -1,0 +1,696 @@
+"""Client side of the distributed sparse embedding parameter server.
+
+:class:`EmbeddingFleet` owns the connections to the embedding servers,
+the consistent-hash ring (rebuilt from the coordinator's membership
+view, so servers can join/leave), and this worker's fencing credentials
+at every server. :class:`ShardedEmbedding` is one table's view over a
+fleet: sparse row pull with the hot-row device cache in front
+(read-through on miss), sparse row push applying the SERVER-side sparse
+optimizer (the reply's updated rows write back into the cache), both
+batched per destination server — one lookup or update is at most one
+RPC per live server regardless of batch size (the ps-lite
+``PullRowSparse`` contract).
+
+Elasticity: a server that stops answering is marked dead locally, the
+ring is rebuilt over the survivors from the refreshed membership view,
+and the affected rows re-route — missing rows on the inheriting server
+are re-seeded from the worker's ``recover`` source (the dense mirror
+that gluon.Trainer keeps) via ``emb_load``, which also hands the new
+owner the current ring epoch so gradients delayed from before the
+reshard are refused typed (store.py). A rejoining server re-registers
+with the coordinator (fresh endpoint in its membership meta) and is
+folded back into the ring on the next refresh.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+from ..membership import StaleWorkerError, WorkerMembership
+from ..resilience import KVStoreError
+from .hashing import HashRing
+from .store import EmbeddingStore
+
+__all__ = ["EmbeddingFleet", "ShardedEmbedding", "LocalEmbeddingServer",
+           "local_fleet", "start_local_server"]
+
+# how a transport-dead server surfaces from AsyncClient.request
+_DEAD_ERRORS = (KVStoreError, ConnectionError, OSError)
+
+_STALE_EPOCH = "stale ring epoch"
+_NO_TABLE = "does not exist on this server"
+
+
+def _server_member_id(index):
+    """Embedding servers register in the coordinator's membership table
+    under a negative id namespace (training workers own the
+    non-negative ints)."""
+    return -(int(index) + 1)
+
+
+class EmbeddingFleet:
+    """Connections + ring + credentials for one worker's view of the
+    embedding server fleet."""
+
+    def __init__(self, endpoints=None, coordinator=None, vnodes=64,
+                 timeout=None, heartbeats=True):
+        from .. import config
+
+        # static seed endpoints: {server_id: (host, port)}; the
+        # membership view (server registrations carrying endpoint meta)
+        # overrides these whenever it knows better
+        self._static = dict(endpoints or {})
+        if coordinator is None:
+            if not self._static:
+                raise MXNetError(
+                    "EmbeddingFleet needs endpoints or a coordinator")
+            coordinator = self._static[sorted(self._static)[0]]
+        self.coordinator = tuple(coordinator)
+        self._timeout = float(timeout if timeout is not None  # sync-ok: host config scalar
+                              else config.get("MXT_KV_DEADLINE"))
+        self._heartbeats = bool(heartbeats)
+        self._endpoints = dict(self._static)
+        self._clients = {}     # server_id -> AsyncClient (data plane)
+        self._members = {}     # server_id -> WorkerMembership (this worker)
+        self._dead = {}        # server_id -> endpoint observed dead
+        self._coord_client = None
+        self._lock = threading.RLock()
+        self.ring = HashRing(vnodes=vnodes)
+        self.epoch = 0
+        self.worker_id = None
+        self._opt_blob = None  # last shipped optimizer (new-server reship)
+        self._tables = []      # ShardedEmbedding registry (re-init heal)
+
+    @classmethod
+    def from_spec(cls, spec, **kw):
+        """Build from an ``MXT_EMBEDDING_SERVERS``-style string:
+        ``host:port,host:port`` — server ids are list positions."""
+        endpoints = {}
+        for i, item in enumerate(s for s in spec.split(",") if s.strip()):
+            host, _, port = item.strip().rpartition(":")
+            endpoints[i] = (host, int(port))
+        return cls(endpoints=endpoints, **kw)
+
+    # -- membership / ring -------------------------------------------------
+    def _coordinator_client(self):
+        from ..async_server import AsyncClient
+
+        if self._coord_client is None:
+            self._coord_client = AsyncClient(
+                self.coordinator[0], self.coordinator[1],
+                timeout=self._timeout)
+        return self._coord_client
+
+    def refresh(self):
+        """Rebuild the ring from the coordinator's live-member view.
+        Registered embedding servers (negative-id members with endpoint
+        meta) take precedence; without any, the static endpoint list is
+        the fleet (minus servers this worker observed dead)."""
+        try:
+            view = self._coordinator_client().request("members")
+        except _DEAD_ERRORS:
+            view = None
+        live = {}
+        epoch = self.epoch
+        if view is not None:
+            epoch = int(view.get("epoch", self.epoch))
+            meta = view.get("meta", {})
+            for wid in view.get("members", {}):
+                m = meta.get(wid)
+                if isinstance(m, dict) and m.get("embedding_server"):
+                    live[int(m.get("index", wid))] = (m["host"],
+                                                      int(m["port"]))
+        if not live:
+            live = {sid: ep for sid, ep in self._static.items()
+                    if self._dead.get(sid) != ep}
+        with self._lock:
+            # a server that re-registered at a NEW endpoint is alive
+            # again; one the coordinator lists at the endpoint this
+            # worker saw die stays dead until it moves
+            for sid, ep in list(live.items()):
+                if self._dead.get(sid) == ep:
+                    del live[sid]
+                elif sid in self._dead:
+                    del self._dead[sid]
+            joined = set(live) - set(self._endpoints) | {
+                sid for sid, ep in live.items()
+                if self._endpoints.get(sid) != ep}
+            for sid in set(self._endpoints) - set(live) | joined:
+                self._drop_client(sid)
+            self._endpoints = live
+            self.epoch = epoch
+            self.ring.rebuild(sorted(live), epoch=epoch)
+        for sid in sorted(joined):
+            self._on_server_joined(sid)
+        return self.ring
+
+    def _on_server_joined(self, sid):
+        """A (re)joined server starts from whatever its snapshot held:
+        re-ship the optimizer, let each table re-create itself, and
+        re-seed the rows this worker trained that now map to it — its
+        snapshot predates the kill, so rows updated on the survivors
+        while it was away would otherwise resurrect stale from the
+        shard file."""
+        if self._opt_blob is not None:
+            try:
+                self.request(sid, "emb_set_optimizer", None, self._opt_blob)
+            except _DEAD_ERRORS:
+                return
+        for table in list(self._tables):
+            table.ensure_table(sid)
+            table.reseed_touched(sid)
+
+    def live_servers(self):
+        with self._lock:
+            return sorted(self._endpoints)
+
+    def mark_dead(self, sid):
+        """This worker observed the server dead (transport failure):
+        drop it locally and rebuild over the survivors, then fold in
+        whatever the coordinator knows."""
+        with self._lock:
+            ep = self._endpoints.pop(sid, None)
+            if ep is not None:
+                self._dead[sid] = ep
+            self._drop_client(sid)
+            self.ring.rebuild(sorted(self._endpoints), epoch=self.epoch)
+        from .. import diagnostics
+
+        diagnostics.record_event("embedding_server_dead", server=sid,
+                                 survivors=len(self._endpoints))
+        self.refresh()
+
+    def _drop_client(self, sid):
+        cl = self._clients.pop(sid, None)
+        if cl is not None:
+            cl.close()
+        wm = self._members.pop(sid, None)
+        if wm is not None:
+            try:
+                wm.stop(deregister=False)
+            except Exception:  # noqa: BLE001 — teardown best effort
+                pass
+
+    # -- credentials -------------------------------------------------------
+    def register_worker(self, worker_id):
+        """Register this worker with every live embedding server: each
+        hands back a fencing generation that stamps all data frames
+        (PR 3 semantics, now covering sparse row pushes)."""
+        self.worker_id = int(worker_id)
+        for sid in self.live_servers():
+            self._ensure_registered(sid)
+        return self
+
+    def _ensure_registered(self, sid):
+        if self.worker_id is None or sid in self._members:
+            return
+        host, port = self._endpoints[sid]
+        wm = WorkerMembership(host, port, self.worker_id,
+                              timeout=self._timeout)
+        wm.register()
+        if self._heartbeats:
+            wm.start_heartbeats()
+        self._members[sid] = wm
+        cl = self._clients.get(sid)
+        if cl is not None:
+            cl.set_credentials(wm.worker_id, wm.generation)
+
+    # -- data plane --------------------------------------------------------
+    def client(self, sid):
+        from ..async_server import AsyncClient
+
+        with self._lock:
+            cl = self._clients.get(sid)
+            if cl is None:
+                if sid not in self._endpoints:
+                    raise KVStoreError(
+                        "embedding server %r is not in the live fleet"
+                        % (sid,))
+                host, port = self._endpoints[sid]
+                cl = self._clients[sid] = AsyncClient(
+                    host, port, timeout=self._timeout)
+        self._ensure_registered(sid)
+        wm = self._members.get(sid)
+        if wm is not None and wm.generation is not None:
+            cl.set_credentials(wm.worker_id, wm.generation)
+        return cl
+
+    def request(self, sid, op, key=None, payload=None):
+        return self.client(sid).request(op, key, payload)
+
+    def scatter(self, requests):
+        """Issue ``{server_id: (op, key, payload)}`` concurrently (one
+        thread per destination beyond the first — each server has its
+        own connection, so fan-out overlaps server-side work). Returns
+        ``{server_id: result_or_exception}``; transport and typed
+        errors come back as values so the caller can heal per server."""
+        out = {}
+
+        def run(sid, req):
+            try:
+                out[sid] = self.request(sid, *req)
+            except (MXNetError,) + _DEAD_ERRORS as e:
+                out[sid] = e
+
+        items = list(requests.items())
+        threads = [threading.Thread(target=run, args=item, daemon=True)
+                   for item in items[1:]]
+        for t in threads:
+            t.start()
+        if items:
+            run(*items[0])
+        for t in threads:
+            t.join()
+        return out
+
+    # -- fleet-wide control ------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Ship the optimizer to every live server (the server applies
+        sparse updates with it). ``param_dict`` is stripped — parameter
+        objects (and their device buffers) must not ride to the fleet;
+        per-key multipliers travel via ``lr_mult``/``wd_mult``."""
+        pd, optimizer.param_dict = optimizer.param_dict, {}
+        try:
+            self._opt_blob = pickle.dumps(optimizer)
+        finally:
+            optimizer.param_dict = pd
+        for sid in self.live_servers():
+            self.request(sid, "emb_set_optimizer", None, self._opt_blob)
+
+    def snapshot(self):
+        """Ask every live server to persist its shard; returns
+        {server_id: path}."""
+        return {sid: self.request(sid, "emb_snapshot")
+                for sid in self.live_servers()}
+
+    def _register_table(self, table):
+        if table not in self._tables:
+            self._tables.append(table)
+
+    def close(self):
+        with self._lock:
+            for sid in list(self._clients):
+                self._drop_client(sid)
+            if self._coord_client is not None:
+                self._coord_client.close()
+                self._coord_client = None
+
+
+class ShardedEmbedding:
+    """One embedding table sharded across the fleet, with the hot-row
+    device cache in front of pulls and the write-back path behind
+    pushes."""
+
+    def __init__(self, fleet, key, shape, dtype="float32", cache_rows=None,
+                 recover=None):
+        from .. import config
+        from .cache import HotRowCache
+
+        self.fleet = fleet
+        self.key = key
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+        self._row_shape = self.shape[1:]
+        self._dim = int(np.prod(self._row_shape)) if self._row_shape else 1
+        if cache_rows is None:
+            cache_rows = int(config.get("MXT_EMBEDDING_CACHE_ROWS"))
+        cache_rows = min(int(cache_rows), self.shape[0])
+        self.cache = HotRowCache("emb:%s" % key, cache_rows, self._dim,
+                                 dtype=dtype) if cache_rows > 0 else None
+        # recover(ids) -> rows: the worker-side source of truth used to
+        # re-seed rows a surviving server inherited without state (the
+        # gluon path wires the dense mirror buffer here)
+        self.recover = recover
+        self._lazy = None      # (seed, scale) when lazily initialized
+        self._attempts = 4     # heal rounds per op (remap/refresh/retry)
+        # ids this worker has pushed: the dirty set re-seeded onto a
+        # REJOINING server, whose snapshot predates its death — without
+        # this, rows updated on the survivors while it was away would
+        # map back to it and resurrect stale
+        self._touched = set()
+        fleet._register_table(self)
+
+    # -- initialization ----------------------------------------------------
+    def init(self, dense):
+        """Scatter initial rows to their owning servers (one emb_init
+        RPC per server). ``dense`` is the full initial value — use
+        :meth:`init_lazy` for tables too big to materialize anywhere."""
+        vals = np.asarray(  # sync-ok: network serialization of init rows
+            dense.asnumpy() if hasattr(dense, "asnumpy") else dense,  # sync-ok: network serialization of init rows (one-time)
+            dtype=self.dtype)
+        if vals.shape != self.shape:
+            raise MXNetError("init shape %s != table shape %s"
+                             % (vals.shape, self.shape))
+        ids = np.arange(self.shape[0], dtype=np.int64)
+        routed = self.fleet.ring.route(ids)
+        reqs = {sid: ("emb_init", self.key,
+                      (self.shape, str(self.dtype), ids[pos], vals[pos],
+                       self.fleet.epoch))
+                for sid, pos in routed.items()}
+        self._raise_failures(self.fleet.scatter(reqs), "emb_init")
+        return self
+
+    def init_lazy(self, seed=0, scale=0.01):
+        """Declare the table everywhere without materializing a single
+        row: servers generate rows deterministically from (seed, row_id)
+        on first touch — the ≥10×-HBM configuration."""
+        self._lazy = (int(seed), float(scale))  # sync-ok: host config scalars
+        for sid in self.fleet.live_servers():
+            self.ensure_table(sid)
+        return self
+
+    def ensure_table(self, sid):
+        """Idempotently (re)create this table's spec on one server — the
+        heal path when a fresh server joins the ring."""
+        try:
+            if self._lazy is not None:
+                self.fleet.request(
+                    sid, "emb_init_lazy", self.key,
+                    (self.shape, str(self.dtype), self._lazy[0],
+                     self._lazy[1], self.fleet.epoch))
+            else:
+                self.fleet.request(
+                    sid, "emb_init", self.key,
+                    (self.shape, str(self.dtype),
+                     np.zeros((0,), np.int64),
+                     np.zeros((0, self._dim), self.dtype),
+                     self.fleet.epoch))
+        except _DEAD_ERRORS:
+            pass
+
+    def reseed_touched(self, sid):
+        """Force-load this worker's trained rows that the (re)joined
+        server now owns: emb_load installs current values AND the
+        current ring epoch (fencing pre-rejoin gradients). Rows this
+        worker never pushed are unchanged since init/snapshot, so the
+        server's own restore is authoritative for them."""
+        if self.recover is None or not self._touched:
+            return
+        ids = np.asarray(sorted(self._touched),  # sync-ok: host id metadata
+                         dtype=np.int64)
+        mine = self.fleet.ring.route(ids).get(sid)
+        if mine is None or not len(mine):
+            return
+        rows = np.asarray(  # sync-ok: rejoin re-seed serialization (cold path)
+            self.recover(ids[mine]), dtype=self.dtype).reshape(
+                len(mine), -1)
+        try:
+            self.fleet.request(sid, "emb_load", self.key,
+                               (ids[mine], rows, self.fleet.epoch))
+        except _DEAD_ERRORS:
+            pass
+
+    @staticmethod
+    def _raise_failures(results, what):
+        for sid, r in results.items():
+            if isinstance(r, BaseException):
+                raise MXNetError("%s failed on embedding server %r: %s"
+                                 % (what, sid, r)) from r
+
+    # -- pull (read-through cache) ----------------------------------------
+    def pull(self, row_ids):
+        """Rows for ``row_ids`` (duplicates fine) as ONE device array of
+        shape ``ids.shape + row_shape``. Cache hits gather on device;
+        misses are fetched batched per owning server and inserted."""
+        import jax.numpy as jnp
+        from .. import telemetry
+
+        t0 = time.perf_counter()
+        ids = np.asarray(  # sync-ok: row ids are host metadata (control plane)
+            row_ids.asnumpy() if hasattr(row_ids, "asnumpy") else row_ids,  # sync-ok: row ids are host metadata (control plane)
+            dtype=np.int64)
+        flat = ids.ravel()
+        uids, inverse = np.unique(flat, return_inverse=True)
+        out = jnp.zeros((len(uids), self._dim), dtype=str(self.dtype))
+        if self.cache is not None:
+            hit_pos, hit_slots, miss_pos = self.cache.lookup(uids)
+            if len(hit_pos):
+                out = out.at[jnp.asarray(hit_pos)].set(
+                    self.cache.gather(hit_slots))
+        else:
+            miss_pos = np.arange(len(uids), dtype=np.int64)
+        if len(miss_pos):
+            fetched = self._fetch(uids[miss_pos])
+            out = out.at[jnp.asarray(miss_pos)].set(
+                jnp.asarray(fetched, dtype=out.dtype))
+            if self.cache is not None:
+                self.cache.insert(uids[miss_pos], fetched)
+        telemetry.record_embedding_pull(time.perf_counter() - t0)
+        return out[jnp.asarray(inverse)].reshape(
+            tuple(ids.shape) + self._row_shape)
+
+    def _fetch(self, miss_ids):
+        """Server fetch of one unique-id batch, with remap/heal rounds:
+        returns rows aligned to ``miss_ids``."""
+        from .. import telemetry
+
+        rows = np.zeros((len(miss_ids), self._dim), dtype=self.dtype)
+        filled = np.zeros(len(miss_ids), dtype=bool)
+        pending = np.arange(len(miss_ids), dtype=np.int64)
+        for _ in range(self._attempts):
+            if not len(pending):
+                break
+            routed = self.fleet.ring.route(miss_ids[pending])
+            results = self.fleet.scatter(
+                {sid: ("emb_pull", self.key,
+                       (miss_ids[pending][pos], self.fleet.epoch))
+                 for sid, pos in routed.items()})
+            retry = []
+            for sid, r in results.items():
+                if isinstance(r, BaseException):
+                    retry.extend(self._heal(sid, r,
+                                            miss_ids[pending]
+                                            [routed[sid]]))
+                    continue
+                found, vals, missing = r
+                if len(found):
+                    vals = np.asarray(vals,  # sync-ok: RPC reply rows are already host bytes
+                                      dtype=self.dtype).reshape(len(found),
+                                                                -1)
+                    telemetry.record_embedding_rpc("emb_pull", vals.nbytes)
+                    idx = {int(i): p for p, i in
+                           enumerate(miss_ids[pending])}
+                    for i, rid in enumerate(found):
+                        p = idx[int(rid)]
+                        rows[pending[p]] = vals[i]
+                        filled[pending[p]] = True
+                else:
+                    telemetry.record_embedding_rpc("emb_pull", 0)
+                if len(missing):
+                    retry.extend(self._reseed(sid, np.asarray(missing)))  # sync-ok: RPC reply ids are host metadata
+            pending = np.asarray(  # sync-ok: host position metadata
+                [p for p in range(len(miss_ids)) if not filled[p]],
+                dtype=np.int64)
+            if len(pending) and not retry:
+                # nothing healed this round — don't spin
+                break
+        if len(pending):
+            raise MXNetError(
+                "embedding pull could not resolve %d row(s) of table %r "
+                "(ids %s...) — rows lost with no recover source"
+                % (len(pending), self.key,
+                   miss_ids[pending][:4].tolist()))
+        return rows
+
+    # -- push (server-side optimizer + write-back) ------------------------
+    def push(self, row_ids, grad_rows):
+        """Apply gradient rows server-side. Duplicate ids are combined
+        (sum) on device first; one RPC per owning server; the reply's
+        updated row values write back into the hot cache."""
+        import jax
+        import jax.numpy as jnp
+        from .. import telemetry
+
+        ids = np.asarray(  # sync-ok: row ids are host metadata (control plane)
+            row_ids.asnumpy() if hasattr(row_ids, "asnumpy") else row_ids,  # sync-ok: row ids are host metadata (control plane)
+            dtype=np.int64).ravel()
+        vals = grad_rows.data if hasattr(grad_rows, "data") else grad_rows
+        vals = jnp.asarray(vals).reshape(len(ids), self._dim)
+        uids, inverse = np.unique(ids, return_inverse=True)
+        if len(uids) != len(ids):
+            vals = jax.ops.segment_sum(vals, jnp.asarray(inverse),
+                                       num_segments=len(uids))
+        grads = np.asarray(  # sync-ok: network serialization of grad rows
+            vals, dtype=np.float32)
+        self._touched.update(int(i) for i in uids)
+        pending = uids
+        pgrads = grads
+        for _ in range(self._attempts):
+            if not len(pending):
+                return self
+            routed = self.fleet.ring.route(pending)
+            results = self.fleet.scatter(
+                {sid: ("emb_push", self.key,
+                       (pending[pos], pgrads[pos], self.fleet.epoch))
+                 for sid, pos in routed.items()})
+            retry = []
+            for sid, r in results.items():
+                if isinstance(r, BaseException):
+                    retry.extend(self._heal(sid, r, pending[routed[sid]]))
+                    continue
+                kids, new_rows, missing = r
+                telemetry.record_embedding_rpc(
+                    "emb_push",
+                    int(pgrads[routed[sid]].nbytes))
+                if len(kids) and self.cache is not None:
+                    if new_rows is not None:
+                        self.cache.insert(kids, np.asarray(  # sync-ok: RPC reply rows are already host bytes (cache write-back)
+                            new_rows, dtype=self.dtype).reshape(
+                                len(kids), -1))
+                    else:
+                        self.cache.invalidate(kids)
+                if len(missing):
+                    retry.extend(self._reseed(sid, np.asarray(missing)))  # sync-ok: RPC reply ids are host metadata
+            if not retry:
+                return self
+            keep = {int(i) for i in retry}
+            sel = np.asarray([p for p, i in enumerate(pending)  # sync-ok: host position metadata
+                              if int(i) in keep], dtype=np.int64)
+            pending, pgrads = pending[sel], pgrads[sel]
+        raise MXNetError(
+            "embedding push could not apply %d row(s) of table %r after "
+            "%d heal rounds" % (len(pending), self.key, self._attempts))
+
+    # -- healing -----------------------------------------------------------
+    def _heal(self, sid, err, ids):
+        """Per-server failure triage. Returns the row ids to retry (the
+        next round re-routes them over the refreshed ring)."""
+        if isinstance(err, StaleWorkerError):
+            if _STALE_EPOCH in str(err):
+                # this worker's ring is behind the server's adopted
+                # reshard epoch: refresh and re-send
+                self.fleet.refresh()
+                return list(ids)
+            raise err  # fenced generation: a zombie must NOT self-heal
+        if isinstance(err, MXNetError) and _NO_TABLE in str(err):
+            self.ensure_table(sid)
+            return list(ids)
+        if isinstance(err, _DEAD_ERRORS):
+            self.fleet.mark_dead(sid)
+            return list(ids)
+        raise err
+
+    def _reseed(self, sid, missing):
+        """Rows the owning server does not hold (it inherited the hash
+        range in a reshard, or restarted from a stale snapshot): re-seed
+        them from the worker-side recover source via emb_load — which
+        also hands the server the current ring epoch to adopt — then
+        retry."""
+        if self._lazy is not None or self.recover is None:
+            # lazy tables materialize server-side; nothing to do here —
+            # and without a recover source the rows are truly lost
+            if self._lazy is not None:
+                return []
+            raise MXNetError(
+                "embedding server %r does not hold rows %s of table %r "
+                "and no recover source is attached (rows lost in a "
+                "reshard?)" % (sid, missing[:4].tolist(), self.key))
+        rows = np.asarray(  # sync-ok: recovery re-seed serialization
+            self.recover(missing), dtype=self.dtype).reshape(
+                len(missing), -1)
+        from .. import diagnostics
+
+        diagnostics.record_event("embedding_reseed", server=sid,
+                                 table=str(self.key), rows=len(missing))
+        try:
+            self.fleet.request(sid, "emb_load", self.key,
+                               (missing, rows, self.fleet.epoch))
+        except _DEAD_ERRORS:
+            self.fleet.mark_dead(sid)
+        return list(missing)
+
+    def rows_resident(self):
+        return len(self.cache) if self.cache is not None else 0
+
+    def close(self):
+        if self.cache is not None:
+            self.cache.close()
+        if self in self.fleet._tables:
+            self.fleet._tables.remove(self)
+
+
+class LocalEmbeddingServer:
+    """One in-process embedding server (tests, benches, single-host
+    rigs): the async transport + an EmbeddingStore + its registration
+    at the fleet coordinator."""
+
+    def __init__(self, index, host, port, server, store, member=None):
+        self.index = index
+        self.host = host
+        self.port = port
+        self.server = server
+        self.store = store
+        self.member = member
+
+    def register(self, coordinator, timeout=5.0):
+        """Announce this server in the coordinator's membership table —
+        the endpoint rides in the registration meta, which is what
+        fleet.refresh() builds the ring from. ``timeout`` bounds every
+        control RPC (including the deregister at close — a dead
+        coordinator must not park teardown for the full transport
+        deadline)."""
+        self.member = WorkerMembership(coordinator[0], coordinator[1],
+                                       _server_member_id(self.index),
+                                       timeout=timeout)
+        self.member.register(meta={
+            "embedding_server": True, "index": self.index,
+            "host": self.host, "port": self.port})
+        self.member.start_heartbeats()
+        return self
+
+    def kill(self):
+        """Ungraceful death: the socket goes away mid-conversation and
+        heartbeats silently stop (no deregistration) — exactly what a
+        SIGKILL looks like to the fleet."""
+        if self.member is not None:
+            self.member.stop(deregister=False)
+        self.server.close()
+
+    def close(self):
+        """Graceful leave (deregisters from the coordinator)."""
+        if self.member is not None:
+            self.member.stop(deregister=True)
+        self.server.close()
+
+
+def start_local_server(index, coordinator=None, snapshot_dir=None,
+                       timeout=5.0):
+    """Spin one embedding server on an ephemeral loopback port."""
+    from .. import async_server
+
+    srv = async_server.AsyncParamServer("127.0.0.1", 0)
+    port = srv._sock.getsockname()[1]
+    store = EmbeddingStore(snapshot_dir=snapshot_dir, server_id=index)
+    srv.attach_embedding(store)
+    handle = LocalEmbeddingServer(index, "127.0.0.1", port, srv, store)
+    if coordinator is not None:
+        handle.register(coordinator, timeout=timeout)
+    return handle
+
+
+def local_fleet(n, snapshot_dir=None, worker_id=0, vnodes=64,
+                timeout=None):
+    """An in-process fleet of ``n`` embedding servers with server 0's
+    membership table as the fleet coordinator. Returns
+    ``(fleet, handles)`` — close the handles when done, NON-coordinator
+    servers first (their graceful deregister needs server 0 alive)."""
+    if n < 1:
+        raise MXNetError("local_fleet needs at least one server")
+    reg_timeout = 5.0 if timeout is None else float(timeout)  # sync-ok: host config scalar
+    handles = [start_local_server(0, snapshot_dir=snapshot_dir)]
+    coord = (handles[0].host, handles[0].port)
+    handles[0].register(coord, timeout=reg_timeout)
+    for i in range(1, n):
+        handles.append(start_local_server(i, coordinator=coord,
+                                          snapshot_dir=snapshot_dir,
+                                          timeout=reg_timeout))
+    fleet = EmbeddingFleet(coordinator=coord, vnodes=vnodes,
+                           timeout=timeout)
+    fleet.refresh()
+    if worker_id is not None:
+        fleet.register_worker(worker_id)
+    return fleet, handles

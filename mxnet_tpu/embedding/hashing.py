@@ -1,0 +1,98 @@
+"""Consistent-hash placement for sharded embedding tables.
+
+``row_id -> virtual node -> live server``: every live embedding server
+owns ``vnodes`` points on a 64-bit ring; a row lands on the first vnode
+clockwise of its hash. The classic properties this buys the fleet
+(ref: ps-lite's key-range partitioner is the static ancestor; consistent
+hashing is its elastic replacement):
+
+- **stability** — adding/removing one server remaps only ~1/N of the
+  rows (the rest keep their owner, so their server-side optimizer state
+  stays put);
+- **balance** — vnodes smooth per-server load to within a few percent;
+- **determinism** — the mapping is a pure function of (sorted server
+  ids, row id), so every worker computes identical placement with no
+  coordination beyond the live-member view it hashes from.
+
+Hashes are ``blake2b`` (stable across processes and Python runs, unlike
+``hash()`` under PYTHONHASHSEED).
+"""
+from __future__ import annotations
+
+import bisect
+import hashlib
+import struct
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["HashRing", "stable_hash"]
+
+
+def stable_hash(data):
+    """64-bit process-stable hash of bytes/str/int."""
+    if isinstance(data, int):
+        data = struct.pack("!q", data)
+    elif isinstance(data, str):
+        data = data.encode("utf-8")
+    return struct.unpack(
+        "!Q", hashlib.blake2b(data, digest_size=8).digest())[0]
+
+
+class HashRing:
+    """A rebuild-in-place consistent-hash ring over live server ids."""
+
+    def __init__(self, vnodes=64):
+        if vnodes < 1:
+            raise MXNetError("HashRing needs at least 1 vnode per server")
+        self._vnodes = int(vnodes)
+        self._points = []   # sorted vnode hashes
+        self._owners = []   # parallel: server id owning each vnode
+        self._servers = ()
+        self.epoch = 0      # membership epoch the ring was built from
+
+    def rebuild(self, server_ids, epoch=None):
+        """Recompute the ring for the given live server set. Sorted input
+        makes the ring a pure function of the member set, so every
+        worker that sees the same membership view routes identically."""
+        servers = tuple(sorted(server_ids, key=str))
+        pts = []
+        for sid in servers:
+            for v in range(self._vnodes):
+                pts.append((stable_hash("%s#%d" % (sid, v)), sid))
+        pts.sort()
+        self._points = [h for h, _ in pts]
+        self._owners = [s for _, s in pts]
+        self._servers = servers
+        if epoch is not None:
+            self.epoch = int(epoch)
+        return self
+
+    @property
+    def servers(self):
+        return self._servers
+
+    def __len__(self):
+        return len(self._servers)
+
+    def owner(self, row_id):
+        """Server id owning one row."""
+        if not self._points:
+            raise MXNetError("hash ring is empty — no live embedding "
+                             "servers (rebuild from the membership view)")
+        i = bisect.bisect_right(self._points, stable_hash(int(row_id)))
+        return self._owners[i % len(self._owners)]
+
+    def route(self, row_ids):
+        """Batch placement: ``{server_id: positions}`` where positions
+        index into ``row_ids`` (host-side metadata — row routing is
+        control plane, never a device read). One entry per DESTINATION
+        server, so a caller issues at most one RPC per server
+        regardless of batch size."""
+        ids = np.asarray(row_ids, dtype=np.int64).ravel()  # sync-ok: row routing is host metadata (control plane)
+        out = {}
+        for pos, rid in enumerate(ids):
+            out.setdefault(self.owner(int(rid)), []).append(pos)
+        return {sid: np.asarray(p, dtype=np.int64)  # sync-ok: host position metadata
+                for sid, p in out.items()}

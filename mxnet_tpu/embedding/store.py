@@ -1,0 +1,395 @@
+"""Server-side sharded embedding table store.
+
+One :class:`EmbeddingStore` rides inside each async parameter server
+(async_server.py dispatches every ``emb_*`` op here): it holds the rows
+this server OWNS under the consistent-hash placement — never the full
+table — plus their per-row optimizer state, and applies sparse pushes
+with the real :class:`~..optimizer.Optimizer` object so server-side
+updates bit-match the local ``update_on_kvstore`` path (the lazy
+``sparse_sgd/adagrad/adam/ftrl_update`` kernels from sparse.py, with the
+table-level update count driving Adam's bias correction exactly like
+``Optimizer._update_count``).
+
+Fencing (the PR 3 design extended to row-granular sparse pushes):
+
+- frames reach :meth:`handle` only after the transport's membership
+  credential check, so a fenced zombie's delayed gradient rows are
+  refused with :class:`~..membership.StaleWorkerError` before any row
+  is touched;
+- every mutating frame additionally carries the sender's *ring epoch*
+  (the membership epoch its hash ring was built from). When a server
+  inherits rows during a reshard (``emb_load``) it adopts that epoch as
+  the table's minimum — a push stamped from before the reshard is
+  refused typed instead of applying a stale gradient to migrated rows
+  (the rendezvous-sequence adoption of ``_adopt_rendezvous_seqs``, for
+  rows).
+
+Durability: ``snapshot_dir`` makes the shard restartable — rows, state,
+update counts, adopted epochs and the optimizer all round-trip through
+one pickle under a CRC manifest (the membership snapshot idiom), so a
+killed server rejoins the fleet with its shard intact.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import zlib
+
+import numpy as np
+
+from ..base import MXNetError
+from ..membership import StaleWorkerError
+
+__all__ = ["EmbeddingStore"]
+
+_MUTATING_OPS = frozenset((
+    "emb_init", "emb_init_lazy", "emb_load", "emb_push",
+    "emb_set_optimizer"))
+
+
+def _lazy_row(seed, row_id, row_shape, scale, dtype):
+    """Deterministic on-demand row materialization: the full table never
+    exists anywhere — a row is a pure function of (seed, row_id), so any
+    server (or a rejoining one) regenerates identical cold rows."""
+    rng = np.random.RandomState((int(seed) * 1000003 + int(row_id))
+                                % (2 ** 32))
+    return rng.normal(0.0, scale, size=row_shape).astype(dtype)
+
+
+class _Table:
+    __slots__ = ("shape", "dtype", "rows", "state", "lazy", "min_epoch",
+                 "nleaves")
+
+    def __init__(self, shape, dtype, lazy=None):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+        self.rows = {}     # row_id -> np.ndarray(shape[1:])
+        self.state = {}    # row_id -> [np.ndarray(shape[1:]), ...]
+        self.lazy = lazy   # (seed, scale) or None
+        self.min_epoch = 0
+        self.nleaves = None  # optimizer state leaves per row (lazy probe)
+
+    @property
+    def row_shape(self):
+        return self.shape[1:]
+
+
+class EmbeddingStore:
+    """The rows one embedding server owns, plus their optimizer state."""
+
+    def __init__(self, snapshot_dir=None, server_id=None):
+        self._lock = threading.Lock()
+        self._tables = {}       # key -> _Table
+        self._optimizer = None
+        self._counts = {}       # key -> table-level update count (Adam t)
+        self.server_id = server_id
+        self.snapshot_dir = snapshot_dir
+        if snapshot_dir:
+            self._load_snapshot()
+
+    # -- dispatch ----------------------------------------------------------
+    def handle(self, op, key, payload):
+        """One ``emb_*`` request -> one reply tuple. The transport has
+        already validated the membership credential; epoch fencing for
+        mutations happens here."""
+        with self._lock:
+            if op == "emb_set_optimizer":
+                opt = pickle.loads(payload)
+                if not getattr(opt, "sparse_capable", False):
+                    raise MXNetError(
+                        "optimizer %s has no row_sparse update path; "
+                        "embedding servers need sgd/adam/adagrad/ftrl"
+                        % type(opt).__name__)
+                if getattr(opt, "multi_precision", False):
+                    raise MXNetError(
+                        "multi_precision optimizers are not supported "
+                        "server-side (row state is kept at table dtype)")
+                self._optimizer = opt
+                for t in self._tables.values():
+                    t.nleaves = None  # re-probe the state layout
+                return ("ok", None)
+            if op == "emb_init":
+                return self._init(key, payload)
+            if op == "emb_init_lazy":
+                return self._init_lazy(key, payload)
+            if op == "emb_load":
+                return self._load(key, payload)
+            if op == "emb_push":
+                return self._push(key, payload)
+            if op == "emb_pull":
+                return self._pull(key, payload)
+            if op == "emb_info":
+                return ("ok", self._info())
+            if op == "emb_snapshot":
+                return ("ok", self._save_snapshot())
+        raise MXNetError("unknown embedding op %r" % (op,))
+
+    # -- tables ------------------------------------------------------------
+    def _table(self, key, shape=None, dtype="float32", lazy=None):
+        t = self._tables.get(key)
+        if t is None:
+            if shape is None:
+                raise MXNetError(
+                    "embedding table %r does not exist on this server — "
+                    "init it first" % (key,))
+            t = self._tables[key] = _Table(shape, dtype, lazy=lazy)
+        return t
+
+    def _init(self, key, payload):
+        shape, dtype, ids, rows, epoch = payload
+        t = self._table(key, shape, dtype)
+        rows = np.asarray(rows, dtype=t.dtype)  # sync-ok: server-side shard storage is host memory by design
+        for i, rid in enumerate(np.asarray(ids, dtype=np.int64)):  # sync-ok: host id metadata
+            # first writer wins, like the dense server's 'init'
+            t.rows.setdefault(int(rid), np.array(rows[i]))
+        del epoch  # init may come from any epoch; fencing starts at load
+        return ("ok", len(t.rows))
+
+    def _init_lazy(self, key, payload):
+        shape, dtype, seed, scale, epoch = payload
+        del epoch
+        self._table(key, shape, dtype,
+                    lazy=(int(seed), float(scale)))  # sync-ok: host config scalars
+        return ("ok", None)
+
+    def _materialize(self, t, rid):
+        row = t.rows.get(rid)
+        if row is None and t.lazy is not None:
+            seed, scale = t.lazy
+            row = t.rows[rid] = _lazy_row(seed, rid, t.row_shape, scale,
+                                          t.dtype)
+        return row
+
+    def _check_epoch(self, t, key, epoch):
+        if int(epoch) < t.min_epoch:
+            raise StaleWorkerError(
+                "stale ring epoch %d for embedding table %r (server "
+                "adopted epoch %d when it inherited rows in a reshard) "
+                "— refresh the ring and re-send" %
+                (int(epoch), key, t.min_epoch))
+
+    def _load(self, key, payload):
+        """Force-install rows (reshard migration / operator restore).
+        Adopts the sender's ring epoch and update count, so gradients
+        delayed from before the reshard are fenced from here on."""
+        if len(payload) == 3:
+            (ids, rows, epoch), num_update = payload, None
+        else:
+            ids, rows, epoch, num_update = payload
+        t = self._tables.get(key)
+        if t is None:
+            raise MXNetError("emb_load before init for table %r" % (key,))
+        rows = np.asarray(rows, dtype=t.dtype)  # sync-ok: server-side shard storage is host memory by design
+        for i, rid in enumerate(np.asarray(ids, dtype=np.int64)):  # sync-ok: host id metadata
+            rid = int(rid)
+            t.rows[rid] = np.array(rows[i])
+            # migrated rows arrive without optimizer state: like a
+            # checkpoint resume without states, their slots restart cold
+            t.state.pop(rid, None)
+        t.min_epoch = max(t.min_epoch, int(epoch))
+        if num_update is not None:
+            self._counts[key] = max(self._counts.get(key, 0),
+                                    int(num_update))
+        return ("ok", len(t.rows))
+
+    # -- pull --------------------------------------------------------------
+    def _pull(self, key, payload):
+        ids, epoch = payload
+        del epoch  # reads are never fenced (matches dense pull)
+        t = self._tables.get(key)
+        if t is None:
+            return ("ok", (np.zeros((0,), np.int64), None,
+                           np.asarray(ids, dtype=np.int64)))  # sync-ok: host id metadata
+        found, rows, missing = [], [], []
+        for rid in np.asarray(ids, dtype=np.int64):  # sync-ok: host id metadata
+            rid = int(rid)
+            row = self._materialize(t, rid)
+            if row is None:
+                missing.append(rid)
+            else:
+                found.append(rid)
+                rows.append(row)
+        return ("ok", (np.asarray(found, dtype=np.int64),  # sync-ok: reply serialization (host bytes)
+                       np.stack(rows).astype(t.dtype) if rows else None,
+                       np.asarray(missing, dtype=np.int64)))  # sync-ok: reply serialization (host bytes)
+
+    # -- push --------------------------------------------------------------
+    def _push(self, key, payload):
+        """Apply one worker's gradient rows with the server-side sparse
+        optimizer. Reply carries the UPDATED row values (the client's
+        hot-row cache writes them back) plus any ids this server does
+        not own a row for (the client recovers those)."""
+        ids, grads, epoch = payload
+        t = self._tables.get(key)
+        if t is None:
+            raise MXNetError("emb_push before init for table %r" % (key,))
+        self._check_epoch(t, key, epoch)
+        ids = np.asarray(ids, dtype=np.int64)  # sync-ok: host id metadata
+        grads = np.asarray(grads)  # sync-ok: frame payload is already host bytes
+        known, missing = [], []
+        for pos, rid in enumerate(ids):
+            rid = int(rid)
+            if self._materialize(t, rid) is None:
+                missing.append(rid)
+            else:
+                known.append(pos)
+        if not known:
+            return ("ok", (np.zeros((0,), np.int64), None,
+                           np.asarray(missing, dtype=np.int64)))  # sync-ok: reply serialization (host bytes)
+        kpos = np.asarray(known, dtype=np.int64)  # sync-ok: host position metadata
+        kids = ids[kpos]
+        new_rows = self._apply(t, key, kids, grads[kpos])
+        for i, rid in enumerate(kids):
+            t.rows[int(rid)] = np.array(new_rows[i])
+        return ("ok", (kids, new_rows,
+                       np.asarray(missing, dtype=np.int64)))  # sync-ok: reply serialization (host bytes)
+
+    def _state_layout(self, t, key):
+        """Probe the optimizer's per-row state structure once per table
+        (None / single array / tuple — all leaves are row-shaped for the
+        sparse-capable optimizers)."""
+        if t.nleaves is not None:
+            return t.nleaves
+        if self._optimizer is None:
+            t.nleaves = 0
+            return 0
+        from ..ndarray.ndarray import NDArray
+        import jax.numpy as jnp
+
+        probe = self._optimizer.create_state(
+            key, NDArray(jnp.zeros((1,) + t.row_shape, t.dtype)))
+        if probe is None:
+            t.nleaves = 0
+        elif isinstance(probe, tuple):
+            t.nleaves = len(probe)
+        else:
+            t.nleaves = 1
+        return t.nleaves
+
+    def _apply(self, t, key, kids, grad_rows):
+        """Run the optimizer over COMPACT (k, *row) arrays: gather the
+        touched rows + their state, wrap as NDArrays, and drive the real
+        ``Optimizer.update_multi_precision`` with a row_sparse gradient
+        whose indices are ``arange(k)`` — identical arithmetic to the
+        local update_on_kvstore path applying the same rows out of the
+        full table, including the table-level Adam bias-correction
+        count."""
+        opt = self._optimizer
+        if opt is None:
+            # replace semantics, matching the dense server's no-updater
+            # push (CopyFromTo(merged, &local))
+            return np.asarray(grad_rows, dtype=t.dtype)  # sync-ok: frame payload is already host bytes
+        from ..ndarray.ndarray import NDArray
+        from ..sparse import RowSparseNDArray
+        import jax.numpy as jnp
+
+        k = len(kids)
+        cshape = (k,) + t.row_shape
+        w = NDArray(jnp.asarray(
+            np.stack([t.rows[int(r)] for r in kids]).astype(t.dtype)))
+        nleaves = self._state_layout(t, key)
+        leaves = []
+        for li in range(nleaves):
+            leaves.append(NDArray(jnp.asarray(np.stack(
+                [t.state[int(r)][li] if int(r) in t.state
+                 else np.zeros(t.row_shape, t.dtype) for r in kids]))))
+        state = None if nleaves == 0 else \
+            (leaves[0] if nleaves == 1 else tuple(leaves))
+        grad = RowSparseNDArray(
+            jnp.asarray(np.asarray(grad_rows, dtype=np.float32)),  # sync-ok: frame payload is already host bytes
+            jnp.arange(k, dtype=jnp.int64), cshape)
+        # resume the table-level update count (snapshot/load adoption)
+        prev = self._counts.get(key)
+        if prev is not None and \
+                opt._index_update_count.get(key, -1) < prev:
+            opt._index_update_count[key] = prev
+        opt.update_multi_precision(key, w, grad, state)
+        self._counts[key] = opt._index_update_count.get(key, 0)
+        new_rows = np.asarray(w.data).astype(t.dtype)  # sync-ok: server-side shard storage is host memory by design
+        if nleaves:
+            leaf_np = [np.asarray(l.data) for l in leaves]  # sync-ok: server-side shard storage is host memory by design
+            for i, rid in enumerate(kids):
+                t.state[int(rid)] = [np.array(l[i]) for l in leaf_np]
+        return new_rows
+
+    # -- views / durability ------------------------------------------------
+    def _info(self):
+        return {key: {"rows": len(t.rows), "shape": t.shape,
+                      "min_epoch": t.min_epoch, "lazy": t.lazy is not None,
+                      "num_update": self._counts.get(key, 0)}
+                for key, t in self._tables.items()}
+
+    def info(self):
+        with self._lock:
+            return self._info()
+
+    def rows_resident(self):
+        with self._lock:
+            return sum(len(t.rows) for t in self._tables.values())
+
+    def _snapshot_path(self):
+        name = "emb_shard_%s.pkl" % (self.server_id
+                                     if self.server_id is not None
+                                     else "srv")
+        return os.path.join(self.snapshot_dir, name)
+
+    def _save_snapshot(self):
+        """Persist the shard (rows + state + counts + epochs + the
+        optimizer) under a CRC manifest; returns the path (None without
+        a snapshot_dir)."""
+        if not self.snapshot_dir:
+            return None
+        payload = pickle.dumps({
+            "tables": {
+                key: {"shape": t.shape, "dtype": str(t.dtype),
+                      "rows": t.rows, "state": t.state, "lazy": t.lazy,
+                      "min_epoch": t.min_epoch}
+                for key, t in self._tables.items()},
+            "counts": dict(self._counts),
+            "optimizer": pickle.dumps(self._optimizer)
+            if self._optimizer is not None else None,
+        }, protocol=pickle.HIGHEST_PROTOCOL)
+        os.makedirs(self.snapshot_dir, exist_ok=True)
+        path = self._snapshot_path()
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(np.uint32(zlib.crc32(payload) & 0xFFFFFFFF).tobytes())
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        from .. import diagnostics
+
+        diagnostics.record_event("embedding_snapshot", server=self.server_id,
+                                 path=path,
+                                 rows=sum(len(t.rows)
+                                          for t in self._tables.values()))
+        return path
+
+    def save_snapshot(self):
+        with self._lock:
+            return self._save_snapshot()
+
+    def _load_snapshot(self):
+        path = self._snapshot_path()
+        if not os.path.exists(path):
+            return False
+        with open(path, "rb") as f:
+            crc = int(np.frombuffer(f.read(4), np.uint32)[0])
+            payload = f.read()
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            raise MXNetError(
+                "embedding shard snapshot %s failed CRC verification "
+                "(corrupt file)" % path)
+        data = pickle.loads(payload)
+        for key, td in data["tables"].items():
+            t = _Table(td["shape"], td["dtype"], lazy=td["lazy"])
+            t.rows = td["rows"]
+            t.state = td["state"]
+            t.min_epoch = td["min_epoch"]
+            self._tables[key] = t
+        self._counts = dict(data["counts"])
+        if data.get("optimizer") is not None:
+            self._optimizer = pickle.loads(data["optimizer"])
+        return True

@@ -65,15 +65,22 @@ class _FusedUpdate:
         if trainer._update_on_kvstore:
             return False
         kv = trainer._kvstore
-        if kv is not None and (kv.type.startswith("dist") or
-                               trainer._compression_params):
+        embedding_kv = kv is not None and kv.type == "dist_embedding"
+        if kv is not None and not embedding_kv and \
+                (kv.type.startswith("dist") or
+                 trainer._compression_params):
             return False
         if jax.process_count() > 1:
             return False
         for p in trainer._params:
             if p.grad_req == "null":
                 continue
-            if getattr(p, "_grad_stype", "default") != "default":
+            if getattr(p, "_grad_stype", "default") != "default" \
+                    and not embedding_kv:
+                # sparse grads are only safe to exclude from the fused
+                # program when the embedding kvstore owns them (the
+                # trainer routes them through _embedding_step); any
+                # other config must stay on the eager per-param path
                 return False
         return True
 
@@ -81,8 +88,12 @@ class _FusedUpdate:
         self._trainer = trainer
         o = trainer._optimizer
         self._opt = o
+        # sparse-grad params are kvstore-owned (dist_embedding routes
+        # them via _embedding_step); the fused program covers the rest
         self._indices = [i for i, p in enumerate(trainer._params)
-                         if p.grad_req != "null"]
+                         if p.grad_req != "null"
+                         and getattr(p, "_grad_stype",
+                                     "default") == "default"]
         self._upds = [self._param_update(o, i) for i in self._indices]
         self._hyper_cache = None  # host floats, cached between steps
         self._jit_guarded = None  # built on first guarded() call
@@ -505,7 +516,18 @@ class Trainer:
         elif has_sparse:
             # sparse grads are applied where the weight lives
             kvstore = kvs.create("local")
-        if kvstore is not None:
+        if kvstore is not None and kvstore.type == "dist_embedding":
+            # hybrid ownership: row_sparse tables update on the sharded
+            # embedding fleet (server-side sparse optimizer), dense
+            # parameters stay on the local — fused — update path
+            if update_on_kvstore is False:
+                raise ValueError(
+                    "update_on_kvstore=False is not supported with "
+                    "kvstore='dist_embedding': sparse tables update on "
+                    "the embedding servers by design")
+            kvstore.set_optimizer(self._optimizer)
+            update_on_kvstore = False
+        elif kvstore is not None:
             if has_sparse:
                 # ref: trainer.py — sparse gradients force
                 # update_on_kvstore=True (row_sparse rows are updated on
@@ -529,6 +551,11 @@ class Trainer:
         self._update_on_kvstore = update_on_kvstore
         self._kv_initialized = True
 
+    @property
+    def _embedding_kv(self):
+        return self._kvstore is not None \
+            and self._kvstore.type == "dist_embedding"
+
     def _init_params(self):
         """Lazily register params whose deferred init has completed."""
         if not self._kv_initialized:
@@ -537,10 +564,16 @@ class Trainer:
             self._params_to_init = []
             return
         remaining = []
+        emb = self._embedding_kv
         for param in self._params_to_init:
             if param._deferred_init is not None or param._data is None:
                 remaining.append(param)
             else:
+                if emb and getattr(param, "_grad_stype",
+                                   "default") != "row_sparse":
+                    # dist_embedding registers ONLY the sparse tables;
+                    # dense params never ship to the fleet
+                    continue
                 idx = self._param2idx[param.name]
                 self._kvstore.init(idx, param.data())
         self._params_to_init = remaining
@@ -589,18 +622,45 @@ class Trainer:
             self._fused = _FusedUpdate(self) if _FusedUpdate.eligible(self) \
                 else False
         from .. import resilience
+        emb = self._embedding_kv
         if resilience.skip_nonfinite_enabled():
-            if self._fused and self._fused.guarded(rescale_grad):
+            # the embedding push is not gated by a deferred flag (rows
+            # apply server-side the moment they arrive), so with an
+            # embedding kvstore the guard must decide SYNCHRONOUSLY
+            # before any row ships
+            if not emb and self._fused and self._fused.guarded(
+                    rescale_grad):
                 return  # guard + update in one launch, flag deferred
             if self._fused:
                 self._fused.flush_guarded()
             if self._grads_overflowed():
                 resilience.record_skipped_step()
                 return
+        if emb:
+            # sparse tables: gradient rows to the fleet (server-side
+            # sparse optimizer), then a row pull of exactly the touched
+            # rows back into the dense mirror — through the hot cache,
+            # which the push's write-back just refreshed
+            self._embedding_step()
         if self._fused and self._fused(rescale_grad):
             return  # one donated launch covered reduce (identity) + update
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+
+    def _embedding_step(self):
+        """Route every row_sparse parameter through the sharded
+        embedding fleet: push gradient rows, pull the updated rows back
+        into the parameter's dense buffer (the device-resident working
+        set — untouched rows keep their values, the lazy-update
+        contract)."""
+        kv = self._kvstore
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or \
+                    getattr(param, "_grad_stype", "default") != "row_sparse":
+                continue
+            grad = param.grad()  # RowSparseNDArray at the boundary
+            kv.push(i, grad)
+            kv.row_sparse_pull(i, out=param.data(), row_ids=grad.indices)
 
     def _grads_overflowed(self):
         """True if any live gradient is non-finite — one fused device
@@ -614,7 +674,8 @@ class Trainer:
         return bool(grads) and not resilience.all_finite(grads)
 
     def _check_and_rescale_grad(self, scale):
-        if self._update_on_kvstore and self._kv_initialized and \
+        if self._kv_initialized and \
+                (self._update_on_kvstore or self._embedding_kv) and \
                 self._optimizer.rescale_grad != scale:
             raise UserWarning(
                 "Possible change in the `batch_size` from previous `step` "
@@ -638,6 +699,11 @@ class Trainer:
 
     def _allreduce_grads(self):
         if self._kvstore is None:
+            return
+        if self._embedding_kv:
+            # sparse params already flowed through _embedding_step;
+            # dense grads stay local (single-process data path — the
+            # fleet holds tables, not a gradient-reduction plane)
             return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
@@ -669,9 +735,13 @@ class Trainer:
         if self._update_on_kvstore:
             return  # weights already updated server-side in _allreduce_grads
         updater = self._updaters[0]
+        emb = self._embedding_kv
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
+            if emb and getattr(param, "_grad_stype",
+                               "default") == "row_sparse":
+                continue  # applied server-side by _embedding_step
             if param._data is None:
                 if not ignore_stale_grad:
                     raise MXNetError(

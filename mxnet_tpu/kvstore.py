@@ -22,6 +22,14 @@ buffers and the optional server-side updater. Types:
   XLA collectives (collectives ARE barriers), hence the server. Without
   the launcher it falls back to synchronous semantics with a warning.
 
+- ``dist_embedding``: the sharded sparse embedding fleet (embedding/) —
+  every registered key is a ``row_sparse`` table consistent-hash-sharded
+  across embedding servers; push sends only gradient rows (applied with
+  the SERVER-side sparse optimizer), ``row_sparse_pull`` returns only
+  requested rows through the hot-row device cache. Dense parameters
+  never route here — gluon.Trainer keeps them on the local (fused)
+  update path.
+
 ``set_optimizer`` installs an Updater so ``push`` applies updates
 server-side (update_on_kvstore=True path), exactly like
 KVStoreDistServer::ApplyUpdates.
@@ -81,7 +89,7 @@ class GradientCompression:
     def __init__(self, threshold=0.5):
         if threshold <= 0:
             raise MXNetError("compression threshold must be positive")
-        self.threshold = float(threshold)
+        self.threshold = float(threshold)  # sync-ok: host config scalar
         self.residual = {}
 
     def compress(self, key, grad):
@@ -130,8 +138,18 @@ class KVStore:
         # cannot take the first-push-initializes branch and install a
         # raw gradient as the weight. One numpy copy per key.
         self._shadow = {}
+        # sharded embedding fleet state (kv_type == "dist_embedding"):
+        # row_sparse tables live sharded across embedding servers
+        # (embedding/), dense keys keep local semantics so the fused
+        # dense step stays intact
+        self._emb_fleet = None
+        self._emb_tables = {}    # key -> embedding.ShardedEmbedding
+        self._emb_mirror = {}    # key -> dense NDArray (recover source)
+        self._emb_handles = []   # in-process fleet servers we own
         if kv_type == "dist_async":
             self._maybe_start_async()
+        elif kv_type == "dist_embedding":
+            self._maybe_start_embedding()
         elif kv_type.startswith("dist"):
             self._maybe_start_elastic()
 
@@ -289,7 +307,7 @@ class KVStore:
 
         from . import async_server, config
 
-        deadline = time.monotonic() + float(config.get("MXT_KV_DEADLINE"))
+        deadline = time.monotonic() + float(config.get("MXT_KV_DEADLINE"))  # sync-ok: host config scalar
         probe = async_server.AsyncClient(host, port)
         try:
             while probe.request("world") < world:
@@ -301,6 +319,59 @@ class KVStore:
                 time.sleep(0.01)
         finally:
             probe.close()
+
+    def _maybe_start_embedding(self):
+        """Connect to (or spin) the sharded embedding server fleet.
+        ``MXT_EMBEDDING_SERVERS`` names a running fleet; without it an
+        in-process fleet of ``MXT_EMBEDDING_LOCAL_SERVERS`` servers
+        starts here (single-host rigs, tests, benches). The worker
+        registers with every server for PR 3 fencing credentials —
+        sparse row pushes ride the same (worker_id, generation)
+        tokens as dense frames."""
+        from . import config, embedding
+
+        spec = config.get("MXT_EMBEDDING_SERVERS")
+        if spec:
+            self._emb_fleet = embedding.EmbeddingFleet.from_spec(spec)
+            self._emb_fleet.refresh()
+            self._emb_fleet.register_worker(self._worker_id())
+        else:
+            self._emb_fleet, self._emb_handles = embedding.local_fleet(
+                int(config.get("MXT_EMBEDDING_LOCAL_SERVERS")),
+                snapshot_dir=config.get("MXT_EMBEDDING_SNAPSHOT_DIR"),
+                worker_id=self._worker_id())
+
+    def is_embedding_key(self, key):
+        return _key_str(key) in self._emb_tables
+
+    def _emb_recover(self, key):
+        """Worker-side row source for reshard re-seeding: the dense
+        mirror (the gluon parameter buffer for trainer-managed tables —
+        row-current because every push is followed by a row pull into
+        it)."""
+        def recover(ids):
+            mirror = self._emb_mirror.get(key)
+            if mirror is None:
+                return None
+            import numpy as np
+
+            return np.asarray(mirror.data[ids])  # sync-ok: reshard re-seed (cold path)
+        return recover
+
+    def close(self):
+        """Tear down owned embedding-fleet resources (no-op for other
+        kvstore types)."""
+        for t in list(self._emb_tables.values()):
+            t.close()
+        self._emb_tables.clear()
+        if self._emb_fleet is not None:
+            self._emb_fleet.close()
+            self._emb_fleet = None
+        # reverse: server 0 is the fleet coordinator — closing it first
+        # would strand every other server's graceful deregister
+        for h in reversed(self._emb_handles):
+            h.close()
+        self._emb_handles = []
 
     def _maybe_start_elastic(self):
         """Opt-in elastic membership for the sync dist types
@@ -359,6 +430,27 @@ class KVStore:
     # -- core API ----------------------------------------------------------
     def init(self, key, value):
         keys, values = self._flatten(key, value)
+        if self._emb_fleet is not None:
+            # dist_embedding: every registered key is a sharded table —
+            # initial rows scatter to their owning servers (one RPC per
+            # server); the init value doubles as the dense mirror that
+            # reshard re-seeding recovers rows from
+            from . import embedding
+            from .sparse import BaseSparseNDArray
+
+            with telemetry.trace_scope():
+                for k, v in zip(keys, values):
+                    if k in self._emb_tables:
+                        continue
+                    tbl = embedding.ShardedEmbedding(
+                        self._emb_fleet, k, v.shape, dtype=v.dtype,
+                        recover=self._emb_recover(k))
+                    tbl.init(v)
+                    self._emb_tables[k] = tbl
+                    if isinstance(v, NDArray) and \
+                            not isinstance(v, BaseSparseNDArray):
+                        self._emb_mirror[k] = v
+            return
         if self._async is not None:
             import numpy as np
 
@@ -366,8 +458,9 @@ class KVStore:
             # key's RPC is a span of it (telemetry.record_rpc both ends)
             with telemetry.trace_scope():
                 for k, v in zip(keys, values):
-                    arr = v.asnumpy() if hasattr(v, "asnumpy") \
-                        else np.asarray(v)
+                    arr = (v.asnumpy()  # sync-ok: network serialization (async push frame)
+                           if hasattr(v, "asnumpy")
+                           else np.asarray(v))  # sync-ok: network serialization (async push frame)
                     self._async.request("init", k, arr)  # first writer wins
                     self._shadow[k] = arr
             return
@@ -399,12 +492,22 @@ class KVStore:
             for v in vals[1:]:
                 total = rsp_add(total, v)
             return total
-        total = vals[0].asnumpy() if isinstance(vals[0], RowSparseNDArray) \
-            else vals[0].data
-        for v in vals[1:]:
-            total = total + (v.asnumpy() if isinstance(v, RowSparseNDArray)
-                             else v.data)
-        return NDArray(total)
+        # mixed dense/row_sparse: reduce ON DEVICE — dense values sum
+        # directly; each row_sparse contribution scatter-adds its rows
+        # over the index union (ref: comm.h rsp reduce). The old path
+        # densified via per-value asnumpy(), a host round-trip per
+        # pushed value on the hot push path.
+        dense = None
+        sparse_vals = []
+        for v in vals:
+            if isinstance(v, RowSparseNDArray):
+                sparse_vals.append(v)
+            else:
+                dense = v.data if dense is None else dense + v.data
+        for v in sparse_vals:
+            dense = dense.at[v._indices].add(
+                v._values.astype(dense.dtype))
+        return NDArray(dense)
 
     def _dist_reduce(self, merged, key=None):
         """Cross-process gradient sum for dist types. With one process this
@@ -437,17 +540,40 @@ class KVStore:
         if isinstance(merged, BaseSparseNDArray):
             # elastic rounds sum densely (per-worker index sets cannot
             # align when the member set changes mid-round)
-            arr = merged.asnumpy()
+            arr = merged.asnumpy()  # sync-ok: elastic rounds reduce densely host-side (documented above)
         else:
-            arr = np.asarray(merged.data)
+            arr = np.asarray(merged.data)  # sync-ok: network serialization (elastic reduce frame)
         total, contributors = self._member.reduce(key, seq, arr)
         if len(contributors) < self.num_workers:
-            total = total * (float(self.num_workers) / len(contributors))
+            total = total * (float(self.num_workers) / len(contributors))  # sync-ok: host scalar renormalization
         return NDArray(total)
 
     def push(self, key, value, priority=0):
         del priority  # XLA async dispatch owns scheduling
         keys, values = self._flatten(key, value)
+        if self._emb_fleet is not None:
+            # sparse row push: only gradient rows + ids travel, batched
+            # per destination server; the server applies the sparse
+            # optimizer and replies with the updated rows (hot-cache
+            # write-back) — ref: KVStoreDistServer sparse DataHandleEx
+            from .sparse import RowSparseNDArray
+
+            with telemetry.trace_scope():
+                for k, v in zip(keys, values):
+                    tbl = self._emb_tables.get(k)
+                    if tbl is None:
+                        raise MXNetError(
+                            "embedding key %s has not been initialized"
+                            % (k,))
+                    merged = self._merge(v)
+                    if isinstance(merged, RowSparseNDArray):
+                        tbl.push(merged._indices, merged._values)
+                    else:
+                        # dense push into a sharded table: every row
+                        import numpy as np
+
+                        tbl.push(np.arange(tbl.shape[0]), merged.data)
+            return
         if self._async is not None:
             # hogwild: this worker's contribution goes straight to the
             # server (which applies it immediately) — no collective, no
@@ -456,7 +582,7 @@ class KVStore:
                 for k, v in zip(keys, values):
                     merged = self._merge(v)
                     merged = self._maybe_compress(k, merged)
-                    arr = merged.asnumpy()
+                    arr = merged.asnumpy()  # sync-ok: network serialization (async push frame)
                     self._async.request("push", k, arr)
                     if self._updater is None:
                         # no server-side optimizer: the push IS the new
@@ -520,6 +646,11 @@ class KVStore:
 
         keys, outs = self._flatten(key, out)
         for k, o in zip(keys, outs):
+            if k in self._emb_tables:
+                raise MXNetError(
+                    "key %s is a sharded embedding table — a full-table "
+                    "pull would materialize every row on this worker; "
+                    "use row_sparse_pull with the batch's row ids" % (k,))
             targets = o if isinstance(o, (list, tuple)) else [o]
             if ignore_sparse:
                 live = [oo for oo in targets
@@ -556,12 +687,46 @@ class KVStore:
         from .sparse import retain_rows
 
         for k, o, r in zip(keys, outs, rids):
+            tbl = self._emb_tables.get(k)
+            if tbl is not None:
+                self._emb_row_pull(k, tbl, o, r)
+                continue
             retain_rows(self._fetch(k), r, out=o)
+
+    def _emb_row_pull(self, key, tbl, out, row_ids):
+        """PullRowSparse against the sharded fleet, through the hot-row
+        cache. A dense ``out`` receives ONLY the requested rows (a
+        device scatter — untouched rows keep their values, the lazy-
+        update contract) and becomes the table's dense mirror; a
+        row_sparse ``out`` receives the retained rows."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        from .sparse import RowSparseNDArray
+
+        ids = np.unique(np.asarray(  # sync-ok: row ids are host metadata
+            row_ids.asnumpy() if hasattr(row_ids, "asnumpy") else row_ids  # sync-ok: row ids are host metadata (control plane)
+        ).astype(np.int64))
+        rows = tbl.pull(ids)  # (n, *row_shape) on device
+        if isinstance(out, RowSparseNDArray):
+            RowSparseNDArray(rows, jnp.asarray(ids),
+                             tbl.shape).copyto(out)
+            return
+        data = out.data
+        out._set_data(data.at[jnp.asarray(ids)].set(
+            rows.astype(data.dtype)))
+        self._emb_mirror[key] = out
 
     # -- optimizer plumbing ------------------------------------------------
     def set_optimizer(self, optimizer):
         """Install a server-side optimizer (ref: kvstore.py —
         set_optimizer; the reference pickles it to the servers)."""
+        if self._emb_fleet is not None:
+            # ship to every embedding server: sparse row pushes apply
+            # THERE (sparse_sgd/adagrad/adam/ftrl_update over the shard)
+            self._optimizer = optimizer
+            self._emb_fleet.set_optimizer(optimizer)
+            return
         # round-trip through pickle like the reference, so state must be
         # serializable (catches the same bugs the reference would)
         self._optimizer = pickle.loads(pickle.dumps(optimizer))
@@ -594,7 +759,7 @@ class KVStore:
             raise MXNetError(
                 "gradient compression requires a dist kvstore (ref: "
                 "kvstore_dist only; local comm is in-process)")
-        threshold = float(params.pop("threshold", 0.5))
+        threshold = float(params.pop("threshold", 0.5))  # sync-ok: host config scalar
         if params:
             raise MXNetError("unknown compression params %s"
                              % sorted(params))
@@ -666,19 +831,20 @@ class KVStore:
         # becomes a typed error instead of a worker wedged forever
         t = threading.Thread(target=run, daemon=True, name="kv-barrier")
         t.start()
-        t.join(float(deadline))
+        t.join(float(deadline))  # sync-ok: host config scalar
         if t.is_alive():
             raise KVStoreError(
                 "kvstore barrier %r exceeded its %.1fs deadline "
                 "(MXT_BARRIER_TIMEOUT/MXT_KV_DEADLINE) — a peer is "
                 "unreachable and will never arrive" % (tag,
-                                                       float(deadline)))
+                                                       float(deadline)))  # sync-ok: host config scalar
         if "err" in box:
             raise box["err"]
 
 
 _KV_TYPES = ("local", "device", "nccl", "dist", "dist_sync", "dist_async",
-             "dist_device_sync", "dist_sync_device", "horovod")
+             "dist_device_sync", "dist_sync_device", "dist_embedding",
+             "horovod")
 
 
 _warned_async = False

@@ -55,12 +55,34 @@ class KVStoreServer:
 
     def run(self):
         """Serve until close(): binds the membership/async server (store
-        ops + register/heartbeat/barrier/reduce) on the coordinator's
-        async port and parks this thread."""
-        from . import async_server
+        ops + register/heartbeat/barrier/reduce + the sharded embedding
+        table ops) on the coordinator's async port and parks this
+        thread. ``MXT_EMBEDDING_SNAPSHOT_DIR`` makes the embedding
+        shard durable across restarts; ``MXT_EMBEDDING_SERVER_ID`` (+
+        optionally ``MXT_EMBEDDING_COORDINATOR=host:port``) registers
+        this process in the fleet's membership table so client rings
+        discover it."""
+        from . import async_server, embedding
 
         host, port = self._addr
         self._server = async_server.get_server(host, port)
+        sid = os.environ.get("MXT_EMBEDDING_SERVER_ID")
+        store = embedding.EmbeddingStore(
+            snapshot_dir=os.environ.get("MXT_EMBEDDING_SNAPSHOT_DIR"),
+            server_id=int(sid) if sid is not None else None)
+        self._server.attach_embedding(store)
+        self._emb_member = None
+        if sid is not None:
+            handle = embedding.LocalEmbeddingServer(
+                int(sid), host, port, self._server, store)
+            coord = os.environ.get("MXT_EMBEDDING_COORDINATOR")
+            if coord and ":" in coord:
+                chost, _, cport = coord.rpartition(":")
+                handle.register((chost, int(cport)))
+            else:
+                # coordinator-less fleet: this server IS the registry
+                handle.register((host, port))
+            self._emb_member = handle.member
         print("KVSTORE_SERVER_READY %s:%d" % (host, port), flush=True)
         try:
             while not self._server._stop.is_set() \
@@ -69,6 +91,8 @@ class KVStoreServer:
         except KeyboardInterrupt:
             pass
         finally:
+            if self._emb_member is not None:
+                self._emb_member.stop(deregister=True)
             self._server.close()
 
     def close(self):

@@ -90,15 +90,19 @@ def lost_worker_count():
 
 
 class MemberInfo:
-    """One registered worker: its fencing generation and last heartbeat."""
+    """One registered worker: its fencing generation, last heartbeat,
+    and optional registration metadata (embedding servers announce
+    their serving endpoint here so clients can rebuild the consistent-
+    hash ring from the membership view alone)."""
 
-    __slots__ = ("worker_id", "generation", "last_beat", "alive")
+    __slots__ = ("worker_id", "generation", "last_beat", "alive", "meta")
 
-    def __init__(self, worker_id, generation, now):
+    def __init__(self, worker_id, generation, now, meta=None):
         self.worker_id = worker_id
         self.generation = generation
         self.last_beat = now
         self.alive = True
+        self.meta = meta
 
 
 class MembershipTable:
@@ -125,18 +129,21 @@ class MembershipTable:
         self._death_listeners = []  # fn(worker_ids) on reap (see below)
 
     # -- registration ------------------------------------------------------
-    def register(self, worker_id, now=None):
+    def register(self, worker_id, now=None, meta=None):
         """Admit (or re-admit) a worker. Returns ``(generation, epoch,
         rejoin)`` — ``rejoin`` is True when this worker_id was known
         before (crashed/fenced/restarted), which entitles it to a state
         snapshot. The previous generation, if any, is fenced by the
-        replacement."""
+        replacement. ``meta`` (a small picklable dict — e.g. an
+        embedding server's serving endpoint) is carried in the member
+        view."""
         now = time.monotonic() if now is None else now
         with self._cond:
             rejoin = worker_id in self._members
             gen = self._next_gen
             self._next_gen += 1
-            self._members[worker_id] = MemberInfo(worker_id, gen, now)
+            self._members[worker_id] = MemberInfo(worker_id, gen, now,
+                                                  meta=meta)
             self._epoch += 1
             epoch = self._epoch
             live = len(self._live_ids_locked())
@@ -286,6 +293,8 @@ class MembershipTable:
                             for w, m in self._members.items() if m.alive},
                 "dead": {w: m.generation
                          for w, m in self._members.items() if not m.alive},
+                "meta": {w: m.meta for w, m in self._members.items()
+                         if m.alive and m.meta is not None},
                 "lost_total": self._lost_total,
             }
 
@@ -466,6 +475,7 @@ class WorkerMembership:
 
         self.worker_id = int(worker_id)
         self.generation = None
+        self._meta = None
         self.epoch = 0
         self.lost_total = 0
         self.snapshot = None
@@ -491,12 +501,16 @@ class WorkerMembership:
         return self._rdv
 
     # -- registration / rejoin --------------------------------------------
-    def register(self, want_snapshot=False):
+    def register(self, want_snapshot=False, meta=None):
         """Register (or rejoin). Fences any previous incarnation of this
         worker_id; on rejoin the server hands back a CRC-verified full
-        parameter snapshot so the worker can resync before pushing."""
-        status = self._ctl.request(
-            "register", None, (self.worker_id, bool(want_snapshot)))
+        parameter snapshot so the worker can resync before pushing.
+        ``meta`` is published in the member view (embedding servers
+        announce their serving endpoint through it)."""
+        self._meta = meta
+        payload = (self.worker_id, bool(want_snapshot)) if meta is None \
+            else (self.worker_id, bool(want_snapshot), meta)
+        status = self._ctl.request("register", None, payload)
         gen, epoch, snap = status
         self.generation = gen
         self.epoch = epoch
@@ -508,7 +522,8 @@ class WorkerMembership:
         """Rejoin after a fencing or server restart: fresh generation,
         current epoch, full snapshot; restarts heartbeats if the sender
         stopped."""
-        self.register(want_snapshot=True)
+        self.register(want_snapshot=True,
+                      meta=getattr(self, "_meta", None))
         if self._thread is not None and not self._thread.is_alive() \
                 and not self._stop.is_set():
             self._thread = None

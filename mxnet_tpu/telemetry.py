@@ -854,6 +854,43 @@ def record_rpc(side, op, seconds=None, nbytes=None, status="ok",
         _dispatch_row(dict(entry, kind="rpc_span"))
 
 
+_emb_rpcs = None
+_emb_bytes = None
+_emb_pull_hist = None
+
+
+def record_embedding_rpc(op, nbytes=0):
+    """One sharded-embedding data RPC (embedding/client.py): per-op
+    totals plus row-payload bytes split by direction — the numerator of
+    the ``embedding_bytes_per_sec`` bench metric."""
+    global _emb_rpcs, _emb_bytes
+    if _emb_rpcs is None:
+        _emb_rpcs = counter(
+            "mxt_embedding_rpcs_total",
+            "Sharded-embedding data RPCs by op (one per destination "
+            "server per batched push/pull).", ("op",))
+        _emb_bytes = counter(
+            "mxt_embedding_bytes_total",
+            "Embedding row bytes moved over the fleet transport.",
+            ("dir",))
+    _emb_rpcs.labels(str(op)).inc()
+    if nbytes:
+        _emb_bytes.labels("push" if op == "emb_push" else "pull").inc(
+            int(nbytes))
+
+
+def record_embedding_pull(seconds):
+    """End-to-end latency of one ShardedEmbedding.pull (cache hits and
+    server fetches included) — mxt_top's embedding p50/p99 source."""
+    global _emb_pull_hist
+    if _emb_pull_hist is None:
+        _emb_pull_hist = histogram(
+            "mxt_embedding_pull_seconds",
+            "ShardedEmbedding.pull latency (device cache + fleet "
+            "fetch).")
+    _emb_pull_hist.observe(seconds)
+
+
 def rpc_spans():
     """The bounded in-memory RPC span log (newest last) — what the
     trace-propagation test and mxt_top's JSONL mode read."""

@@ -25,6 +25,10 @@ EXAMPLES = {
     "examples/wide_deep_ctr.py": [
         "--iters", "4", "--batch-size", "32", "--wide-vocab", "500",
         "--deep-vocab", "200"],
+    "examples/train_wide_deep.py": [
+        "--iters", "2", "--batch-size", "16", "--wide-vocab", "300",
+        "--deep-vocab", "100", "--embedding-servers", "2",
+        "--cache-rows", "32"],
     "examples/gpt_lm_pretrain.py": [
         "--iters", "2", "--batch-size", "8", "--seq-len", "16",
         "--tp", "2"],
@@ -43,7 +47,17 @@ EXAMPLES = {
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.mark.parametrize("script", sorted(EXAMPLES))
+# train_wide_deep spins a 2-server embedding fleet and compiles a second
+# WideDeep train graph — tier-1's budget is dot-count-bound, and the
+# dist_embedding path already runs end-to-end in tests/test_embedding.py,
+# so the example smoke rides the slow tier
+_SLOW_EXAMPLES = {"examples/train_wide_deep.py"}
+
+
+@pytest.mark.parametrize(
+    "script",
+    [pytest.param(s, marks=pytest.mark.slow) if s in _SLOW_EXAMPLES
+     else s for s in sorted(EXAMPLES)])
 def test_example_runs(script, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)  # scratch data dirs land here
     monkeypatch.setattr(sys, "argv", [script] + list(EXAMPLES[script]))

@@ -169,6 +169,66 @@ def test_sparse_adagrad_and_ftrl_update_touched_only():
         assert not np.allclose(wn[[2, 5]], w0[[2, 5]])
 
 
+def _multi_step_touched_parity(name, nsteps=3, atol=1e-7, **hp):
+    """Drive the server-side sparse update fns (sparse_adagrad_update /
+    sparse_ftrl_update via Optimizer.update's stype dispatch) against
+    the dense optimizer fed the zero-padded dense gradient: touched
+    rows must bit-match the dense arithmetic, untouched rows (weight
+    AND state) must be exactly unchanged — the lazy-update contract the
+    embedding servers rely on."""
+    shape = (10, 4)
+    touched = np.array([1, 4, 6, 9])
+    untouched = [0, 2, 3, 5, 7, 8]
+    rng = np.random.RandomState(7)
+    w0 = rng.randn(*shape).astype("f4")
+    opt_s = mx.optimizer.create(name, **hp)
+    opt_d = mx.optimizer.create(name, **hp)
+    w_s, w_d = nd.array(w0.copy()), nd.array(w0.copy())
+    st_s = opt_s.create_state(0, w_s)
+    st_d = opt_d.create_state(0, w_d)
+
+    def leaves(st):
+        return st if isinstance(st, tuple) else (st,)
+
+    st0 = [l.asnumpy() for l in leaves(st_s)]
+    for _ in range(nsteps):
+        gvals = rng.randn(len(touched), shape[1]).astype("f4")
+        gd = np.zeros(shape, "f4")
+        gd[touched] = gvals
+        opt_s.update(0, w_s,
+                     sparse.row_sparse_array((gvals, touched),
+                                             shape=shape), st_s)
+        opt_d.update(0, w_d, nd.array(gd), st_d)
+    ws, wd_ = w_s.asnumpy(), w_d.asnumpy()
+    # touched rows: identical arithmetic to the dense kernel
+    np.testing.assert_allclose(ws[touched], wd_[touched],
+                               rtol=0, atol=atol)
+    # untouched rows: weight AND optimizer state untouched (no wd
+    # decay, no history drift — ref lazy_update semantics)
+    np.testing.assert_array_equal(ws[untouched], w0[untouched])
+    for l0, l in zip(st0, leaves(st_s)):
+        np.testing.assert_array_equal(l.asnumpy()[untouched],
+                                      l0[untouched])
+    for l_s, l_d in zip(leaves(st_s), leaves(st_d)):
+        np.testing.assert_allclose(l_s.asnumpy()[touched],
+                                   l_d.asnumpy()[touched],
+                                   rtol=0, atol=atol)
+
+
+def test_sparse_adagrad_update_parity_vs_dense_rows():
+    _multi_step_touched_parity("adagrad", learning_rate=0.2, wd=0.01,
+                               rescale_grad=0.5, clip_gradient=0.4)
+
+
+def test_sparse_ftrl_update_parity_vs_dense_rows():
+    # ftrl recomputes w from (z, n) wholesale; the dense kernel and the
+    # sparse path order the float32 ops differently, so parity is
+    # ulp-level, not bit-level
+    _multi_step_touched_parity("ftrl", learning_rate=0.2, wd=0.01,
+                               rescale_grad=0.5, clip_gradient=0.4,
+                               atol=1e-5)
+
+
 # ---------------------------------------------------------------------------
 # kvstore row_sparse
 # ---------------------------------------------------------------------------

@@ -59,5 +59,27 @@ for seed in "${SEEDS[@]}"; do
     fi
 done
 
+# -- embedding fleet sweep --------------------------------------------------
+# embedding_server_kill: the chaos-marked cells in tests/test_embedding.py
+# kill one embedding server mid-train (consistent-hash remap to the
+# survivors, worker-side re-seed of inherited rows), then restart it from
+# its shard snapshot and fold it back into the ring — all typed, no
+# hang; the outer `timeout` is only the backstop.
+for seed in "${SEEDS[@]}"; do
+    echo "== embedding sweep: MXT_CHAOS_SEED=$seed (cell timeout ${CELL_TIMEOUT}s)"
+    timeout -k 10 "$CELL_TIMEOUT" env JAX_PLATFORMS=cpu \
+        MXT_CHAOS_SEED="$seed" \
+        python -m pytest tests/test_embedding.py -q -m "chaos and not slow" \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    rc=$?
+    if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+        echo "!! HANG: embedding sweep seed=$seed exceeded ${CELL_TIMEOUT}s" >&2
+        fail=1
+    elif [ "$rc" -ne 0 ]; then
+        echo "!! FAIL: embedding sweep seed=$seed rc=$rc" >&2
+        fail=1
+    fi
+done
+
 [ "$fail" -eq 0 ] && echo "chaos matrix: all seeds clean"
 exit "$fail"

@@ -85,6 +85,22 @@ SCAN = {
     # deferred protocol (one stacked read per K steps) and the
     # per-request prefill PendingValue. model.py's reference_decode is
     # the parity oracle and marks its per-step read sync-ok.
+    # kvstore's sparse paths: _merge now reduces row_sparse lists over
+    # the index union ON DEVICE, and the dist_embedding row push/pull
+    # runs between every sparse step — the intended syncs left are the
+    # network-serialization boundaries (a frame must be host bytes) and
+    # host config scalars, each annotated.
+    "mxnet_tpu/kvstore.py": _ALL,
+    # the sharded embedding client/cache sit on the per-step sparse
+    # path: row ids are host metadata by design (routing is control
+    # plane), and row values leave the device only at the RPC
+    # serialization boundary — any UNMARKED read means the cache
+    # started round-tripping device rows per lookup.
+    "mxnet_tpu/embedding/__init__.py": _ALL,
+    "mxnet_tpu/embedding/hashing.py": _ALL,
+    "mxnet_tpu/embedding/cache.py": _ALL,
+    "mxnet_tpu/embedding/client.py": _ALL,
+    "mxnet_tpu/embedding/store.py": _ALL,
     "mxnet_tpu/serving/__init__.py": _ALL,
     "mxnet_tpu/serving/engine.py": _ALL,
     "mxnet_tpu/serving/scheduler.py": _ALL,

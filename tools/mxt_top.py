@@ -300,6 +300,21 @@ def render(samples, prev, dt):
     lost_causes.sort(reverse=True)
     stalls = metric_sum(samples, "mxt_watchdog_stalls_total")
 
+    # embedding section (mxnet_tpu/embedding/): only rendered when a
+    # sharded embedding client has published its cache gauges — a dense
+    # trainer or a server-only process shows no embedding noise
+    emb_resident = metric_sum(samples, "mxt_embedding_rows_resident")
+    emb_hits = metric_sum(samples, "mxt_embedding_cache_hits_total")
+    emb_miss = metric_sum(samples, "mxt_embedding_cache_misses_total")
+    emb_evict = metric_sum(samples, "mxt_embedding_cache_evictions_total")
+    emb_ratio = None
+    if emb_hits is not None or emb_miss is not None:
+        total = (emb_hits or 0) + (emb_miss or 0)
+        emb_ratio = (emb_hits or 0) / total if total else None
+    emb_p50, emb_p99 = histogram_quantiles(
+        samples, "mxt_embedding_pull_seconds", (0.50, 0.99))
+    emb_bytes_rate, _ = rate("mxt_embedding_bytes_total")
+
     # serving section (mxnet_tpu/serving/): only rendered when the
     # process has served — a pure trainer shows no serving noise
     tok_rate, tok_total = rate("mxt_serving_tokens_total")
@@ -356,6 +371,17 @@ def render(samples, prev, dt):
                          % (_fmt(goodput, "%.3f"), top))
         if stalls:
             lines.append("  watchdog stalls  %s" % _fmt(stalls, "%.0f"))
+    if emb_resident is not None or emb_ratio is not None:
+        lines += [
+            "-" * 46,
+            "  emb rows res.    %s   hit ratio %s"
+            % (_fmt(emb_resident, "%.0f"),
+               _fmt(emb_ratio, "%.3f")),
+            "  emb pull p50/p99 %s / %s   evicted %s"
+            % (_fmt_s(emb_p50), _fmt_s(emb_p99),
+               _fmt(emb_evict, "%.0f")),
+            "  emb bytes/s      %s" % _fmt_b(emb_bytes_rate),
+        ]
     if tok_total is not None:
         lines += [
             "-" * 46,
