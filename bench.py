@@ -45,7 +45,7 @@ _CONFIG_COST = {"resnet50": 420, "bert": 300, "lstm_ptb": 200,
                 "wide_deep": 200, "lenet": 150, "pipeline": 150,
                 "async_ab": 90, "telemetry_ab": 60, "diag_ab": 60,
                 "cold_warm": 120, "serving": 150, "zero_stage": 90,
-                "embedding_ab": 90}
+                "embedding_ab": 90, "serving_fleet": 120}
 
 
 def _remaining():
@@ -1093,6 +1093,106 @@ def bench_embedding_ab(platform, dtype):
     return scaling, row
 
 
+def bench_serving_fleet(platform, dtype):
+    """serving_fleet_ab (serving/fleet.py + router.py): the SAME
+    mixed-length traffic routed through a 1-replica and a 2-replica
+    membership-backed serving fleet (SLO-aware router, load-aware
+    placement), plus a kill-one-replica-mid-run chaos cell on the
+    2-replica fleet — the row records tokens/s and request p50/p99 per
+    fleet size and asserts-by-record that the kill cell loses ZERO
+    accepted requests (every one completes via failover, idempotency-
+    deduped, `kill_failovers` > 0)."""
+    import numpy as np
+
+    from mxnet_tpu import serving
+
+    del dtype  # f32: the A/B isolates routing, not math throughput
+    slots = int(os.environ.get("BENCH_FLEET_SLOTS", "4"))
+    n_req = int(os.environ.get("BENCH_FLEET_REQUESTS", "16"))
+    layers, heads, hdim = 2, 2, 16
+    model = serving.TinyDecoder(vocab=512, num_layers=layers,
+                                num_heads=heads, head_dim=hdim,
+                                max_len=512)
+    params = model.init_params(0)
+
+    def factory():
+        return serving.DecodeEngine(
+            model, params=params, slots=slots,
+            cache=serving.PagedKVCache(layers, heads, hdim,
+                                       num_pages=256, page_size=16),
+            prefill_buckets=(64,), max_context=128)
+
+    def traffic(router):
+        rng = np.random.RandomState(11)
+        out = []
+        for i in range(n_req):
+            plen = int(rng.randint(4, 49))
+            mnew = int(rng.randint(4, 17))
+            out.append(router.submit(
+                rng.randint(1, 512, plen).tolist(),
+                max_new_tokens=mnew, token="fb-%d" % i))
+        return out
+
+    def run(n, kill_at=None):
+        pool, srv = serving.local_serving_fleet(n, factory)
+        router = serving.FleetRouter(pool)
+        try:
+            reqs = traffic(router)
+            t0 = time.perf_counter()
+            if kill_at is not None:
+                while router.step() and router.steps < kill_at:
+                    pass
+                pool.get(n - 1).kill()
+            router.run(max_steps=20000)
+            dt = time.perf_counter() - t0
+            done = [r for r in reqs if r.state == "completed"]
+            tokens = sum(len(r.result) for r in done)
+            lats = sorted(r.t_finish - r.t_submit for r in done)
+            pick = lambda q: lats[min(len(lats) - 1,
+                                      int(q * len(lats)))] \
+                if lats else 0.0
+            return {
+                "tokens_per_sec": tokens / dt if dt else 0.0,
+                "completed": len(done),
+                "lost": len(reqs) - len(done),
+                "p50_ms": pick(0.50) * 1e3, "p99_ms": pick(0.99) * 1e3,
+                "failovers": sum(r.failovers for r in reqs),
+                "hedges": sum(r.hedges for r in reqs),
+            }
+        finally:
+            for h in pool.replicas():
+                try:
+                    h.close()
+                except Exception:  # noqa: BLE001 — killed handles
+                    pass
+            srv.close()
+
+    one = run(1)
+    two = run(2)
+    killed = run(2, kill_at=6)
+    scaling = two["tokens_per_sec"] / one["tokens_per_sec"] \
+        if one["tokens_per_sec"] else 0.0
+    row = {
+        "config": "serving_fleet_ab", "chips": 1, "batch_size": slots,
+        "dtype": "float32", "platform": platform, "requests": n_req,
+        "images_or_tokens_per_sec_per_chip": round(
+            two["tokens_per_sec"], 2),
+        "tokens_per_sec_1rep": round(one["tokens_per_sec"], 2),
+        "tokens_per_sec_2rep": round(two["tokens_per_sec"], 2),
+        "replica_scaling_x": round(scaling, 3),
+        "p99_ms_1rep": round(one["p99_ms"], 2),
+        "p99_ms_2rep": round(two["p99_ms"], 2),
+        "kill_completed": killed["completed"],
+        "kill_lost_requests": killed["lost"],
+        "kill_failovers": killed["failovers"],
+        "kill_p99_ms": round(killed["p99_ms"], 2),
+        "kill_tokens_per_sec": round(killed["tokens_per_sec"], 2),
+        "mfu": None, "flops_per_sample": None,
+    }
+    _emit_jsonl(row)
+    return scaling, row
+
+
 def bench_cold_warm(platform, dtype):
     """Cold-vs-warm start A/B (tuning/): the SAME canonical fused-step
     loop run in two fresh processes sharing one persistent compile cache
@@ -1377,7 +1477,8 @@ def main():
     configs = os.environ.get(
         "BENCH_CONFIGS",
         "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline,async_ab,"
-        "telemetry_ab,diag_ab,cold_warm,serving,zero_stage,embedding_ab"
+        "telemetry_ab,diag_ab,cold_warm,serving,zero_stage,embedding_ab,"
+        "serving_fleet"
     ).split(",")
 
     # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
@@ -1410,6 +1511,9 @@ def main():
         "embedding_ab": ("embedding_server_scaling",
                          "x (2srv/1srv embedding bytes/sec)",
                          bench_embedding_ab),
+        "serving_fleet": ("serving_fleet_scaling",
+                          "x (2rep/1rep fleet tokens/s)",
+                          bench_serving_fleet),
     }
     headline = None
     errors = []
@@ -1417,7 +1521,8 @@ def main():
     best_resnet = None
     for name in ("resnet50", "bert", "lstm_ptb", "wide_deep", "lenet",
                  "pipeline", "async_ab", "telemetry_ab", "diag_ab",
-                 "cold_warm", "serving", "zero_stage", "embedding_ab"):
+                 "cold_warm", "serving", "zero_stage", "embedding_ab",
+                 "serving_fleet"):
         if name not in configs:
             continue
         cost = float(os.environ.get("BENCH_COST_%s" % name.upper(),

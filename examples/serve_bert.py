@@ -20,6 +20,14 @@ Run::
 boundaries) on the same traffic, the throughput case for continuous
 batching. `--telemetry PATH` writes the JSONL event stream mxt_top can
 tail live: `python tools/mxt_top.py --jsonl PATH`.
+
+`--replicas N` serves the traffic through an N-replica fault-tolerant
+fleet instead (membership-backed pool + SLO-aware router: load-aware
+dispatch, hedged retries, failover with idempotency tokens), and
+`--kill-one` SIGKILL-emulates one replica mid-run to demonstrate that
+every accepted request still completes (failover, zero lost)::
+
+    python examples/serve_bert.py --replicas 2 --kill-one
 """
 from __future__ import annotations
 
@@ -86,6 +94,15 @@ def main():
     p.add_argument("--head-dim", type=int, default=32)
     p.add_argument("--max-new", type=int, default=48,
                    help="upper bound of the random decode budgets")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve through an N-replica fault-tolerant "
+                        "fleet (membership pool + SLO-aware router) "
+                        "instead of a single batcher")
+    p.add_argument("--kill-one", action="store_true",
+                   help="with --replicas >= 2: kill one replica "
+                        "mid-run (no deregister, heartbeats stop) and "
+                        "show every request still completing via "
+                        "failover")
     p.add_argument("--watchdog", type=float, nargs="?", const=30.0,
                    default=None, metavar="SECONDS",
                    help="arm the diagnostics layer (flight recorder + "
@@ -131,6 +148,54 @@ def main():
               "replay them from disk)"
               % (n, time.perf_counter() - t0))
         return eng
+
+    if args.replicas > 1 or args.kill_one:
+        n = max(2 if args.kill_one else 1, args.replicas)
+        pool, coord = serving.local_serving_fleet(n, engine)
+        router = serving.FleetRouter(pool, slo=args.deadline)
+        rng = __import__("numpy").random.RandomState(7)
+        t0 = time.perf_counter()
+        reqs = []
+        for i in range(args.requests):
+            plen = int(rng.randint(4, 97))
+            mnew = int(rng.randint(8, max(9, args.max_new + 1)))
+            reqs.append(router.submit(
+                rng.randint(1, 512, plen).tolist(),
+                max_new_tokens=mnew, deadline=args.deadline,
+                token="req-%d" % i))
+        if args.kill_one:
+            while router.step() and router.steps < 8:
+                pass
+            victim = pool.get(n - 1)
+            victim.kill()
+            print("killed replica %d mid-run (no deregister — the "
+                  "fleet fails its in-flight requests over)"
+                  % victim.index)
+        router.run()
+        dt = time.perf_counter() - t0
+        done = [r for r in reqs if r.state == "completed"]
+        tokens = sum(len(r.result) for r in done)
+        lats = sorted(r.t_finish - r.t_submit for r in done)
+        pick = (lambda q: lats[min(len(lats) - 1, int(q * len(lats)))]
+                if lats else 0.0)
+        print("fleet(%d): %d/%d completed, %d lost, %.1fs"
+              % (n, len(done), len(reqs), len(reqs) - len(done), dt))
+        print("   %.0f tokens/s   request p50 %.0fms  p99 %.0fms"
+              % (tokens / dt, pick(0.5) * 1e3, pick(0.99) * 1e3))
+        print("   failovers %d   hedges %d   replays %d   by replica: %s"
+              % (sum(r.failovers for r in reqs),
+                 sum(r.hedges for r in reqs), router.replays,
+                 {h.index: sum(1 for r in done
+                               if r.committed_by == h.index)
+                  for h in pool.replicas()}))
+        for h in pool.replicas():
+            try:
+                h.close()
+            except Exception:  # noqa: BLE001 — killed handles
+                pass
+        coord.close()
+        nd.waitall()
+        return
 
     cont = run(serving.ContinuousBatcher, engine(),
                make_traffic(args.requests, 7, 512, args.deadline,

@@ -209,6 +209,7 @@ class AsyncParamServer:
         self._store = {}     # key -> np.ndarray (the weight)
         self._updater = None
         self.embedding = None  # EmbeddingStore (attach_embedding)
+        self.serving = None    # ServingHost (attach_serving)
         self._mutate = threading.Lock()  # ps-lite customer-thread analog
         self._conns = set()  # live client sockets, torn down by close()
         self._conns_lock = threading.Lock()
@@ -348,6 +349,16 @@ class AsyncParamServer:
         self.embedding = store
         return store
 
+    def attach_serving(self, host):
+        """Host a standalone serving replica's front door on this
+        server: every ``srv_*`` frame (submit/cancel/poll/load/drain —
+        serving/fleet.py ServingHost) dispatches to it. Serving ops
+        carry no membership credential — the fencing that matters for
+        the fleet is router-side (a fenced replica's late reply is
+        refused typed at the accept gate)."""
+        self.serving = host
+        return host
+
     def _fencing_active(self):
         from . import config
 
@@ -443,6 +454,12 @@ class AsyncParamServer:
             # credential fencing already ran above; the store adds the
             # row-granular ring-epoch fence for mutations
             return self.embedding.handle(op, key, payload)
+        # -- standalone serving replica (serving/fleet.py) ----------------
+        elif op.startswith("srv_"):
+            if self.serving is None:
+                return ("err", "this server hosts no serving replica "
+                               "(attach_serving / serving.serve_replica)")
+            return self.serving.handle(op, key, payload)
         # -- membership ops (ref: ps-lite Van ADD_NODE/HEARTBEAT) --------
         elif op == "register":
             meta = None

@@ -156,7 +156,11 @@ _declare("MXT_FAULT", str, None,
          "hb_drop loses membership heartbeats on the wire, "
          "worker_freeze:worker=I[,after=K] freezes worker I's heartbeat "
          "thread (zombie emulation), rejoin_race:ms=N widens the "
-         "server-side re-registration fencing window.")
+         "server-side re-registration fencing window; "
+         "replica_kill:replica=I[,after=K] kills serving replica I at "
+         "its Kth router tick (in-flight requests fail over), "
+         "replica_slow:replica=I,ms=N[,after=K] stalls replica I's "
+         "decode for N ms (hedge bait).")
 
 _declare("MXT_MEMBERSHIP", bool, True,
          "Elastic membership for the dist kvstore (membership.py): "
@@ -243,6 +247,20 @@ _declare("MXT_SERVING_SLOTS", int, 8,
          "batcher recomposes requests into this fixed-shape batch every "
          "step, so the decode program compiles once regardless of "
          "traffic (inactive slots are masked, not reshaped away).")
+
+_declare("MXT_FLEET_HEDGE_DELAY", float, None,
+         "Hedge delay in seconds for the serving fleet router "
+         "(serving/router.py): a dispatched request with no result "
+         "after this long is speculatively duplicated onto a second "
+         "replica — first completion wins, the loser is cancelled "
+         "through the replica's eviction path. Unset derives the delay "
+         "per request as half its deadline (or half the router's "
+         "slo=); requests with neither never hedge.")
+_declare("MXT_FLEET_HEDGE_BUDGET", int, None,
+         "Max concurrently-hedged requests fleet-wide: bounds the "
+         "extra load a brownout can recruit, so hedging can never "
+         "double the fleet's work. 0 disables hedging; unset derives "
+         "max(1, fleet slot capacity // 4).")
 
 _declare("MXT_WATCHDOG_TIMEOUT", float, None,
          "Hang-watchdog stall threshold in seconds (diagnostics.py): a "

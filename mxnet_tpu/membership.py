@@ -457,6 +457,16 @@ def verify_snapshot(snap):
 # waiters for rounds that were about to answer.
 _RENDEZVOUS_MARGIN = 5.0
 
+# Graceful deregister is BEST-EFFORT and short-bounded: it runs during
+# teardown, when the coordinator may already be gone (the PR 10
+# teardown-order gotcha generalized — a fleet closed coordinator-first
+# used to cost a full transport deadline PER dependent handle, because
+# the deregister's reconnect spun out the handle's whole connect
+# timeout). The bound caps both the retry deadline and the reconnect
+# window; a missed deregister just means the reaper counts the member
+# lost, which teardown doesn't care about.
+_DEREGISTER_DEADLINE = 2.0
+
 
 class WorkerMembership:
     """One worker's membership session: registration, the background
@@ -666,11 +676,20 @@ class WorkerMembership:
 
             diagnostics.unregister_source(self._beat_source)
         if deregister and self.generation is not None and not self.fenced:
+            # best-effort, short-bounded: shrinking the control client's
+            # connect timeout bounds the reconnect a dead coordinator
+            # would otherwise spin for (the deadline alone only bounds
+            # the retry loop, not the reconnect inside it)
+            old_timeout = self._ctl._timeout
+            self._ctl._timeout = min(old_timeout, _DEREGISTER_DEADLINE)
             try:
                 self._ctl.request(
-                    "deregister", None, (self.worker_id, self.generation))
+                    "deregister", None, (self.worker_id, self.generation),
+                    deadline=_DEREGISTER_DEADLINE)
             except (MXNetError, ConnectionError, OSError):
                 pass
+            finally:
+                self._ctl._timeout = old_timeout
         if self._rdv is not None:
             self._rdv.close()
         self._ctl.close()

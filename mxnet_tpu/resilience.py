@@ -50,6 +50,11 @@ checkpoint writes at named points.
     rejoin_race:ms=30            # widen the server-side window between
                                  # fencing the old generation and
                                  # answering a re-registration
+    replica_kill:replica=1,after=6  # kill serving replica 1 at its 6th
+                                 # router tick (ungraceful: in-flight
+                                 # requests fail over to survivors)
+    replica_slow:replica=0,ms=500   # stall serving replica 0's decode
+                                 # for 500 ms (the router's hedge bait)
 
 ``p`` defaults to 1.0, ``n`` (max firings) to unlimited, ``seed`` to 0.
 One injector instance lives per distinct spec string so the drawn

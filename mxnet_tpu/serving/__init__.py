@@ -22,6 +22,14 @@ production-traffic half:
 - :mod:`~mxnet_tpu.serving.metrics` — SLO metrics
   (``mxt_serving_*``) through the PR-5 telemetry registry;
   ``tools/mxt_top.py`` renders them live.
+- :mod:`~mxnet_tpu.serving.fleet` /
+  :mod:`~mxnet_tpu.serving.router` — the fault-tolerant serving
+  fleet: replicas REGISTER in a coordinator's membership table
+  (heartbeat liveness, endpoint + capacity meta), an SLO-aware
+  :class:`FleetRouter` dispatches load-aware with hedged retries,
+  transparent failover on replica death (idempotency tokens — a
+  replayed completed request never re-decodes), graceful drain +
+  AOT-warm rejoin, and typed refusal of fenced zombies' late replies.
 
 Minimal use::
 
@@ -35,14 +43,29 @@ Minimal use::
                                  deadline=0.5))
     for req in sched.run():
         print(req.id, req.state, req.output_tokens)
+
+Fleet use::
+
+    pool, coord = serving.local_serving_fleet(2, make_engine)
+    router = serving.FleetRouter(pool, slo=0.5)
+    rr = router.submit([17, 3, 99], max_new_tokens=32, token="req-1")
+    router.run()
+    print(rr.state, rr.result)   # survives a replica kill mid-run
 """
 from __future__ import annotations
 
 from .engine import DecodeEngine
+from .fleet import (LocalReplica, RemoteReplica, ReplicaPool,
+                    ServingHost, StaleReplicaError, local_serving_fleet,
+                    serve_replica)
 from .kv_cache import PagedKVCache
 from .model import TinyDecoder
+from .router import FleetRouter, RoutedRequest
 from .scheduler import ContinuousBatcher, Request, StaticBatcher
 from . import metrics
 
 __all__ = ["DecodeEngine", "PagedKVCache", "TinyDecoder",
-           "ContinuousBatcher", "Request", "StaticBatcher", "metrics"]
+           "ContinuousBatcher", "Request", "StaticBatcher", "metrics",
+           "FleetRouter", "RoutedRequest", "ReplicaPool", "LocalReplica",
+           "RemoteReplica", "ServingHost", "StaleReplicaError",
+           "local_serving_fleet", "serve_replica"]

@@ -1,0 +1,754 @@
+"""Serving fleet — membership-backed replica pool and replica lifecycle.
+
+PR 7 built one serving replica; "millions of users" is a fleet of them
+behind a front door. This module is the pool half of that front door
+(the dispatch half is :mod:`~mxnet_tpu.serving.router`):
+
+- **Registration.** Serving replicas REGISTER in the coordinator's
+  :class:`~mxnet_tpu.membership.MembershipTable` under their own id
+  namespace (``-(1<<20) - index`` — training workers own the
+  non-negative ints, embedding servers the small negatives) with
+  endpoint + capacity metadata riding the registration ``meta``,
+  exactly like the PR 10 embedding servers. Heartbeat-backed liveness
+  is therefore free: the coordinator's reaper fences a silent replica
+  and the pool's death listener (``MembershipTable.add_death_listener``
+  — the same hook the elastic reshard controller rides) feeds the
+  router's failover scan.
+
+- **Lifecycle.** A replica moves ``warming -> routable -> draining ->
+  drained`` (or ``-> dead``). It is only marked routable AFTER its
+  engine AOT-warms through ``tuning.warmup()``; with a shared
+  ``MXT_COMPILE_CACHE_DIR`` a rejoining or hot-spare replica replays
+  every request-path program from disk — rejoin never serves a cold
+  compile (the PR 6 contract extended to fleet membership).
+
+- **Fencing.** A replica the reaper declared dead may still be running
+  (the zombie scenario): its late replies are refused with the typed
+  :class:`StaleReplicaError` by the router's accept gate, never
+  committed — the request has already failed over to a survivor.
+
+- **Standalone role.** ``python -m mxnet_tpu.serving.fleet`` hosts one
+  replica as its own process (the ``kvstore_server.py`` discipline):
+  an async server answering ``srv_*`` ops (:class:`ServingHost`), a
+  decode loop thread, and a membership registration at the coordinator
+  carrying the endpoint so routers discover it.
+  :class:`RemoteReplica` is the router-side handle for one.
+
+Failure injection (``MXT_FAULT``): ``replica_kill:replica=I[,after=K]``
+kills replica I at its Kth router tick (ungraceful — in-flight requests
+fail over); ``replica_slow:replica=I,ms=N[,after=K]`` stalls replica
+I's decode for N ms (hedge bait). Both are seeded and deterministic.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..base import MXNetError
+from ..membership import StaleWorkerError, WorkerMembership
+from ..resilience import KVStoreError
+from . import metrics as _m
+from .scheduler import ContinuousBatcher, Request
+
+__all__ = [
+    "StaleReplicaError", "LocalReplica", "RemoteReplica", "ReplicaPool",
+    "ServingHost", "local_serving_fleet", "serve_replica",
+]
+
+# replica member-id namespace: training workers register non-negative
+# ints, embedding servers -(index+1); serving replicas sit far below
+# both so the three populations can share one coordinator table
+_REPLICA_NS = 1 << 20
+
+# lifecycle states
+WARMING = "warming"
+ROUTABLE = "routable"
+DRAINING = "draining"
+DRAINED = "drained"
+DEAD = "dead"
+_STATES = (WARMING, ROUTABLE, DRAINING, DRAINED, DEAD)
+
+
+class StaleReplicaError(StaleWorkerError):
+    """A reply arrived from a serving replica that was fenced (reaped by
+    the membership coordinator, killed, or replaced): the router refuses
+    to commit it — the request has been (or will be) re-dispatched onto
+    a survivor, and a zombie's late tokens must never race that."""
+
+
+def _replica_member_id(index):
+    return -(_REPLICA_NS + int(index))
+
+
+def _replica_index(member_id):
+    return -int(member_id) - _REPLICA_NS
+
+
+def _is_replica_member(member_id):
+    try:
+        return int(member_id) <= -_REPLICA_NS
+    except (TypeError, ValueError):
+        return False
+
+
+class LocalReplica:
+    """One in-process serving replica: engine + continuous batcher +
+    membership registration, with the drain/rejoin/kill lifecycle the
+    router drives. The handle interface (``load``/``submit_copy``/
+    ``cancel_copy``/``poll``/``tick``) is shared with
+    :class:`RemoteReplica` so the router never cares which it holds."""
+
+    def __init__(self, index, engine_factory, coordinator=None,
+                 now_fn=time.monotonic, heartbeats=True, reg_timeout=5.0):
+        self.index = int(index)
+        self._factory = engine_factory
+        self.coordinator = coordinator
+        self._now = now_fn
+        self._heartbeats = bool(heartbeats)
+        self._reg_timeout = reg_timeout
+        self.engine = None
+        self.batcher = None
+        self.member = None
+        self.generation = None
+        self.capacity = 0
+        self.state = WARMING
+        self.killed = False
+        self.slow_until = 0.0   # replica_slow brownout horizon
+        self._ticks = 0
+        self._copies = {}       # copy_id -> Request live on this replica
+        self._poll_cursor = 0   # read cursor into batcher.completed
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def alive(self):
+        return self.state in (ROUTABLE, DRAINING)
+
+    @property
+    def fenced(self):
+        """True when this replica's membership credential is no longer
+        the live one (killed, reaped, or replaced): the router's accept
+        gate refuses its replies typed."""
+        m = self.member
+        return self.killed or (m is not None and m.fenced)
+
+    def start(self, warm=True):
+        """Build the engine, AOT-warm it through ``tuning.warmup()``
+        (zero request-path compiles with a warm persistent cache),
+        register in the coordinator's membership table, and only THEN
+        become routable — a cold replica is never offered traffic."""
+        self.state = WARMING
+        self.killed = False
+        self.slow_until = 0.0
+        self._copies.clear()
+        self._poll_cursor = 0
+        self.engine = self._factory()
+        self.capacity = int(self.engine.slots)
+        self.batcher = ContinuousBatcher(self.engine, now_fn=self._now)
+        if warm:
+            from .. import tuning
+
+            tuning.warmup(steps=(self.engine,), kernels=False,
+                          include_live=False, reason="fleet_replica")
+        self._register()
+        self.state = ROUTABLE
+        from .. import diagnostics
+
+        diagnostics.record_event("fleet_replica_routable",
+                                 replica=self.index,
+                                 slots=self.capacity)
+        return self
+
+    def _register(self):
+        if self.coordinator is None:
+            return
+        self.member = WorkerMembership(
+            self.coordinator[0], self.coordinator[1],
+            _replica_member_id(self.index), timeout=self._reg_timeout)
+        self.member.register(meta={
+            "serving_replica": True, "index": self.index,
+            "slots": int(self.engine.slots), "endpoint": None})
+        if self._heartbeats:
+            self.member.start_heartbeats()
+        self.generation = self.member.generation
+
+    def kill(self):
+        """Ungraceful death (SIGKILL emulation): heartbeats silently
+        stop, nothing deregisters, in-flight requests are stranded —
+        exactly what the reaper + the router's failover must absorb."""
+        if self.state == DEAD:
+            return
+        self.killed = True
+        self.state = DEAD
+        if self.member is not None:
+            self.member.stop(deregister=False)
+
+    def mark_dead(self):
+        """The pool observed this replica dead (reaper listener or
+        transport failure): same terminal state as :meth:`kill`."""
+        self.kill()
+
+    def drain_start(self):
+        if self.alive:
+            self.state = DRAINING
+
+    def finish_drain(self):
+        """Complete a drain: flush the engine window (every in-flight
+        step's tokens delivered), then deregister gracefully — bounded,
+        so a dead coordinator cannot park the drain (membership.py's
+        best-effort deregister deadline)."""
+        if self.batcher is not None:
+            self.batcher.drain()
+        if self.member is not None:
+            self.member.stop(deregister=True)
+            self.member = None
+        self.generation = None
+        self.state = DRAINED
+        from .. import diagnostics
+
+        diagnostics.record_event("fleet_replica_drained",
+                                 replica=self.index)
+
+    def rejoin(self, warm=True, fresh_engine=True):
+        """Rejoin after a drain or death: rebuild (by default a FRESH
+        engine — the hot-spare shape), AOT-warm through the shared
+        compile cache, re-register under a fresh generation, and only
+        then serve again."""
+        if self.state not in (DRAINED, DEAD):
+            raise MXNetError(
+                "replica %d cannot rejoin from state %r (drain or kill "
+                "it first)" % (self.index, self.state))
+        if self.member is not None:   # killed: stop the old session
+            self.member.stop(deregister=False)
+            self.member = None
+        if not fresh_engine and self.engine is not None:
+            old_engine = self.engine
+            factory, self._factory = self._factory, lambda: old_engine
+            try:
+                return self.start(warm=warm)
+            finally:
+                self._factory = factory
+        return self.start(warm=warm)
+
+    def close(self):
+        if self.member is not None:
+            self.member.stop(deregister=not self.killed)
+            self.member = None
+
+    # -- the router-facing handle interface --------------------------------
+    def load(self):
+        """Queue-depth / active-slot / capacity gauges the router's
+        load-aware pick dispatches on (the same quantities
+        serving/metrics.py exports)."""
+        if not self.alive:
+            raise ConnectionError(
+                "serving replica %d is %s" % (self.index, self.state))
+        return {"queue": len(self.batcher._queue),
+                "active": len(self.batcher._slot_req),
+                "slots": self.capacity}
+
+    def submit_copy(self, copy_id, prompt, max_new_tokens, deadline=None,
+                    eos_id=None):
+        """Dispatch one request copy into this replica's batcher.
+        Returns the copy's admission state (``queued`` or — for a
+        request that can never fit this engine — ``rejected``)."""
+        if not self.alive:
+            raise ConnectionError(
+                "serving replica %d is %s" % (self.index, self.state))
+        req = Request(prompt, max_new_tokens=max_new_tokens,
+                      deadline=deadline, eos_id=eos_id,
+                      request_id=copy_id)
+        self.batcher.submit(req)
+        if req.state == "rejected":
+            return "rejected"
+        self._copies[copy_id] = req
+        return req.state
+
+    def cancel_copy(self, copy_id):
+        """Evict one copy (queued or running) through the scheduler's
+        cancel hook — the hedge-loser / drain-migration path."""
+        req = self._copies.get(copy_id)
+        if req is not None:
+            self.batcher.cancel(req)
+
+    def queued_copies(self):
+        """Copy ids still admission-queued here (migratable on drain)."""
+        return [cid for cid, r in self._copies.items()
+                if r.state == "queued"]
+
+    def poll(self):
+        """Newly finalized copies as ``(copy_id, state, tokens)``."""
+        out = []
+        if self.batcher is None:
+            return out
+        done = self.batcher.completed
+        while self._poll_cursor < len(done):
+            r = done[self._poll_cursor]
+            self._poll_cursor += 1
+            if r.id in self._copies:
+                del self._copies[r.id]
+                out.append((r.id, r.state, list(r.output_tokens)))
+        return out
+
+    def pending(self):
+        return self.batcher is not None and bool(
+            self.batcher._queue or self.batcher._slot_req)
+
+    def tick(self, now=None):
+        """One co-operative scheduler tick (the router's step drives
+        every in-process replica). Consults the seeded ``replica_kill``
+        / ``replica_slow`` fault rules first so chaos cells are
+        deterministic; a browned-out replica makes no decode progress
+        until its stall horizon passes (hedge bait)."""
+        from .. import resilience
+
+        if self.state in (DEAD, DRAINED):
+            return False
+        now = self._now() if now is None else now
+        inj = resilience.fault_point()
+        rule = inj.rule("replica_kill")
+        if rule is not None \
+                and int(rule.get("replica", -1)) == self.index \
+                and self._ticks >= int(rule.get("after", 0)) \
+                and inj.should("replica_kill"):
+            self.kill()
+            return False
+        rule = inj.rule("replica_slow")
+        if rule is not None \
+                and int(rule.get("replica", -1)) == self.index \
+                and self._ticks >= int(rule.get("after", 0)) \
+                and inj.should("replica_slow"):
+            self.slow_until = now + \
+                float(rule.get("ms", 50.0)) / 1e3  # sync-ok: host rule param
+        self._ticks += 1
+        if now < self.slow_until:
+            return False
+        if self.pending():
+            self.batcher.step()
+            return True
+        if self._copies:
+            # idle but copies undelivered: their tail tokens are still
+            # riding the deferred window — drain it so completions land
+            # now instead of at the fleet-wide flush (the amortized
+            # window stays intact while the replica is busy)
+            self.batcher.drain()
+        return False
+
+    def flush(self):
+        """Drain the engine's in-flight window (deferred tokens land)."""
+        if self.batcher is not None and self.state not in (DEAD,):
+            self.batcher.drain()
+
+
+class RemoteReplica:
+    """Router-side handle for a standalone replica process
+    (:func:`serve_replica`): the same interface as :class:`LocalReplica`
+    but every call is one ``srv_*`` op over the authenticated async
+    transport. The remote process drives its own decode loop, so
+    :meth:`tick` is a no-op here."""
+
+    def __init__(self, index, host, port, slots=None, timeout=None):
+        from .. import config
+        from ..async_server import AsyncClient
+
+        self.index = int(index)
+        self.host = host
+        self.port = int(port)
+        self.capacity = int(slots or 0)
+        self.state = ROUTABLE
+        self.killed = False
+        self.generation = None
+        self.member = None
+        self.slow_until = 0.0
+        self.batcher = None
+        t = timeout if timeout is not None else config.get(
+            "MXT_KV_DEADLINE")
+        self._cl = AsyncClient(host, self.port,
+                               timeout=float(t))  # sync-ok: host config scalar
+
+    @property
+    def alive(self):
+        return self.state in (ROUTABLE, DRAINING)
+
+    @property
+    def fenced(self):
+        return self.killed
+
+    def load(self):
+        ld = self._cl.request("srv_load")
+        if not self.capacity:
+            self.capacity = int(ld.get("slots", 0))
+        return ld
+
+    def submit_copy(self, copy_id, prompt, max_new_tokens, deadline=None,
+                    eos_id=None):
+        return self._cl.request(
+            "srv_submit", None,
+            (copy_id, [int(t) for t in prompt], int(max_new_tokens),
+             deadline, eos_id))
+
+    def cancel_copy(self, copy_id):
+        self._cl.request("srv_cancel", None, copy_id)
+
+    def queued_copies(self):
+        return list(self._cl.request("srv_queued"))
+
+    def poll(self):
+        return [tuple(x) for x in self._cl.request("srv_poll")]
+
+    def pending(self):
+        ld = self.load()
+        return bool(ld.get("queue") or ld.get("active"))
+
+    def tick(self, now=None):
+        return False  # the remote process self-drives its decode loop
+
+    def flush(self):
+        pass
+
+    def drain_start(self):
+        if self.alive:
+            self.state = DRAINING
+            try:
+                self._cl.request("srv_drain", None, True)
+            except (KVStoreError, ConnectionError, OSError):
+                pass
+
+    def finish_drain(self):
+        self.state = DRAINED
+
+    def kill(self):
+        if self.state == DEAD:
+            return
+        self.killed = True
+        self.state = DEAD
+        self._cl.close()
+
+    def mark_dead(self):
+        self.kill()
+
+    def rejoin(self, warm=True, **kw):
+        raise MXNetError(
+            "a RemoteReplica rejoins from its own process (restart it; "
+            "it re-registers and re-warms itself before serving)")
+
+    def close(self):
+        self._cl.close()
+
+
+class ReplicaPool:
+    """The router's view of the fleet: handles by replica index, the
+    load-aware pick, and death intake from the coordinator's membership
+    reaper (the same ``add_death_listener`` hook the elastic reshard
+    controller uses — listener callbacks run on the reaper thread, so
+    they only RECORD here; the router applies them at its next step)."""
+
+    def __init__(self, coordinator=None, server=None):
+        self.coordinator = coordinator
+        self.server = server  # in-process coordinator AsyncParamServer
+        self._handles = {}
+        self._lock = threading.Lock()
+        self._dead_pending = []
+        if server is not None:
+            server.membership.add_death_listener(self._on_deaths)
+
+    # -- membership --------------------------------------------------------
+    def add(self, handle):
+        self._handles[handle.index] = handle
+        self.publish()
+        return handle
+
+    def get(self, rid):
+        return self._handles[rid]
+
+    def replicas(self):
+        return [self._handles[k] for k in sorted(self._handles)]
+
+    def routable(self):
+        return [h for h in self.replicas()
+                if h.state == ROUTABLE and not h.fenced]
+
+    def total_capacity(self):
+        return sum(int(h.capacity or 0) for h in self.replicas()
+                   if h.state in (ROUTABLE, DRAINING))
+
+    def pick(self, exclude=()):
+        """Least-loaded routable replica — the SLO-aware placement
+        rule: (queue depth + active slots) / capacity, ties broken by
+        lowest index for determinism. A replica whose load probe fails
+        is marked dead on the spot (transport-observed death)."""
+        best, best_score = None, None
+        for h in self.routable():
+            if h.index in exclude:
+                continue
+            try:
+                ld = h.load()
+            except (ConnectionError, OSError):
+                self.mark_dead(h.index)
+                continue
+            slots = max(1, int(ld.get("slots") or h.capacity or 1))
+            score = (int(ld.get("queue", 0))
+                     + int(ld.get("active", 0))) / float(slots)  # sync-ok: host gauge arithmetic
+            if best_score is None or score < best_score:
+                best, best_score = h, score
+        return best
+
+    def _on_deaths(self, worker_ids):
+        # reaper-thread callback: record only (never mutate handles or
+        # touch telemetry from under the membership reaper)
+        rids = [_replica_index(w) for w in worker_ids
+                if _is_replica_member(w)]
+        if rids:
+            with self._lock:
+                self._dead_pending.extend(rids)
+
+    def poll_deaths(self):
+        """Apply reaper-reported deaths; returns the replica ids newly
+        marked dead this call."""
+        with self._lock:
+            rids, self._dead_pending = self._dead_pending, []
+        out = []
+        for rid in rids:
+            h = self._handles.get(rid)
+            if h is not None and h.state != DEAD:
+                self.mark_dead(rid)
+                out.append(rid)
+        return out
+
+    def mark_dead(self, rid):
+        """This pool observed replica ``rid`` dead (reaper verdict or a
+        transport failure mid-dispatch)."""
+        h = self._handles.get(rid)
+        if h is None or h.state == DEAD:
+            return
+        h.mark_dead()
+        from .. import diagnostics
+
+        diagnostics.record_event("fleet_replica_dead", replica=rid)
+        self.publish()
+
+    def refresh(self):
+        """Reconcile with the coordinator's membership view: fence
+        handles whose registration is gone/dead, and discover standalone
+        replicas that registered an endpoint we have no handle for."""
+        view = None
+        if self.server is not None:
+            view = self.server.membership.view()
+        if view is None:
+            return self
+        dead = {_replica_index(w) for w in view.get("dead", {})
+                if _is_replica_member(w)}
+        live = {_replica_index(w) for w in view.get("members", {})
+                if _is_replica_member(w)}
+        for rid, h in list(self._handles.items()):
+            if rid in dead and h.state not in (DEAD, DRAINED):
+                self.mark_dead(rid)
+        for w, meta in view.get("meta", {}).items():
+            if not (_is_replica_member(w) and isinstance(meta, dict)
+                    and meta.get("serving_replica")):
+                continue
+            rid = int(meta.get("index", _replica_index(w)))
+            ep = meta.get("endpoint")
+            if rid in live and rid not in self._handles and ep:
+                self.add(RemoteReplica(rid, ep[0], ep[1],
+                                       slots=meta.get("slots")))
+        self.publish()
+        return self
+
+    def publish(self):
+        """Export ``mxt_fleet_replicas{state}`` (mxt_top's fleet line)."""
+        counts = {s: 0 for s in _STATES}
+        for h in self._handles.values():
+            counts[h.state] = counts.get(h.state, 0) + 1
+        g = _m.fleet_replicas()
+        for s, n in counts.items():
+            g.labels(s).set(n)
+
+    def close(self):
+        for h in self.replicas():
+            try:
+                h.close()
+            except Exception:  # noqa: BLE001 — teardown best effort
+                pass
+
+
+def local_serving_fleet(n, engine_factory, now_fn=time.monotonic,
+                        warm=True, heartbeats=True):
+    """An in-process fleet: one coordinator async server (the membership
+    table), ``n`` :class:`LocalReplica`\\ s registered in it over real
+    loopback sockets, and the pool wired to the reaper's death listener.
+    Returns ``(pool, coordinator_server)`` — close the pool's replicas,
+    then the server (the order is forgiving: graceful deregister is
+    bounded)."""
+    from ..async_server import AsyncParamServer
+
+    if n < 1:
+        raise MXNetError("a serving fleet needs at least one replica")
+    srv = AsyncParamServer("127.0.0.1", 0)
+    coord = ("127.0.0.1", srv._sock.getsockname()[1])
+    pool = ReplicaPool(coordinator=coord, server=srv)
+    for i in range(n):
+        pool.add(LocalReplica(i, engine_factory, coordinator=coord,
+                              now_fn=now_fn,
+                              heartbeats=heartbeats).start(warm=warm))
+    pool.publish()
+    return pool, srv
+
+
+# ---------------------------------------------------------------------------
+# standalone replica role (the kvstore_server.py discipline)
+# ---------------------------------------------------------------------------
+class ServingHost:
+    """Server-side ``srv_*`` op handler for a standalone replica:
+    attached to an :class:`~mxnet_tpu.async_server.AsyncParamServer` via
+    ``attach_serving``. One lock serializes op handling against the
+    decode loop thread (the batcher is host bookkeeping, not
+    thread-safe by itself)."""
+
+    def __init__(self, batcher):
+        self.batcher = batcher
+        self.admitting = True
+        self._copies = {}
+        self._cursor = 0
+        self._lock = threading.Lock()
+
+    def handle(self, op, key, payload):
+        del key
+        with self._lock:
+            if op == "srv_submit":
+                if not self.admitting:
+                    return ("err", "replica is draining (not admitting)")
+                cid, prompt, max_new, deadline, eos = payload
+                req = Request(prompt, max_new_tokens=max_new,
+                              deadline=deadline, eos_id=eos,
+                              request_id=cid)
+                self.batcher.submit(req)
+                if req.state == "rejected":
+                    return ("ok", "rejected")
+                self._copies[cid] = req
+                return ("ok", req.state)
+            elif op == "srv_cancel":
+                req = self._copies.get(payload)
+                if req is not None:
+                    self.batcher.cancel(req)
+                return ("ok", None)
+            elif op == "srv_queued":
+                return ("ok", [cid for cid, r in self._copies.items()
+                               if r.state == "queued"])
+            elif op == "srv_poll":
+                out = []
+                done = self.batcher.completed
+                while self._cursor < len(done):
+                    r = done[self._cursor]
+                    self._cursor += 1
+                    if r.id in self._copies:
+                        del self._copies[r.id]
+                        out.append((r.id, r.state,
+                                    list(r.output_tokens)))
+                return ("ok", out)
+            elif op == "srv_load":
+                return ("ok", {
+                    "queue": len(self.batcher._queue),
+                    "active": len(self.batcher._slot_req),
+                    "slots": int(self.batcher.engine.slots)})
+            elif op == "srv_drain":
+                self.admitting = not bool(payload)
+                return ("ok", None)
+        return ("err", "unknown serving op %r" % (op,))
+
+    def step(self):
+        """One decode-loop tick under the op lock; returns True when
+        work was done (the loop thread backs off otherwise)."""
+        with self._lock:
+            if self.batcher._queue or self.batcher._slot_req:
+                self.batcher.step()
+                return True
+            self.batcher.drain()
+        return False
+
+    def run_loop(self, stop_event, idle=0.005):
+        while not stop_event.is_set():
+            if not self.step():
+                stop_event.wait(idle)
+
+
+def serve_replica(engine, coordinator, index=0, host="127.0.0.1",
+                  port=0, now_fn=time.monotonic):
+    """Host one replica as a standalone server: binds an async server
+    answering ``srv_*`` ops, AOT-warms the engine, registers at the
+    ``coordinator`` membership table with the endpoint + capacity meta
+    routers discover remotely, and starts the decode loop thread.
+    Returns ``(server, host_obj, member, stop)`` — call ``stop()`` to
+    drain the loop, deregister, and close."""
+    from .. import tuning
+    from ..async_server import AsyncParamServer
+
+    srv = AsyncParamServer(host, port)
+    bound = srv._sock.getsockname()
+    batcher = ContinuousBatcher(engine, now_fn=now_fn)
+    hostobj = ServingHost(batcher)
+    srv.attach_serving(hostobj)
+    tuning.warmup(steps=(engine,), kernels=False, include_live=False,
+                  reason="fleet_replica")
+    member = WorkerMembership(coordinator[0], coordinator[1],
+                              _replica_member_id(index))
+    member.register(meta={
+        "serving_replica": True, "index": int(index),
+        "slots": int(engine.slots),
+        "endpoint": (bound[0], int(bound[1]))})
+    member.start_heartbeats()
+    stop_event = threading.Event()
+    loop = threading.Thread(target=hostobj.run_loop, args=(stop_event,),
+                            daemon=True, name="fleet-replica-%d" % index)
+    loop.start()
+
+    def stop():
+        stop_event.set()
+        loop.join(timeout=5.0)
+        member.stop(deregister=True)
+        srv.close()
+
+    return srv, hostobj, member, stop
+
+
+def main():
+    """``python -m mxnet_tpu.serving.fleet`` — demo standalone replica:
+    a TinyDecoder engine (geometry via ``MXT_FLEET_MODEL=layers,heads,
+    head_dim``) registered at ``MXT_FLEET_COORDINATOR=host:port`` under
+    ``MXT_FLEET_REPLICA_ID``. Real deployments build their own engine
+    and call :func:`serve_replica` directly."""
+    coord = os.environ.get("MXT_FLEET_COORDINATOR")
+    if not coord or ":" not in coord:
+        raise MXNetError(
+            "set MXT_FLEET_COORDINATOR=host:port (the membership "
+            "coordinator the replica registers with)")
+    chost, _, cport = coord.rpartition(":")
+    geom = os.environ.get("MXT_FLEET_MODEL", "2,2,16").split(",")
+    layers, heads, hdim = (int(x) for x in geom)
+    index = int(os.environ.get("MXT_FLEET_REPLICA_ID", "0"))
+    from .model import TinyDecoder
+    from .engine import DecodeEngine
+
+    model = TinyDecoder(vocab=512, num_layers=layers, num_heads=heads,
+                        head_dim=hdim, max_len=512)
+    eng = DecodeEngine(model, params=model.init_params(0))
+    srv, _, _, stop = serve_replica(eng, (chost, int(cport)),
+                                    index=index,
+                                    port=int(os.environ.get(
+                                        "MXT_FLEET_PORT", "0")))
+    print("SERVING_REPLICA_READY %s:%d"
+          % srv._sock.getsockname()[:2], flush=True)
+    try:
+        while not srv._stop.wait(0.2):
+            pass
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,443 @@
+"""SLO-aware fleet router — the dispatch half of the serving front door.
+
+One :class:`FleetRouter` fronts a :class:`~mxnet_tpu.serving.fleet.
+ReplicaPool`: it owns the request queue, picks replicas by load
+(queue-depth + active slots over capacity — the gauges replicas already
+export), and wires the three robustness behaviors end to end:
+
+- **Failover.** A request whose replica is reaped mid-flight (the
+  membership death listener, or a transport failure observed at
+  dispatch) is transparently re-enqueued onto a survivor. Every routed
+  request carries an **idempotency token**: a replay of an
+  already-completed token returns the recorded result — it never
+  re-decodes. Dispatch retries ride ``resilience.kv_retry``'s typed
+  backoff/deadline machinery, so a fleet with no survivors surfaces as
+  a clean :class:`~mxnet_tpu.resilience.KVStoreError`, never a hang.
+
+- **Hedged dispatch.** A request with no result past its SLO-derived
+  hedge delay (half its deadline, or the router's ``slo``, or
+  ``MXT_FLEET_HEDGE_DELAY``) is speculatively duplicated onto a second
+  replica. First completion wins and is committed once; the loser is
+  cancelled through the replica scheduler's eviction path. The hedge
+  budget (``MXT_FLEET_HEDGE_BUDGET``, default fleet-capacity/4) bounds
+  concurrent hedges so a brownout cannot double the fleet's load.
+
+- **Fencing.** Completions are accepted through one gate: a reply from
+  a fenced replica (reaped zombie, killed, replaced) raises the typed
+  :class:`~mxnet_tpu.serving.fleet.StaleReplicaError` and is never
+  committed — the request's failover copy is the only writer.
+
+Host/device split: the router is PURE host bookkeeping over host
+scalars (queue lengths, wall-clock stamps, token lists already
+materialized by the replicas' deferred windows). It performs zero
+device reads — tools/check_host_syncs.py lint-enforces that.
+
+Telemetry: ``mxt_fleet_replicas{state}``, per-replica
+``mxt_fleet_{dispatch,hedges,failovers,stale_replies}_total``,
+``mxt_fleet_requests_total{outcome}``, ``mxt_fleet_replays_total``,
+and the ``mxt_fleet_request_latency_seconds`` histogram — all rendered
+by ``tools/mxt_top.py``'s fleet section.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import time
+
+from ..resilience import KVStoreError
+from . import metrics as _m
+from .fleet import DEAD, DRAINING, StaleReplicaError
+
+__all__ = ["RoutedRequest", "FleetRouter"]
+
+_tok_ids = itertools.count()
+
+
+class RoutedRequest:
+    """One fleet-level request: prompt + budget + SLO, the idempotency
+    token, and the dispatch/hedge/failover record the router fills in.
+    ``result`` is the committed token list (exactly one commit ever
+    happens per token — ``commits`` asserts it)."""
+
+    __slots__ = ("token", "prompt", "max_new_tokens", "deadline",
+                 "eos_id", "state", "result", "committed_by", "commits",
+                 "copies", "dispatches", "hedges", "failovers",
+                 "hedge_delay", "t_submit", "t_dispatch", "t_finish",
+                 "_ncopy")
+
+    def __init__(self, prompt, max_new_tokens=16, deadline=None,
+                 eos_id=None, token=None):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline = None if deadline is None else float(deadline)  # sync-ok: host scalar
+        self.eos_id = eos_id
+        self.token = token if token is not None \
+            else "fr-%d" % next(_tok_ids)
+        self.state = "queued"  # queued|dispatched|completed|evicted|rejected
+        self.result = None
+        self.committed_by = None
+        self.commits = 0
+        self.copies = {}       # replica_id -> copy_id currently live
+        self.dispatches = 0
+        self.hedges = 0
+        self.failovers = 0
+        self.hedge_delay = None
+        self.t_submit = self.t_dispatch = self.t_finish = None
+        self._ncopy = 0
+
+    @property
+    def done(self):
+        return self.state in ("completed", "evicted", "rejected")
+
+
+class FleetRouter:
+    """Front-door dispatch over a replica pool (see module docstring)."""
+
+    def __init__(self, pool, now_fn=time.monotonic, slo=None,
+                 hedge_delay=None, hedge_budget=None):
+        from .. import config
+
+        self.pool = pool
+        self._now = now_fn
+        self.slo = None if slo is None else float(slo)  # sync-ok: host scalar
+        if hedge_delay is None:
+            hedge_delay = config.get("MXT_FLEET_HEDGE_DELAY")
+        self.hedge_delay = hedge_delay
+        if hedge_budget is None:
+            hedge_budget = config.get("MXT_FLEET_HEDGE_BUDGET")
+        self.hedge_budget = hedge_budget  # None -> capacity-derived
+        self._queue = collections.deque()
+        self._inflight = {}   # token -> RoutedRequest
+        self._by_copy = {}    # copy_id -> RoutedRequest
+        self._results = {}    # token -> completed RoutedRequest (record)
+        self.finished = []    # terminal requests in finish order
+        self.steps = 0
+        self.replays = 0
+        self.stale_replies = 0
+
+    # -- intake ------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=16, deadline=None,
+               eos_id=None, token=None):
+        """Queue one request. ``token`` is the idempotency key: a token
+        whose request already COMPLETED returns the recorded
+        :class:`RoutedRequest` immediately (never re-decodes); one still
+        in flight returns that in-flight request (no duplicate)."""
+        if token is not None:
+            done = self._results.get(token)
+            if done is not None:
+                self.replays += 1
+                _m.fleet_replays_total().inc()
+                return done
+            live = self._inflight.get(token)
+            if live is not None:
+                return live
+        rr = RoutedRequest(prompt, max_new_tokens=max_new_tokens,
+                           deadline=deadline, eos_id=eos_id, token=token)
+        rr.t_submit = self._now()
+        rr.hedge_delay = self._hedge_delay_for(rr)
+        self._inflight[rr.token] = rr
+        self._queue.append(rr)
+        return rr
+
+    def _hedge_delay_for(self, rr):
+        if self.hedge_delay is not None:
+            return float(self.hedge_delay)  # sync-ok: host config scalar
+        budget = rr.deadline if rr.deadline is not None else self.slo
+        return None if budget is None else 0.5 * budget
+
+    # -- the per-tick loop -------------------------------------------------
+    def step(self):
+        """One router tick: apply reaper-reported deaths, fail over
+        orphaned requests, dispatch the queue load-aware, hedge stalled
+        requests, tick every in-process replica's batcher, collect
+        completions through the fence gate, and finish drains. Returns
+        True while work remains."""
+        now = self._now()
+        self.steps += 1
+        self.pool.poll_deaths()
+        self._failover_scan()
+        self._dispatch_queue()
+        self._hedge_scan(now)
+        for h in self.pool.replicas():
+            h.tick(now)
+        self._poll_completions()
+        self._finish_drains()
+        self.pool.publish()
+        return bool(self._queue or self._inflight)
+
+    def run(self, max_steps=100000):
+        """Drive until every submitted request is terminal (or the step
+        bound trips). A non-empty queue with zero routable replicas and
+        nothing in flight raises a typed KVStoreError instead of
+        spinning."""
+        while (self._queue or self._inflight) \
+                and self.steps < int(max_steps):
+            if self._queue and not self.pool.routable() \
+                    and not any(rr.copies
+                                for rr in self._inflight.values()):
+                # nothing dispatched anywhere and nowhere to dispatch:
+                # spinning would never finish — fail typed instead
+                raise KVStoreError(
+                    "serving fleet has no routable replicas for %d "
+                    "queued request(s)" % len(self._queue))
+            self.step()
+        self.flush()
+        return self.finished
+
+    def flush(self):
+        """Barrier: drain every live replica's in-flight window and
+        collect what completed."""
+        for h in self.pool.replicas():
+            if h.state != DEAD:
+                try:
+                    h.flush()
+                except (ConnectionError, OSError):
+                    self.pool.mark_dead(h.index)
+        self._poll_completions()
+        # a drain that emptied on the final tick still deregisters
+        self._finish_drains()
+        self.pool.publish()
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch_queue(self):
+        while self._queue:
+            if not self.pool.routable():
+                break
+            rr = self._queue.popleft()
+            try:
+                self._dispatch(rr)
+            except KVStoreError:
+                # no replica could take it right now: keep it queued
+                self._queue.appendleft(rr)
+                break
+
+    def _dispatch(self, rr, exclude=()):
+        """Place one copy of ``rr`` on the least-loaded routable replica
+        (never one that already holds a copy). Rides kv_retry: a replica
+        that dies between pick and submit is marked dead and the retry
+        picks a survivor; true exhaustion is a typed KVStoreError."""
+        from .. import resilience
+
+        tried = set(exclude)
+
+        def attempt():
+            h = self.pool.pick(exclude=tried | set(rr.copies))
+            if h is None:
+                raise KVStoreError(
+                    "no routable serving replica for request %r"
+                    % (rr.token,))
+            cid = "%s#%d" % (rr.token, rr._ncopy)
+            try:
+                state = h.submit_copy(cid, rr.prompt, rr.max_new_tokens,
+                                      deadline=rr.deadline,
+                                      eos_id=rr.eos_id)
+            except (ConnectionError, OSError):
+                tried.add(h.index)
+                self.pool.mark_dead(h.index)
+                raise
+            return h, cid, state
+
+        h, cid, state = resilience.kv_retry("fleet_dispatch", rr.token,
+                                            attempt)
+        rr._ncopy += 1
+        if state == "rejected":
+            # deterministic admission reject (cannot ever fit the
+            # engine): terminal, not retried
+            self._finish(rr, "rejected")
+            return None
+        rr.copies[h.index] = cid
+        self._by_copy[cid] = rr
+        rr.dispatches += 1
+        rr.state = "dispatched"
+        if rr.t_dispatch is None:
+            rr.t_dispatch = self._now()
+        _m.fleet_dispatch_total().labels(str(h.index)).inc()
+        return h
+
+    # -- failover ----------------------------------------------------------
+    def _failover_scan(self):
+        """Strip copies living on dead/fenced replicas; a request left
+        with no live copy re-enqueues at the FRONT of the queue (it has
+        already waited) unless its token already committed."""
+        # a fenced-but-unmarked replica (the zombie verdict landed
+        # between steps, its process may still be decoding): collect
+        # its late replies ONE last time — every one is refused typed
+        # at the accept gate, never committed — then mark it dead
+        for h in self.pool.replicas():
+            if h.state == DEAD or not h.fenced:
+                continue
+            try:
+                late = h.poll()
+            except (ConnectionError, OSError):
+                late = []
+            for cid, state, tokens in late:
+                try:
+                    self.accept(h, cid, state, tokens)
+                except StaleReplicaError:
+                    self.stale_replies += 1
+                    _m.fleet_stale_replies_total().labels(
+                        str(h.index)).inc()
+            self.pool.mark_dead(h.index)
+        for rr in list(self._inflight.values()):
+            for rid, cid in list(rr.copies.items()):
+                h = self.pool.get(rid)
+                if h.state != DEAD and not h.fenced:
+                    continue
+                if h.state != DEAD:
+                    self.pool.mark_dead(rid)
+                del rr.copies[rid]
+                self._by_copy.pop(cid, None)
+                rr.failovers += 1
+                _m.fleet_failovers_total().labels(str(rid)).inc()
+            if not rr.copies and not rr.done \
+                    and rr.token not in self._results \
+                    and rr not in self._queue:
+                rr.state = "queued"
+                self._queue.appendleft(rr)
+
+    # -- hedging -----------------------------------------------------------
+    def _hedge_budget(self):
+        if self.hedge_budget is not None:
+            return int(self.hedge_budget)
+        return max(1, self.pool.total_capacity() // 4)
+
+    def _hedge_scan(self, now):
+        budget = self._hedge_budget()
+        if budget <= 0:
+            return
+        outstanding = sum(1 for rr in self._inflight.values()
+                          if len(rr.copies) > 1)
+        for rr in list(self._inflight.values()):
+            if outstanding >= budget:
+                break
+            if rr.done or len(rr.copies) != 1 or rr.hedge_delay is None \
+                    or rr.t_dispatch is None \
+                    or now - rr.t_dispatch <= rr.hedge_delay:
+                continue
+            try:
+                h = self._dispatch(rr, exclude=set(rr.copies))
+            except KVStoreError:
+                continue  # no second replica available to hedge onto
+            if h is not None:
+                rr.hedges += 1
+                outstanding += 1
+                _m.fleet_hedges_total().labels(str(h.index)).inc()
+
+    # -- completion / fencing ----------------------------------------------
+    def _poll_completions(self):
+        for h in self.pool.replicas():
+            if h.state == DEAD:
+                continue  # a dead replica's replies only arrive through
+                # accept(), which refuses them typed (zombie path)
+            try:
+                done = h.poll()
+            except (ConnectionError, OSError):
+                self.pool.mark_dead(h.index)
+                continue
+            for cid, state, tokens in done:
+                try:
+                    self.accept(h, cid, state, tokens)
+                except StaleReplicaError:
+                    self.stale_replies += 1
+                    _m.fleet_stale_replies_total().labels(
+                        str(h.index)).inc()
+                    self.pool.mark_dead(h.index)
+
+    def accept(self, handle, copy_id, state, tokens):
+        """THE fence gate: deliver one copy's terminal state. A reply
+        from a fenced replica (reaped zombie, killed, replaced) raises
+        the typed :class:`StaleReplicaError` — its tokens are never
+        committed; the failover copy is the only writer. Cancelled
+        losers and detached copies settle silently."""
+        if handle.fenced or handle.state == DEAD:
+            raise StaleReplicaError(
+                "late reply %r from fenced serving replica %d (state "
+                "%r): the request has failed over — a zombie's tokens "
+                "are refused, not committed"
+                % (copy_id, handle.index, handle.state))
+        rr = self._by_copy.pop(copy_id, None)
+        if rr is None:
+            return False  # cancelled loser / drained-away copy
+        for rid, cid in list(rr.copies.items()):
+            if cid == copy_id:
+                del rr.copies[rid]
+        if rr.token in self._results:
+            return False  # already committed (duplicate completion)
+        if state == "completed":
+            self._commit(rr, handle, tokens)
+        elif state in ("evicted", "rejected") and not rr.copies:
+            # every copy is gone and none completed: the SLO miss (or
+            # admission reject) is the request's real outcome
+            self._finish(rr, state)
+        return True
+
+    def _commit(self, rr, handle, tokens):
+        rr.result = [int(t) for t in tokens]
+        rr.commits += 1
+        rr.committed_by = handle.index
+        # cancel losers through the replica scheduler's eviction path
+        for rid, cid in list(rr.copies.items()):
+            self._by_copy.pop(cid, None)
+            try:
+                self.pool.get(rid).cancel_copy(cid)
+            except (ConnectionError, OSError):
+                self.pool.mark_dead(rid)
+        rr.copies.clear()
+        self._results[rr.token] = rr
+        self._finish(rr, "completed")
+
+    def _finish(self, rr, outcome):
+        rr.state = outcome
+        rr.t_finish = self._now()
+        self._inflight.pop(rr.token, None)
+        self.finished.append(rr)
+        _m.fleet_requests_total().labels(outcome).inc()
+        if outcome == "completed" and rr.t_submit is not None:
+            _m.fleet_request_latency().observe(
+                max(0.0, rr.t_finish - rr.t_submit))
+
+    # -- drain / rejoin ----------------------------------------------------
+    def drain(self, rid):
+        """Graceful drain of replica ``rid``: stop routing to it,
+        MIGRATE its still-queued copies back to the router (they
+        re-dispatch onto peers), let running copies finish, and — once
+        it is empty — deregister it cleanly (``_finish_drains``).
+        Rejoin via ``pool.get(rid).rejoin()``: the replica AOT-warms
+        through the shared compile cache before it is routable again."""
+        h = self.pool.get(rid)
+        h.drain_start()
+        try:
+            queued = h.queued_copies()
+        except (ConnectionError, OSError):
+            self.pool.mark_dead(rid)
+            return h
+        for cid in queued:
+            rr = self._by_copy.pop(cid, None)
+            try:
+                h.cancel_copy(cid)
+            except (ConnectionError, OSError):
+                self.pool.mark_dead(rid)
+                break
+            if rr is None:
+                continue
+            for r2, c2 in list(rr.copies.items()):
+                if c2 == cid:
+                    del rr.copies[r2]
+            if not rr.copies and not rr.done \
+                    and rr.token not in self._results:
+                rr.state = "queued"
+                self._queue.appendleft(rr)
+        self.pool.publish()
+        return h
+
+    def _finish_drains(self):
+        for h in self.pool.replicas():
+            if h.state != DRAINING:
+                continue
+            if h.pending():
+                continue
+            if any(h.index in rr.copies
+                   for rr in self._inflight.values()):
+                continue
+            h.finish_drain()
+            self.pool.publish()

@@ -191,6 +191,36 @@ class ContinuousBatcher:
             r._take_first(now)
         self._reap_finished(now)
 
+    def cancel(self, request):
+        """Force-evict one request — queued or running — freeing its
+        slot and pages: the fleet router's hedge-loser and
+        drain-migration hook. Rides the deadline-eviction bookkeeping
+        (same ``outcome="evicted"`` accounting, same mid-window safety:
+        in-flight steps still attribute through their metadata and the
+        late tokens are discarded). Idempotent; returns True when the
+        request was live here."""
+        if request.done:
+            return False
+        hit = False
+        try:
+            self._queue.remove(request)
+            hit = True
+        except ValueError:
+            pass
+        for slot, req in list(self._slot_req.items()):
+            if req is request:
+                self.engine.release(slot)
+                del self._slot_req[slot]
+                hit = True
+        if not hit:
+            return False
+        request.state = "evicted"
+        request.t_finish = self._now()
+        self._finalize(request, "evicted")
+        _m.queue_depth().set(len(self._queue))
+        _m.active_requests().set(len(self._slot_req))
+        return True
+
     # -- internals --------------------------------------------------------
     def _free_slots(self):
         return [s for s in range(self.engine.slots)

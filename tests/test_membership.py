@@ -901,3 +901,36 @@ def test_snapshot_crc_verification():
     with pytest.raises(MXNetError, match="CRC"):
         membership.verify_snapshot(bad)
     assert membership.verify_snapshot(None) is None
+
+
+# ---------------------------------------------------------------------------
+# teardown order: graceful deregister is best-effort and SHORT-bounded
+# ---------------------------------------------------------------------------
+def test_deregister_bounded_after_coordinator_close():
+    """The PR 10 teardown-order gotcha, generalized: closing a
+    coordinator BEFORE its dependents used to cost each dependent's
+    graceful deregister a full transport deadline (the reconnect spun
+    out the handle's whole connect timeout). Deregister is now
+    best-effort under membership._DEREGISTER_DEADLINE — a reversed
+    close order costs ~2s per handle, not 30s, and never raises."""
+    srv = async_server.AsyncParamServer("127.0.0.1", 0)
+    port = srv._sock.getsockname()[1]
+    wm = membership.WorkerMembership("127.0.0.1", port, 7, timeout=30.0)
+    wm.register()
+    srv.close()                      # the coordinator dies FIRST
+    t0 = time.monotonic()
+    wm.stop(deregister=True)         # must not park for ~timeout
+    dt = time.monotonic() - t0
+    assert dt < 4 * membership._DEREGISTER_DEADLINE, \
+        "deregister against a dead coordinator took %.1fs" % dt
+    # the bound is per-stop, so closing N dependents after the
+    # coordinator is N * ~2s, not N * 30s; and a LIVE coordinator
+    # still deregisters gracefully (fast path unaffected)
+    srv2 = async_server.AsyncParamServer("127.0.0.1", 0)
+    port2 = srv2._sock.getsockname()[1]
+    wm2 = membership.WorkerMembership("127.0.0.1", port2, 8)
+    wm2.register()
+    assert 8 in srv2.membership.live_ids()
+    wm2.stop(deregister=True)
+    assert 8 not in srv2.membership.live_ids()
+    srv2.close()

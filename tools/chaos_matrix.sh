@@ -81,5 +81,27 @@ for seed in "${SEEDS[@]}"; do
     fi
 done
 
+# -- serving fleet sweep ------------------------------------------------------
+# replica_kill / replica_slow: the chaos-marked cells in tests/test_fleet.py
+# kill one serving replica at a seeded router tick (in-flight requests fail
+# over to survivors, token-exact, zero lost) and brown one out (the hedge
+# fires at the SLO-derived delay, the healthy replica wins, the loser is
+# cancelled) — all typed, no hang; the outer `timeout` is only the backstop.
+for seed in "${SEEDS[@]}"; do
+    echo "== fleet sweep: MXT_CHAOS_SEED=$seed (cell timeout ${CELL_TIMEOUT}s)"
+    timeout -k 10 "$CELL_TIMEOUT" env JAX_PLATFORMS=cpu \
+        MXT_CHAOS_SEED="$seed" \
+        python -m pytest tests/test_fleet.py -q -m "chaos and not slow" \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    rc=$?
+    if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+        echo "!! HANG: fleet sweep seed=$seed exceeded ${CELL_TIMEOUT}s" >&2
+        fail=1
+    elif [ "$rc" -ne 0 ]; then
+        echo "!! FAIL: fleet sweep seed=$seed rc=$rc" >&2
+        fail=1
+    fi
+done
+
 [ "$fail" -eq 0 ] && echo "chaos matrix: all seeds clean"
 exit "$fail"

@@ -107,6 +107,14 @@ SCAN = {
     "mxnet_tpu/serving/kv_cache.py": _ALL,
     "mxnet_tpu/serving/model.py": _ALL,
     "mxnet_tpu/serving/metrics.py": _ALL,
+    # the fleet router sits ABOVE the decode hot path but runs between
+    # every decode tick of every replica: routing decisions must be
+    # host arithmetic on gauges and wall clocks, never a device read —
+    # one stray sync here re-serializes the whole fleet's pipelines.
+    # Control-plane scalars (config values, fault-rule params) are the
+    # only sanctioned float()s, each sync-ok annotated.
+    "mxnet_tpu/serving/fleet.py": _ALL,
+    "mxnet_tpu/serving/router.py": _ALL,
 }
 
 _MARKER = "sync-ok"

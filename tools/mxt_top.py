@@ -31,6 +31,13 @@ and, when the process serves (mxnet_tpu/serving/ metrics present):
     request p50/p99    decode-phase request latency quantiles
     kv pages           paged KV-cache occupancy vs pool capacity
 
+and, when a fleet router is live (serving/fleet.py + router.py):
+
+    fleet replicas     routable / total, draining + dead counts
+    disp/hedge/fail    dispatches, hedged duplicates, failovers (plus
+                       fenced-zombie replies refused typed)
+    routed p50/p99     fleet-level request latency (submit -> commit)
+
 and, when the diagnostics layer publishes (mxnet_tpu/diagnostics.py):
 
     hbm <pool>         per-subsystem device bytes (params / optimizer /
@@ -315,6 +322,21 @@ def render(samples, prev, dt):
         samples, "mxt_embedding_pull_seconds", (0.50, 0.99))
     emb_bytes_rate, _ = rate("mxt_embedding_bytes_total")
 
+    # fleet section (serving/fleet.py + serving/router.py): only
+    # rendered when a fleet router has published replica-state gauges
+    flt_states = {}
+    for (n, lab), v in samples.items():
+        if n == "mxt_fleet_replicas":
+            d = dict(lab)
+            if "state" in d:
+                flt_states[d["state"]] = v
+    flt_disp = metric_sum(samples, "mxt_fleet_dispatch_total")
+    flt_hedge = metric_sum(samples, "mxt_fleet_hedges_total")
+    flt_fail = metric_sum(samples, "mxt_fleet_failovers_total")
+    flt_stale = metric_sum(samples, "mxt_fleet_stale_replies_total")
+    flt_p50, flt_p99 = histogram_quantiles(
+        samples, "mxt_fleet_request_latency_seconds", (0.50, 0.99))
+
     # serving section (mxnet_tpu/serving/): only rendered when the
     # process has served — a pure trainer shows no serving noise
     tok_rate, tok_total = rate("mxt_serving_tokens_total")
@@ -381,6 +403,22 @@ def render(samples, prev, dt):
             % (_fmt_s(emb_p50), _fmt_s(emb_p99),
                _fmt(emb_evict, "%.0f")),
             "  emb bytes/s      %s" % _fmt_b(emb_bytes_rate),
+        ]
+    if flt_states:
+        lines += [
+            "-" * 46,
+            "  fleet replicas   %s routable / %s total   (drain %s "
+            "dead %s)"
+            % (_fmt(flt_states.get("routable", 0), "%.0f"),
+               _fmt(sum(flt_states.values()), "%.0f"),
+               _fmt(flt_states.get("draining", 0)
+                    + flt_states.get("drained", 0), "%.0f"),
+               _fmt(flt_states.get("dead", 0), "%.0f")),
+            "  disp/hedge/fail  %s / %s / %s   stale refused %s"
+            % (_fmt(flt_disp, "%.0f"), _fmt(flt_hedge, "%.0f"),
+               _fmt(flt_fail, "%.0f"), _fmt(flt_stale, "%.0f")),
+            "  routed p50/p99   %s / %s"
+            % (_fmt_s(flt_p50), _fmt_s(flt_p99)),
         ]
     if tok_total is not None:
         lines += [
