@@ -45,7 +45,8 @@ _CONFIG_COST = {"resnet50": 420, "bert": 300, "lstm_ptb": 200,
                 "wide_deep": 200, "lenet": 150, "pipeline": 150,
                 "async_ab": 90, "telemetry_ab": 60, "diag_ab": 60,
                 "cold_warm": 120, "serving": 150, "zero_stage": 90,
-                "embedding_ab": 90, "serving_fleet": 120}
+                "embedding_ab": 90, "serving_fleet": 120,
+                "speculative": 120, "kv_quant": 90}
 
 
 def _remaining():
@@ -1015,10 +1016,17 @@ def bench_embedding_ab(platform, dtype):
     vocab = int(os.environ.get("BENCH_EMB_VOCAB",
                                "50000" if small else "500000"))
     dim = int(os.environ.get("BENCH_EMB_DIM", "64"))
-    batch = int(os.environ.get("BENCH_EMB_BATCH",
-                               "4096" if small else "16384"))
+    # 16k rows/step: the PERF.md-recorded geometry where the server-side
+    # sparse apply (the part that scales with the fleet) dominates the
+    # per-RPC fixed cost — smaller batches mostly measure transport
+    batch = int(os.environ.get("BENCH_EMB_BATCH", "16384"))
     iters = int(os.environ.get("BENCH_EMB_ITERS", "8" if small else "20"))
-    warmup = int(os.environ.get("BENCH_EMB_WARMUP", "2"))
+    # shape warmup: with the pow2 row-count buckets the first few steps
+    # compile one program per touched bucket and the timed lap replays
+    # them — 3 laps cover the unique/hit/miss buckets this geometry
+    # visits, so the A/B measures transport+apply, not XLA compiles
+    # (the pre-bucket rows measured ~320 compiles over 8 steps)
+    warmup = int(os.environ.get("BENCH_EMB_WARMUP", "3"))
     cache_rows = int(os.environ.get("BENCH_EMB_CACHE", "8192"))
 
     def counter_total(name):
@@ -1043,6 +1051,8 @@ def bench_embedding_ab(platform, dtype):
             # long cold tail that keeps the fleet busy
             return (rng.zipf(1.2, size=batch) % vocab).astype(np.int64)
 
+        from mxnet_tpu import tuning
+
         try:
             for _ in range(warmup):
                 ids = sample()
@@ -1050,12 +1060,14 @@ def bench_embedding_ab(platform, dtype):
                 tbl.push(ids, rows * 0.01)
             b0 = counter_total("mxt_embedding_bytes_total")
             r0 = counter_total("mxt_embedding_rpcs_total")
+            c0 = tuning.compile_stats()
             t0 = time.perf_counter()
             for _ in range(iters):
                 ids = sample()
                 rows = tbl.pull(ids)
                 tbl.push(ids, rows * 0.01)
             dt = time.perf_counter() - t0
+            c1 = tuning.compile_stats()
             nbytes = counter_total("mxt_embedding_bytes_total") - b0
             rpcs = counter_total("mxt_embedding_rpcs_total") - r0
             return {
@@ -1063,6 +1075,11 @@ def bench_embedding_ab(platform, dtype):
                 "samples_per_sec": batch * iters / dt if dt else 0.0,
                 "rpcs_per_step": rpcs / (2.0 * iters),  # pull+push = 1 step
                 "hit_ratio": tbl.cache.hit_ratio,
+                # bucket-bounded claim: compiles in the TIMED lap (the
+                # pre-bucket code recompiled the sparse path per step)
+                "measured_compiles": c1["compiles"] - c0["compiles"],
+                "measured_compile_ms": round(
+                    (c1["compile_seconds"] - c0["compile_seconds"]) * 1e3),
             }
         finally:
             tbl.close()
@@ -1071,8 +1088,14 @@ def bench_embedding_ab(platform, dtype):
             for h in reversed(handles):
                 h.close()
 
-    one = run(1)
-    two = run(2)
+    def best(n_servers, reps=2):
+        # best-of-reps per leg: the legs run sequentially, so one
+        # scheduler hiccup would otherwise skew the ratio either way
+        runs = [run(n_servers) for _ in range(reps)]
+        return max(runs, key=lambda r: r["bytes_per_sec"])
+
+    one = best(1)
+    two = best(2)
     scaling = two["bytes_per_sec"] / one["bytes_per_sec"] \
         if one["bytes_per_sec"] else 0.0
     row = {
@@ -1088,6 +1111,9 @@ def bench_embedding_ab(platform, dtype):
         "rpcs_per_step_1srv": round(one["rpcs_per_step"], 2),
         "rpcs_per_step_2srv": round(two["rpcs_per_step"], 2),
         "samples_per_sec_2srv": round(two["samples_per_sec"], 1),
+        "measured_compiles_1srv": one["measured_compiles"],
+        "measured_compiles_2srv": two["measured_compiles"],
+        "measured_compile_ms_2srv": two["measured_compile_ms"],
     }
     _emit_jsonl(row)
     return scaling, row
@@ -1191,6 +1217,209 @@ def bench_serving_fleet(platform, dtype):
     }
     _emit_jsonl(row)
     return scaling, row
+
+
+def bench_speculative(platform, dtype):
+    """speculative_ab (serving/speculative.py): the SAME mixed-length
+    traffic decoded by the plain engine and by the speculative engine
+    (1-layer truncated draft of the 4-layer target, draft_k proposals
+    verified in one wide launch). Records tokens/s both ways, the
+    acceptance rate, host syncs/step, and asserts-by-record that the
+    two engines' token streams are IDENTICAL (greedy token-exact —
+    speculation changes the schedule, never the output)."""
+    import numpy as np
+
+    from mxnet_tpu import profiler, serving
+
+    del dtype  # f32: the A/B isolates scheduling, not math throughput
+    slots = int(os.environ.get("BENCH_SPEC_SLOTS", "8"))
+    n_req = int(os.environ.get("BENCH_SPEC_REQUESTS", "24"))
+    draft_k = int(os.environ.get("BENCH_SPEC_K", "4"))
+    layers, heads, hdim = 4, 2, 32
+    model = serving.TinyDecoder(vocab=512, num_layers=layers,
+                                num_heads=heads, head_dim=hdim,
+                                max_len=512)
+    params = model.init_params(0)
+    draft, dparams = model.truncated(params, 1)
+
+    def traffic(n):
+        rng = np.random.RandomState(7)
+        return [(rng.randint(1, 512, int(rng.randint(4, 97))).tolist(),
+                 int(rng.randint(8, 65))) for _ in range(n)]
+
+    def run(spec):
+        if spec:
+            eng = serving.SpeculativeEngine(
+                model, draft, params=params, draft_params=dparams,
+                draft_k=draft_k, slots=slots,
+                cache=serving.PagedKVCache(layers, heads, hdim,
+                                           num_pages=128, page_size=16),
+                draft_cache=serving.PagedKVCache(
+                    1, heads, hdim, num_pages=128, page_size=16),
+                prefill_buckets=(64, 128), max_context=176)
+        else:
+            eng = serving.DecodeEngine(
+                model, params=params, slots=slots,
+                cache=serving.PagedKVCache(layers, heads, hdim,
+                                           num_pages=128, page_size=16),
+                prefill_buckets=(64, 128), max_context=176)
+        eng.aot_warmup()
+        warm = serving.ContinuousBatcher(eng)
+        for p, m in traffic(6):
+            warm.submit(serving.Request(p, max_new_tokens=m))
+        warm.run()
+        best = None
+        for _ in range(3):  # best-of-3: steady-state, box-noise-proof
+            sched = serving.ContinuousBatcher(eng)
+            reqs = [sched.submit(serving.Request(p, max_new_tokens=m))
+                    for p, m in traffic(n_req)]
+            h0 = profiler.host_sync_count()
+            t0 = time.perf_counter()
+            sched.run(max_steps=50000)
+            dt = time.perf_counter() - t0
+            syncs = profiler.host_sync_count() - h0
+            toks = sum(len(r.output_tokens) for r in reqs)
+            lap = {"streams": [r.output_tokens for r in reqs],
+                   "tokens_per_sec": toks / dt if dt else 0.0,
+                   "steps": sched.steps,
+                   "host_syncs_per_step": syncs / max(1, sched.steps)}
+            if best is None or lap["tokens_per_sec"] \
+                    > best["tokens_per_sec"]:
+                best = lap
+        return best
+
+    def counter_total(name):
+        from mxnet_tpu import telemetry
+
+        fam = telemetry.registry().get(name)
+        if fam is None:
+            return 0.0
+        return float(sum(ch.value for ch in fam.children().values()))
+
+    base = run(False)
+    p0 = counter_total("mxt_serving_spec_proposed_tokens_total")
+    a0 = counter_total("mxt_serving_spec_accepted_tokens_total")
+    spec = run(True)
+    proposed = counter_total(
+        "mxt_serving_spec_proposed_tokens_total") - p0
+    accepted = counter_total(
+        "mxt_serving_spec_accepted_tokens_total") - a0
+    speedup = spec["tokens_per_sec"] / base["tokens_per_sec"] \
+        if base["tokens_per_sec"] else 0.0
+    row = {
+        "config": "speculative_ab", "chips": 1, "batch_size": slots,
+        "dtype": "float32", "platform": platform, "requests": n_req,
+        "draft_k": draft_k,
+        "images_or_tokens_per_sec_per_chip": round(
+            spec["tokens_per_sec"], 2),
+        "baseline_tokens_per_sec": round(base["tokens_per_sec"], 2),
+        "speculative_tokens_per_sec": round(spec["tokens_per_sec"], 2),
+        "speculative_speedup": round(speedup, 3),
+        "token_exact": base["streams"] == spec["streams"],
+        "acceptance_rate": round(accepted / proposed, 4)
+        if proposed else None,
+        "baseline_steps": base["steps"],
+        "speculative_steps": spec["steps"],
+        "host_syncs_per_step": round(spec["host_syncs_per_step"], 3),
+        "mfu": None, "flops_per_sample": None,
+    }
+    _emit_jsonl(row)
+    return speedup, row
+
+
+def bench_kv_quant(platform, dtype):
+    """kv_quant_ab (serving/kv_cache.py quantized pools): the SAME
+    short-sequence flood served from an f32 KV pool and from an int8
+    pool holding the SAME DEVICE BYTE BUDGET — the quantized pool packs
+    ~3-4x the pages, so admission keeps ~3-4x the sequences resident
+    concurrently (the capacity half), at bounded output divergence and
+    unchanged decode-loop syncs/step (the quality/async halves)."""
+    import numpy as np
+
+    from mxnet_tpu import profiler, serving
+
+    del dtype
+    # slots exceed what the f32 pool can seat at this byte budget: the
+    # POOL is the binding resource, so resident concurrency measures
+    # page capacity (the quantized pool's whole point), not slot count
+    slots = int(os.environ.get("BENCH_KVQ_SLOTS", "48"))
+    n_req = int(os.environ.get("BENCH_KVQ_REQUESTS", "64"))
+    budget = int(os.environ.get("BENCH_KVQ_BYTES", str(768 << 10)))
+    layers, heads, hdim = 2, 2, 32
+    model = serving.TinyDecoder(vocab=512, num_layers=layers,
+                                num_heads=heads, head_dim=hdim,
+                                max_len=512)
+    params = model.init_params(0)
+
+    def traffic(n):
+        rng = np.random.RandomState(11)
+        return [(rng.randint(1, 512, int(rng.randint(8, 33))).tolist(),
+                 int(rng.randint(8, 25))) for _ in range(n)]
+
+    def run(quantized):
+        pages = serving.PagedKVCache.pages_for_budget(
+            budget, layers, heads, hdim, page_size=16,
+            quantized=quantized)
+        cache = serving.PagedKVCache(layers, heads, hdim,
+                                     num_pages=pages, page_size=16,
+                                     quantized=quantized)
+        eng = serving.DecodeEngine(model, params=params, slots=slots,
+                                   cache=cache,
+                                   prefill_buckets=(64,),
+                                   max_context=64)
+        eng.aot_warmup()
+        warm = serving.ContinuousBatcher(eng)
+        warm.submit(serving.Request([1, 2, 3], max_new_tokens=4))
+        warm.run()
+        sched = serving.ContinuousBatcher(eng)
+        reqs = [sched.submit(serving.Request(p, max_new_tokens=m))
+                for p, m in traffic(n_req)]
+        peak = 0
+        h0 = profiler.host_sync_count()
+        t0 = time.perf_counter()
+        while (sched._queue or sched._slot_req) and sched.steps < 20000:
+            sched.step()
+            peak = max(peak, len(cache._quota))
+        sched.drain()
+        dt = time.perf_counter() - t0
+        syncs = profiler.host_sync_count() - h0
+        toks = sum(len(r.output_tokens) for r in reqs)
+        return {"streams": [r.output_tokens for r in reqs],
+                "pages": pages, "peak_resident": peak,
+                "tokens_per_sec": toks / dt if dt else 0.0,
+                "page_bytes": cache.page_bytes,
+                "host_syncs_per_step": syncs / max(1, sched.steps)}
+
+    f32 = run(False)
+    q8 = run(True)
+    total = sum(len(s) for s in f32["streams"])
+    same = sum(sum(1 for x, y in zip(a, b) if x == y)
+               for a, b in zip(f32["streams"], q8["streams"]))
+    ratio = q8["peak_resident"] / f32["peak_resident"] \
+        if f32["peak_resident"] else 0.0
+    row = {
+        "config": "kv_quant_ab", "chips": 1, "batch_size": slots,
+        "dtype": "float32", "platform": platform, "requests": n_req,
+        "byte_budget": budget,
+        "pages_f32": f32["pages"], "pages_int8": q8["pages"],
+        "page_bytes_f32": f32["page_bytes"],
+        "page_bytes_int8": q8["page_bytes"],
+        "peak_resident_f32": f32["peak_resident"],
+        "peak_resident_int8": q8["peak_resident"],
+        "resident_ratio": round(ratio, 3),
+        "token_agreement": round(same / total, 4) if total else None,
+        "tokens_per_sec_f32": round(f32["tokens_per_sec"], 2),
+        "tokens_per_sec_int8": round(q8["tokens_per_sec"], 2),
+        "images_or_tokens_per_sec_per_chip": round(
+            q8["tokens_per_sec"], 2),
+        "host_syncs_per_step_f32": round(
+            f32["host_syncs_per_step"], 3),
+        "host_syncs_per_step_int8": round(
+            q8["host_syncs_per_step"], 3),
+        "mfu": None, "flops_per_sample": None,
+    }
+    _emit_jsonl(row)
+    return ratio, row
 
 
 def bench_cold_warm(platform, dtype):
@@ -1478,7 +1707,7 @@ def main():
         "BENCH_CONFIGS",
         "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline,async_ab,"
         "telemetry_ab,diag_ab,cold_warm,serving,zero_stage,embedding_ab,"
-        "serving_fleet"
+        "serving_fleet,speculative,kv_quant"
     ).split(",")
 
     # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
@@ -1514,6 +1743,12 @@ def main():
         "serving_fleet": ("serving_fleet_scaling",
                           "x (2rep/1rep fleet tokens/s)",
                           bench_serving_fleet),
+        "speculative": ("speculative_decode_speedup",
+                        "x (speculative/plain tokens/s, token-exact)",
+                        bench_speculative),
+        "kv_quant": ("kv_quant_resident_ratio",
+                     "x (int8/f32 resident sequences at equal bytes)",
+                     bench_kv_quant),
     }
     headline = None
     errors = []
@@ -1522,7 +1757,7 @@ def main():
     for name in ("resnet50", "bert", "lstm_ptb", "wide_deep", "lenet",
                  "pipeline", "async_ab", "telemetry_ab", "diag_ab",
                  "cold_warm", "serving", "zero_stage", "embedding_ab",
-                 "serving_fleet"):
+                 "serving_fleet", "speculative", "kv_quant"):
         if name not in configs:
             continue
         cost = float(os.environ.get("BENCH_COST_%s" % name.upper(),

@@ -28,6 +28,15 @@ dispatch, hedged retries, failover with idempotency tokens), and
 every accepted request still completes (failover, zero lost)::
 
     python examples/serve_bert.py --replicas 2 --kill-one
+
+`--draft-k K` turns on speculative decoding (a 1-layer truncated draft
+proposes K tokens per slot; the target verifies them in one wide
+launch — greedy token-exact, more tokens per launch) and
+`--quantize-kv` serves from int8 KV pages (~4x the resident sequences
+per byte of pool). Both compose with every other flag::
+
+    python examples/serve_bert.py --draft-k 4 --quantize-kv --ab
+    python examples/serve_bert.py --draft-k 4 --replicas 2 --kill-one
 """
 from __future__ import annotations
 
@@ -103,6 +112,13 @@ def main():
                         "mid-run (no deregister, heartbeats stop) and "
                         "show every request still completing via "
                         "failover")
+    p.add_argument("--draft-k", type=int, default=0, metavar="K",
+                   help="speculative decoding: a 1-layer truncated "
+                        "draft proposes K tokens per slot, verified in "
+                        "one wide launch (token-exact; 0 = off)")
+    p.add_argument("--quantize-kv", action="store_true",
+                   help="serve from int8-quantized KV pages (per-row "
+                        "scales; ~4x resident sequences per pool byte)")
     p.add_argument("--watchdog", type=float, nargs="?", const=30.0,
                    default=None, metavar="SECONDS",
                    help="arm the diagnostics layer (flight recorder + "
@@ -133,14 +149,29 @@ def main():
                                 head_dim=args.head_dim, max_len=512)
     params = model.init_params(0)
 
+    if args.draft_k:
+        draft_model, draft_params = model.truncated(params, 1)
+
     def engine():
         cache = serving.PagedKVCache(model.num_layers, model.num_heads,
                                      model.head_dim,
-                                     num_pages=args.pages)
-        eng = serving.DecodeEngine(model, params=params,
-                                   slots=args.slots, cache=cache,
-                                   prefill_buckets=(64, 128),
-                                   max_context=256)
+                                     num_pages=args.pages,
+                                     quantized=args.quantize_kv)
+        if args.draft_k:
+            eng = serving.SpeculativeEngine(
+                model, draft_model, params=params,
+                draft_params=draft_params, draft_k=args.draft_k,
+                slots=args.slots, cache=cache,
+                draft_cache=serving.PagedKVCache(
+                    draft_model.num_layers, draft_model.num_heads,
+                    draft_model.head_dim, num_pages=args.pages,
+                    quantized=args.quantize_kv),
+                prefill_buckets=(64, 128), max_context=256)
+        else:
+            eng = serving.DecodeEngine(model, params=params,
+                                       slots=args.slots, cache=cache,
+                                       prefill_buckets=(64, 128),
+                                       max_context=256)
         t0 = time.perf_counter()
         n = eng.aot_warmup()
         print("aot_warmup: %d request-path programs in %.1fs "

@@ -162,8 +162,21 @@ class HotRowCache:
                 self._lru[rid] = None
                 self._lru.move_to_end(rid)
                 slots.append(slot)
-        self._buf = self._buf.at[jnp.asarray(slots)].set(
-            jnp.asarray(rows, dtype=self._buf.dtype))
+        # pow2 row bucket: the insert count is data-dependent (miss
+        # batches, push write-backs), and an unbucketed scatter shape
+        # recompiled per step; pad slots out of range (dropped) and
+        # rows with zeros. Padding happens host-side — both callers
+        # (server fetch, push-reply write-back) hand rows that are
+        # already host bytes off the RPC reply.
+        from .client import bucket_rows as _bucket
+
+        nb = _bucket(len(slots))
+        pslots = np.full((nb,), self.capacity, np.int64)
+        pslots[:len(slots)] = slots
+        prows = np.zeros((nb, self.dim), dtype=str(self._buf.dtype))
+        prows[:len(slots)] = np.asarray(rows)  # sync-ok: RPC reply rows are already host bytes
+        self._buf = self._buf.at[jnp.asarray(pslots)].set(
+            jnp.asarray(prows), mode="drop")
         if evicted:
             self._c_evict.labels(self.name).inc(evicted)
         self._publish()

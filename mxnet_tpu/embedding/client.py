@@ -36,7 +36,25 @@ from .hashing import HashRing
 from .store import EmbeddingStore
 
 __all__ = ["EmbeddingFleet", "ShardedEmbedding", "LocalEmbeddingServer",
-           "local_fleet", "start_local_server"]
+           "local_fleet", "start_local_server", "bucket_rows"]
+
+
+def bucket_rows(n):
+    """Next power of two ≥ n (min 1) — the row-count shape bucket.
+
+    Every per-step device program in the sparse path (the client's
+    duplicate-id segment-sum, the pull scatter/gathers, the server's
+    compact sparse apply) takes a DATA-DEPENDENT unique-row count;
+    unbucketed, a zipf draw mints a fresh XLA program almost every step
+    (PERF.md measured ~320 compiles over 8 bench steps — both A/B legs
+    were compile-bound). Padding the row axis to pow2 buckets bounds
+    the program count at log2(batch) per op, the ``tuning.paged_key``
+    discipline applied to the embedding fleet."""
+    n = max(1, int(n))
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
 
 # how a transport-dead server surfaces from AsyncClient.request
 _DEAD_ERRORS = (KVStoreError, ConnectionError, OSError)
@@ -425,18 +443,33 @@ class ShardedEmbedding:
             dtype=np.int64)
         flat = ids.ravel()
         uids, inverse = np.unique(flat, return_inverse=True)
-        out = jnp.zeros((len(uids), self._dim), dtype=str(self.dtype))
+        # every device shape below is padded to a pow2 row bucket so a
+        # varying unique/hit/miss count replays a compiled program
+        # instead of minting a new one (out-of-range pad positions are
+        # dropped by the scatters)
+        ub = bucket_rows(len(uids))
+        out = jnp.zeros((ub, self._dim), dtype=str(self.dtype))
         if self.cache is not None:
             hit_pos, hit_slots, miss_pos = self.cache.lookup(uids)
             if len(hit_pos):
-                out = out.at[jnp.asarray(hit_pos)].set(
-                    self.cache.gather(hit_slots))
+                hb = bucket_rows(len(hit_pos))
+                pos = np.full((hb,), ub, np.int64)  # pad -> dropped
+                pos[:len(hit_pos)] = hit_pos
+                slots = np.zeros((hb,), np.int64)
+                slots[:len(hit_slots)] = hit_slots
+                out = out.at[jnp.asarray(pos)].set(
+                    self.cache.gather(slots), mode="drop")
         else:
             miss_pos = np.arange(len(uids), dtype=np.int64)
         if len(miss_pos):
             fetched = self._fetch(uids[miss_pos])
-            out = out.at[jnp.asarray(miss_pos)].set(
-                jnp.asarray(fetched, dtype=out.dtype))
+            mb = bucket_rows(len(miss_pos))
+            pos = np.full((mb,), ub, np.int64)  # pad -> dropped
+            pos[:len(miss_pos)] = miss_pos
+            rows = np.zeros((mb, self._dim), fetched.dtype)
+            rows[:len(miss_pos)] = fetched
+            out = out.at[jnp.asarray(pos)].set(
+                jnp.asarray(rows, dtype=out.dtype), mode="drop")
             if self.cache is not None:
                 self.cache.insert(uids[miss_pos], fetched)
         telemetry.record_embedding_pull(time.perf_counter() - t0)
@@ -459,12 +492,11 @@ class ShardedEmbedding:
                 {sid: ("emb_pull", self.key,
                        (miss_ids[pending][pos], self.fleet.epoch))
                  for sid, pos in routed.items()})
+            mp = miss_ids[pending]  # sorted: unique ids keep their order
             retry = []
             for sid, r in results.items():
                 if isinstance(r, BaseException):
-                    retry.extend(self._heal(sid, r,
-                                            miss_ids[pending]
-                                            [routed[sid]]))
+                    retry.extend(self._heal(sid, r, mp[routed[sid]]))
                     continue
                 found, vals, missing = r
                 if len(found):
@@ -472,19 +504,19 @@ class ShardedEmbedding:
                                       dtype=self.dtype).reshape(len(found),
                                                                 -1)
                     telemetry.record_embedding_rpc("emb_pull", vals.nbytes)
-                    idx = {int(i): p for p, i in
-                           enumerate(miss_ids[pending])}
-                    for i, rid in enumerate(found):
-                        p = idx[int(rid)]
-                        rows[pending[p]] = vals[i]
-                        filled[pending[p]] = True
+                    # vectorized reply decode: found ⊆ mp and mp is
+                    # sorted, so one searchsorted aligns every reply
+                    # row (the per-row python dict walk was a measured
+                    # per-step cost that DOUBLED with the server count)
+                    found = np.asarray(found, dtype=np.int64)  # sync-ok: reply ids are host metadata
+                    at = pending[np.searchsorted(mp, found)]
+                    rows[at] = vals
+                    filled[at] = True
                 else:
                     telemetry.record_embedding_rpc("emb_pull", 0)
                 if len(missing):
                     retry.extend(self._reseed(sid, np.asarray(missing)))  # sync-ok: RPC reply ids are host metadata
-            pending = np.asarray(  # sync-ok: host position metadata
-                [p for p in range(len(miss_ids)) if not filled[p]],
-                dtype=np.int64)
+            pending = np.flatnonzero(~filled).astype(np.int64)  # sync-ok: host position metadata
             if len(pending) and not retry:
                 # nothing healed this round — don't spin
                 break
@@ -511,11 +543,17 @@ class ShardedEmbedding:
         vals = grad_rows.data if hasattr(grad_rows, "data") else grad_rows
         vals = jnp.asarray(vals).reshape(len(ids), self._dim)
         uids, inverse = np.unique(ids, return_inverse=True)
-        if len(uids) != len(ids):
-            vals = jax.ops.segment_sum(vals, jnp.asarray(inverse),
-                                       num_segments=len(uids))
+        # duplicate-id combine on device, into a pow2-bucketed segment
+        # count: the unique count is data-dependent, and an unbucketed
+        # num_segments recompiled this op (and everything downstream)
+        # nearly every step. Also aligns grads to uids ORDER always —
+        # the dup-free path used to ship original-order rows against
+        # sorted unique ids.
+        ub = bucket_rows(len(uids))
+        vals = jax.ops.segment_sum(vals, jnp.asarray(inverse),
+                                   num_segments=ub)
         grads = np.asarray(  # sync-ok: network serialization of grad rows
-            vals, dtype=np.float32)
+            vals, dtype=np.float32)[:len(uids)]
         self._touched.update(int(i) for i in uids)
         pending = uids
         pgrads = grads

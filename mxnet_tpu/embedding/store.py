@@ -267,6 +267,18 @@ class EmbeddingStore:
             t.nleaves = 1
         return t.nleaves
 
+    @staticmethod
+    def _bucket_rows(n):
+        """Next power of two ≥ n — the server-side half of the sparse
+        row-count shape buckets (embedding/client.py bucket_rows): the
+        sparse-optimizer kernels dispatch per (k, row) shape, and an
+        unbucketed data-dependent k recompiled them nearly every push."""
+        n = max(1, int(n))
+        p = 1
+        while p < n:
+            p <<= 1
+        return p
+
     def _apply(self, t, key, kids, grad_rows):
         """Run the optimizer over COMPACT (k, *row) arrays: gather the
         touched rows + their state, wrap as NDArrays, and drive the real
@@ -274,7 +286,9 @@ class EmbeddingStore:
         whose indices are ``arange(k)`` — identical arithmetic to the
         local update_on_kvstore path applying the same rows out of the
         full table, including the table-level Adam bias-correction
-        count."""
+        count. The row axis pads to a pow2 bucket (zero rows + zero
+        grads + zero state — every sparse kernel is row-wise, so pad
+        rows never touch real ones) and results slice back."""
         opt = self._optimizer
         if opt is None:
             # replace semantics, matching the dense server's no-updater
@@ -285,20 +299,25 @@ class EmbeddingStore:
         import jax.numpy as jnp
 
         k = len(kids)
-        cshape = (k,) + t.row_shape
-        w = NDArray(jnp.asarray(
-            np.stack([t.rows[int(r)] for r in kids]).astype(t.dtype)))
+        kb = self._bucket_rows(k)
+        cshape = (kb,) + t.row_shape
+        wrows = np.zeros(cshape, t.dtype)
+        wrows[:k] = np.stack([t.rows[int(r)] for r in kids])
+        w = NDArray(jnp.asarray(wrows))
         nleaves = self._state_layout(t, key)
         leaves = []
         for li in range(nleaves):
-            leaves.append(NDArray(jnp.asarray(np.stack(
+            srows = np.zeros(cshape, t.dtype)
+            srows[:k] = np.stack(
                 [t.state[int(r)][li] if int(r) in t.state
-                 else np.zeros(t.row_shape, t.dtype) for r in kids]))))
+                 else np.zeros(t.row_shape, t.dtype) for r in kids])
+            leaves.append(NDArray(jnp.asarray(srows)))
         state = None if nleaves == 0 else \
             (leaves[0] if nleaves == 1 else tuple(leaves))
+        grows = np.zeros(cshape, np.float32)
+        grows[:k] = np.asarray(grad_rows, dtype=np.float32)  # sync-ok: frame payload is already host bytes
         grad = RowSparseNDArray(
-            jnp.asarray(np.asarray(grad_rows, dtype=np.float32)),  # sync-ok: frame payload is already host bytes
-            jnp.arange(k, dtype=jnp.int64), cshape)
+            jnp.asarray(grows), jnp.arange(kb, dtype=jnp.int64), cshape)
         # resume the table-level update count (snapshot/load adoption)
         prev = self._counts.get(key)
         if prev is not None and \
@@ -306,7 +325,7 @@ class EmbeddingStore:
             opt._index_update_count[key] = prev
         opt.update_multi_precision(key, w, grad, state)
         self._counts[key] = opt._index_update_count.get(key, 0)
-        new_rows = np.asarray(w.data).astype(t.dtype)  # sync-ok: server-side shard storage is host memory by design
+        new_rows = np.asarray(w.data).astype(t.dtype)[:k]  # sync-ok: server-side shard storage is host memory by design
         if nleaves:
             leaf_np = [np.asarray(l.data) for l in leaves]  # sync-ok: server-side shard storage is host memory by design
             for i, rid in enumerate(kids):

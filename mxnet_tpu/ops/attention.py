@@ -568,17 +568,29 @@ def ragged_attention_reference(q, k, v, valid_length, sm_scale=None):
 
 
 def _paged_gather_reference(q, k_pages, v_pages, page_table, context_lens,
-                            sm_scale):
+                            sm_scale, k_scales=None, v_scales=None):
     """XLA path: gather the page table into dense K/V and run the masked
     reference. Correct everywhere (the CPU/serving-test path) and the
     per-shape alternative the tuning table may prefer on-chip for short
     contexts, where one fused gather+softmax beats the kernel's
-    page-at-a-time grid."""
+    page-at-a-time grid.
+
+    Quantized pools (int8 pages + per-(position, head) amax planes)
+    dequantize AFTER the gather — only the sequence's own pages pay the
+    int8->f32 convert, never the whole pool."""
     B = q.shape[0]
     P, S, H, D = k_pages.shape
     max_pages = page_table.shape[1]
-    kg = k_pages[page_table.reshape(-1)].reshape(B, max_pages, S, H, D)
-    vg = v_pages[page_table.reshape(-1)].reshape(B, max_pages, S, H, D)
+    flat = page_table.reshape(-1)
+    kg = k_pages[flat].reshape(B, max_pages, S, H, D)
+    vg = v_pages[flat].reshape(B, max_pages, S, H, D)
+    if k_scales is not None:
+        kg = kg.astype(jnp.float32) * (
+            k_scales[flat].reshape(B, max_pages, S, H)
+            * (1.0 / 127.0))[..., None]
+        vg = vg.astype(jnp.float32) * (
+            v_scales[flat].reshape(B, max_pages, S, H)
+            * (1.0 / 127.0))[..., None]
     k = jnp.transpose(kg.reshape(B, max_pages * S, H, D), (0, 2, 1, 3))
     v = jnp.transpose(vg.reshape(B, max_pages * S, H, D), (0, 2, 1, 3))
     return ragged_attention_reference(q, k, v, context_lens, sm_scale)
@@ -697,7 +709,8 @@ def _paged_decode_pallas(q, k_pages, v_pages, page_table, context_lens,
     )(page_table, context_lens, q, k_pages, v_pages)
 
 
-def _record_paged_signature(q, k_pages, page_table, sm_scale):
+def _record_paged_signature(q, k_pages, page_table, sm_scale,
+                            quantized=False):
     """Remember this decode dispatch's shape signature so a fresh
     serving replica's tuning.warmup() can AOT-compile the paged
     attention program before the first request lands."""
@@ -707,6 +720,8 @@ def _record_paged_signature(q, k_pages, page_table, sm_scale):
         tuning.record_signature("paged_attention", {
             "q_shape": list(q.shape), "pool_shape": list(k_pages.shape),
             "max_pages": int(page_table.shape[1]),
+            "pool_dtype": str(k_pages.dtype),
+            "quantized": bool(quantized),
             "dtype": str(q.dtype), "sm_scale": float(sm_scale)})
     except Exception:  # noqa: BLE001 — bookkeeping must not fail the op
         pass
@@ -714,7 +729,8 @@ def _record_paged_signature(q, k_pages, page_table, sm_scale):
 
 @register("ragged_paged_attention", differentiable=False)
 def ragged_paged_attention(query, k_pages, v_pages, page_table,
-                           context_lens, sm_scale=None, interpret=None):
+                           context_lens, sm_scale=None, interpret=None,
+                           k_scales=None, v_scales=None):
     """Decode-time attention over a paged KV cache — one query token per
     sequence gathers its K/V prefix through a page table (PAPERS.md
     arXiv 2604.15464; the serving sibling of :func:`flash_attention`).
@@ -729,11 +745,22 @@ def ragged_paged_attention(query, k_pages, v_pages, page_table,
     Backend choice and the head-block config come from the tuning table
     (``tuning.resolve_paged``), exactly like the flash kernel's blocks;
     ``interpret=True`` forces the Pallas kernel in interpret mode (the
-    CPU parity path tests use)."""
+    CPU parity path tests use).
+
+    ``k_scales``/``v_scales`` — (num_pages, page_size, H) per-row amax
+    planes — mark the pools int8-quantized: the gather fallback
+    dequantizes after the page gather. The Pallas kernel has no
+    quantized lowering yet, so quantized pools always take the XLA
+    path (the tuning-table backend choice applies to f32 pools only)."""
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(query.shape[-1]))
     sm_scale = float(sm_scale)
-    _record_paged_signature(query, k_pages, page_table, sm_scale)
+    _record_paged_signature(query, k_pages, page_table, sm_scale,
+                            quantized=k_scales is not None)
+    if k_scales is not None:
+        return _paged_gather_reference(query, k_pages, v_pages,
+                                       page_table, context_lens, sm_scale,
+                                       k_scales, v_scales)
     from .. import tuning
 
     cfg = tuning.resolve_paged(

@@ -186,6 +186,33 @@ def quantized_flatten(data, min_data, max_data):
     return (data.reshape((data.shape[0], -1)), min_data, max_data)
 
 
+# ---------------------------------------------------------------------------
+# weight-only quantization (serving decode matmuls; no reference-op
+# heritage — this is the serving-economics half of the int8 family)
+# ---------------------------------------------------------------------------
+def quantize_rowwise(w):
+    """f32 (k, n) weight -> (int8 q, (n,) f32 amax): symmetric signed
+    int8 per OUTPUT column, ``scale = 127 / amax`` — finer than the
+    tensor-wide (min, max) triple because decode matmul error is
+    dominated by the widest column. Zero columns get amax 0 and
+    dequantize to exact zeros."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+    scale = _INT8_MAX / jnp.maximum(amax, 1e-30)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) * scale[None, :]),
+                 -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    return q, amax
+
+
+def woq_matmul(x, qw, amax):
+    """Weight-only-quantized matmul: activations stay float, the int8
+    weight dequantizes AT the matmul (XLA folds the per-column rescale
+    into the epilogue, and on HBM-bound decode shapes the win is the
+    4x smaller weight read — the same bytes argument as the quantized
+    KV pages). Numerics == ``x @ dequantize(qw)`` exactly."""
+    w = qw.astype(jnp.float32) * (amax * (1.0 / _INT8_MAX))[None, :]
+    return x @ w
+
+
 @register("quantized_act", aliases=("_contrib_quantized_act",),
           num_outputs=3, differentiable=False)
 def quantized_act(data, min_data, max_data, act_type="relu"):

@@ -19,6 +19,14 @@ production-traffic half:
 - :mod:`~mxnet_tpu.serving.model` — the decode-model adapter protocol
   and :class:`TinyDecoder`, the pure-JAX causal LM the tests, bench,
   and examples drive.
+- :mod:`~mxnet_tpu.serving.speculative` — :class:`SpeculativeEngine`:
+  a cheap draft model proposes ``draft_k`` tokens per slot, the target
+  verifies all of them in ONE wide launch (greedy token-exact by
+  construction), acceptance committed device-side — two launches per
+  round for up to k tokens. Compose with ``PagedKVCache(
+  quantized=True)`` for int8 KV pages (~4x resident sequences per
+  byte) and ``TinyDecoder.quantize_params`` for weight-only int8
+  decode matmuls routed per shape by ``tuning.resolve_quant``.
 - :mod:`~mxnet_tpu.serving.metrics` — SLO metrics
   (``mxt_serving_*``) through the PR-5 telemetry registry;
   ``tools/mxt_top.py`` renders them live.
@@ -62,9 +70,11 @@ from .kv_cache import PagedKVCache
 from .model import TinyDecoder
 from .router import FleetRouter, RoutedRequest
 from .scheduler import ContinuousBatcher, Request, StaticBatcher
+from .speculative import SpeculativeEngine
 from . import metrics
 
-__all__ = ["DecodeEngine", "PagedKVCache", "TinyDecoder",
+__all__ = ["DecodeEngine", "SpeculativeEngine", "PagedKVCache",
+           "TinyDecoder",
            "ContinuousBatcher", "Request", "StaticBatcher", "metrics",
            "FleetRouter", "RoutedRequest", "ReplicaPool", "LocalReplica",
            "RemoteReplica", "ServingHost", "StaleReplicaError",

@@ -21,18 +21,26 @@ scheduler (``nd.PendingValue`` underneath), so host_syncs/step <= 1/K
 exactly like the training stream, and ``tools/check_host_syncs.py``
 lint-enforces it stays that way.
 
-Prefill runs per request through shape-bucketed jit programs (prompt
-padded to the bucket, ragged valid_length masks the tail), writes the
-prompt's K/V pages with a donated scatter, and seeds the slot with the
-first sampled token — returned to the scheduler as a PendingValue it
+Admission is ONE fused shape-bucketed program per prefill bucket:
+the prompt pass (padded to the bucket, ragged valid_length masks the
+tail), the page-pool scatter, and the slot-state commit all land in a
+single dispatch — on CPU each eager slot edit costs a real
+millisecond, so admission used to dominate request rate. The first
+sampled token returns to the scheduler as a PendingValue it
 materializes at the next retirement boundary (one amortized read per
-REQUEST, not per step).
+REQUEST, not per step). The active mask lives host-side and ships
+with each dispatch, so activate/deactivate/release are flag flips.
 
-``aot_warmup()`` lowers-and-compiles the decode step, every prefill
-bucket, and the page-write programs from live shapes; the engine
+``aot_warmup()`` lowers-and-compiles the decode step and every
+bucket's fused admission program from live shapes; the engine
 registers itself with ``tuning.register_step``, so a fresh replica's
 ``tuning.warmup()`` (plus the persistent compile cache) pays zero
 request-path JIT — the PR-6 contract extended to serving.
+
+``serving/speculative.py`` subclasses this engine to commit up to
+``draft_k`` tokens per round (draft proposes, target verifies in one
+wide launch) — :func:`one_token_pass` below is the shared per-token
+core that makes the verify pass bit-identical to sequential decode.
 """
 from __future__ import annotations
 
@@ -45,11 +53,67 @@ from ..base import MXNetError
 from . import metrics as _m
 from .kv_cache import PagedKVCache
 
-__all__ = ["DecodeEngine"]
+__all__ = ["DecodeEngine", "one_token_pass"]
+
+
+def one_token_pass(model, cache, params, kv, ctx, tokens, page_tables,
+                   active, table_width, slots):
+    """ONE decoder token step as a pure traced function: embed each
+    slot's current token, append its K/V into the paged pool (inactive
+    slots write the scratch page), attend the prefix through the page
+    table, and greedy-sample the next token.
+
+    This is the shared core of the plain decode step AND the
+    speculative verify/draft programs (serving/speculative.py): the
+    verify pass is literally this function unrolled k times, so a
+    committed speculative token is computed by the bit-identical op
+    sequence a sequential decode would have used — greedy
+    token-exactness by construction, not by tolerance.
+
+    Returns ``(kv_state, new_context_lens, next_tokens)``.
+    """
+    import jax.numpy as jnp
+
+    from ..ops import attention as A
+
+    S = cache.page_size
+    scratch = cache.scratch_page
+    actb = active.astype(bool)
+    pos = ctx  # each slot's next KV index (== its current length)
+    rows = jnp.arange(slots)
+    # inactive slots write their (ignored) K/V to the scratch page
+    page_idx = jnp.where(
+        actb,
+        page_tables[rows, jnp.clip(pos // S, 0, table_width - 1)],
+        scratch)
+    slot_idx = pos % S
+    newlens = ctx + active
+
+    h = model.embed(params, tokens,
+                    jnp.clip(pos, 0, model.max_len - 1))
+    for l in range(model.num_layers):
+        q, kn, vn = model.layer_qkv(params, l, h)  # (B, H, D) each
+        kv = cache.write_token(kv, l, page_idx, slot_idx, kn, vn)
+        kl, vl, ks, vs = cache.attend_views(kv, l)
+        attn = A.ragged_paged_attention(
+            q, kl, vl, page_tables, newlens,
+            sm_scale=model.sm_scale, k_scales=ks, v_scales=vs)
+        h = model.layer_finish(params, l, h, attn)
+    logits = model.logits(params, h)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    nxt = jnp.where(actb, nxt, tokens)  # inactive slots hold
+    return kv, newlens, nxt
 
 
 class DecodeEngine:
     """Fixed-slot decode executor over a :class:`PagedKVCache`."""
+
+    # extra tokens reserved past prompt+max_new per sequence: the
+    # speculative subclass sets this to its draft width (a verify pass
+    # may write up to k-1 positions past the committed budget)
+    _reserve_slack = 0
+    # tokens a decode step may commit per slot (speculative: draft_k)
+    tokens_per_step = 1
 
     def __init__(self, model, params=None, slots=None, cache=None,
                  prefill_buckets=(64, 256), max_context=None, seed=0):
@@ -69,14 +133,18 @@ class DecodeEngine:
         S = self.cache.page_size
         self.max_context = int(min(max_context or model.max_len,
                                    model.max_len))
-        self.table_width = -(-self.max_context // S)
+        self.table_width = -(-(self.max_context
+                               + self._reserve_slack) // S)
 
         B = self.slots
         scratch = self.cache.scratch_page
         self._tokens = jnp.zeros((B,), jnp.int32)
         self._ctx = jnp.zeros((B,), jnp.int32)
-        self._active = jnp.zeros((B,), jnp.int32)
         self._pt = jnp.full((B, self.table_width), scratch, jnp.int32)
+        # the active mask lives on HOST and ships with each dispatch
+        # (one tiny h2d per step): activate/deactivate/release are then
+        # pure flag flips instead of eager device edits — recomposition
+        # costs nothing between launches
         self._host_active = np.zeros(B, bool)
         self._host_len = np.zeros(B, np.int64)
         self._seq_of_slot = {}
@@ -89,16 +157,16 @@ class DecodeEngine:
         self.window = _engine.InflightWindow(
             name="serving_decode", on_values=self._deliver)
 
-        # tokens (arg 4) is NOT donated: each step's sampled-token array
+        # tokens (arg 3) is NOT donated: each step's sampled-token array
         # is also staged in the in-flight window for the stacked
         # deferred read, and donating it on the next step would delete
-        # a buffer the window still holds
+        # a buffer the window still holds. arg 1 is the cache's whole
+        # functional state tuple (pools + quantization scale planes).
         self._jit_step = jax.jit(self._step_impl,
-                                 donate_argnums=(1, 2, 3))
+                                 donate_argnums=(1, 2))
         self._buckets = sorted({self._round_bucket(b)
                                 for b in prefill_buckets})
-        self._prefill_fns = {}
-        self._write_fns = {}
+        self._admit_fns = {}
         tuning.register_step(self)
         # diagnostics HBM ledger: the replica's weights (the KV pool
         # registers itself in PagedKVCache). Host arithmetic on shape
@@ -128,42 +196,11 @@ class DecodeEngine:
         return b
 
     # -- the decode hot path ----------------------------------------------
-    def _step_impl(self, params, k_pages, v_pages, ctx, tokens,
-                   page_tables, active):
-        import jax.numpy as jnp
-
-        from ..ops import attention as A
-
-        model = self.model
-        S = self.cache.page_size
-        scratch = self.cache.scratch_page
-        actb = active.astype(bool)
-        pos = ctx  # each slot's next KV index (== its current length)
-        rows = jnp.arange(self.slots)
-        # inactive slots write their (ignored) K/V to the scratch page
-        page_idx = jnp.where(
-            actb,
-            page_tables[rows, jnp.clip(pos // S, 0, self.table_width - 1)],
-            scratch)
-        slot_idx = pos % S
-        newlens = ctx + active
-
-        h = model.embed(params, tokens,
-                        jnp.clip(pos, 0, model.max_len - 1))
-        for l in range(model.num_layers):
-            q, kn, vn = model.layer_qkv(params, l, h)  # (B, H, D) each
-            k_pages = k_pages.at[l, page_idx, slot_idx].set(
-                kn.astype(k_pages.dtype))
-            v_pages = v_pages.at[l, page_idx, slot_idx].set(
-                vn.astype(v_pages.dtype))
-            attn = A.ragged_paged_attention(
-                q, k_pages[l], v_pages[l], page_tables, newlens,
-                sm_scale=model.sm_scale)
-            h = model.layer_finish(params, l, h, attn)
-        logits = model.logits(params, h)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        nxt = jnp.where(actb, nxt, tokens)  # inactive slots hold
-        return k_pages, v_pages, newlens, nxt
+    def _step_impl(self, params, kv, ctx, tokens, page_tables, active):
+        kv, newlens, nxt = one_token_pass(
+            self.model, self.cache, params, kv, ctx, tokens,
+            page_tables, active, self.table_width, self.slots)
+        return kv, newlens, nxt
 
     def _ensure_pages(self, slots):
         """Grow page tables for slots whose next token crosses into an
@@ -175,6 +212,13 @@ class DecodeEngine:
             if self.cache.alloc_for(seq, int(self._host_len[s]) + 1):
                 row = self.cache.page_table_row(seq, self.table_width)
                 self._pt = self._pt.at[s].set(jnp.asarray(row))
+
+    def _active_arr(self):
+        """This dispatch's active mask, built fresh from the host flags
+        (host→device ship, never a read)."""
+        import jax.numpy as jnp
+
+        return jnp.asarray(self._host_active.astype(np.int32))
 
     def decode_step(self, meta=None):
         """Dispatch ONE decode step for every active slot; returns the
@@ -188,16 +232,16 @@ class DecodeEngine:
         self._ensure_pages(act)
         self._inflight_meta.append(meta)
         try:
-            kp, vp, ctx, tok = self._jit_step(
-                self.params, self.cache.k_pages, self.cache.v_pages,
-                self._ctx, self._tokens, self._pt, self._active)
+            kv, ctx, tok = self._jit_step(
+                self.params, self.cache.state(),
+                self._ctx, self._tokens, self._pt, self._active_arr())
         except Exception as e:  # noqa: BLE001 — OOM gets the HBM ledger
             from .. import diagnostics
 
             self._inflight_meta.pop()
             diagnostics.reraise_if_oom(e, "serving_decode")
             raise
-        self.cache.swap(kp, vp)
+        self.cache.swap(kv)
         self._ctx, self._tokens = ctx, tok
         for s in act:
             self._host_len[s] += 1
@@ -213,6 +257,21 @@ class DecodeEngine:
         cb = self.on_tokens
         if cb is not None:
             cb(step_no, row, meta)
+
+    def decode_row(self, row, slot):
+        """The tokens one retired step row carries for ``slot`` —
+        exactly one for the plain engine. The speculative subclass
+        returns the whole accepted prefix (variable length), which is
+        why the scheduler asks the engine instead of indexing the row
+        itself."""
+        return [int(row[slot])]
+
+    def can_admit(self, total_tokens):
+        """Whether admission-side page reservations for a request of
+        ``total_tokens`` (prompt + max_new) would succeed right now —
+        the scheduler's gate. Covers the engine's reservation slack and
+        (in the speculative subclass) the draft cache too."""
+        return self.cache.can_reserve(total_tokens + self._reserve_slack)
 
     def flush(self):
         """Drain the in-flight window (every dispatched step's tokens
@@ -234,42 +293,38 @@ class DecodeEngine:
         vr = jnp.transpose(vs[:, 0], (0, 2, 1, 3)).reshape(
             model.num_layers, nbp, S, model.num_heads, model.head_dim)
         tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (1,)
-        return (kr.astype(self.cache.dtype), vr.astype(self.cache.dtype),
-                tok0)
+        # pages leave this program at compute dtype; the page-write
+        # program casts (or quantizes) into the pool's storage dtype
+        return kr, vr, tok0
 
-    def _prefill_fn(self, bucket):
+    def _admit_impl(self, params, kv, pt, tokens, ctx, padded, valid,
+                    ids, row, slot, t, *, bucket):
+        """The whole device side of one admission as ONE program:
+        bucketed prompt prefill, page-pool scatter, and the slot-state
+        commit (page-table row, first sampled token, context length).
+        Admission used to cost ~5 eager dispatches; on CPU each eager
+        scatter is a real millisecond, so fusing them is a measurable
+        request-rate win."""
+        kpag, vpag, tok0 = self._prefill_impl(params, padded, valid,
+                                              bucket=bucket)
+        kv = self.cache.write_pages(kv, kpag, vpag, ids)
+        return (kv, pt.at[slot].set(row), tokens.at[slot].set(tok0[0]),
+                ctx.at[slot].set(t), tok0)
+
+    def _admit_fn(self, bucket):
         import jax
 
-        fn = self._prefill_fns.get(bucket)
+        fn = self._admit_fns.get(bucket)
         if fn is None:
-            fn = self._prefill_fns[bucket] = jax.jit(
-                functools.partial(self._prefill_impl, bucket=bucket))
+            fn = self._admit_fns[bucket] = jax.jit(
+                functools.partial(self._admit_impl, bucket=bucket),
+                donate_argnums=(1, 2, 4))
         return fn
 
-    def _write_fn(self, nbp):
-        import jax
-
-        fn = self._write_fns.get(nbp)
-        if fn is None:
-            def write(kp, vp, kn, vn, ids):
-                return kp.at[:, ids].set(kn), vp.at[:, ids].set(vn)
-
-            fn = self._write_fns[nbp] = jax.jit(write,
-                                                donate_argnums=(0, 1))
-        return fn
-
-    def admit(self, slot, seq_id, prompt_tokens, max_new_tokens):
-        """Prefill a request into a free slot: reserve its worst-case
-        pages, run the bucketed prompt pass, scatter the prompt K/V into
-        the pool, and seed the slot with the first sampled token.
-
-        Returns a PendingValue of that first token — deferred like
-        everything else; the scheduler materializes it at a retirement
-        boundary (the prefill has certainly finished by then)."""
-        import jax.numpy as jnp
-
-        from ..ndarray.pending import PendingValue
-
+    def _admit_prep(self, slot, seq_id, prompt_tokens, max_new_tokens):
+        """Host half of admission: validation, worst-case reservation,
+        upfront allocation, and the padded/ids/row arrays the fused
+        admit program consumes."""
         if self._host_active[slot] or slot in self._seq_of_slot:
             raise MXNetError("slot %d is occupied" % slot)
         prompt = np.array(list(prompt_tokens), np.int32)
@@ -281,68 +336,95 @@ class DecodeEngine:
             raise MXNetError(
                 "prompt+max_new = %d exceeds the engine's max context %d"
                 % (total, self.max_context))
-        if not self.cache.reserve(seq_id, total):
+        # slack covers speculative-verify overshoot past the budget
+        if not self.cache.reserve(seq_id, total + self._reserve_slack):
             raise MXNetError("KV pool too busy for sequence %r (check "
-                             "cache.can_reserve before admitting)"
+                             "engine.can_admit before admitting)"
                              % (seq_id,))
+        self._post_reserve(seq_id, total)
         bucket = self._bucket_for(T)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :T] = prompt
+        self.cache.alloc_for(seq_id, T)
+        pages = self.cache.pages_of(seq_id)
+        nbp = bucket // self.cache.page_size
+        ids = np.full((nbp,), self.cache.scratch_page, np.int32)
+        n = min(len(pages), nbp)  # upfront-allocated tails stay put
+        ids[:n] = pages[:n]  # bucket tail pages scatter to scratch
+        row = self.cache.page_table_row(seq_id, self.table_width)
+        return {"T": T, "bucket": bucket, "padded": padded, "ids": ids,
+                "row": row, "prompt": prompt}
+
+    def admit(self, slot, seq_id, prompt_tokens, max_new_tokens):
+        """Prefill a request into a free slot: reserve its worst-case
+        pages, then ONE fused dispatch runs the bucketed prompt pass,
+        scatters the prompt K/V into the pool, and seeds the slot with
+        the first sampled token.
+
+        Returns a PendingValue of that first token — deferred like
+        everything else; the scheduler materializes it at a retirement
+        boundary (the prefill has certainly finished by then)."""
+        import jax.numpy as jnp
+
+        from ..ndarray.pending import PendingValue
+
+        p = self._admit_prep(slot, seq_id, prompt_tokens, max_new_tokens)
         try:
-            kpag, vpag, tok0 = self._prefill_fn(bucket)(
-                self.params, jnp.asarray(padded),
-                jnp.asarray(np.array([T], np.int32)))
+            kv, self._pt, self._tokens, self._ctx, tok0 = \
+                self._admit_fn(p["bucket"])(
+                    self.params, self.cache.state(), self._pt,
+                    self._tokens, self._ctx, jnp.asarray(p["padded"]),
+                    jnp.asarray(np.array([p["T"]], np.int32)),
+                    jnp.asarray(p["ids"]), jnp.asarray(p["row"]),
+                    np.int32(slot), np.int32(p["T"]))
         except Exception as e:  # noqa: BLE001 — OOM gets the HBM ledger
             from .. import diagnostics
 
             self.cache.free(seq_id)  # release the admission reservation
             diagnostics.reraise_if_oom(e, "serving_prefill")
             raise
-        self.cache.alloc_for(seq_id, T)
-        pages = self.cache.pages_of(seq_id)
-        nbp = bucket // self.cache.page_size
-        ids = np.full((nbp,), self.cache.scratch_page, np.int32)
-        ids[:len(pages)] = pages  # bucket tail pages scatter to scratch
-        kp, vp = self._write_fn(nbp)(
-            self.cache.k_pages, self.cache.v_pages, kpag, vpag,
-            jnp.asarray(ids))
-        self.cache.swap(kp, vp)
-
+        self.cache.swap(kv)
         self._seq_of_slot[slot] = seq_id
         self._host_active[slot] = True
-        self._host_len[slot] = T
-        self._pt = self._pt.at[slot].set(
-            jnp.asarray(self.cache.page_table_row(seq_id,
-                                                  self.table_width)))
-        self._tokens = self._tokens.at[slot].set(tok0[0])
-        self._ctx = self._ctx.at[slot].set(T)
-        self._active = self._active.at[slot].set(1)
+        self._host_len[slot] = p["T"]
         _m.tokens_total().inc()  # the prefill-sampled first token
         return PendingValue(tok0)
+
+    def _post_reserve(self, seq_id, total):
+        """Subclass hook: runs right after the admission reservation,
+        before the prompt's pages allocate (the speculative engine
+        materializes its full worst-case allocation here so the page
+        table row is written complete, once)."""
 
     # -- recomposition ----------------------------------------------------
     def deactivate(self, slot):
         """Stop decoding a slot without releasing its pages (static
-        batching's idle state; also the first half of release)."""
-        if self._host_active[slot]:
-            self._host_active[slot] = False
-            self._active = self._active.at[slot].set(0)
+        batching's idle state; also the first half of release). A pure
+        host flag flip — the mask ships with the next dispatch."""
+        self._host_active[slot] = False
+
+    def activate(self, slot):
+        """Resume decoding a deactivated slot (its pages, context and
+        current token were preserved). The speculative scheduler parks
+        slots here while their budget is possibly complete in flight —
+        a parked slot must NOT advance device-side, or tokens would be
+        committed that the host never attributes."""
+        if slot in self._seq_of_slot:
+            self._host_active[slot] = True
 
     def release(self, slot):
-        """Retire a slot: deactivate, free the sequence's pages and
-        reservation, and point its page-table row back at scratch.
-        In-flight steps still referencing the old pages read the old
-        pool *values* (dataflow), so this is safe mid-window."""
-        import jax.numpy as jnp
-
+        """Retire a slot: deactivate and free the sequence's pages and
+        reservation. The stale page-table row stays — an inactive
+        slot's reads are fully masked and its writes go to scratch, and
+        the next admission overwrites the row — so recomposition costs
+        zero device edits. In-flight steps still referencing the freed
+        pages read the old pool *values* (dataflow), so this is safe
+        mid-window."""
         self.deactivate(slot)
         seq = self._seq_of_slot.pop(slot, None)
         if seq is not None:
             self.cache.free(seq)
         self._host_len[slot] = 0
-        self._pt = self._pt.at[slot].set(
-            jnp.full((self.table_width,), self.cache.scratch_page,
-                     jnp.int32))
 
     def defrag(self):
         """Compact the KV pool and re-emit live slots' page-table rows
@@ -368,27 +450,25 @@ class DecodeEngine:
         def sds(a):
             return jax.ShapeDtypeStruct(a.shape, a.dtype)
 
+        i32 = jnp.int32
         pstruct = jax.tree_util.tree_map(sds, self.params)
+        kv_sds = tuple(sds(a) for a in self.cache.state())
         n = 0
         self._jit_step.lower(
-            pstruct, sds(self.cache.k_pages), sds(self.cache.v_pages),
-            sds(self._ctx), sds(self._tokens), sds(self._pt),
-            sds(self._active)).compile()
+            pstruct, kv_sds, sds(self._ctx), sds(self._tokens),
+            sds(self._pt),
+            jax.ShapeDtypeStruct((self.slots,), i32)).compile()
         n += 1
-        L, H, D = (self.model.num_layers, self.model.num_heads,
-                   self.model.head_dim)
         S = self.cache.page_size
         for bucket in list(self._buckets):
-            nbp = bucket // S
-            self._prefill_fn(bucket).lower(
-                pstruct,
-                jax.ShapeDtypeStruct((1, bucket), jnp.int32),
-                jax.ShapeDtypeStruct((1,), jnp.int32)).compile()
-            pool = jax.ShapeDtypeStruct(
-                (L, nbp, S, H, D), self.cache.dtype)
-            self._write_fn(nbp).lower(
-                sds(self.cache.k_pages), sds(self.cache.v_pages),
-                pool, pool,
-                jax.ShapeDtypeStruct((nbp,), jnp.int32)).compile()
-            n += 2
+            self._admit_fn(bucket).lower(
+                pstruct, kv_sds, sds(self._pt), sds(self._tokens),
+                sds(self._ctx),
+                jax.ShapeDtypeStruct((1, bucket), i32),
+                jax.ShapeDtypeStruct((1,), i32),
+                jax.ShapeDtypeStruct((bucket // S,), i32),
+                jax.ShapeDtypeStruct((self.table_width,), i32),
+                jax.ShapeDtypeStruct((), i32),
+                jax.ShapeDtypeStruct((), i32)).compile()
+            n += 1
         return n

@@ -28,6 +28,20 @@ The extra page at index ``num_pages`` is the **scratch page**: masked
 writes of inactive batch slots and padded page-table entries route
 there, keeping the decode program's shapes fixed without conditional
 writes.
+
+Quantized pages (``quantized=True``): the K/V pools store symmetric
+signed int8 with a per-(position, head) float32 amax alongside —
+``scale(q) = 127 / amax``, the ops/quantization.py triple convention
+with the range carried as one scalar per row instead of a (min, max)
+pair. Page bytes drop ~4x (int8 payload + scales worth 4/head_dim of
+it), so the same byte budget holds ~4x the pages / resident sequences;
+the decode step quantizes each appended K/V row on device and the
+attention read dequantizes after the page gather
+(ops/attention.ragged_paged_attention's XLA fallback — the Pallas
+kernel path declines quantized pools). All pool arrays — payload and
+scales — travel the same donated-through-the-program route; the
+functional ``state()`` tuple is what the engine threads through its
+jitted programs.
 """
 from __future__ import annotations
 
@@ -51,7 +65,7 @@ class PagedKVCache:
     """One serving replica's KV page pool + page-table bookkeeping."""
 
     def __init__(self, num_layers, num_heads, head_dim, num_pages=None,
-                 page_size=None, dtype="float32"):
+                 page_size=None, dtype="float32", quantized=False):
         import jax.numpy as jnp
 
         self.num_layers = int(num_layers)
@@ -65,11 +79,17 @@ class PagedKVCache:
                              or _config().get("MXT_SERVING_PAGES"))
         if self.num_pages < 1:
             raise MXNetError("a KV cache needs at least one page")
-        self.dtype = jnp.dtype(dtype)
+        self.quantized = bool(quantized)
+        self.dtype = jnp.dtype("int8" if self.quantized else dtype)
         shape = (self.num_layers, self.num_pages + 1, self.page_size,
                  self.num_heads, self.head_dim)
         self.k_pages = jnp.zeros(shape, self.dtype)
         self.v_pages = jnp.zeros(shape, self.dtype)
+        self.k_scales = self.v_scales = None
+        if self.quantized:
+            sshape = shape[:-1]  # one amax per (layer, page, pos, head)
+            self.k_scales = jnp.zeros(sshape, jnp.float32)
+            self.v_scales = jnp.zeros(sshape, jnp.float32)
 
         self._lock = threading.Lock()
         self._free = list(range(self.num_pages - 1, -1, -1))  # pop() = 0
@@ -77,12 +97,37 @@ class PagedKVCache:
         self._quota = {}     # seq_id -> reserved page count (total)
         _m.kv_pages_total().set(self.num_pages)
         # diagnostics HBM ledger: the whole preallocated K+V pool
-        # (scratch page included) — .nbytes is shape metadata, no read
+        # (scratch page + scale planes included) — .nbytes is shape
+        # metadata, no read
         from .. import diagnostics
 
         diagnostics.hbm_set("kv_cache", "pool",
-                            self.k_pages.nbytes + self.v_pages.nbytes)
+                            sum(a.nbytes for a in self.state()))
         self._publish()
+
+    @classmethod
+    def pages_for_budget(cls, nbytes, num_layers, num_heads, head_dim,
+                         page_size=None, dtype="float32", quantized=False):
+        """How many pool pages a byte budget buys at this geometry —
+        the capacity half of the kv_quant A/B: the quantized pool packs
+        ~4x the pages (so ~4x the resident sequences) into the same
+        budget. Scratch page and scale planes are charged too."""
+        import numpy as np
+
+        page_size = int(page_size or _config().get("MXT_PAGE_SIZE"))
+        per_pos = num_heads * head_dim * (
+            1 if quantized else np.dtype(dtype).itemsize)
+        if quantized:
+            per_pos += num_heads * 4  # the f32 amax plane
+        page_bytes = 2 * num_layers * page_size * per_pos  # K and V
+        return max(0, int(nbytes) // page_bytes - 1)  # -1: scratch page
+
+    @property
+    def page_bytes(self):
+        """Device bytes one pool page costs (K + V + scales, all
+        layers) — shape metadata only."""
+        total = sum(a.nbytes for a in self.state())
+        return total // (self.num_pages + 1)
 
     # -- helpers ----------------------------------------------------------
     @property
@@ -99,6 +144,10 @@ class PagedKVCache:
             len(p) for p in self._pages.values())
         _m.kv_pages_in_use().set(in_use)
         _m.kv_pages_reserved().set(max(0, reserved))
+        if self.quantized:
+            # quantized-page occupancy: its own gauge so mxt_top can
+            # show how much of the serving load runs on int8 pages
+            _m.kv_quant_pages_in_use().set(in_use)
 
     # -- reservation + allocation ----------------------------------------
     def available(self):
@@ -184,11 +233,80 @@ class PagedKVCache:
             return self.num_pages - len(self._free)
 
     # -- device plumbing --------------------------------------------------
-    def swap(self, k_pages, v_pages):
+    def state(self):
+        """The pool's functional device state as one tuple — what the
+        engine donates through its jitted programs. ``(k, v)`` for f32
+        pools, ``(k, v, k_scales, v_scales)`` for quantized ones."""
+        if self.quantized:
+            return (self.k_pages, self.v_pages,
+                    self.k_scales, self.v_scales)
+        return (self.k_pages, self.v_pages)
+
+    def swap(self, *state):
         """Adopt the pool arrays a donated decode/prefill program
-        returned (the old ones were its inputs and are now invalid)."""
-        self.k_pages = k_pages
-        self.v_pages = v_pages
+        returned (the old ones were its inputs and are now invalid).
+        Accepts the :meth:`state` tuple, splatted or as one argument."""
+        if len(state) == 1 and isinstance(state[0], (tuple, list)):
+            state = tuple(state[0])
+        self.k_pages, self.v_pages = state[0], state[1]
+        if self.quantized:
+            self.k_scales, self.v_scales = state[2], state[3]
+
+    @staticmethod
+    def _quantize(x):
+        """Symmetric int8 per-(…, head) row quantization: amax over the
+        head_dim axis, q = round(x * 127/amax). Pure device math — runs
+        inside the jitted decode/prefill programs."""
+        import jax.numpy as jnp
+
+        amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+        q = jnp.clip(jnp.round(x.astype(jnp.float32)
+                               * (127.0 / jnp.maximum(amax, 1e-30))
+                               [..., None]), -127, 127).astype(jnp.int8)
+        return q, amax
+
+    def write_token(self, state, layer, page_idx, slot_idx, kn, vn):
+        """Functionally append one token's K/V rows — ``kn``/``vn`` are
+        (B, H, D) float — into layer ``layer`` at (page, in-page slot)
+        per batch row; returns the new state tuple. Quantized pools
+        quantize on device and store the amax plane alongside."""
+        if self.quantized:
+            kq, ka = self._quantize(kn)
+            vq, va = self._quantize(vn)
+            kp = state[0].at[layer, page_idx, slot_idx].set(kq)
+            vp = state[1].at[layer, page_idx, slot_idx].set(vq)
+            ks = state[2].at[layer, page_idx, slot_idx].set(ka)
+            vs = state[3].at[layer, page_idx, slot_idx].set(va)
+            return (kp, vp, ks, vs)
+        kp = state[0].at[layer, page_idx, slot_idx].set(
+            kn.astype(state[0].dtype))
+        vp = state[1].at[layer, page_idx, slot_idx].set(
+            vn.astype(state[1].dtype))
+        return (kp, vp)
+
+    def attend_views(self, state, layer):
+        """One layer's pool views for the attention read:
+        ``(k, v, k_scales, v_scales)`` with None scales for f32 pools —
+        exactly the argument shape ragged_paged_attention takes."""
+        if self.quantized:
+            return (state[0][layer], state[1][layer],
+                    state[2][layer], state[3][layer])
+        return state[0][layer], state[1][layer], None, None
+
+    def write_pages(self, state, k_rows, v_rows, page_ids):
+        """Functionally install whole prefill pages: ``k_rows``/
+        ``v_rows`` are float ``(L, n, S, H, D)``, ``page_ids`` (n,)
+        pool indices (scratch-padded tails welcome). Quantizes first on
+        quantized pools; returns the new state tuple."""
+        if self.quantized:
+            kq, ka = self._quantize(k_rows)
+            vq, va = self._quantize(v_rows)
+            return (state[0].at[:, page_ids].set(kq),
+                    state[1].at[:, page_ids].set(vq),
+                    state[2].at[:, page_ids].set(ka),
+                    state[3].at[:, page_ids].set(va))
+        return (state[0].at[:, page_ids].set(k_rows.astype(state[0].dtype)),
+                state[1].at[:, page_ids].set(v_rows.astype(state[1].dtype)))
 
     def page_table_row(self, seq_id, width):
         """(width,) int32 page-table row for a batch slot: the
@@ -230,5 +348,10 @@ class PagedKVCache:
         # overlapping src/dst ranges cannot clobber each other
         self.k_pages = self.k_pages.at[:, dst].set(self.k_pages[:, src])
         self.v_pages = self.v_pages.at[:, dst].set(self.v_pages[:, src])
+        if self.quantized:
+            self.k_scales = self.k_scales.at[:, dst].set(
+                self.k_scales[:, src])
+            self.v_scales = self.v_scales.at[:, dst].set(
+                self.v_scales[:, src])
         self._publish()
         return len(src)

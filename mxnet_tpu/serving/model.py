@@ -76,6 +76,81 @@ class TinyDecoder:
             p["fc2_%d" % l] = w(f, m)
         return p
 
+    def truncated(self, params, num_layers):
+        """A layer-truncated DRAFT of this model: same geometry, the
+        first ``num_layers`` transformer layers, shared embeddings and
+        final norm. Greedy streams of a truncated prefix agree with the
+        full model on most steps (repetitive greedy attractors), which
+        is what makes it a useful speculative draft without any
+        training. Returns ``(draft_model, draft_params)`` — the params
+        are the SAME arrays (zero extra device bytes)."""
+        num_layers = int(num_layers)
+        if not 1 <= num_layers <= self.num_layers:
+            raise ValueError("draft layers must be in [1, %d], got %d"
+                             % (self.num_layers, num_layers))
+        draft = TinyDecoder(vocab=self.vocab, num_layers=num_layers,
+                            num_heads=self.num_heads,
+                            head_dim=self.head_dim,
+                            ffn_hidden=self.ffn_hidden,
+                            max_len=self.max_len)
+        keep = {"wte", "wpe", "lnf_g", "lnf_b"}
+        dp = {}
+        for key, val in params.items():
+            base = key.split("_")[0]
+            if key in keep:
+                dp[key] = val
+            elif base in ("ln1", "ln2", "qkv", "o", "fc1", "fc2"):
+                layer = int(key.split("_")[1])
+                if layer < num_layers:
+                    dp[key] = val
+        return draft, dp
+
+    # -- weight-only int8 quantization ------------------------------------
+    _WOQ_KEYS = ("qkv", "o", "fc1", "fc2")
+
+    def quantize_params(self, params, resolve=None):
+        """Weight-only int8 quantization of the decode matmuls: each
+        eligible weight (qkv/o/fc1/fc2 per layer) is replaced by an
+        ``<name>__q`` int8 matrix + ``<name>__s`` per-column amax when
+        the per-shape routing decision says the quantized kernel wins
+        there — by default :func:`tuning.resolve_quant` (table hit,
+        else the heuristic cost model; measured entries win on
+        device). Tied embeddings stay f32 (they also feed lookups).
+
+        Returns ``(new_params, report)`` with report mapping weight key
+        to the backend chosen."""
+        from .. import tuning
+        from ..ops import quantization as Q
+
+        resolve = resolve or (lambda k_, n_: tuning.resolve_quant(
+            "woq_matmul", k_, n_, "float32"))
+        out, report = {}, {}
+        for key, val in params.items():
+            base = key.split("_")[0]
+            if base in self._WOQ_KEYS and getattr(val, "ndim", 0) == 2:
+                ent = resolve(int(val.shape[0]), int(val.shape[1]))
+                backend = ent.get("backend", "fp") \
+                    if isinstance(ent, dict) else str(ent)
+                report[key] = backend
+                if backend == "int8":
+                    q, amax = Q.quantize_rowwise(val)
+                    out[key + "__q"] = q
+                    out[key + "__s"] = amax
+                    continue
+            out[key] = val
+        return out, report
+
+    def _mm(self, params, name, x):
+        """One decode matmul, routed: the weight-only-quantized kernel
+        when ``quantize_params`` stored this weight as int8, the plain
+        f32 matmul otherwise. Trace-time branch — zero runtime cost."""
+        if name + "__q" in params:
+            from ..ops import quantization as Q
+
+            return Q.woq_matmul(x, params[name + "__q"],
+                                params[name + "__s"])
+        return x @ params[name]
+
     # -- shared layer math (identical trace for prefill and decode) -------
     @staticmethod
     def _ln(x, g, b):
@@ -94,7 +169,7 @@ class TinyDecoder:
         import jax.numpy as jnp
 
         x = self._ln(h, params["ln1_%d_g" % l], params["ln1_%d_b" % l])
-        qkv = x @ params["qkv_%d" % l]
+        qkv = self._mm(params, "qkv_%d" % l, x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = q.shape[:-1] + (self.num_heads, self.head_dim)
         return q.reshape(shape), k.reshape(shape), v.reshape(shape)
@@ -104,10 +179,12 @@ class TinyDecoder:
         import jax
 
         m = self.model_dim
-        h = h + attn.reshape(attn.shape[:-2] + (m,)) @ params["o_%d" % l]
+        h = h + self._mm(params, "o_%d" % l,
+                         attn.reshape(attn.shape[:-2] + (m,)))
         x = self._ln(h, params["ln2_%d_g" % l], params["ln2_%d_b" % l])
-        return h + jax.nn.gelu(x @ params["fc1_%d" % l]) \
-            @ params["fc2_%d" % l]
+        return h + self._mm(
+            params, "fc2_%d" % l,
+            jax.nn.gelu(self._mm(params, "fc1_%d" % l, x)))
 
     def logits(self, params, h):
         return self._ln(h, params["lnf_g"], params["lnf_b"]) \

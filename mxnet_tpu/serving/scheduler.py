@@ -71,6 +71,12 @@ class Request:
         self._first_pv = None  # deferred first token from prefill
         self._eos = False
         self._finalized = False
+        # speculative (variable-advance) accounting: steps dispatched
+        # but not yet retired, and the token-count UPPER bound they
+        # imply (observed + inflight * k) — the dispatch gate that keeps
+        # page usage within the admission reservation
+        self._inflight = 0
+        self._ub = 0
 
     @property
     def done(self):
@@ -137,9 +143,12 @@ class ContinuousBatcher:
         rejected immediately rather than deadlocking the queue."""
         request.t_submit = self._now()
         total = len(request.prompt) + request.max_new_tokens
+        # a speculative engine reserves extra overshoot pages per
+        # sequence — impossibility is judged against the padded need
+        padded = total + getattr(self.engine, "_reserve_slack", 0)
         cache = self.engine.cache
         if total > self.engine.max_context \
-                or cache.pages_needed(total) > cache.num_pages:
+                or cache.pages_needed(padded) > cache.num_pages:
             request.state = "rejected"
             self._finalize(request, "rejected")
             return request
@@ -149,6 +158,28 @@ class ContinuousBatcher:
         return request
 
     # -- the per-step recomposition loop ----------------------------------
+    @property
+    def _k(self):
+        """Tokens one decode step may commit per slot (1 for the plain
+        engine, draft_k for a speculative one)."""
+        return int(getattr(self.engine, "tokens_per_step", 1) or 1)
+
+    def _may_dispatch(self, req):
+        """Whether a running request should ride the next decode step.
+        Plain engines: stop once the whole budget is dispatched (each
+        step is exactly one token). Speculative engines advance a slot
+        by a device-side VARIABLE 1..k tokens the host only learns at
+        retirement, so the gate is the upper bound: dispatch while even
+        full acceptance of everything in flight could not finish the
+        budget — this also caps context overshoot at one round past the
+        budget, which is what the admission reservation slack covers."""
+        if req.done:
+            return False
+        k = self._k
+        if k <= 1:
+            return req._dispatched < req.max_new_tokens
+        return req._ub < req.max_new_tokens
+
     def step(self):
         """One scheduler tick: evict blown deadlines, retire finished
         slots, admit what fits, dispatch one decode step. Returns True
@@ -159,11 +190,39 @@ class ContinuousBatcher:
         self._reap_finished(now)
         self._admit(now)
         meta = tuple((s, r) for s, r in sorted(self._slot_req.items())
-                     if not r.done and r._dispatched < r.max_new_tokens)
+                     if self._may_dispatch(r))
+        k = self._k
+        if k > 1:
+            # a speculative engine advances EVERY device-active slot
+            # each round, so the active mask must mirror the dispatch
+            # set exactly: a gated slot left active would commit tokens
+            # the host never attributes (silent stream corruption)
+            dispatch = {s for s, _ in meta}
+            for slot in self._slot_req:
+                if slot in dispatch:
+                    self.engine.activate(slot)
+                else:
+                    self.engine.deactivate(slot)
         if meta:
             self.engine.decode_step(meta=meta)
             for _, r in meta:
                 r._dispatched += 1
+                r._inflight += 1
+                r._ub += k
+        elif self._slot_req:
+            # every occupied slot is gated on deferred results (budget
+            # possibly complete): force the reads — the in-flight
+            # window if rounds are pending, else the prefill-sampled
+            # first token — so the host learns the true advances and
+            # either finishes the requests or resumes dispatching
+            if self.engine.window.pending:
+                self.engine.flush()
+            else:
+                for req in list(self._slot_req.values()):
+                    req._take_first(now)
+                    req._ub = len(req.output_tokens) \
+                        + req._inflight * self._k
+                self._reap_finished(now)
         return bool(meta or self._queue or self._slot_req)
 
     def run(self, max_steps=100000):
@@ -249,12 +308,21 @@ class ContinuousBatcher:
         _m.queue_depth().set(len(self._queue))
         _m.active_requests().set(len(self._slot_req))
 
+    def _quota_done(self, req):
+        """Slot-release test. Plain engines may release the slot the
+        step the budget is DISPATCHED (1 token/step — the tail rows
+        attribute through metadata). A speculative slot's advance is
+        variable, so only observed completion releases it."""
+        if req.done:
+            return True
+        return self._k <= 1 and req._dispatched >= req.max_new_tokens
+
     def _reap_finished(self, now):
         """Release slots whose request finished — by observed completion
         (EOS) or by dispatch quota (every budgeted token is at least in
         flight; the remaining rows attribute through step metadata)."""
         for slot, req in list(self._slot_req.items()):
-            if req.done or req._dispatched >= req.max_new_tokens:
+            if self._quota_done(req):
                 req._take_first(now)  # covers max_new_tokens == 1
                 self.engine.release(slot)
                 del self._slot_req[slot]
@@ -268,7 +336,7 @@ class ContinuousBatcher:
         while self._queue and self._free_slots():
             req = self._queue[0]
             total = len(req.prompt) + req.max_new_tokens
-            if not self.engine.cache.can_reserve(total):
+            if not self.engine.can_admit(total):
                 break  # pages busy; retiring traffic will free them
             self._queue.popleft()
             slot = self._free_slots()[0]
@@ -279,6 +347,8 @@ class ContinuousBatcher:
                 slot, req.id, req.prompt, req.max_new_tokens)
             req.state = "running"
             req._dispatched = 1  # the prefill-sampled token
+            req._inflight = 0
+            req._ub = 1
             self._slot_req[slot] = req
         _m.queue_depth().set(len(self._queue))
         _m.active_requests().set(len(self._slot_req))
@@ -286,14 +356,20 @@ class ContinuousBatcher:
     def _on_tokens(self, step_no, row, meta):
         """Engine retirement callback: one host token row + the step's
         composition metadata. Runs inside the window's deferred read —
-        records only; slot recomposition stays in step()."""
+        records only; slot recomposition stays in step(). The engine
+        decodes the row (a speculative round carries a variable-length
+        accepted prefix per slot; the plain engine exactly one token)."""
         del step_no
         self._diag.progress("serving_decode")
         now = self._now()
+        k = self._k
         for slot, req in (meta or ()):
             req._take_first(now)
             was_done = req.done
-            req._record(int(row[slot]), now)
+            for tok in self.engine.decode_row(row, slot):
+                req._record(int(tok), now)
+            req._inflight = max(0, req._inflight - 1)
+            req._ub = len(req.output_tokens) + req._inflight * k
             if req.state == "completed" and not was_done:
                 self._finalize(req, "completed")
 
@@ -335,7 +411,7 @@ class StaticBatcher(ContinuousBatcher):
             return
         finished = []
         for slot, req in items:
-            if req.done or req._dispatched >= req.max_new_tokens:
+            if self._quota_done(req):
                 self.engine.deactivate(slot)  # idle, not released
                 finished.append((slot, req))
         if len(finished) == len(items):  # batch boundary: release all

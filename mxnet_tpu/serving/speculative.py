@@ -1,0 +1,490 @@
+"""Speculative decoding — more tokens per decode launch.
+
+The plain decode engine pays one fused launch per generated token. Here
+a cheap **draft model** (any adapter-protocol model, serving/model.py —
+typically a truncated-layer copy of the target) proposes ``draft_k``
+tokens per slot, and the target model verifies ALL of them in ONE wide
+launch. Two launches per round, up to ``draft_k`` committed tokens:
+
+- **draft chain** (1 launch): ``draft_k`` sequential single-token
+  passes of the draft model, unrolled inside one jitted program over
+  the draft's own (smaller) paged KV cache. The draft consumes the
+  same committed prefix the target does, so its KV coverage always
+  equals the target's context length — no catch-up passes, no gaps.
+- **verify** (1 launch): ``draft_k`` single-token passes of the TARGET
+  model unrolled inside one program, consuming ``[current_token,
+  d_1..d_{k-1}]``. Each pass is literally
+  :func:`~mxnet_tpu.serving.engine.one_token_pass` — the bit-identical
+  op sequence sequential decode would run — so a committed token can
+  never differ from the non-speculative stream: greedy token-exactness
+  by construction. The accepted prefix length ``m`` (1 + matching
+  draft prefix) and the commit — context lengths, current tokens — are
+  computed ON DEVICE; KV rows written past ``m`` are garbage that the
+  ragged length masks and the next round overwrites.
+
+Host protocol: the engine stages one ``(slots, k+1)`` int32 row per
+round — ``[m, g_1..g_k]`` per slot — into the in-flight window, so K
+rounds still retire through ONE deferred transfer (host_syncs/step
+unchanged; the scheduler learns every round's variable advance at
+retirement via :meth:`decode_row`). Page safety without host reads:
+admission reserves AND allocates ``prompt + max_new + draft_k`` tokens
+of pages up front for both caches (the verify pass may overshoot the
+budget by at most ``draft_k - 1`` positions; EOS-late semantics already
+discard the overshoot), so no per-step page-table edit ever needs the
+device-side lengths.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from . import metrics as _m
+from .engine import DecodeEngine, one_token_pass
+from .kv_cache import PagedKVCache
+
+__all__ = ["SpeculativeEngine"]
+
+
+class SpeculativeEngine(DecodeEngine):
+    """Draft-and-verify decode over two paged KV caches."""
+
+    def __init__(self, model, draft_model, params=None, draft_params=None,
+                 draft_k=4, slots=None, cache=None, draft_cache=None,
+                 prefill_buckets=(64, 256), max_context=None, seed=0):
+        import jax
+        import jax.numpy as jnp
+
+        self.draft_k = int(draft_k)
+        if self.draft_k < 2:
+            raise MXNetError("speculative decoding needs draft_k >= 2 "
+                             "(draft_k=1 is the plain engine)")
+        # set BEFORE super().__init__: the base class sizes page tables
+        # and admission reservations with this slack
+        self._reserve_slack = self.draft_k
+        self.tokens_per_step = self.draft_k
+        super().__init__(model, params=params, slots=slots, cache=cache,
+                         prefill_buckets=prefill_buckets,
+                         max_context=max_context, seed=seed)
+
+        self.draft_model = draft_model
+        self.draft_params = draft_params if draft_params is not None \
+            else draft_model.init_params(seed)
+        self.dcache = draft_cache or PagedKVCache(
+            draft_model.num_layers, draft_model.num_heads,
+            draft_model.head_dim, num_pages=self.cache.num_pages,
+            page_size=self.cache.page_size,
+            quantized=self.cache.quantized)
+        dS = self.dcache.page_size
+        if dS != self.cache.page_size:
+            raise MXNetError(
+                "draft cache page size %d != target page size %d — the "
+                "prefill buckets are shared, so both caches must page "
+                "identically" % (dS, self.cache.page_size))
+        self.dtable_width = -(-(self.max_context + self.draft_k) // dS)
+        self._dpt = jnp.full((self.slots, self.dtable_width),
+                             self.dcache.scratch_page, jnp.int32)
+        # the draft's context length IS the target's (same committed
+        # prefix, rewound together at every verify commit) — no second
+        # length array exists to drift
+        # ONE fused launch per speculative round: the draft chain and
+        # the wide verify compose into a single donated program (the
+        # verify consumes the chain's proposals as traced values — no
+        # intermediate dispatch, no host hop between the halves)
+        self._jit_round = jax.jit(self._round_impl,
+                                  donate_argnums=(2, 3, 4))
+        self._sadmit_fns = {}
+        from .. import diagnostics
+
+        diagnostics.hbm_set(
+            "params", "draft_model",
+            sum(l.nbytes for l in
+                jax.tree_util.tree_leaves(self.draft_params)
+                if hasattr(l, "nbytes")))
+
+    # -- traced programs ---------------------------------------------------
+    def _chain_impl(self, dparams, dkv, ctx, tokens, dpt, active):
+        """``draft_k`` sequential draft passes in one program: returns
+        the updated draft pool state and the (B, k) proposed tokens.
+        ``ctx`` is read-only here (the verify program owns its donation);
+        the draft writes its K/V at the same positions the target will.
+
+        The prefix is gathered dense ONCE per layer and the chain's own
+        rows land in that dense buffer as it walks (same values a
+        re-gather would read — the pool pages only change where the
+        buffer does); the pool itself takes one batched scatter of all
+        k rows at the end. Cuts the chain's device traffic from
+        k gathers + k scatters to 1 + 1 per layer."""
+        import jax.numpy as jnp
+
+        from ..ops import attention as A
+
+        k = self.draft_k
+        B = self.slots
+        dm = self.draft_model
+        dS = self.dcache.page_size
+        scratch = self.dcache.scratch_page
+        actb = active.astype(bool)
+        rows = jnp.arange(B)
+        pos = ctx[:, None] + jnp.arange(k, dtype=ctx.dtype)[None, :]
+        page_idx = jnp.where(
+            actb[:, None],
+            dpt[rows[:, None], jnp.clip(pos // dS, 0,
+                                        self.dtable_width - 1)],
+            scratch)
+        slot_idx = pos % dS
+        # per-layer dense prefix views + per-layer staged window rows
+        dense = [self._gather_dense_from(self.dcache, dkv, l, dpt)
+                 for l in range(dm.num_layers)]
+        staged_k = [[] for _ in range(dm.num_layers)]
+        staged_v = [[] for _ in range(dm.num_layers)]
+        t, outs = tokens, []
+        for i in range(k):
+            cur = ctx + i * active
+            h = dm.embed(dparams, t,
+                         jnp.clip(cur, 0, dm.max_len - 1))
+            for l in range(dm.num_layers):
+                q, kn, vn = dm.layer_qkv(dparams, l, h)   # (B, H, D)
+                kd, vd = dense[l]
+                kd = kd.at[rows, :, cur, :].set(kn, mode="drop")
+                vd = vd.at[rows, :, cur, :].set(vn, mode="drop")
+                dense[l] = (kd, vd)
+                staged_k[l].append(kn)
+                staged_v[l].append(vn)
+                attn = A.ragged_attention_reference(
+                    q, kd, vd, cur + active, sm_scale=dm.sm_scale)
+                h = dm.layer_finish(dparams, l, h, attn)
+            logits = dm.logits(dparams, h)
+            t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            t = jnp.where(actb, t, tokens)
+            outs.append(t)
+        # one batched pool scatter per layer keeps future rounds' pages
+        for l in range(dm.num_layers):
+            dkv = self.dcache.write_token(
+                dkv, l, page_idx, slot_idx,
+                jnp.stack(staged_k[l], axis=1),
+                jnp.stack(staged_v[l], axis=1))
+        return dkv, jnp.stack(outs, axis=1)
+
+    @staticmethod
+    def _gather_dense_from(cache, kv, layer, pt):
+        """(B, H, T, D) dense K/V views of one layer's pages (dequantized
+        for int8 pools) — shared by the chain and verify programs."""
+        import jax.numpy as jnp
+
+        kl, vl, ks, vs = cache.attend_views(kv, layer)
+        B = pt.shape[0]
+        P, S, H, D = kl.shape
+        mp = pt.shape[1]
+        flat = pt.reshape(-1)
+        kg = kl[flat].reshape(B, mp, S, H, D)
+        vg = vl[flat].reshape(B, mp, S, H, D)
+        if ks is not None:
+            kg = kg.astype(jnp.float32) * (
+                ks[flat].reshape(B, mp, S, H) * (1.0 / 127.0))[..., None]
+            vg = vg.astype(jnp.float32) * (
+                vs[flat].reshape(B, mp, S, H) * (1.0 / 127.0))[..., None]
+        kd = jnp.transpose(kg.reshape(B, mp * S, H, D), (0, 2, 1, 3))
+        vd = jnp.transpose(vg.reshape(B, mp * S, H, D), (0, 2, 1, 3))
+        return kd, vd
+
+    def _gather_dense(self, kv, layer, pt):
+        """One layer's pool pages gathered dense through the page
+        table — (B, H, T, D) K and V, dequantized for int8 pools:
+        exactly the gather ``ragged_paged_attention``'s XLA fallback
+        performs, hoisted so the k per-position attention reads share
+        it instead of re-gathering per pass."""
+        return self._gather_dense_from(self.cache, kv, layer, pt)
+
+    def _verify_impl(self, params, kv, ctx, tokens, d_toks, pt, active):
+        """``draft_k`` target positions verified in one wide pass plus
+        the device-side accept/commit: returns (kv, new_ctx, new_tokens,
+        row) with row = (B, k+1) int32 ``[m, g_1..g_k]`` per slot.
+
+        Layer-major like a prefill: per layer ONE batched pool scatter
+        of all k new K/V rows and ONE dense gather, then k masked
+        single-query attention reads (``ragged_attention_reference`` on
+        the same gathered values the sequential decode path reads — the
+        shapes and values per read are identical to the plain engine's,
+        which is what keeps committed tokens bit-equal to its stream).
+        Rows written past the accepted prefix are garbage the ragged
+        masks hide and the next round overwrites."""
+        import jax.numpy as jnp
+
+        from ..ops import attention as A
+
+        k = self.draft_k
+        B = self.slots
+        model = self.model
+        S = self.cache.page_size
+        scratch = self.cache.scratch_page
+        actb = active.astype(bool)
+        rows = jnp.arange(B)
+        x = jnp.concatenate([tokens[:, None], d_toks[:, :k - 1]],
+                            axis=1)                              # (B, k)
+        pos = ctx[:, None] + jnp.arange(k)[None, :]              # (B, k)
+        page_idx = jnp.where(
+            actb[:, None],
+            pt[rows[:, None], jnp.clip(pos // S, 0,
+                                       self.table_width - 1)],
+            scratch)
+        slot_idx = pos % S
+        h = model.embed(params, x,
+                        jnp.clip(pos, 0, model.max_len - 1))     # (B,k,M)
+        # per-position ragged masks, hoisted: query i sees positions
+        # < ctx + i + 1 — the exact bias a sequential step at that
+        # length builds (make_padding_bias), shared by every layer
+        T = self.table_width * S
+        biases = [A.make_padding_bias(ctx + (i + 1) * active,
+                                      max_len=T, dtype="float32")
+                  for i in range(k)]
+        sm = float(model.sm_scale)  # sync-ok: host model hyper, not a device read
+        for l in range(model.num_layers):
+            q, kn, vn = model.layer_qkv(params, l, h)            # (B,k,H,D)
+            kv = self.cache.write_token(kv, l, page_idx, slot_idx,
+                                        kn, vn)
+            kd, vd = self._gather_dense(kv, l, pt)
+            attn = []
+            for i in range(k):
+                # single-query reference read per position — the SAME
+                # op sequence (and therefore bit pattern) as the plain
+                # engine's paged-attention fallback at that length
+                out = A._attention_reference(
+                    q[:, i][:, :, None, :], kd, vd, biases[i], False,
+                    sm)
+                attn.append(out[:, :, 0])
+            h = model.layer_finish(params, l, h,
+                                   jnp.stack(attn, axis=1))
+        logits = model.logits(params, h)                         # (B,k,V)
+        G = jnp.argmax(logits, axis=-1).astype(jnp.int32)        # (B,k)
+        G = jnp.where(actb[:, None], G, tokens[:, None])
+        # token i+1 is valid iff its input d_i matched the target's g_i
+        # for EVERY i up to there: m = 1 + longest matching draft prefix
+        match = (d_toks[:, :k - 1] == G[:, :k - 1])   # (B, k-1)
+        # dtype pinned: cumprod would promote int32 -> int64 under x64,
+        # silently retracing every warmed program at a second signature
+        prefix = jnp.cumprod(match.astype(jnp.int32), axis=1,
+                             dtype=jnp.int32)
+        m = (1 + jnp.sum(prefix, axis=1)).astype(jnp.int32)
+        m = jnp.where(actb, m, jnp.int32(0))          # (B,) in [1, k]
+        newlens = ctx + m
+        rows = jnp.arange(self.slots)
+        new_tok = jnp.where(actb,
+                            G[rows, jnp.clip(m - 1, 0, k - 1)], tokens)
+        row = jnp.concatenate([m[:, None], G], axis=1).astype(jnp.int32)
+        return kv, newlens, new_tok.astype(jnp.int32), row
+
+    def _round_impl(self, params, dparams, kv, dkv, ctx, tokens, pt,
+                    dpt, active):
+        """One whole speculative round — draft chain then wide verify —
+        as a single traced program."""
+        dkv, d_toks = self._chain_impl(dparams, dkv, ctx, tokens, dpt,
+                                       active)
+        kv, newlens, new_tok, row = self._verify_impl(
+            params, kv, ctx, tokens, d_toks, pt, active)
+        return kv, dkv, newlens, new_tok, row
+
+    # -- the decode hot path ----------------------------------------------
+    def decode_step(self, meta=None):
+        """One speculative round: draft chain launch + verify launch;
+        the (B, k+1) accept row rides the in-flight window exactly like
+        the plain engine's token row (same single deferred read per K
+        rounds). Page tables were fully materialized at admission, so
+        no host-side length bookkeeping runs here at all."""
+        act = [s for s in range(self.slots) if self._host_active[s]]
+        if not act:
+            return None
+        self._inflight_meta.append(meta)
+        try:
+            kv, dkv, ctx, tok, row = self._jit_round(
+                self.params, self.draft_params, self.cache.state(),
+                self.dcache.state(), self._ctx, self._tokens,
+                self._pt, self._dpt, self._active_arr())
+        except Exception as e:  # noqa: BLE001 — OOM gets the HBM ledger
+            from .. import diagnostics
+
+            self._inflight_meta.pop()
+            diagnostics.reraise_if_oom(e, "serving_spec_decode")
+            raise
+        self.dcache.swap(dkv)
+        self.cache.swap(kv)
+        self._ctx, self._tokens = ctx, tok
+        _m.spec_proposed_total().inc((self.draft_k - 1) * len(act))
+        _m.decode_batch_occupancy().observe(len(act))
+        return self.window.push(row, value=row)
+
+    def decode_row(self, row, slot):
+        """The accepted prefix one retired round carries for ``slot``:
+        ``row[slot] = [m, g_1..g_k]`` — m committed tokens. Feeds the
+        acceptance-rate and throughput counters (deferred accounting:
+        this runs inside the window's one read per K rounds)."""
+        m = int(row[slot, 0])
+        toks = [int(t) for t in row[slot, 1:1 + m]]
+        if m > 0:
+            _m.tokens_total().inc(m)
+            _m.spec_accepted_total().inc(m - 1)
+        return toks
+
+    # -- admission ---------------------------------------------------------
+    def can_admit(self, total_tokens):
+        padded = total_tokens + self._reserve_slack
+        return (self.cache.can_reserve(padded)
+                and self.dcache.can_reserve(padded))
+
+    def _post_reserve(self, seq_id, total):
+        """Materialize the full worst-case allocation in BOTH caches
+        the moment the target reservation lands: decode rounds then
+        never touch page bookkeeping, and the page-table rows written
+        at admission are complete (no lazy growth, no host-side length
+        tracking — the device owns the lengths)."""
+        padded = total + self._reserve_slack
+        if not self.dcache.reserve(seq_id, padded):
+            self.cache.free(seq_id)
+            raise MXNetError("draft KV pool too busy for sequence %r "
+                             "(check engine.can_admit before admitting)"
+                             % (seq_id,))
+        # the worst-case pages were promised at reservation: cannot fail
+        self.cache.alloc_for(seq_id, padded)
+        self.dcache.alloc_for(seq_id, padded)
+
+    def _dprefill_impl(self, dparams, tokens, valid, *, bucket):
+        import jax.numpy as jnp
+
+        dm = self.draft_model
+        dS = self.dcache.page_size
+        nbp = bucket // dS
+        ks, vs, _ = dm.prefill(dparams, tokens, valid)
+        kr = jnp.transpose(ks[:, 0], (0, 2, 1, 3)).reshape(
+            dm.num_layers, nbp, dS, dm.num_heads, dm.head_dim)
+        vr = jnp.transpose(vs[:, 0], (0, 2, 1, 3)).reshape(
+            dm.num_layers, nbp, dS, dm.num_heads, dm.head_dim)
+        return kr, vr
+
+    def _sadmit_impl(self, params, dparams, kv, dkv, pt, dpt, tokens,
+                     ctx, padded, valid, ids, dids, row, drow, slot, t,
+                     *, bucket):
+        """One fused dispatch for the WHOLE speculative admission:
+        target prefill + page write + slot commit (the base program)
+        and the draft prefill + page write + draft page-table row."""
+        kv, pt, tokens, ctx, tok0 = self._admit_impl(
+            params, kv, pt, tokens, ctx, padded, valid, ids, row,
+            slot, t, bucket=bucket)
+        dkr, dvr = self._dprefill_impl(dparams, padded, valid,
+                                       bucket=bucket)
+        dkv = self.dcache.write_pages(dkv, dkr, dvr, dids)
+        return kv, dkv, pt, dpt.at[slot].set(drow), tokens, ctx, tok0
+
+    def _sadmit_fn(self, bucket):
+        import functools
+
+        import jax
+
+        fn = self._sadmit_fns.get(bucket)
+        if fn is None:
+            fn = self._sadmit_fns[bucket] = jax.jit(
+                functools.partial(self._sadmit_impl, bucket=bucket),
+                donate_argnums=(2, 3, 4, 5, 7))
+        return fn
+
+    def admit(self, slot, seq_id, prompt_tokens, max_new_tokens):
+        """Both halves of a speculative admission in ONE dispatch: the
+        _post_reserve hook reserved + allocated both caches up front,
+        then the fused program prefills target AND draft, scatters both
+        prompt K/V page sets, and commits the slot state."""
+        import jax.numpy as jnp
+
+        from ..ndarray.pending import PendingValue
+
+        p = self._admit_prep(slot, seq_id, prompt_tokens, max_new_tokens)
+        dS = self.dcache.page_size
+        dnbp = p["bucket"] // dS
+        dpages = self.dcache.pages_of(seq_id)
+        dids = np.full((dnbp,), self.dcache.scratch_page, np.int32)
+        n = min(len(dpages), dnbp)
+        dids[:n] = dpages[:n]  # bucket tail pages scatter to scratch
+        drow = self.dcache.page_table_row(seq_id, self.dtable_width)
+        try:
+            (kv, dkv, self._pt, self._dpt, self._tokens, self._ctx,
+             tok0) = self._sadmit_fn(p["bucket"])(
+                self.params, self.draft_params, self.cache.state(),
+                self.dcache.state(), self._pt, self._dpt, self._tokens,
+                self._ctx, jnp.asarray(p["padded"]),
+                jnp.asarray(np.array([p["T"]], np.int32)),
+                jnp.asarray(p["ids"]), jnp.asarray(dids),
+                jnp.asarray(p["row"]), jnp.asarray(drow),
+                np.int32(slot), np.int32(p["T"]))
+        except Exception as e:  # noqa: BLE001 — OOM gets the HBM ledger
+            from .. import diagnostics
+
+            self.cache.free(seq_id)
+            self.dcache.free(seq_id)
+            diagnostics.reraise_if_oom(e, "serving_prefill")
+            raise
+        self.cache.swap(kv)
+        self.dcache.swap(dkv)
+        self._seq_of_slot[slot] = seq_id
+        self._host_active[slot] = True
+        self._host_len[slot] = p["T"]
+        _m.tokens_total().inc()  # the prefill-sampled first token
+        return PendingValue(tok0)
+
+    # -- recomposition -----------------------------------------------------
+    def release(self, slot):
+        """Retire a slot in BOTH caches (stale page-table rows stay —
+        masked for inactive slots, overwritten at the next admission)."""
+        seq = self._seq_of_slot.get(slot)
+        super().release(slot)
+        if seq is not None:
+            self.dcache.free(seq)
+
+    def defrag(self):
+        """Compact both pools; re-emit every live slot's rows for both
+        page tables."""
+        import jax.numpy as jnp
+
+        moved = super().defrag()
+        dmoved = self.dcache.defrag()
+        if dmoved:
+            for s, seq in self._seq_of_slot.items():
+                self._dpt = self._dpt.at[s].set(jnp.asarray(
+                    self.dcache.page_table_row(seq, self.dtable_width)))
+        return moved + dmoved
+
+    # -- AOT warm-start ----------------------------------------------------
+    def aot_warmup(self):
+        """Lower-and-compile every request-path program: the draft
+        chain, the wide verify, and the fused two-model admission per
+        prefill bucket. (The plain single-token step is not compiled —
+        this engine never dispatches it.)"""
+        import jax
+        import jax.numpy as jnp
+
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        i32 = jnp.int32
+        pstruct = jax.tree_util.tree_map(sds, self.params)
+        dstruct = jax.tree_util.tree_map(sds, self.draft_params)
+        kv_sds = tuple(sds(a) for a in self.cache.state())
+        dkv_sds = tuple(sds(a) for a in self.dcache.state())
+        act = jax.ShapeDtypeStruct((self.slots,), i32)
+        n = 0
+        self._jit_round.lower(
+            pstruct, dstruct, kv_sds, dkv_sds, sds(self._ctx),
+            sds(self._tokens), sds(self._pt), sds(self._dpt),
+            act).compile()
+        n += 1
+        S, dS = self.cache.page_size, self.dcache.page_size
+        for bucket in list(self._buckets):
+            self._sadmit_fn(bucket).lower(
+                pstruct, dstruct, kv_sds, dkv_sds, sds(self._pt),
+                sds(self._dpt), sds(self._tokens), sds(self._ctx),
+                jax.ShapeDtypeStruct((1, bucket), i32),
+                jax.ShapeDtypeStruct((1,), i32),
+                jax.ShapeDtypeStruct((bucket // S,), i32),
+                jax.ShapeDtypeStruct((bucket // dS,), i32),
+                jax.ShapeDtypeStruct((self.table_width,), i32),
+                jax.ShapeDtypeStruct((self.dtable_width,), i32),
+                jax.ShapeDtypeStruct((), i32),
+                jax.ShapeDtypeStruct((), i32)).compile()
+            n += 1
+        return n

@@ -26,24 +26,28 @@ from __future__ import annotations
 from . import autotune, compile_cache, table as _table_mod, warmup as _warmup
 from .autotune import (attention_candidates, attention_cost, bn_candidates,
                        bn_cost, heuristic_attention, heuristic_bn,
-                       heuristic_paged, measure_attention, measure_bn,
-                       paged_candidates, paged_cost, resolve_attention,
-                       resolve_bn, resolve_paged)
+                       heuristic_paged, heuristic_quant,
+                       measure_attention, measure_bn,
+                       paged_candidates, paged_cost, quant_cost,
+                       resolve_attention, resolve_bn, resolve_paged,
+                       resolve_quant)
 from .compile_cache import (cache_dir, compile_stats, install_listeners,
                             setup as setup_compile_cache)
 from .table import (TABLE_VERSION, TuneTable, attn_key, bn_key, device_kind,
-                    paged_key, reset, save, table)
+                    paged_key, quant_key, reset, save, table)
 from .warmup import record_signature, register_step, signatures, warmup
 
 __all__ = [
     "attention_candidates", "attention_cost", "bn_candidates", "bn_cost",
     "heuristic_attention", "heuristic_bn", "heuristic_paged",
+    "heuristic_quant",
     "measure_attention", "measure_bn", "paged_candidates", "paged_cost",
-    "resolve_attention", "resolve_bn", "resolve_paged",
+    "quant_cost",
+    "resolve_attention", "resolve_bn", "resolve_paged", "resolve_quant",
     "cache_dir", "compile_stats", "install_listeners",
     "setup_compile_cache",
     "TABLE_VERSION", "TuneTable", "attn_key", "bn_key", "device_kind",
-    "paged_key", "reset", "save", "table",
+    "paged_key", "quant_key", "reset", "save", "table",
     "record_signature", "register_step", "signatures", "warmup",
     "autotune", "compile_cache",
 ]

@@ -224,6 +224,50 @@ def resolve_paged(q_shape, page_size, max_pages, dtype):
 
 
 # --------------------------------------------------------------------------
+# quantized-vs-float decode matmuls (weight-only int8 serving)
+# --------------------------------------------------------------------------
+def quant_cost(k, n, backend):
+    """Deterministic relative cost of one (k, n) decode matmul on one
+    backend. Decode matmuls are weight-BYTES-bound (batch is a handful
+    of slots, the weight tile is read once per launch): float charges
+    4 bytes/element; int8 charges 1 byte/element + the per-column amax
+    plane + a dequant-epilogue tax + a fixed kernel-setup overhead that
+    keeps tiny layers on the fused float path."""
+    if backend == "int8":
+        return 1.0 * k * n + 4.0 * n + 0.25 * k * n + 2048.0
+    return 4.0 * k * n
+
+
+def heuristic_quant(op, k, n, dtype):
+    """Cost-model backend choice for one decode-matmul shape bucket:
+    'int8' (weight-only-quantized kernel) when the quantized bytes +
+    dequant tax undercut the float weight read, else 'fp'."""
+    del op, dtype
+    ci, cf = quant_cost(k, n, "int8"), quant_cost(k, n, "fp")
+    backend = "int8" if ci < cf else "fp"
+    return {"backend": backend, "source": "heuristic",
+            "score": round(min(ci, cf), 3)}
+
+
+def resolve_quant(op, k, n, dtype):
+    """The per-shape quantized-vs-float decision a serving engine's
+    weight quantization consults (TinyDecoder.quantize_params): table
+    hit, else the cost model, recorded under the pow2 (k, n) bucket.
+    Like resolve_paged there is no inline measurement (the decision is
+    made at engine build, not dispatch) — measured entries arrive via
+    offline sweeps writing the table and are never downgraded here.
+    ``MXT_TUNE_MODE=off`` bypasses the table entirely."""
+    if _mode() == "off":
+        return heuristic_quant(op, k, n, dtype)
+    tab = _table_mod.table()
+    key = _table_mod.quant_key(op, k, n, dtype)
+    ent = tab.lookup(key)
+    if ent is not None:
+        return ent
+    return tab.record(key, heuristic_quant(op, k, n, dtype))
+
+
+# --------------------------------------------------------------------------
 # BN backward
 # --------------------------------------------------------------------------
 def bn_candidates(m, c):

@@ -102,6 +102,16 @@ def paged_key(q_shape, page_size, max_pages, dtype, kind=None):
         bucket_rows(max_pages), str(dtype), kind or device_kind())
 
 
+def quant_key(op, k, n, dtype, kind=None):
+    """Quantized-vs-float kernel bucket for one decode matmul shape:
+    (reduction k, output n) both round to the next power of two — the
+    same bounded-growth discipline as paged_key, keyed per device kind
+    because the int8 win is a memory-bandwidth property of the chip."""
+    return "quant|%s|k%d|n%d|%s|%s" % (
+        str(op), bucket_rows(k), bucket_rows(n), str(dtype),
+        kind or device_kind())
+
+
 class TuneTable:
     """One process's view of the tuning table: entries + signatures,
     loaded from ``path`` when it exists (corrupted/stale files are

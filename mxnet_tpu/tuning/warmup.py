@@ -125,10 +125,21 @@ def _warm_paged(spec):
 
     sm_scale = float(spec["sm_scale"])  # sync-ok: host float from JSON
     q = _sds(spec["q_shape"], spec["dtype"])
-    kp = _sds(spec["pool_shape"], spec["dtype"])
-    vp = _sds(spec["pool_shape"], spec["dtype"])
+    pool_dtype = spec.get("pool_dtype", spec["dtype"])
+    kp = _sds(spec["pool_shape"], pool_dtype)
+    vp = _sds(spec["pool_shape"], pool_dtype)
     pt = _sds((spec["q_shape"][0], spec["max_pages"]), jnp.int32)
     cl = _sds((spec["q_shape"][0],), jnp.int32)
+    if spec.get("quantized"):
+        sc = _sds(tuple(spec["pool_shape"][:-1]), jnp.float32)
+
+        def fwd(q_, kp_, vp_, pt_, cl_, ks_, vs_):
+            return A.ragged_paged_attention(q_, kp_, vp_, pt_, cl_,
+                                            sm_scale=sm_scale,
+                                            k_scales=ks_, v_scales=vs_)
+
+        jax.jit(fwd).lower(q, kp, vp, pt, cl, sc, sc).compile()
+        return "paged_attention"
 
     def fwd(q_, kp_, vp_, pt_, cl_):
         return A.ragged_paged_attention(q_, kp_, vp_, pt_, cl_,

@@ -1,0 +1,428 @@
+"""Speculative + quantized decode (mxnet_tpu/serving/speculative.py,
+quantized PagedKVCache pools, weight-only int8 matmuls routed by
+tuning.resolve_quant).
+
+The PR-12 acceptance surface on CPU:
+
+- greedy token-EXACTNESS of the speculative engine vs the plain engine
+  across mixed ragged traffic (bit-identical streams — speculation may
+  change the schedule, never the output), including k > remaining
+  budget and EOS-landing-inside-a-draft-window edge cases;
+- quantized-KV capacity: an int8 pool holding the SAME device byte
+  budget seats >= 1.9x the pages/resident sequences, at bounded output
+  divergence (and exact parity against the quantized oracle);
+- the async contract survives speculation: <= 1 host sync per K decode
+  rounds, accept rows riding the in-flight window;
+- resolve_quant table semantics (pow2 buckets, measured-wins);
+- chaos: a speculative fleet's replica_kill failover replays in-flight
+  requests token-exact (no re-decode divergence) — swept per seed by
+  tools/chaos_matrix.sh.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mxnet_tpu import engine as eng_mod
+from mxnet_tpu import nd, profiler, serving, telemetry, tuning
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ops import quantization as Q
+from mxnet_tpu.serving import (ContinuousBatcher, DecodeEngine,
+                               PagedKVCache, Request, SpeculativeEngine,
+                               TinyDecoder)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_table(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXT_TUNE_TABLE", str(tmp_path / "tune.json"))
+    tuning.reset()
+    yield
+    tuning.reset()
+
+
+MODEL = TinyDecoder(vocab=128, num_layers=2, num_heads=2, head_dim=16,
+                    max_len=512)
+PARAMS = MODEL.init_params(3)
+DRAFT, DPARAMS = MODEL.truncated(PARAMS, 1)
+
+_ENGINES = {}  # (spec, quantized, k) -> engine, reused when drained
+
+
+def _engine(spec, quantized=False, k=4, fresh=False):
+    key = (spec, quantized, k)
+    if not fresh and key in _ENGINES:
+        eng = _ENGINES[key]
+        if eng.cache.pages_in_use() == 0 and not eng._seq_of_slot:
+            return eng
+    if spec:
+        eng = SpeculativeEngine(
+            MODEL, DRAFT, params=PARAMS, draft_params=DPARAMS,
+            draft_k=k, slots=4,
+            cache=PagedKVCache(2, 2, 16, num_pages=128, page_size=8,
+                               quantized=quantized),
+            draft_cache=PagedKVCache(1, 2, 16, num_pages=128,
+                                     page_size=8, quantized=quantized),
+            prefill_buckets=(16,), max_context=128)
+    else:
+        eng = DecodeEngine(
+            MODEL, params=PARAMS, slots=4,
+            cache=PagedKVCache(2, 2, 16, num_pages=128, page_size=8,
+                               quantized=quantized),
+            prefill_buckets=(16,), max_context=128)
+    if not fresh:
+        _ENGINES[key] = eng
+    return eng
+
+
+def _traffic():
+    rng = np.random.RandomState(0)
+    return [(rng.randint(1, 128, plen).tolist(), mnew)
+            for plen, mnew in [(3, 6), (9, 4), (1, 8), (14, 3), (5, 12),
+                               (2, 7), (30, 1), (8, 2)]]
+
+
+def _run(eng, traffic):
+    sched = ContinuousBatcher(eng)
+    reqs = [sched.submit(Request(p, max_new_tokens=m))
+            for p, m in traffic]
+    sched.run(max_steps=20000)
+    return reqs, sched
+
+
+# ---------------------------------------------------------------------------
+# greedy token-exactness
+# ---------------------------------------------------------------------------
+def test_speculative_matches_plain_engine_mixed_traffic():
+    """8 mixed-ragged requests through 4 slots: the speculative stream
+    is BIT-identical to the plain engine's, which is itself the
+    cache-free dense oracle's."""
+    base, bs = _run(_engine(False), _traffic())
+    spec, ss = _run(_engine(True), _traffic())
+    for a, b in zip(base, spec):
+        assert a.state == b.state == "completed"
+        assert a.output_tokens == b.output_tokens
+    # fewer scheduler rounds: that is the whole point
+    assert ss.steps < bs.steps
+    ref = MODEL.reference_decode(PARAMS, base[0].prompt,
+                                 base[0].max_new_tokens)
+    assert base[0].output_tokens == ref
+
+
+def test_speculative_k_exceeds_remaining_budget():
+    """max_new < draft_k: the verify window overshoots the budget, the
+    scheduler discards the tail, the stream is still exact (and the
+    overshoot pages were covered by the admission slack)."""
+    for p, m in [([7, 3], 1), ([5], 2), ([9, 1, 4], 3)]:
+        spec, _ = _run(_engine(True), [(p, m)])
+        assert spec[0].state == "completed"
+        assert spec[0].output_tokens == MODEL.reference_decode(
+            PARAMS, p, m)
+        assert len(spec[0].output_tokens) == m
+
+
+def test_speculative_eos_inside_draft_window():
+    """EOS produced mid-draft-window: generation stops AT the first
+    EOS exactly (post-EOS tokens of the same verify row discarded)."""
+    prompt = [5, 9, 2]
+    ref = MODEL.reference_decode(PARAMS, prompt, 10)
+    eos = ref[2]
+    stop = ref.index(eos) + 1
+    sched = ContinuousBatcher(_engine(True))
+    r = sched.submit(Request(prompt, max_new_tokens=10, eos_id=eos))
+    sched.run()
+    assert r.state == "completed"
+    assert r.output_tokens == ref[:stop]
+    assert r.output_tokens[-1] == eos
+
+
+def test_speculative_draft_k_validation():
+    with pytest.raises(MXNetError):
+        SpeculativeEngine(MODEL, DRAFT, params=PARAMS,
+                          draft_params=DPARAMS, draft_k=1, slots=2)
+
+
+# ---------------------------------------------------------------------------
+# the async contract with speculation on
+# ---------------------------------------------------------------------------
+def test_spec_decode_loop_sync_bound():
+    """<= 1 host sync per K rounds once steady — the staged (B, k+1)
+    accept rows retire through ONE deferred read like plain tokens."""
+    eng = _engine(True)
+    sched = ContinuousBatcher(eng)
+    sched.submit(Request([5, 9, 2], max_new_tokens=60))
+    for _ in range(3):
+        sched.step()
+    with eng_mod.bulk(4):
+        h0 = profiler.host_sync_count()
+        for _ in range(8):
+            sched.step()
+        syncs = profiler.host_sync_count() - h0
+    assert syncs <= 8 // 4 + 1, \
+        "spec decode loop performed %d syncs over 8 rounds at K=4" % syncs
+    sched.run()
+    nd.waitall()
+
+
+def test_spec_acceptance_metrics():
+    def total(name):
+        fam = telemetry.registry().get(name)
+        return sum(ch.value for ch in fam.children().values()) \
+            if fam else 0.0
+
+    p0 = total("mxt_serving_spec_proposed_tokens_total")
+    a0 = total("mxt_serving_spec_accepted_tokens_total")
+    reqs, _ = _run(_engine(True), _traffic())
+    proposed = total("mxt_serving_spec_proposed_tokens_total") - p0
+    accepted = total("mxt_serving_spec_accepted_tokens_total") - a0
+    assert proposed > 0
+    assert 0 <= accepted <= proposed
+
+
+# ---------------------------------------------------------------------------
+# quantized KV pages
+# ---------------------------------------------------------------------------
+def test_kv_quant_double_resident_capacity():
+    """Same device byte budget -> >= 1.9x pages AND >= 1.9x concurrent
+    resident sequences through a slot-rich engine."""
+    budget = 512 << 10
+    pf = PagedKVCache.pages_for_budget(budget, 2, 2, 16, page_size=8,
+                                       quantized=False)
+    pq = PagedKVCache.pages_for_budget(budget, 2, 2, 16, page_size=8,
+                                       quantized=True)
+    assert pq >= 1.9 * pf
+    # live capacity: sequences of 4 pages each until reservation fails
+    def resident(quantized, pages):
+        cache = PagedKVCache(2, 2, 16, num_pages=pages, page_size=8,
+                             quantized=quantized)
+        n = 0
+        while cache.reserve("s%d" % n, 32):
+            n += 1
+        return n
+
+    rf = resident(False, pf)
+    rq = resident(True, pq)
+    assert rq >= 1.9 * rf
+    # the byte accounting is real: both pools fit the budget
+    cf = PagedKVCache(2, 2, 16, num_pages=pf, page_size=8)
+    cq = PagedKVCache(2, 2, 16, num_pages=pq, page_size=8,
+                      quantized=True)
+    assert sum(a.nbytes for a in cf.state()) <= budget
+    assert sum(a.nbytes for a in cq.state()) <= budget
+
+
+def test_kv_quant_bounded_divergence_and_internal_exactness():
+    """int8 pages: output streams stay CLOSE to the f32 engine's
+    (bounded divergence), and the quantized engine is internally exact
+    (speculative == plain under the same quantized pools)."""
+    base, _ = _run(_engine(False), _traffic())
+    q8, _ = _run(_engine(False, quantized=True), _traffic())
+    total = sum(len(r.output_tokens) for r in base)
+    same = sum(sum(1 for x, y in zip(a.output_tokens, b.output_tokens)
+                   if x == y) for a, b in zip(base, q8))
+    assert same / total >= 0.8, \
+        "int8 KV diverged on %d/%d tokens" % (total - same, total)
+    spec_q, _ = _run(_engine(True, quantized=True), _traffic())
+    for a, b in zip(q8, spec_q):
+        assert a.output_tokens == b.output_tokens
+
+
+def test_kv_quant_attention_parity():
+    """The quantized gather fallback: dequantized paged attention is
+    close to the f32 path on the same logical values."""
+    rng = np.random.RandomState(2)
+    B, H, D, S, P = 2, 2, 16, 8, 10
+    q = jnp.asarray(rng.normal(size=(B, H, D)).astype("f4"))
+    k = rng.normal(size=(P, S, H, D)).astype("f4")
+    v = rng.normal(size=(P, S, H, D)).astype("f4")
+    pt = jnp.asarray([[0, 1, 2], [3, 4, 5]], dtype=jnp.int32)
+    cl = jnp.asarray([5, 23], dtype=jnp.int32)
+    ref = np.array(nd.ragged_paged_attention(
+        q, jnp.asarray(k), jnp.asarray(v), pt, cl).data)
+
+    def quant(x):
+        amax = np.abs(x).max(axis=-1)
+        qx = np.clip(np.round(x * (127.0 / np.maximum(amax, 1e-30))
+                              [..., None]), -127, 127).astype(np.int8)
+        return jnp.asarray(qx), jnp.asarray(amax.astype("f4"))
+
+    kq, ks = quant(k)
+    vq, vs = quant(v)
+    got = np.array(nd.ragged_paged_attention(
+        q, kq, vq, pt, cl, k_scales=ks, v_scales=vs).data)
+    np.testing.assert_allclose(got, ref, atol=5e-2)
+
+
+# ---------------------------------------------------------------------------
+# weight-only quantization + resolve_quant
+# ---------------------------------------------------------------------------
+def test_woq_matmul_parity():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(size=(4, 64)).astype("f4"))
+    w = jnp.asarray(rng.normal(size=(64, 96)).astype("f4"))
+    qw, amax = Q.quantize_rowwise(w)
+    assert qw.dtype == jnp.int8 and amax.shape == (96,)
+    got = np.array(Q.woq_matmul(x, qw, amax))
+    np.testing.assert_allclose(got, np.array(x @ w), atol=2e-1)
+    # dequantized weight error is the int8 grid, column-scaled
+    deq = np.array(qw, dtype=np.float32) * (np.array(amax) / 127.0)
+    assert np.max(np.abs(deq - np.array(w))) <= np.max(np.array(amax)) \
+        / 127.0 + 1e-6
+
+
+def test_quantize_params_routing_and_exactness():
+    """quantize_params stores int8 where resolve_quant says 'int8';
+    the quantized ENGINE matches the quantized ORACLE token for token
+    (quantization shifts the function, never the engine's fidelity)."""
+    qparams, report = MODEL.quantize_params(PARAMS)
+    assert report and all(b in ("int8", "fp") for b in report.values())
+    assert any(k.endswith("__q") for k in qparams) \
+        or all(b == "fp" for b in report.values())
+    prompt = [5, 9, 2, 44]
+    eng = DecodeEngine(MODEL, params=qparams, slots=2,
+                       cache=PagedKVCache(2, 2, 16, num_pages=64,
+                                          page_size=8),
+                       prefill_buckets=(16,), max_context=64)
+    sched = ContinuousBatcher(eng)
+    r = sched.submit(Request(prompt, max_new_tokens=8))
+    sched.run()
+    assert r.output_tokens == MODEL.reference_decode(qparams, prompt, 8)
+
+
+def test_resolve_quant_table_semantics():
+    # pow2 bucketing: nearby shapes share a key, measured entries win
+    k1 = tuning.quant_key("woq_matmul", 65, 190, "float32")
+    k2 = tuning.quant_key("woq_matmul", 127, 255, "float32")
+    assert k1 == k2
+    ent = tuning.resolve_quant("woq_matmul", 64, 192, "float32")
+    assert ent["backend"] in ("int8", "fp")
+    assert ent["source"] == "heuristic"
+    key = tuning.quant_key("woq_matmul", 64, 192, "float32")
+    tuning.table().record(key, {"backend": "fp", "source": "measured"})
+    assert tuning.resolve_quant(
+        "woq_matmul", 64, 192, "float32")["backend"] == "fp"
+    # heuristic re-record never downgrades the measured entry
+    tuning.table().record(key, {"backend": "int8",
+                                "source": "heuristic"})
+    assert tuning.table().peek(key)["source"] == "measured"
+    # tiny layers stay fp, big decode matmuls go int8
+    assert tuning.heuristic_quant("woq_matmul", 8, 8,
+                                  "float32")["backend"] == "fp"
+    assert tuning.heuristic_quant("woq_matmul", 256, 1024,
+                                  "float32")["backend"] == "int8"
+
+
+# ---------------------------------------------------------------------------
+# AOT warm + recomposition with speculation
+# ---------------------------------------------------------------------------
+def test_spec_aot_warmup_and_defrag():
+    eng = _engine(True, fresh=True)
+    # fused round + one fused two-model admission per bucket
+    assert eng.aot_warmup() >= 2
+    sched = ContinuousBatcher(eng)
+    a = sched.submit(Request([3, 1, 4, 1, 5], max_new_tokens=8))
+    b = sched.submit(Request([9, 2], max_new_tokens=8))
+    for _ in range(2):
+        sched.step()
+    eng.flush()
+    eng.defrag()
+    sched.run()
+    for r in (a, b):
+        assert r.output_tokens == MODEL.reference_decode(
+            PARAMS, r.prompt, r.max_new_tokens)
+
+
+# ---------------------------------------------------------------------------
+# chaos: speculative fleet failover replays token-exact
+# ---------------------------------------------------------------------------
+def _spec_factory():
+    return SpeculativeEngine(
+        MODEL, DRAFT, params=PARAMS, draft_params=DPARAMS, draft_k=3,
+        slots=2,
+        cache=PagedKVCache(2, 2, 16, num_pages=64, page_size=8),
+        draft_cache=PagedKVCache(1, 2, 16, num_pages=64, page_size=8),
+        prefill_buckets=(16,), max_context=64)
+
+
+@pytest.mark.chaos
+def test_chaos_spec_fleet_replica_kill_replay(monkeypatch):
+    """Seeded replica_kill on a SPECULATIVE-engine fleet: the router
+    fails the dead replica's in-flight requests over and every stream
+    completes token-exact vs the oracle — failover replays speculative
+    requests without re-decode divergence."""
+    from mxnet_tpu import resilience
+    from mxnet_tpu.serving import FleetRouter
+
+    seed = int(os.environ.get("MXT_CHAOS_SEED", "0"))
+    monkeypatch.setenv("MXT_KV_RETRIES", "1")
+    monkeypatch.setenv("MXT_KV_RETRY_BASE", "0.02")
+    monkeypatch.setenv("MXT_KV_RETRY_MAX", "0.05")
+    monkeypatch.setenv(
+        "MXT_FAULT", "replica_kill:replica=1,after=2,n=1,seed=%d" % seed)
+    resilience.reset_faults()
+    try:
+        pool, srv = serving.local_serving_fleet(2, _spec_factory)
+        router = FleetRouter(pool)
+        rng = np.random.RandomState(seed)
+        reqs = [router.submit(rng.randint(1, 128, 4).tolist(),
+                              max_new_tokens=8, token="sk%d" % i)
+                for i in range(6)]
+        router.run(max_steps=4000)
+        assert pool.get(1).state == "dead"
+        assert all(rr.state == "completed" for rr in reqs)
+        for rr in reqs:
+            assert rr.result == MODEL.reference_decode(
+                PARAMS, rr.prompt, rr.max_new_tokens), rr.token
+        assert sum(rr.failovers for rr in reqs) > 0
+        for h in pool.replicas():
+            try:
+                h.close()
+            except Exception:  # noqa: BLE001 — killed handles
+                pass
+        srv.close()
+    finally:
+        resilience.reset_faults()
+
+
+# ---------------------------------------------------------------------------
+# lint + telemetry surface
+# ---------------------------------------------------------------------------
+def test_speculative_module_lint_enforced():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_host_syncs", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "check_host_syncs.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    assert "mxnet_tpu/serving/speculative.py" in m.SCAN
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bad = [b for b in m.check(root)
+           if b[0].startswith(("mxnet_tpu/serving/",
+                               "mxnet_tpu/embedding/"))]
+    assert not bad, bad
+
+
+def test_mxt_top_spec_and_quant_lines():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "mxt_top", os.path.join(os.path.dirname(__file__), "..",
+                                "tools", "mxt_top.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    samples = {
+        ("mxt_serving_tokens_total", frozenset()): 120,
+        ("mxt_serving_spec_proposed_tokens_total", frozenset()): 90,
+        ("mxt_serving_spec_accepted_tokens_total", frozenset()): 60,
+        ("mxt_serving_kv_quant_pages_in_use", frozenset()): 7,
+    }
+    frame = mod.render(samples, None, 0)
+    assert "spec accept" in frame and "0.667" in frame
+    assert "int8 kv pages" in frame
+    # a non-speculative f32 replica renders neither line
+    plain = mod.render({("mxt_serving_tokens_total", frozenset()): 5},
+                       None, 0)
+    assert "spec accept" not in plain and "int8 kv pages" not in plain

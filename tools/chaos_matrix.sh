@@ -103,5 +103,27 @@ for seed in "${SEEDS[@]}"; do
     fi
 done
 
+# -- speculative-decode fleet sweep -------------------------------------------
+# spec_replica_kill: the chaos-marked cells in tests/test_speculative.py
+# kill one replica of a SPECULATIVE-engine fleet mid-run — the router
+# fails the in-flight requests over and every completed stream is
+# token-exact vs the unkilled single-replica oracle (failover replays
+# speculative requests without re-decode divergence); typed, no hang.
+for seed in "${SEEDS[@]}"; do
+    echo "== speculative sweep: MXT_CHAOS_SEED=$seed (cell timeout ${CELL_TIMEOUT}s)"
+    timeout -k 10 "$CELL_TIMEOUT" env JAX_PLATFORMS=cpu \
+        MXT_CHAOS_SEED="$seed" \
+        python -m pytest tests/test_speculative.py -q -m "chaos and not slow" \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    rc=$?
+    if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+        echo "!! HANG: speculative sweep seed=$seed exceeded ${CELL_TIMEOUT}s" >&2
+        fail=1
+    elif [ "$rc" -ne 0 ]; then
+        echo "!! FAIL: speculative sweep seed=$seed rc=$rc" >&2
+        fail=1
+    fi
+done
+
 [ "$fail" -eq 0 ] && echo "chaos matrix: all seeds clean"
 exit "$fail"

@@ -107,6 +107,12 @@ SCAN = {
     "mxnet_tpu/serving/kv_cache.py": _ALL,
     "mxnet_tpu/serving/model.py": _ALL,
     "mxnet_tpu/serving/metrics.py": _ALL,
+    # the speculative round is TWO traced programs per k committed
+    # tokens; the accepted-prefix commit is device-side by design, so
+    # any unmarked read here would mean the host started peeking at
+    # accept counts per round — exactly the sync class the staged
+    # (B, k+1) row protocol exists to avoid
+    "mxnet_tpu/serving/speculative.py": _ALL,
     # the fleet router sits ABOVE the decode hot path but runs between
     # every decode tick of every replica: routing decisions must be
     # host arithmetic on gauges and wall clocks, never a device read —

@@ -349,6 +349,14 @@ def render(samples, prev, dt):
     pages_total = metric_sum(samples, "mxt_serving_kv_pages_total")
     evicted = metric_sum(samples, "mxt_serving_requests_total",
                          outcome="evicted")
+    # speculative decode + quantized-page gauges (PR 12): rendered only
+    # when the engine actually speculates / serves int8 pages
+    spec_prop = metric_sum(samples,
+                           "mxt_serving_spec_proposed_tokens_total")
+    spec_acc = metric_sum(samples,
+                          "mxt_serving_spec_accepted_tokens_total")
+    quant_pages = metric_sum(samples,
+                             "mxt_serving_kv_quant_pages_in_use")
 
     lines = [
         "mxt_top  %s" % time.strftime("%H:%M:%S"),
@@ -433,6 +441,14 @@ def render(samples, prev, dt):
             "  kv pages         %s / %s in use"
             % (_fmt(pages_used, "%.0f"), _fmt(pages_total, "%.0f")),
         ]
+        if spec_prop:
+            lines.append(
+                "  spec accept      %s   (%s / %s draft tokens)"
+                % (_fmt((spec_acc or 0) / spec_prop, "%.3f"),
+                   _fmt(spec_acc, "%.0f"), _fmt(spec_prop, "%.0f")))
+        if quant_pages is not None:
+            lines.append("  int8 kv pages    %s in use"
+                         % _fmt(quant_pages, "%.0f"))
     return "\n".join(lines)
 
 
