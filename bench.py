@@ -46,7 +46,7 @@ _CONFIG_COST = {"resnet50": 420, "bert": 300, "lstm_ptb": 200,
                 "async_ab": 90, "telemetry_ab": 60, "diag_ab": 60,
                 "cold_warm": 120, "serving": 150, "zero_stage": 90,
                 "embedding_ab": 90, "serving_fleet": 120,
-                "speculative": 120, "kv_quant": 90}
+                "speculative": 120, "kv_quant": 90, "fleet_obs": 90}
 
 
 def _remaining():
@@ -1219,6 +1219,105 @@ def bench_serving_fleet(platform, dtype):
     return scaling, row
 
 
+def bench_fleet_observability(platform, dtype):
+    """fleet_observability_ab (telemetry_fleet.py): the SAME
+    mixed-length traffic routed through a 2-replica membership-backed
+    fleet with the fleet collector scraping on a background thread vs
+    observability idle. The collector reads registries and wall clocks
+    — never the device — so the row asserts-by-record that serving-path
+    host-sync counts per decode step are IDENTICAL and records the
+    tokens/s overhead ratio (target >= 0.97x)."""
+    import numpy as np
+
+    from mxnet_tpu import profiler, serving, telemetry_fleet
+
+    del dtype  # f32: the A/B isolates observability overhead
+    slots = int(os.environ.get("BENCH_FLEET_SLOTS", "4"))
+    n_req = int(os.environ.get("BENCH_FLEET_REQUESTS", "16"))
+    layers, heads, hdim = 2, 2, 16
+    model = serving.TinyDecoder(vocab=512, num_layers=layers,
+                                num_heads=heads, head_dim=hdim,
+                                max_len=512)
+    params = model.init_params(0)
+
+    def factory():
+        return serving.DecodeEngine(
+            model, params=params, slots=slots,
+            cache=serving.PagedKVCache(layers, heads, hdim,
+                                       num_pages=256, page_size=16),
+            prefill_buckets=(64,), max_context=128)
+
+    def run(collect):
+        pool, srv = serving.local_serving_fleet(2, factory)
+        router = serving.FleetRouter(pool)
+        coll = None
+        if collect:
+            coll = telemetry_fleet.FleetCollector(server=srv)
+            coll.refresh()
+            coll.start(interval=0.05)
+        try:
+            rng = np.random.RandomState(11)
+            reqs = []
+            for i in range(n_req):
+                plen = int(rng.randint(4, 49))
+                mnew = int(rng.randint(4, 17))
+                reqs.append(router.submit(
+                    rng.randint(1, 512, plen).tolist(),
+                    max_new_tokens=mnew, token="fo-%d" % i))
+            h0 = profiler.host_sync_count()
+            t0 = time.perf_counter()
+            router.run(max_steps=20000)
+            dt = time.perf_counter() - t0
+            syncs = profiler.host_sync_count() - h0
+            steps = sum(h.batcher.steps for h in pool.replicas())
+            done = [r for r in reqs if r.state == "completed"]
+            tokens = sum(len(r.result) for r in done)
+            scrapes = 0
+            if coll is not None:
+                coll.scrape()  # at least one full pass is guaranteed
+                scrapes = coll.scrapes
+            return {
+                "tokens_per_sec": tokens / dt if dt else 0.0,
+                "completed": len(done),
+                "syncs_per_step": syncs / max(1, steps),
+                "scrapes": scrapes,
+            }
+        finally:
+            if coll is not None:
+                coll.close()
+            for h in pool.replicas():
+                try:
+                    h.close()
+                except Exception:  # noqa: BLE001 — teardown best effort
+                    pass
+            srv.close()
+
+    run(False)  # discarded warmup leg: both timed legs run shape-warm
+    base = run(False)
+    obs = run(True)
+    ratio = obs["tokens_per_sec"] / base["tokens_per_sec"] \
+        if base["tokens_per_sec"] else 0.0
+    row = {
+        "config": "fleet_observability_ab", "chips": 1,
+        "batch_size": slots, "dtype": "float32", "platform": platform,
+        "requests": n_req,
+        "images_or_tokens_per_sec_per_chip": round(
+            obs["tokens_per_sec"], 2),
+        "idle_tokens_per_sec": round(base["tokens_per_sec"], 2),
+        "collector_tokens_per_sec": round(obs["tokens_per_sec"], 2),
+        "observability_overhead_x": round(ratio, 3),
+        "syncs_per_step_idle": round(base["syncs_per_step"], 4),
+        "syncs_per_step_collector": round(obs["syncs_per_step"], 4),
+        "sync_parity": base["syncs_per_step"] == obs["syncs_per_step"],
+        "collector_scrapes": obs["scrapes"],
+        "completed_idle": base["completed"],
+        "completed_collector": obs["completed"],
+        "mfu": None, "flops_per_sample": None,
+    }
+    _emit_jsonl(row)
+    return ratio, row
+
+
 def bench_speculative(platform, dtype):
     """speculative_ab (serving/speculative.py): the SAME mixed-length
     traffic decoded by the plain engine and by the speculative engine
@@ -1707,7 +1806,7 @@ def main():
         "BENCH_CONFIGS",
         "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline,async_ab,"
         "telemetry_ab,diag_ab,cold_warm,serving,zero_stage,embedding_ab,"
-        "serving_fleet,speculative,kv_quant"
+        "serving_fleet,speculative,kv_quant,fleet_obs"
     ).split(",")
 
     # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
@@ -1749,6 +1848,9 @@ def main():
         "kv_quant": ("kv_quant_resident_ratio",
                      "x (int8/f32 resident sequences at equal bytes)",
                      bench_kv_quant),
+        "fleet_obs": ("fleet_observability_overhead",
+                      "x (collector-on/off fleet tokens/s)",
+                      bench_fleet_observability),
     }
     headline = None
     errors = []
@@ -1757,7 +1859,8 @@ def main():
     for name in ("resnet50", "bert", "lstm_ptb", "wide_deep", "lenet",
                  "pipeline", "async_ab", "telemetry_ab", "diag_ab",
                  "cold_warm", "serving", "zero_stage", "embedding_ab",
-                 "serving_fleet", "speculative", "kv_quant"):
+                 "serving_fleet", "speculative", "kv_quant",
+                 "fleet_obs"):
         if name not in configs:
             continue
         cost = float(os.environ.get("BENCH_COST_%s" % name.upper(),

@@ -112,6 +112,14 @@ def main():
                         "mid-run (no deregister, heartbeats stop) and "
                         "show every request still completing via "
                         "failover")
+    p.add_argument("--fleet-top", action="store_true",
+                   help="with --replicas N: run the fleet telemetry "
+                        "collector (telemetry_fleet.py) alongside the "
+                        "router — membership-discovered members scraped "
+                        "over the async transport, merged into one "
+                        "member-labeled fleet page — and render one "
+                        "fleet mxt_top frame plus a request trace tree "
+                        "at the end")
     p.add_argument("--draft-k", type=int, default=0, metavar="K",
                    help="speculative decoding: a 1-layer truncated "
                         "draft proposes K tokens per slot, verified in "
@@ -180,10 +188,18 @@ def main():
               % (n, time.perf_counter() - t0))
         return eng
 
-    if args.replicas > 1 or args.kill_one:
+    if args.replicas > 1 or args.kill_one or args.fleet_top:
         n = max(2 if args.kill_one else 1, args.replicas)
         pool, coord = serving.local_serving_fleet(n, engine)
         router = serving.FleetRouter(pool, slo=args.deadline)
+        collector = None
+        if args.fleet_top:
+            from mxnet_tpu import telemetry_fleet
+
+            collector = telemetry_fleet.FleetCollector(server=coord)
+            telemetry_fleet.set_default_collector(collector)
+            collector.refresh()
+            collector.start(interval=0.2)
         rng = __import__("numpy").random.RandomState(7)
         t0 = time.perf_counter()
         reqs = []
@@ -219,6 +235,39 @@ def main():
                  {h.index: sum(1 for r in done
                                if r.committed_by == h.index)
                   for h in pool.replicas()}))
+        if collector is not None:
+            from mxnet_tpu import telemetry_fleet
+
+            collector.stop()
+            collector.scrape()
+            sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                            "..", "tools"))
+            try:
+                import mxt_top
+            finally:
+                sys.path.pop(0)
+            samples = mxt_top.parse_prometheus(
+                collector.render_prometheus())
+            print("\n-- fleet mxt_top (one frame over the merged "
+                  "member-labeled page; live: mxt_top --fleet "
+                  "--url http://127.0.0.1:$MXT_TELEMETRY_PORT) --")
+            print(mxt_top.render(samples, None, 0))
+            shown = next((r for r in reqs if r.failovers or r.hedges),
+                         reqs[0] if reqs else None)
+            if shown is not None:
+                tree = collector.trace_tree(shown.trace_id)
+                print("\n-- trace %s (token %s: %s) --"
+                      % (shown.trace_id, shown.token,
+                         "failover" if shown.failovers else
+                         ("hedged" if shown.hedges else "plain")))
+                for track in sorted(tree["tracks"]):
+                    print("  %-12s %s" % (track, " -> ".join(
+                        s["name"] for s in tree["tracks"][track])))
+                print("(Chrome trace-event JSON: GET /debug/timeline"
+                      "?trace_id=%s on the telemetry endpoint, or "
+                      "load it in Perfetto)" % shown.trace_id)
+            collector.close()
+            telemetry_fleet.set_default_collector(None)
         for h in pool.replicas():
             try:
                 h.close()

@@ -460,6 +460,16 @@ class AsyncParamServer:
                 return ("err", "this server hosts no serving replica "
                                "(attach_serving / serving.serve_replica)")
             return self.serving.handle(op, key, payload)
+        # -- fleet telemetry scrape (telemetry_fleet.py collector) --------
+        elif op == "tel_snapshot":
+            # this process's whole metrics registry as a serializable
+            # snapshot — read-only, unfenced (scraping must work even
+            # while membership churns), pure host data
+            return ("ok", telemetry.registry_export())
+        elif op == "tel_spans":
+            # the bounded request-trace span log (optionally filtered
+            # to one trace_id carried in the payload)
+            return ("ok", telemetry.trace_spans(payload))
         # -- membership ops (ref: ps-lite Van ADD_NODE/HEARTBEAT) --------
         elif op == "register":
             meta = None

@@ -262,6 +262,19 @@ _declare("MXT_FLEET_HEDGE_BUDGET", int, None,
          "double the fleet's work. 0 disables hedging; unset derives "
          "max(1, fleet slot capacity // 4).")
 
+_declare("MXT_FLEET_SCRAPE_TIMEOUT", float, 5.0,
+         "Per-member transport deadline in seconds for the fleet "
+         "telemetry collector's tel_snapshot/tel_spans scrapes "
+         "(telemetry_fleet.py): a dead or hung member costs at most "
+         "this long and is then marked stale with its last-seen age — "
+         "the collector never hangs on a member.")
+
+_declare("MXT_FLEET_SCRAPE_INTERVAL", float, 2.0,
+         "Background scrape period in seconds for "
+         "telemetry_fleet.FleetCollector.start() — how often the "
+         "collector refreshes membership and re-scrapes every member's "
+         "registry and trace spans.")
+
 _declare("MXT_WATCHDOG_TIMEOUT", float, None,
          "Hang-watchdog stall threshold in seconds (diagnostics.py): a "
          "progress source (engine window retires, KVStore RPC "

@@ -916,6 +916,14 @@ def handle_debug(path, query=""):
             return (503, "text/plain; charset=utf-8",
                     ("trace capture failed: %s" % e).encode("utf-8"))
         return 200, "application/zip", data
+    if path == "/debug/timeline":
+        # distributed request traces as Chrome trace-event JSON
+        # (Perfetto-loadable): one trace via ?trace_id=, or the whole
+        # fleet's span log. Served by the fleet collector when one is
+        # registered; a bare replica serves its own spans.
+        from . import telemetry_fleet
+
+        return telemetry_fleet.handle_timeline(params)
     return 404, "text/plain; charset=utf-8", b"unknown debug route"
 
 

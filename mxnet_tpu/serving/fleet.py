@@ -144,7 +144,8 @@ class LocalReplica:
         self._poll_cursor = 0
         self.engine = self._factory()
         self.capacity = int(self.engine.slots)
-        self.batcher = ContinuousBatcher(self.engine, now_fn=self._now)
+        self.batcher = ContinuousBatcher(self.engine, now_fn=self._now,
+                                         track="replica-%d" % self.index)
         if warm:
             from .. import tuning
 
@@ -248,16 +249,18 @@ class LocalReplica:
                 "slots": self.capacity}
 
     def submit_copy(self, copy_id, prompt, max_new_tokens, deadline=None,
-                    eos_id=None):
+                    eos_id=None, trace_id=None):
         """Dispatch one request copy into this replica's batcher.
         Returns the copy's admission state (``queued`` or — for a
-        request that can never fit this engine — ``rejected``)."""
+        request that can never fit this engine — ``rejected``).
+        ``trace_id`` threads the router's distributed trace through
+        this replica's queue/prefill/decode spans."""
         if not self.alive:
             raise ConnectionError(
                 "serving replica %d is %s" % (self.index, self.state))
         req = Request(prompt, max_new_tokens=max_new_tokens,
                       deadline=deadline, eos_id=eos_id,
-                      request_id=copy_id)
+                      request_id=copy_id, trace_id=trace_id)
         self.batcher.submit(req)
         if req.state == "rejected":
             return "rejected"
@@ -381,11 +384,15 @@ class RemoteReplica:
         return ld
 
     def submit_copy(self, copy_id, prompt, max_new_tokens, deadline=None,
-                    eos_id=None):
+                    eos_id=None, trace_id=None):
+        # the trace_id rides the srv_submit frame, so the remote
+        # replica's queue/prefill/decode spans land in ITS span log
+        # under the router's trace — the collector's tel_spans scrape
+        # reunites them
         return self._cl.request(
             "srv_submit", None,
             (copy_id, [int(t) for t in prompt], int(max_new_tokens),
-             deadline, eos_id))
+             deadline, eos_id, trace_id))
 
     def cancel_copy(self, copy_id):
         self._cl.request("srv_cancel", None, copy_id)
@@ -556,10 +563,21 @@ class ReplicaPool:
         return self
 
     def publish(self):
-        """Export ``mxt_fleet_replicas{state}`` (mxt_top's fleet line)."""
+        """Export ``mxt_fleet_replicas{state}`` (mxt_top's fleet line)
+        plus per-replica occupancy gauges (the router's load signal,
+        published so the fleet collector's per-replica view needs no
+        extra RPCs — in-process handles read their batcher directly; a
+        dead/drained replica publishes 0 so its occupancy can never
+        linger at a stale value in an aggregate)."""
         counts = {s: 0 for s in _STATES}
+        occ = _m.fleet_replica_occupancy()
         for h in self._handles.values():
             counts[h.state] = counts.get(h.state, 0) + 1
+            b = getattr(h, "batcher", None)
+            if h.state in (DEAD, DRAINED) or b is None:
+                occ.labels(str(h.index)).set(0)
+            else:
+                occ.labels(str(h.index)).set(len(b._slot_req))
         g = _m.fleet_replicas()
         for s, n in counts.items():
             g.labels(s).set(n)
@@ -618,10 +636,13 @@ class ServingHost:
             if op == "srv_submit":
                 if not self.admitting:
                     return ("err", "replica is draining (not admitting)")
-                cid, prompt, max_new, deadline, eos = payload
+                # pre-tracing routers send 5-tuples; the trace_id is
+                # the optional 6th element
+                cid, prompt, max_new, deadline, eos = payload[:5]
+                trace_id = payload[5] if len(payload) > 5 else None
                 req = Request(prompt, max_new_tokens=max_new,
                               deadline=deadline, eos_id=eos,
-                              request_id=cid)
+                              request_id=cid, trace_id=trace_id)
                 self.batcher.submit(req)
                 if req.state == "rejected":
                     return ("ok", "rejected")
@@ -685,7 +706,8 @@ def serve_replica(engine, coordinator, index=0, host="127.0.0.1",
 
     srv = AsyncParamServer(host, port)
     bound = srv._sock.getsockname()
-    batcher = ContinuousBatcher(engine, now_fn=now_fn)
+    batcher = ContinuousBatcher(engine, now_fn=now_fn,
+                                track="replica-%d" % index)
     hostobj = ServingHost(batcher)
     srv.attach_serving(hostobj)
     tuning.warmup(steps=(engine,), kernels=False, include_live=False,

@@ -44,6 +44,7 @@ import collections
 import itertools
 import time
 
+from .. import telemetry
 from ..resilience import KVStoreError
 from . import metrics as _m
 from .fleet import DEAD, DRAINING, StaleReplicaError
@@ -51,6 +52,8 @@ from .fleet import DEAD, DRAINING, StaleReplicaError
 __all__ = ["RoutedRequest", "FleetRouter"]
 
 _tok_ids = itertools.count()
+
+_TRACK = "router"  # the router's row in the distributed trace timeline
 
 
 class RoutedRequest:
@@ -63,7 +66,7 @@ class RoutedRequest:
                  "eos_id", "state", "result", "committed_by", "commits",
                  "copies", "dispatches", "hedges", "failovers",
                  "hedge_delay", "t_submit", "t_dispatch", "t_finish",
-                 "_ncopy")
+                 "trace_id", "_ncopy")
 
     def __init__(self, prompt, max_new_tokens=16, deadline=None,
                  eos_id=None, token=None):
@@ -83,6 +86,7 @@ class RoutedRequest:
         self.failovers = 0
         self.hedge_delay = None
         self.t_submit = self.t_dispatch = self.t_finish = None
+        self.trace_id = None   # minted by the router at submit
         self._ncopy = 0
 
     @property
@@ -134,6 +138,11 @@ class FleetRouter:
         rr = RoutedRequest(prompt, max_new_tokens=max_new_tokens,
                            deadline=deadline, eos_id=eos_id, token=token)
         rr.t_submit = self._now()
+        # the distributed trace starts HERE: one trace_id per routed
+        # request, propagated through every dispatch, hedge duplicate,
+        # failover re-enqueue, and the replicas' srv_* frames — the
+        # fleet collector reassembles the span tree from it alone
+        rr.trace_id = telemetry.new_trace_id()
         rr.hedge_delay = self._hedge_delay_for(rr)
         self._inflight[rr.token] = rr
         self._queue.append(rr)
@@ -144,6 +153,13 @@ class FleetRouter:
             return float(self.hedge_delay)  # sync-ok: host config scalar
         budget = rr.deadline if rr.deadline is not None else self.slo
         return None if budget is None else 0.5 * budget
+
+    def _span(self, rr, name, t0, t1, **attrs):
+        """One router-track span/instant for ``rr``'s trace (host wall
+        clocks the router already keeps — never a device read)."""
+        telemetry.record_trace_span(
+            name, rr.trace_id, t0, t1, clock_now=self._now(),
+            track=_TRACK, token=rr.token, **attrs)
 
     # -- the per-tick loop -------------------------------------------------
     def step(self):
@@ -230,7 +246,8 @@ class FleetRouter:
             try:
                 state = h.submit_copy(cid, rr.prompt, rr.max_new_tokens,
                                       deadline=rr.deadline,
-                                      eos_id=rr.eos_id)
+                                      eos_id=rr.eos_id,
+                                      trace_id=rr.trace_id)
             except (ConnectionError, OSError):
                 tried.add(h.index)
                 self.pool.mark_dead(h.index)
@@ -249,9 +266,11 @@ class FleetRouter:
         self._by_copy[cid] = rr
         rr.dispatches += 1
         rr.state = "dispatched"
+        now = self._now()
         if rr.t_dispatch is None:
-            rr.t_dispatch = self._now()
+            rr.t_dispatch = now
         _m.fleet_dispatch_total().labels(str(h.index)).inc()
+        self._span(rr, "dispatch", now, now, replica=h.index, copy=cid)
         return h
 
     # -- failover ----------------------------------------------------------
@@ -294,6 +313,9 @@ class FleetRouter:
                     and rr not in self._queue:
                 rr.state = "queued"
                 self._queue.appendleft(rr)
+                now = self._now()
+                self._span(rr, "failover_reenqueue", now, now,
+                           failovers=rr.failovers)
 
     # -- hedging -----------------------------------------------------------
     def _hedge_budget(self):
@@ -322,6 +344,7 @@ class FleetRouter:
                 rr.hedges += 1
                 outstanding += 1
                 _m.fleet_hedges_total().labels(str(h.index)).inc()
+                self._span(rr, "hedge", now, now, replica=h.index)
 
     # -- completion / fencing ----------------------------------------------
     def _poll_completions(self):
@@ -350,6 +373,11 @@ class FleetRouter:
         committed; the failover copy is the only writer. Cancelled
         losers and detached copies settle silently."""
         if handle.fenced or handle.state == DEAD:
+            rr = self._by_copy.get(copy_id)
+            if rr is not None:
+                now = self._now()
+                self._span(rr, "stale_refused", now, now,
+                           replica=handle.index, copy=copy_id)
             raise StaleReplicaError(
                 "late reply %r from fenced serving replica %d (state "
                 "%r): the request has failed over — a zombie's tokens "
@@ -375,6 +403,9 @@ class FleetRouter:
         rr.result = [int(t) for t in tokens]
         rr.commits += 1
         rr.committed_by = handle.index
+        now = self._now()
+        self._span(rr, "commit", now, now, replica=handle.index,
+                   commits=rr.commits)
         # cancel losers through the replica scheduler's eviction path
         for rid, cid in list(rr.copies.items()):
             self._by_copy.pop(cid, None)
@@ -382,6 +413,11 @@ class FleetRouter:
                 self.pool.get(rid).cancel_copy(cid)
             except (ConnectionError, OSError):
                 self.pool.mark_dead(rid)
+            else:
+                # the hedge loser's cancel, visible on its own right in
+                # the trace (the loser replica's evicted span pairs it)
+                self._span(rr, "cancel", now, now, replica=rid,
+                           copy=cid)
         rr.copies.clear()
         self._results[rr.token] = rr
         self._finish(rr, "completed")
@@ -391,6 +427,10 @@ class FleetRouter:
         rr.t_finish = self._now()
         self._inflight.pop(rr.token, None)
         self.finished.append(rr)
+        if rr.t_submit is not None:
+            self._span(rr, "request", rr.t_submit, rr.t_finish,
+                       outcome=outcome, hedges=rr.hedges,
+                       failovers=rr.failovers, commits=rr.commits)
         _m.fleet_requests_total().labels(outcome).inc()
         if outcome == "completed" and rr.t_submit is not None:
             _m.fleet_request_latency().observe(

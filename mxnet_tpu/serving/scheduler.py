@@ -47,12 +47,30 @@ __all__ = ["Request", "ContinuousBatcher", "StaticBatcher"]
 _req_ids = itertools.count()
 
 
+def _trace_span(req, name, t0, t1, now, **attrs):
+    """Stamp one request-lifecycle span against the request's trace_id
+    (a no-op for untraced requests). Host wall clocks only — the spans
+    that depend on device results (prefill's first token, decode
+    completion) are stamped from inside the engine window's EXISTING
+    deferred retirement, so tracing adds zero device syncs."""
+    if req.trace_id is None or t0 is None or t1 is None:
+        return
+    from .. import telemetry
+
+    telemetry.record_trace_span(
+        name, req.trace_id, t0, t1, clock_now=now,
+        track=getattr(req, "_track", None), request=req.id, **attrs)
+
+
 class Request:
     """One generation request: a prompt, a token budget, an optional
-    deadline, and the output/latency record the scheduler fills in."""
+    deadline, and the output/latency record the scheduler fills in.
+    ``trace_id`` (minted by the fleet router, or caller-supplied)
+    threads the request through the distributed-tracing layer: the
+    scheduler stamps queue/prefill/decode spans against it."""
 
     def __init__(self, prompt, max_new_tokens=16, deadline=None,
-                 eos_id=None, request_id=None):
+                 eos_id=None, request_id=None, trace_id=None):
         self.prompt = [int(t) for t in prompt]
         if not self.prompt:
             raise MXNetError("Request needs a non-empty prompt")
@@ -64,6 +82,8 @@ class Request:
         self.eos_id = None if eos_id is None else int(eos_id)
         self.id = request_id if request_id is not None \
             else "req-%d" % next(_req_ids)
+        self.trace_id = None if trace_id is None else str(trace_id)
+        self._track = None  # timeline row, stamped by the batcher
         self.output_tokens = []
         self.state = "created"  # queued|running|completed|evicted|rejected
         self.t_submit = self.t_admit = self.t_first = self.t_finish = None
@@ -95,6 +115,9 @@ class Request:
             if self.t_admit is not None:
                 _m.request_latency().labels("prefill").observe(
                     max(0.0, now - self.t_admit))
+            # the prefill span closes here, inside the deferred read
+            # that just materialized the first token — zero new syncs
+            _trace_span(self, "prefill", self.t_admit, now, now)
         self._record(tok, now)
 
     def _record(self, tok, now):
@@ -107,18 +130,27 @@ class Request:
         self.output_tokens.append(int(tok))
         if self.t_first is None:
             self.t_first = now
+            _trace_span(self, "prefill", self.t_admit, now, now)
         if self.eos_id is not None and int(tok) == self.eos_id:
             self._eos = True
         if self._eos or len(self.output_tokens) >= self.max_new_tokens:
             self.state = "completed"
             self.t_finish = now
+            # the decode-window span: first token -> last observed
+            # token, closed inside the in-flight window's retirement
+            _trace_span(self, "decode", self.t_first, now, now,
+                        tokens=len(self.output_tokens))
 
 
 class ContinuousBatcher:
     """Admission queue + per-step batch recomposition over one engine."""
 
-    def __init__(self, engine, now_fn=time.monotonic):
+    def __init__(self, engine, now_fn=time.monotonic, track=None):
         self.engine = engine
+        # the timeline row traced requests' spans land on (a fleet
+        # replica names this "replica-<i>"; standalone batchers group
+        # under "batcher")
+        self.track = str(track) if track is not None else "batcher"
         engine.on_tokens = self._on_tokens
         self._queue = collections.deque()
         self._slot_req = {}  # slot -> Request currently OWNING the slot
@@ -142,6 +174,7 @@ class ContinuousBatcher:
         prompt+budget over the engine's context or the whole pool — are
         rejected immediately rather than deadlocking the queue."""
         request.t_submit = self._now()
+        request._track = self.track
         total = len(request.prompt) + request.max_new_tokens
         # a speculative engine reserves extra overshoot pages per
         # sequence — impossibility is judged against the padded need
@@ -343,6 +376,7 @@ class ContinuousBatcher:
             req.t_admit = now
             _m.request_latency().labels("queue").observe(
                 max(0.0, now - req.t_submit))
+            _trace_span(req, "queue", req.t_submit, now, now)
             req._first_pv = self.engine.admit(
                 slot, req.id, req.prompt, req.max_new_tokens)
             req.state = "running"
@@ -379,6 +413,10 @@ class ContinuousBatcher:
         req._finalized = True
         _m.requests_total().labels(outcome).inc()
         if outcome in ("evicted", "rejected"):
+            now = self._now()
+            _trace_span(req, outcome, req.t_submit,
+                        req.t_finish if req.t_finish is not None
+                        else now, now)
             # SLO misses ride the flight recorder: a post-mortem shows
             # WHICH requests were shed in the run-up to an incident
             self._diag.record_event(

@@ -64,12 +64,14 @@ from .base import MXNetError
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
     "counter", "gauge", "histogram", "render_prometheus",
+    "registry_export",
     "emit_event", "flush", "jsonl_path",
     "add_event_tap", "remove_event_tap",
     "record_phase", "record_dispatch", "record_step_retired",
     "record_compile", "record_compile_cache", "record_tune_lookup",
     "trace_scope", "current_trace_id", "new_trace_id", "new_span_id",
     "record_rpc", "rpc_spans", "clear_rpc_spans",
+    "record_trace_span", "trace_spans", "clear_trace_spans",
     "start_http_server", "http_port", "histogram_quantile",
     "sanitize_metric_name",
 ]
@@ -415,6 +417,32 @@ class MetricsRegistry:
                     out[key] = ch.value
         return out
 
+    def export(self):
+        """Serializable full-registry snapshot — the ``tel_snapshot``
+        wire payload the fleet collector (telemetry_fleet.py) scrapes:
+        one dict per family (name/kind/help/labelnames, histogram
+        buckets) with every child's current value or bucket snapshot.
+        Pure host data; picklable and JSON-able."""
+        fams = []
+        for fam, children in self.collect():
+            d = {"name": fam.name, "kind": fam.kind, "help": fam.help,
+                 "labelnames": list(fam.labelnames)}
+            if fam.kind == "histogram":
+                d["buckets"] = list(fam.buckets)
+            ch = []
+            for values, child in sorted(children.items()):
+                if fam.kind == "histogram":
+                    snap = child.snapshot()
+                    ch.append([list(values),
+                               {"counts": list(snap["counts"]),
+                                "sum": snap["sum"],
+                                "count": snap["count"]}])
+                else:
+                    ch.append([list(values), child.value])
+            d["children"] = ch
+            fams.append(d)
+        return {"ts": round(time.time(), 6), "families": fams}
+
     def render_prometheus(self):
         """Text exposition format (the /metrics payload)."""
         lines = []
@@ -480,6 +508,12 @@ def histogram(name, help="", labelnames=(), buckets=None):
 
 def render_prometheus():
     return _REGISTRY.render_prometheus()
+
+
+def registry_export():
+    """The process registry as a serializable snapshot (what the
+    ``tel_snapshot`` async-server op answers with)."""
+    return _REGISTRY.export()
 
 
 # --------------------------------------------------------------------------
@@ -902,6 +936,58 @@ def clear_rpc_spans():
 
 
 # --------------------------------------------------------------------------
+# request-lifecycle trace spans (the distributed tracing layer the
+# fleet collector reassembles — telemetry_fleet.py)
+# --------------------------------------------------------------------------
+# Bounded like the RPC span log: old traces age out, appends never
+# block. One row per closed span: the serving router/scheduler stamp
+# queue/prefill/decode/commit spans against the request's trace_id from
+# host wall clocks they already keep (spans CLOSE inside the existing
+# deferred PendingValue retirement, so the layer adds zero device
+# syncs — the mxt_step_latency_seconds discipline).
+_TRACE_SPAN_LOG = collections.deque(maxlen=8192)
+
+
+def record_trace_span(name, trace_id, t0, t1, clock_now=None,
+                      track=None, **attrs):
+    """Record one closed span of a distributed request trace.
+
+    ``t0``/``t1`` are in the CALLER's clock (``time.monotonic`` or a
+    test fake); ``clock_now`` is that clock's current reading, used to
+    shift the span onto the wall-clock epoch so spans from different
+    processes line up in one timeline. ``track`` names the timeline row
+    ("router", "replica-0", ...). Returns the stored row (or None when
+    ``trace_id`` is None — untraced requests cost nothing)."""
+    if trace_id is None:
+        return None
+    off = 0.0 if clock_now is None else time.time() - clock_now
+    row = {"kind": "trace_span", "name": str(name),
+           "trace_id": str(trace_id), "span_id": new_span_id(),
+           "track": None if track is None else str(track),
+           "t0": round(float(t0) + off, 6),  # sync-ok: host wall-clock scalar
+           "t1": round(float(t1) + off, 6)}  # sync-ok: host wall-clock scalar
+    if attrs:
+        row["attrs"] = {k: v for k, v in attrs.items() if v is not None}
+    _TRACE_SPAN_LOG.append(row)
+    if _events_active():
+        _dispatch_row(dict(row))
+    return row
+
+
+def trace_spans(trace_id=None):
+    """The bounded request-trace span log (oldest first), optionally
+    filtered to one trace — the ``tel_spans`` wire payload."""
+    rows = list(_TRACE_SPAN_LOG)
+    if trace_id is None:
+        return rows
+    return [r for r in rows if r["trace_id"] == trace_id]
+
+
+def clear_trace_spans():
+    _TRACE_SPAN_LOG.clear()
+
+
+# --------------------------------------------------------------------------
 # HTTP exposition endpoint
 # --------------------------------------------------------------------------
 _http_server = None
@@ -921,8 +1007,9 @@ def start_http_server(port=None):
             path, _, query = self.path.partition("?")
             if path.startswith("/debug/"):
                 # diagnostics debug routes (stacks / memory /
-                # flightrecorder / trace) ride the same endpoint so one
-                # scrape target serves both metrics and post-mortems
+                # flightrecorder / trace / timeline) ride the same
+                # endpoint so one scrape target serves both metrics and
+                # post-mortems
                 try:
                     from . import diagnostics
 
@@ -932,6 +1019,28 @@ def start_http_server(port=None):
                     # must never take the exposition endpoint down
                     status, ctype = 500, "text/plain; charset=utf-8"
                     body = ("debug route error: %s" % e).encode("utf-8")
+            elif path == "/fleet":
+                # the fleet collector's merged view (member-labeled
+                # samples from every scraped fleet member) — what
+                # `mxt_top --fleet` tails
+                try:
+                    from . import telemetry_fleet
+
+                    c = telemetry_fleet.default_collector()
+                    if c is None:
+                        status = 404
+                        ctype = "text/plain; charset=utf-8"
+                        body = (b"no fleet collector is running in this "
+                                b"process (telemetry_fleet.FleetCollector"
+                                b" + set_default_collector)")
+                    else:
+                        status = 200
+                        ctype = ("text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                        body = c.render_prometheus().encode("utf-8")
+                except Exception as e:  # noqa: BLE001 — see above
+                    status, ctype = 500, "text/plain; charset=utf-8"
+                    body = ("fleet route error: %s" % e).encode("utf-8")
             else:
                 status = 200
                 ctype = "text/plain; version=0.0.4; charset=utf-8"

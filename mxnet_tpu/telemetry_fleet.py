@@ -1,0 +1,720 @@
+"""Fleet-wide observability plane — membership-driven metric
+aggregation and end-to-end distributed request tracing.
+
+PRs 10-12 made the repo a genuine fleet: serving replicas, embedding
+servers, and training workers all register in one coordinator
+MembershipTable. Every observability surface so far (the PR 5 registry,
+the PR 9 diagnostics, ``mxt_top``) is strictly process-local — an
+operator has N Prometheus endpoints and no answer to "what happened to
+request X" once it crossed the router, a hedge, a failover, and a
+replica's decode engine. This module is the cross-process half:
+
+1. **Membership-driven collector.** :class:`FleetCollector` discovers
+   every live member from the coordinator's membership view — the
+   registration ``meta`` already carries each serving replica's and
+   embedding server's endpoint — and scrapes each one's metrics
+   registry and trace spans over the SAME authenticated async-server
+   transport the data plane uses (new ``tel_snapshot`` / ``tel_spans``
+   ops; requests ride ``resilience.kv_retry`` with a bounded deadline,
+   so a dead member is marked *stale* with its last-seen age — never a
+   hang). Scraped registries merge into one :class:`FleetRegistry`
+   using PR 5's mergeable histograms: identical-bucket histograms fold
+   across members for fleet-level quantiles, every sample is re-exposed
+   with a ``member`` label (stale members additionally carry
+   ``stale="true"`` plus ``mxt_fleet_scrape_age_seconds{member}`` so a
+   reaped member's gauges can never masquerade as live data).
+
+2. **Distributed request tracing.** The fleet router mints a
+   ``trace_id`` per request at ``submit`` and propagates it through
+   dispatch, hedge duplicates, failover re-enqueues, and the replicas'
+   ``srv_*`` frames; router and scheduler stamp
+   queue/prefill/decode/commit spans against it host-side (spans close
+   inside the existing deferred PendingValue retirement — zero new
+   device syncs, lint-enforced by tools/check_host_syncs.py, which
+   scans this module too). The collector reassembles the span trees
+   from every member's ``tel_spans`` and :func:`chrome_trace` exports
+   **Chrome trace-event JSON** loadable in Perfetto — a hedged request
+   renders as two replica tracks with the loser's cancel visible;
+   ``/debug/timeline?trace_id=`` (and whole-fleet ``/debug/timeline``)
+   serve it from the telemetry endpoint.
+
+Host/device split: the collector is PURE host bookkeeping — wire
+payloads, wall clocks, dict merges. It performs zero device reads and
+runs entirely off the serving hot path (its scrapes read registries
+the hot paths already maintain), so serving-path host-sync counts are
+bit-identical with the collector on or off — asserted in
+tests/test_telemetry_fleet.py and the ``fleet_observability_ab`` bench
+row.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from .base import MXNetError
+from . import telemetry
+from .telemetry import histogram_quantile, sanitize_metric_name
+
+__all__ = [
+    "FleetRegistry", "FleetCollector", "chrome_trace", "trace_tree",
+    "default_collector", "set_default_collector", "handle_timeline",
+]
+
+# the member/staleness labels the fleet view owns: a scraped family
+# already carrying one would produce ambiguous series after the merge
+_RESERVED_LABELS = ("member", "stale")
+
+
+# ---------------------------------------------------------------------------
+# the merged fleet registry
+# ---------------------------------------------------------------------------
+class FleetRegistry:
+    """One merged view over many processes' registry snapshots.
+
+    ``ingest`` folds a :func:`telemetry.registry_export` payload in
+    under a member name; families are schema-checked across members
+    (same name must mean same kind/labels/buckets everywhere — a
+    mismatch is a typed error, never a silent second metric, exactly
+    the process-local registry's contract lifted to the fleet) and the
+    reserved ``member``/``stale`` labels collide typed.
+    ``render_prometheus`` re-exposes every sample with the ``member``
+    label (+ ``stale="true"`` for members whose last scrape failed);
+    :meth:`merged_histogram` folds identical-bucket histograms across
+    members — the cross-process aggregation PR 5's mergeable histogram
+    children were built for."""
+
+    def __init__(self):
+        self._fams = {}  # name -> family record (see ingest)
+
+    def ingest(self, member, export, stale=False):
+        """Fold one member's registry snapshot in (replacing any
+        earlier snapshot from the same member)."""
+        member = str(member)
+        for fam in (export or {}).get("families", ()):
+            name = sanitize_metric_name(fam["name"])
+            kind = str(fam["kind"])
+            labelnames = tuple(fam.get("labelnames") or ())
+            for reserved in _RESERVED_LABELS:
+                if reserved in labelnames:
+                    raise MXNetError(
+                        "fleet registry label collision: member %r "
+                        "exports metric %r with label %r, which the "
+                        "fleet view reserves for scrape provenance"
+                        % (member, name, reserved))
+            buckets = tuple(fam.get("buckets") or ()) or None
+            cur = self._fams.get(name)
+            if cur is None:
+                cur = self._fams[name] = {
+                    "kind": kind, "help": str(fam.get("help", "")),
+                    "labelnames": labelnames, "buckets": buckets,
+                    "members": {}}
+            else:
+                if cur["kind"] != kind:
+                    raise MXNetError(
+                        "fleet registry schema mismatch: metric %r is a "
+                        "%s on member %r but was a %s on an earlier "
+                        "member" % (name, kind, member, cur["kind"]))
+                if cur["labelnames"] != labelnames:
+                    raise MXNetError(
+                        "fleet registry schema mismatch: metric %r has "
+                        "labels %s on member %r but %s elsewhere"
+                        % (name, labelnames, member, cur["labelnames"]))
+                if cur["buckets"] != buckets:
+                    raise MXNetError(
+                        "fleet registry schema mismatch: histogram %r "
+                        "buckets differ on member %r — identical bounds "
+                        "are the merge precondition" % (name, member))
+            cur["members"][member] = {
+                "stale": bool(stale),
+                "children": {tuple(str(v) for v in values): payload
+                             for values, payload in fam["children"]}}
+
+    def drop_member(self, member):
+        """Remove every series a member contributed (the drop half of
+        drop-or-label stale hygiene)."""
+        member = str(member)
+        for fam in self._fams.values():
+            fam["members"].pop(member, None)
+
+    def members(self):
+        out = set()
+        for fam in self._fams.values():
+            out.update(fam["members"])
+        return sorted(out)
+
+    def families(self):
+        return sorted(self._fams)
+
+    def get(self, name):
+        return self._fams.get(sanitize_metric_name(name))
+
+    # -- cross-member aggregation ------------------------------------------
+    def merged_histogram(self, name, labels=None, include_stale=False):
+        """One bucket-wise merged snapshot of histogram ``name`` across
+        every (live, unless ``include_stale``) member — and across its
+        labelsets unless ``labels`` pins one. Returns ``{"buckets",
+        "counts", "sum", "count"}``; merged quantiles over it equal the
+        quantiles of the union of every member's observations (same
+        bounds, summed counts — the PR 5 merge contract)."""
+        fam = self.get(name)
+        if fam is None:
+            raise MXNetError("fleet registry has no metric %r" % name)
+        if fam["kind"] != "histogram":
+            raise MXNetError("fleet metric %r is a %s, not a histogram"
+                             % (name, fam["kind"]))
+        want = None
+        if labels is not None:
+            want = tuple(str(labels[k]) for k in fam["labelnames"])
+        bounds = fam["buckets"] or ()
+        counts = [0] * (len(bounds) + 1)
+        total, csum = 0, 0.0
+        for rec in fam["members"].values():
+            if rec["stale"] and not include_stale:
+                continue
+            for values, snap in rec["children"].items():
+                if want is not None and values != want:
+                    continue
+                for i, c in enumerate(snap["counts"]):
+                    counts[i] += int(c)
+                total += int(snap["count"])
+                csum += float(snap["sum"])  # sync-ok: host wire scalar
+        return {"buckets": tuple(bounds), "counts": counts,
+                "sum": csum, "count": total}
+
+    def quantile(self, name, q, labels=None, include_stale=False):
+        snap = self.merged_histogram(name, labels=labels,
+                                     include_stale=include_stale)
+        return histogram_quantile(q, list(snap["buckets"]),
+                                  list(snap["counts"]))
+
+    def merged_value(self, name, labels=None, include_stale=False):
+        """Sum of a counter/gauge across members (a fleet total)."""
+        fam = self.get(name)
+        if fam is None:
+            return None
+        want = None
+        if labels is not None:
+            want = tuple(str(labels[k]) for k in fam["labelnames"])
+        total, seen = 0.0, False
+        for rec in fam["members"].values():
+            if rec["stale"] and not include_stale:
+                continue
+            for values, v in rec["children"].items():
+                if fam["kind"] == "histogram":
+                    continue
+                if want is not None and values != want:
+                    continue
+                total += float(v)  # sync-ok: host wire scalar
+                seen = True
+        return total if seen else None
+
+    # -- exposition ---------------------------------------------------------
+    def render_prometheus(self):
+        """The fleet page: every member's samples re-labeled with
+        ``member=`` (+ ``stale="true"`` where the last scrape failed).
+        Per-member values are bit-identical to the member's own page —
+        the merge adds provenance, it never rewrites data."""
+        from .telemetry import _fmt, _label_str
+
+        lines = []
+        for name in sorted(self._fams):
+            fam = self._fams[name]
+            if fam["help"]:
+                lines.append("# HELP %s %s"
+                             % (name, fam["help"].replace("\n", " ")))
+            lines.append("# TYPE %s %s" % (name, fam["kind"]))
+            for member in sorted(fam["members"]):
+                rec = fam["members"][member]
+                extra_names = ("member", "stale") if rec["stale"] \
+                    else ("member",)
+                extra_values = (member, "true") if rec["stale"] \
+                    else (member,)
+                for values in sorted(rec["children"]):
+                    payload = rec["children"][values]
+                    base = _label_str(fam["labelnames"] + extra_names,
+                                      values + extra_values)
+                    if fam["kind"] == "histogram":
+                        cum = 0
+                        for bound, c in zip(fam["buckets"] or (),
+                                            payload["counts"]):
+                            cum += c
+                            lines.append("%s_bucket%s %d" % (
+                                name,
+                                _label_str(
+                                    fam["labelnames"] + extra_names
+                                    + ("le",),
+                                    values + extra_values
+                                    + (_fmt(bound),)), cum))
+                        lines.append("%s_bucket%s %d" % (
+                            name,
+                            _label_str(
+                                fam["labelnames"] + extra_names
+                                + ("le",),
+                                values + extra_values + ("+Inf",)),
+                            payload["count"]))
+                        lines.append("%s_sum%s %s"
+                                     % (name, base, _fmt(payload["sum"])))
+                        lines.append("%s_count%s %d"
+                                     % (name, base, payload["count"]))
+                    else:
+                        lines.append("%s%s %s"
+                                     % (name, base, _fmt(payload)))
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# collector-owned metrics (live in the LOCAL process registry, so they
+# show on the collector's own endpoint AND — via the local member — on
+# the fleet page)
+# ---------------------------------------------------------------------------
+def _scrape_age_gauge():
+    return telemetry.gauge(
+        "mxt_fleet_scrape_age_seconds",
+        "Seconds since each fleet member's last successful telemetry "
+        "scrape (stale members keep aging — their samples carry "
+        "stale=\"true\" on the fleet page).", ("member",))
+
+
+def _scrapes_total():
+    return telemetry.counter(
+        "mxt_fleet_scrapes_total",
+        "Fleet telemetry scrapes by member and outcome (an 'error' "
+        "marks the member stale; its last snapshot stays labeled, "
+        "never silently live).", ("member", "outcome"))
+
+
+def _members_gauge():
+    return telemetry.gauge(
+        "mxt_fleet_members",
+        "Fleet members known to the collector by scrape state.",
+        ("state",))
+
+
+# the collector's own meta-metric families — appended verbatim to the
+# fleet page (they already carry the member label natively)
+_COLLECTOR_META = ("mxt_fleet_scrape_age_seconds",
+                   "mxt_fleet_scrapes_total", "mxt_fleet_members")
+
+
+def _render_collector_meta():
+    """The collector-owned families' exposition lines, filtered out of
+    the process's full render (they are scalars, so family = the first
+    token up to '{' or ' ')."""
+    out = []
+    for line in telemetry.render_prometheus().splitlines():
+        if line.startswith("#"):
+            parts = line.split()
+            fam = parts[2] if len(parts) > 2 else ""
+        else:
+            fam = line.partition("{")[0].partition(" ")[0]
+        if fam in _COLLECTOR_META:
+            out.append(line)
+    return "\n".join(out) + "\n" if out else ""
+
+
+# ---------------------------------------------------------------------------
+# the membership-driven collector
+# ---------------------------------------------------------------------------
+class _Target:
+    """One scrape target: a fleet member's telemetry endpoint plus the
+    newest snapshot/spans we hold for it."""
+
+    __slots__ = ("name", "endpoint", "client", "snapshot", "spans",
+                 "last_ok", "stale", "error", "local")
+
+    def __init__(self, name, endpoint=None, local=False):
+        self.name = str(name)
+        self.endpoint = endpoint   # (host, port) or None for local
+        self.local = bool(local)
+        self.client = None
+        self.snapshot = None
+        self.spans = []
+        self.last_ok = None
+        self.stale = False
+        self.error = None
+
+
+def member_name(meta, worker_id=None):
+    """Canonical member name from registration meta: serving replicas
+    are ``replica-<i>``, embedding servers ``emb-<i>``, anything else
+    ``member-<worker_id>``."""
+    if isinstance(meta, dict):
+        if meta.get("serving_replica"):
+            return "replica-%d" % int(meta.get("index", 0))
+        if meta.get("embedding_server"):
+            return "emb-%d" % int(meta.get("index", 0))
+    return "member-%s" % (worker_id,)
+
+
+def _meta_endpoint(meta):
+    """The scrapeable async-server endpoint a member announced in its
+    registration meta, or None (in-process members carry none — the
+    collector covers them through its local member)."""
+    if not isinstance(meta, dict):
+        return None
+    ep = meta.get("endpoint")
+    if ep:
+        return (ep[0], int(ep[1]))
+    if meta.get("host") and meta.get("port"):
+        return (meta["host"], int(meta["port"]))
+    return None
+
+
+class FleetCollector:
+    """Discover fleet members from the coordinator's membership table,
+    scrape each one's registry + trace spans over the async transport,
+    and serve the merged fleet view (see module docstring).
+
+    ``server`` is an in-process coordinator
+    :class:`~mxnet_tpu.async_server.AsyncParamServer` (the
+    ``local_serving_fleet`` shape); ``coordinator`` is a ``(host,
+    port)`` pair for a remote one — either supplies the membership
+    view. ``include_local=True`` (default) also ingests THIS process's
+    registry and spans as member ``local``, which is what covers
+    in-process replicas (they share the collector's registry)."""
+
+    def __init__(self, server=None, coordinator=None, include_local=True,
+                 local_name="local", timeout=None,
+                 now_fn=time.monotonic):
+        from . import config
+
+        self.server = server
+        self.coordinator = coordinator
+        self.include_local = bool(include_local)
+        self.local_name = str(local_name)
+        if timeout is None:
+            timeout = config.get("MXT_FLEET_SCRAPE_TIMEOUT")
+        self.timeout = float(timeout)  # sync-ok: host config scalar
+        self._now = now_fn
+        self._lock = threading.Lock()
+        self._targets = {}   # name -> _Target
+        self._coord_client = None
+        self._thread = None
+        self._stop = threading.Event()
+        self.scrapes = 0
+        if self.include_local:
+            self._targets[self.local_name] = _Target(
+                self.local_name, local=True)
+
+    # -- membership discovery ----------------------------------------------
+    def _membership_view(self):
+        if self.server is not None:
+            return self.server.membership.view()
+        if self.coordinator is None:
+            return None
+        from .async_server import AsyncClient
+
+        try:
+            if self._coord_client is None:
+                self._coord_client = AsyncClient(
+                    self.coordinator[0], int(self.coordinator[1]),
+                    timeout=self.timeout)
+            return self._coord_client.request(
+                "members", deadline=self.timeout)
+        except (MXNetError, ConnectionError, OSError):
+            if self._coord_client is not None:
+                self._coord_client.close()
+                self._coord_client = None
+            return None
+
+    def refresh(self):
+        """Reconcile targets with the membership view: members that
+        registered an endpoint become (or stay) remote scrape targets.
+        A member that vanished from the view KEEPS its target — the
+        next scrape fails typed and marks it stale with its last-seen
+        age, which is exactly the operator-visible verdict a reaped
+        member deserves (silent removal would let its gauges vanish
+        without a trace)."""
+        view = self._membership_view()
+        if view is None:
+            return self
+        meta = view.get("meta", {})
+        with self._lock:
+            for wid, m in meta.items():
+                ep = _meta_endpoint(m)
+                if ep is None:
+                    continue  # in-process member: the local target covers it
+                name = member_name(m, wid)
+                t = self._targets.get(name)
+                if t is None:
+                    self._targets[name] = _Target(name, endpoint=ep)
+                elif t.endpoint != ep:
+                    # the member re-registered elsewhere (restart):
+                    # drop the dead connection, adopt the new endpoint
+                    if t.client is not None:
+                        t.client.close()
+                        t.client = None
+                    t.endpoint = ep
+        return self
+
+    def add_member(self, name, host, port):
+        """Explicit remote target (tests, static fleets without a
+        coordinator)."""
+        with self._lock:
+            self._targets[str(name)] = _Target(name,
+                                               endpoint=(host, int(port)))
+        return self
+
+    def targets(self):
+        with self._lock:
+            return dict(self._targets)
+
+    # -- scraping ------------------------------------------------------------
+    def _scrape_one(self, t, now):
+        """Scrape one target; never raises, never hangs past the
+        bounded transport deadline — a failure marks the target stale
+        and keeps its last snapshot for the stale-labeled page."""
+        from .async_server import AsyncClient
+
+        if t.local:
+            t.snapshot = telemetry.registry_export()
+            t.spans = telemetry.trace_spans()
+            t.last_ok, t.stale, t.error = now, False, None
+            _scrapes_total().labels(t.name, "ok").inc()
+            return True
+        try:
+            if t.client is None:
+                t.client = AsyncClient(t.endpoint[0], t.endpoint[1],
+                                       timeout=self.timeout)
+            # rides AsyncClient.request's kv_retry machinery under an
+            # explicit deadline: a dead/hung member costs a bounded
+            # timeout, then surfaces as a typed stale verdict
+            t.snapshot = t.client.request("tel_snapshot",
+                                          deadline=self.timeout)
+            t.spans = list(t.client.request("tel_spans",
+                                            deadline=self.timeout))
+            t.last_ok, t.stale, t.error = now, False, None
+            _scrapes_total().labels(t.name, "ok").inc()
+            return True
+        except (MXNetError, ConnectionError, OSError) as e:
+            t.stale = True
+            t.error = str(e)
+            if t.client is not None:
+                t.client.close()
+                t.client = None
+            _scrapes_total().labels(t.name, "error").inc()
+            return False
+
+    def scrape(self):
+        """One scrape pass over every target. Publishes
+        ``mxt_fleet_scrape_age_seconds{member}`` and the member-state
+        gauge; returns self (chain ``.fleet_registry()``)."""
+        now = self._now()
+        self.scrapes += 1
+        live = stale = 0
+        for t in self.targets().values():
+            self._scrape_one(t, now)
+            if t.stale:
+                stale += 1
+            else:
+                live += 1
+            age = 0.0 if t.last_ok is None else max(0.0, now - t.last_ok)
+            _scrape_age_gauge().labels(t.name).set(round(age, 6))
+        g = _members_gauge()
+        g.labels("live").set(live)
+        g.labels("stale").set(stale)
+        return self
+
+    def fleet_registry(self):
+        """The merged :class:`FleetRegistry` over the newest scrapes
+        (stale members included, labeled). Families a member exports
+        with the reserved ``member``/``stale`` labels — a member that
+        itself runs a collector, or this process's own scrape
+        meta-metrics — are skipped here rather than raised: the strict
+        typed collision stays in :meth:`FleetRegistry.ingest` for
+        direct callers, but a legitimate scrape must never die on
+        nested provenance."""
+        reg = FleetRegistry()
+        for t in self.targets().values():
+            if t.snapshot is None:
+                continue
+            fams = [f for f in t.snapshot.get("families", ())
+                    if not any(r in (f.get("labelnames") or ())
+                               for r in _RESERVED_LABELS)]
+            reg.ingest(t.name, {"families": fams}, stale=t.stale)
+        return reg
+
+    def render_prometheus(self):
+        """The fleet exposition page from the newest scrapes: every
+        member's samples with ``member=`` provenance, plus the
+        collector's own scrape meta-metrics (age/outcome/member-state)
+        rendered verbatim."""
+        page = self.fleet_registry().render_prometheus()
+        meta = _render_collector_meta()
+        return page + meta if meta else page
+
+    # -- trace reassembly ----------------------------------------------------
+    def spans(self, trace_id=None):
+        """Every span the fleet knows for ``trace_id`` (or all traces):
+        this process's span log plus each scraped member's, de-duplicated
+        by span id (the local member and a remote registration of the
+        same process must not double-count)."""
+        seen = set()
+        out = []
+        rows = list(telemetry.trace_spans(trace_id))
+        for t in self.targets().values():
+            for r in t.spans:
+                if trace_id is not None \
+                        and r.get("trace_id") != trace_id:
+                    continue
+                rows.append(r)
+        for r in rows:
+            sid = r.get("span_id")
+            if sid is not None and sid in seen:
+                continue
+            if sid is not None:
+                seen.add(sid)
+            out.append(r)
+        out.sort(key=lambda r: (r.get("t0") or 0.0, r.get("t1") or 0.0))
+        return out
+
+    def chrome_trace(self, trace_id=None):
+        return chrome_trace(self.spans(trace_id))
+
+    def trace_tree(self, trace_id):
+        return trace_tree(self.spans(trace_id), trace_id)
+
+    # -- background loop ------------------------------------------------------
+    def start(self, interval=None):
+        """Refresh+scrape on a daemon thread every ``interval`` seconds
+        (default ``MXT_FLEET_SCRAPE_INTERVAL``)."""
+        from . import config
+
+        if interval is None:
+            interval = config.get("MXT_FLEET_SCRAPE_INTERVAL")
+        interval = float(interval)  # sync-ok: host config scalar
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.refresh()
+                    self.scrape()
+                except Exception:  # noqa: BLE001 — the collector must
+                    pass           # never take the fleet down
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="mxt-fleet-collector")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def close(self):
+        self.stop()
+        for t in self.targets().values():
+            if t.client is not None:
+                try:
+                    t.client.close()
+                except OSError:
+                    pass
+                t.client = None
+        if self._coord_client is not None:
+            self._coord_client.close()
+            self._coord_client = None
+        if default_collector() is self:
+            set_default_collector(None)
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event export
+# ---------------------------------------------------------------------------
+def chrome_trace(spans):
+    """Chrome trace-event JSON (the Perfetto-loadable dict) from span
+    rows. Tracks ("router", "replica-0", ...) become processes, each
+    trace_id a named thread within them — so a hedged request shows as
+    the same trace on two replica tracks, and zero-duration rows
+    (commit, hedge, cancel, failover re-enqueue) render as instant
+    events."""
+    events = []
+    pids = {}       # track -> pid
+    tids = {}       # (pid, trace_id) -> tid
+    for s in sorted(spans, key=lambda r: (r.get("t0") or 0.0)):
+        track = s.get("track") or "process"
+        pid = pids.get(track)
+        if pid is None:
+            pid = pids[track] = len(pids) + 1
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": pid, "tid": 0, "ts": 0,
+                           "args": {"name": track}})
+        key = (pid, s.get("trace_id"))
+        tid = tids.get(key)
+        if tid is None:
+            tid = tids[key] = sum(1 for k in tids if k[0] == pid) + 1
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": pid, "tid": tid, "ts": 0,
+                           "args": {"name": "trace %s"
+                                    % (s.get("trace_id"),)}})
+        t0 = float(s.get("t0") or 0.0)  # sync-ok: host wire scalar
+        t1 = float(s.get("t1") or t0)   # sync-ok: host wire scalar
+        args = dict(s.get("attrs") or {})
+        args["trace_id"] = s.get("trace_id")
+        ev = {"name": s.get("name"), "cat": "mxt", "pid": pid,
+              "tid": tid, "ts": round(t0 * 1e6, 3), "args": args}
+        if t1 > t0:
+            ev["ph"] = "X"
+            ev["dur"] = round((t1 - t0) * 1e6, 3)
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def trace_tree(spans, trace_id):
+    """One request's span tree, reconstructed from trace_id alone:
+    ``{"trace_id", "names", "tracks": {track: [span, ...]}, "t0",
+    "t1"}`` with per-track spans time-ordered — what the acceptance
+    asserts walk."""
+    mine = [s for s in spans if s.get("trace_id") == trace_id]
+    mine.sort(key=lambda r: (r.get("t0") or 0.0, r.get("t1") or 0.0))
+    tracks = {}
+    for s in mine:
+        tracks.setdefault(s.get("track") or "process", []).append(s)
+    return {
+        "trace_id": trace_id,
+        "names": [s.get("name") for s in mine],
+        "tracks": tracks,
+        "t0": min((s.get("t0") or 0.0 for s in mine), default=None),
+        "t1": max((s.get("t1") or 0.0 for s in mine), default=None),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the process-default collector + the /debug/timeline route
+# ---------------------------------------------------------------------------
+_default_lock = threading.Lock()
+_default = None
+
+
+def default_collector():
+    """The process's registered fleet collector (what ``/fleet`` and
+    ``/debug/timeline`` serve from), or None."""
+    return _default
+
+
+def set_default_collector(collector):
+    """Install (or with None, clear) the process-default collector."""
+    global _default
+    with _default_lock:
+        _default = collector
+    return collector
+
+
+def handle_timeline(params):
+    """``/debug/timeline[?trace_id=...]`` → Chrome trace-event JSON.
+    With a default collector: the whole fleet's spans; without one:
+    this process's span log (a single replica is still traceable)."""
+    tid = params.get("trace_id")
+    c = default_collector()
+    spans = c.spans(tid) if c is not None else telemetry.trace_spans(tid)
+    doc = chrome_trace(spans)
+    return (200, "application/json",
+            json.dumps(doc, default=str).encode("utf-8"))

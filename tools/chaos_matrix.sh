@@ -125,5 +125,28 @@ for seed in "${SEEDS[@]}"; do
     fi
 done
 
+# -- fleet observability sweep ------------------------------------------------
+# replica_kill during ACTIVE traces: the chaos-marked cells in
+# tests/test_telemetry_fleet.py kill one replica mid-run and assert the
+# failed-over requests' trace trees still export (failover_reenqueue
+# span present, commits==1) and that the collector scraping a dead
+# endpoint gets a typed stale verdict — bounded, never a hang; the
+# outer `timeout` is only the backstop.
+for seed in "${SEEDS[@]}"; do
+    echo "== fleet-obs sweep: MXT_CHAOS_SEED=$seed (cell timeout ${CELL_TIMEOUT}s)"
+    timeout -k 10 "$CELL_TIMEOUT" env JAX_PLATFORMS=cpu \
+        MXT_CHAOS_SEED="$seed" \
+        python -m pytest tests/test_telemetry_fleet.py -q -m "chaos and not slow" \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    rc=$?
+    if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+        echo "!! HANG: fleet-obs sweep seed=$seed exceeded ${CELL_TIMEOUT}s" >&2
+        fail=1
+    elif [ "$rc" -ne 0 ]; then
+        echo "!! FAIL: fleet-obs sweep seed=$seed rc=$rc" >&2
+        fail=1
+    fi
+done
+
 [ "$fail" -eq 0 ] && echo "chaos matrix: all seeds clean"
 exit "$fail"

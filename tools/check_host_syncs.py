@@ -57,6 +57,13 @@ SCAN = {
     "mxnet_tpu/gluon/trainer.py": _ALL,
     "mxnet_tpu/ndarray/pending.py": _ALL,
     "mxnet_tpu/telemetry.py": _ALL,
+    # the fleet observability plane: the collector runs OFF the serving
+    # hot path, but its span-stamping hooks live inside the router tick
+    # and the scheduler's deferred retirements — everything here must
+    # be host wall clocks and wire payloads; the sanctioned float()s
+    # are config scalars and already-transferred wire values, each
+    # sync-ok annotated.
+    "mxnet_tpu/telemetry_fleet.py": _ALL,
     "mxnet_tpu/gluon/contrib/estimator.py": _ALL,
     "mxnet_tpu/monitor.py": _TRANSFER,
     "mxnet_tpu/metric.py": [r"\.asnumpy\(", r"\.asscalar\(",

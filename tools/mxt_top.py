@@ -38,6 +38,16 @@ and, when a fleet router is live (serving/fleet.py + router.py):
                        fenced-zombie replies refused typed)
     routed p50/p99     fleet-level request latency (submit -> commit)
 
+and, with ``--fleet`` (the telemetry_fleet.py collector's merged page —
+member-labeled samples from every scraped fleet member):
+
+    fleet members      live/stale member count + stale names
+    fleet tok/s        tokens/s summed across every member
+    occupancy          active decode slots per replica
+    emb hit ratio      per-embedding-server cache hit ratio
+    goodput min/mean   worst / average goodput across workers
+    scrape age         seconds since each member's last good scrape
+
 and, when the diagnostics layer publishes (mxnet_tpu/diagnostics.py):
 
     hbm <pool>         per-subsystem device bytes (params / optimizer /
@@ -337,6 +347,64 @@ def render(samples, prev, dt):
     flt_p50, flt_p99 = histogram_quantiles(
         samples, "mxt_fleet_request_latency_seconds", (0.50, 0.99))
 
+    # fleet-SCOPE section (telemetry_fleet.py collector page, reached
+    # via --fleet): only rendered when member-labeled samples are
+    # present — i.e. the source is a merged fleet page, not a single
+    # process's endpoint. Per-member breakdowns: serving occupancy,
+    # embedding hit ratio, goodput min/mean, scrape age + staleness.
+    fleet_members = sorted({dict(lab).get("member")
+                            for (n, lab), v in samples.items()
+                            if "member" in dict(lab)} - {None})
+    fleet_stale = sorted({dict(lab).get("member")
+                          for (n, lab), v in samples.items()
+                          if dict(lab).get("stale") == "true"} - {None})
+    fleet_tok_rate = fleet_occ = fleet_emb = fleet_good = None
+    fleet_ages = {}
+    if fleet_members:
+        fleet_tok_rate, _ = rate("mxt_serving_tokens_total")
+        # per-replica occupancy (summed over members — each replica's
+        # gauge is published by exactly one pool), falling back to the
+        # per-member active-request gauge for non-serving members
+        occ_by_rep = {}
+        for (n, lab), v in samples.items():
+            if n == "mxt_fleet_replica_occupancy":
+                d = dict(lab)
+                if "replica" in d:
+                    occ_by_rep[d["replica"]] = \
+                        occ_by_rep.get(d["replica"], 0.0) + v
+        if occ_by_rep:
+            fleet_occ = ["r%s=%d" % (r, int(v))
+                         for r, v in sorted(occ_by_rep.items())]
+        else:
+            fleet_occ = []
+            for m in fleet_members:
+                occ = metric_sum(samples,
+                                 "mxt_serving_active_requests",
+                                 member=m)
+                if occ is not None:
+                    fleet_occ.append("%s=%d" % (m, int(occ)))
+        fleet_emb = []
+        for m in fleet_members:
+            h = metric_sum(samples, "mxt_embedding_cache_hits_total",
+                           member=m)
+            ms_ = metric_sum(samples, "mxt_embedding_cache_misses_total",
+                             member=m)
+            if h is None and ms_ is None:
+                continue
+            tot = (h or 0) + (ms_ or 0)
+            if tot:
+                fleet_emb.append("%s=%.3f" % (m, (h or 0) / tot))
+        goods = [metric_sum(samples, "mxt_goodput_ratio", member=m)
+                 for m in fleet_members]
+        goods = [g for g in goods if g is not None]
+        if goods:
+            fleet_good = (min(goods), sum(goods) / len(goods))
+        for m in fleet_members:
+            age = metric_sum(samples, "mxt_fleet_scrape_age_seconds",
+                             member=m)
+            if age is not None:
+                fleet_ages[m] = age
+
     # serving section (mxnet_tpu/serving/): only rendered when the
     # process has served — a pure trainer shows no serving noise
     tok_rate, tok_total = rate("mxt_serving_tokens_total")
@@ -412,6 +480,24 @@ def render(samples, prev, dt):
                _fmt(emb_evict, "%.0f")),
             "  emb bytes/s      %s" % _fmt_b(emb_bytes_rate),
         ]
+    if fleet_members:
+        ages = ["%s %s" % (m, _fmt_s(fleet_ages[m]))
+                for m in sorted(fleet_ages)]
+        lines += [
+            "-" * 46,
+            "  fleet members    %d   stale: %s"
+            % (len(fleet_members),
+               ", ".join(fleet_stale) if fleet_stale else "none"),
+            "  fleet tok/s      %s" % _fmt(fleet_tok_rate),
+            "  occupancy        %s"
+            % (" ".join(fleet_occ) if fleet_occ else "--"),
+        ]
+        if fleet_emb:
+            lines.append("  emb hit ratio    %s" % " ".join(fleet_emb))
+        if fleet_good is not None:
+            lines.append("  goodput min/mean %.3f / %.3f" % fleet_good)
+        if ages:
+            lines.append("  scrape age       %s" % "  ".join(ages))
     if flt_states:
         lines += [
             "-" * 46,
@@ -462,6 +548,13 @@ def main(argv=None):
     p.add_argument("--interval", type=float, default=2.0)
     p.add_argument("--once", action="store_true",
                    help="render one frame and exit (no screen clear)")
+    p.add_argument("--fleet", action="store_true",
+                   help="scrape the fleet collector's merged page "
+                        "(--url + /fleet): member-labeled samples from "
+                        "every fleet member, with a fleet-scope "
+                        "section (tokens/s, per-replica occupancy, "
+                        "per-server embedding hit ratio, goodput "
+                        "min/mean, scrape ages)")
     args = p.parse_args(argv)
 
     if args.jsonl:
@@ -474,6 +567,8 @@ def main(argv=None):
                 p.error("give --url or --jsonl (or set "
                         "MXT_TELEMETRY_PORT)")
             url = "http://127.0.0.1:%s" % port
+        if args.fleet:
+            url = url.rstrip("/") + "/fleet"
         src = EndpointSource(url)
 
     prev, t_prev = None, None
