@@ -46,7 +46,8 @@ _CONFIG_COST = {"resnet50": 420, "bert": 300, "lstm_ptb": 200,
                 "async_ab": 90, "telemetry_ab": 60, "diag_ab": 60,
                 "cold_warm": 120, "serving": 150, "zero_stage": 90,
                 "embedding_ab": 90, "serving_fleet": 120,
-                "speculative": 120, "kv_quant": 90, "fleet_obs": 90}
+                "speculative": 120, "kv_quant": 90, "fleet_obs": 90,
+                "streaming_input": 90}
 
 
 def _remaining():
@@ -688,6 +689,196 @@ def bench_input_pipeline(platform, dtype):
     }
     _emit_jsonl(row)
     return img_s, row
+
+
+def bench_streaming_input(platform, dtype):
+    """Streaming data plane A/B (mxnet_tpu/data_plane/): the SAME
+    synthetic recordio shards consumed by (a) the per-process gluon
+    DataLoader (locked shared reader + per-sample decode in
+    ``__getitem__`` — the pattern the data plane replaces) and (b) the
+    chunk-leased decode-worker fleet as TWO in-process hosts sharing one
+    lease ledger. Both legs run the full feed path (decode + augment +
+    batchify + NDArray device wrap) and report consumer-observed
+    ``data_wait`` per step; the plane leg also reports the ledger's
+    steal count. The plane's per-core edge is algorithmic, not just
+    parallel: chunk-sequential reads, decode straight into preallocated
+    batch slots (no per-sample Python/np.stack pass), and JPEG
+    draft-mode DCT downscaling when a resize target is set. Legs are
+    shape-warm: each runs one discarded warm epoch first (the PR 12
+    bench gotcha)."""
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from mxnet_tpu import data_plane, recordio
+    from mxnet_tpu.gluon.data import DataLoader, Dataset
+    from mxnet_tpu.io.io import _crop, _resize_short
+    from mxnet_tpu.recordio import unpack_img
+
+    del dtype  # host decode A/B: uint8 jpeg -> float32 both ways
+    n_img = int(os.environ.get("BENCH_SIAB_IMAGES", "192"))
+    hw = int(os.environ.get("BENCH_SIAB_HW", "192"))
+    resize = int(os.environ.get("BENCH_SIAB_RESIZE", "96"))
+    crop = int(os.environ.get("BENCH_SIAB_CROP", "64"))
+    batch = int(os.environ.get("BENCH_SIAB_BATCH", "32"))
+    epochs = int(os.environ.get("BENCH_SIAB_EPOCHS", "2"))
+    workers = int(os.environ.get("BENCH_SIAB_WORKERS", "2"))
+    chunk = int(os.environ.get("BENCH_SIAB_CHUNK", "32"))
+
+    tmp = tempfile.mkdtemp(prefix="mxt_siab_bench_")
+    try:
+        rng = np.random.RandomState(0)
+        shards = []
+        gid = 0
+        for s in range(2):
+            frec = os.path.join(tmp, "part-%d.rec" % s)
+            fidx = os.path.join(tmp, "part-%d.idx" % s)
+            w = recordio.MXIndexedRecordIO(fidx, frec, "w")
+            for _ in range(n_img // 2):
+                base = rng.randint(0, 255, (8, 8, 3))
+                img = np.kron(base, np.ones((hw // 8, hw // 8, 1)))
+                img = np.clip(img + rng.randint(0, 12, img.shape),
+                              0, 255).astype(np.uint8)
+                w.write_idx(gid, recordio.pack_img(
+                    recordio.IRHeader(0, float(gid % 10), gid, 0), img,
+                    img_fmt=".jpg", quality=90))
+                gid += 1
+            w.close()
+            shards.append(frec)
+
+        class _RecDataset(Dataset):
+            """The per-process pattern: one shared (locked) reader,
+            per-sample decode in __getitem__."""
+
+            def __init__(self, recs):
+                self._readers = []
+                self._index = []
+                self._lock = threading.Lock()
+                for si, r in enumerate(recs):
+                    rd = recordio.MXIndexedRecordIO(
+                        os.path.splitext(r)[0] + ".idx", r, "r")
+                    self._readers.append(rd)
+                    self._index.extend((si, k) for k in rd.keys)
+                self._rng = np.random.RandomState(0)
+
+            def __len__(self):
+                return len(self._index)
+
+            def __getitem__(self, i):
+                si, k = self._index[i]
+                with self._lock:
+                    raw = self._readers[si].read_idx(k)
+                header, img = unpack_img(raw)
+                img = _resize_short(img, resize)
+                img = _crop(img, crop, crop, rand=True, rng=self._rng)
+                return img.astype(np.float32), np.float32(header.label)
+
+        def leg_loader():
+            ds = _RecDataset(shards)
+            n_batches = [0]
+
+            def one_epoch():
+                dl = DataLoader(ds, batch_size=batch, shuffle=True,
+                                num_workers=workers, thread_pool=True,
+                                last_batch="keep")
+                seen = 0
+                for b in dl:
+                    seen += b[0].shape[0]
+                    n_batches[0] += 1
+                return seen
+
+            one_epoch()  # warm: thread spin-up, page cache
+            n_batches[0] = 0
+            seen = 0
+            t0 = time.perf_counter()
+            for _ in range(epochs):
+                seen += one_epoch()
+            dt = time.perf_counter() - t0
+            return seen / dt, dt / max(1, n_batches[0])
+
+        manifest = data_plane.ShardManifest(shards, chunk_records=chunk)
+        decoder = data_plane.ImageDecoder(
+            (3, crop, crop), rand_crop=True, resize=resize,
+            layout="NHWC", dtype="float32")
+
+        def plane_epoch(seed, epoch):
+            """One epoch as TWO in-process hosts over a shared ledger
+            (each host: `workers` decode threads), aggregate img/s."""
+            ledger = data_plane.ChunkLedger()
+            counts = {}
+            waits = {}
+
+            def host(h):
+                # heterogeneous hosts (host 1 decodes with ONE worker):
+                # the realistic slow-peer scenario — host 0 runs dry
+                # first and steals host 1's tail, so the row's steal
+                # count exercises the cross-host path
+                loader = data_plane.StreamingDataLoader(
+                    manifest, batch, decoder, host_id=h, num_hosts=2,
+                    ledger=ledger, seed=seed, start_epoch=epoch,
+                    num_workers=workers if h == 0 else 1)
+                seen = nb = 0
+                for b in loader:
+                    seen += b.data.shape[0]
+                    nb += 1
+                counts[h] = seen
+                waits[h] = nb
+
+            ts = [threading.Thread(target=host, args=(h,))
+                  for h in (0, 1)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            dt = time.perf_counter() - t0
+            stats = ledger.stats()
+            # fleet-level input latency: wall time per DELIVERED batch
+            # (the same definition the baseline leg's single consumer
+            # measures — its loop time per batch)
+            wait = dt / max(1, sum(waits.values()))
+            return sum(counts.values()) / dt, wait, stats
+
+        def leg_plane():
+            plane_epoch(0, 0)  # warm
+            seen_rate = steals = 0
+            waits = []
+            for e in range(epochs):
+                r, w, stats = plane_epoch(0, e + 1)
+                seen_rate += r
+                waits.append(w)
+                steals += stats["steals"]
+            return seen_rate / epochs, max(waits), steals
+
+        loader_img_s, loader_wait = leg_loader()
+        plane_img_s, plane_wait, steals = leg_plane()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    speedup = plane_img_s / loader_img_s if loader_img_s else 0.0
+    row = {
+        "config": "streaming_input_ab", "chips": 0, "batch_size": batch,
+        "dtype": "uint8->float32", "platform": platform,
+        "host_cores": os.cpu_count(), "decode_workers": workers,
+        "hosts": 2, "chunk_records": chunk,
+        "dataloader_img_per_sec": round(loader_img_s, 2),
+        "data_plane_img_per_sec": round(plane_img_s, 2),
+        "dataloader_data_wait_ms_per_step": round(loader_wait * 1e3, 3),
+        "data_plane_data_wait_ms_per_step": round(plane_wait * 1e3, 3),
+        "steal_count": int(steals),
+        "images_or_tokens_per_sec_per_chip": round(plane_img_s, 2),
+        "mfu": None, "flops_per_sample": None,
+        "streaming_input_speedup": round(speedup, 4),
+        "note": "host decode A/B on %dx%d jpeg -> resize %d -> crop %d; "
+                "plane uses jpeg draft-mode DCT downscale + slot decode "
+                "(deterministic; pixel values differ from the full-res "
+                "decode+resize baseline by construction)"
+                % (hw, hw, resize, crop),
+    }
+    _emit_jsonl(row)
+    return speedup, row
 
 
 def bench_async_ab(platform, dtype):
@@ -1806,7 +1997,7 @@ def main():
         "BENCH_CONFIGS",
         "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline,async_ab,"
         "telemetry_ab,diag_ab,cold_warm,serving,zero_stage,embedding_ab,"
-        "serving_fleet,speculative,kv_quant,fleet_obs"
+        "serving_fleet,speculative,kv_quant,fleet_obs,streaming_input"
     ).split(",")
 
     # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
@@ -1851,6 +2042,9 @@ def main():
         "fleet_obs": ("fleet_observability_overhead",
                       "x (collector-on/off fleet tokens/s)",
                       bench_fleet_observability),
+        "streaming_input": ("streaming_input_speedup",
+                            "x (data plane/per-process DataLoader img/s)",
+                            bench_streaming_input),
     }
     headline = None
     errors = []
@@ -1860,7 +2054,7 @@ def main():
                  "pipeline", "async_ab", "telemetry_ab", "diag_ab",
                  "cold_warm", "serving", "zero_stage", "embedding_ab",
                  "serving_fleet", "speculative", "kv_quant",
-                 "fleet_obs"):
+                 "fleet_obs", "streaming_input"):
         if name not in configs:
             continue
         cost = float(os.environ.get("BENCH_COST_%s" % name.upper(),

@@ -5,10 +5,21 @@ optimizer) is ONE jitted XLA program over a device mesh, with the batch
 sharded along the data axis; the native C++ record engine feeds the
 decode workers when available.
 
+``--streaming-input`` swaps the per-process iterator for the pod-scale
+streaming data plane (mxnet_tpu/data_plane/): the shard's records are
+chunk-leased to a per-host decode-worker fleet (``MXT_DATA_WORKERS``),
+partitioned across hosts from the launch-line topology with cross-host
+work stealing, and the consumer's wait time is stamped as the per-host
+``data_wait`` phase — add ``--telemetry`` and point
+``python tools/mxt_top.py --jsonl imagenet_telemetry.jsonl --once`` at
+it to see the per-host data rec/s + data_wait attribution live.
+
 Without a real shard this still runs: --synthetic generates a small
-RecordIO file of random JPEGs first.
+indexed RecordIO file of random JPEGs first.
 
 Run:  python examples/train_imagenet_resnet.py --synthetic --iters 10
+      python examples/train_imagenet_resnet.py --synthetic --iters 10 \
+          --streaming-input --telemetry
 """
 import argparse
 
@@ -20,17 +31,20 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import numpy as np
 
 import mxnet_tpu as mx
-from mxnet_tpu import nd, parallel, recordio
+from mxnet_tpu import data_plane, nd, parallel, recordio
 from mxnet_tpu.gluon import model_zoo, nn
 
 
 def make_synthetic_shard(path, n=256, hw=96):
+    """Indexed shard (the .idx sidecar is what lets the data plane's
+    chunks seek mid-shard; ImageRecordIter ignores it happily)."""
     rng = np.random.RandomState(0)
-    w = recordio.MXRecordIO(path, "w")
+    idx = os.path.splitext(path)[0] + ".idx"
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
     for i in range(n):
         img = rng.randint(0, 255, (hw, hw, 3), dtype=np.uint8)
-        w.write(recordio.pack_img((0, float(i % 10), i, 0), img,
-                                  img_fmt=".png"))
+        w.write_idx(i, recordio.pack_img((0, float(i % 10), i, 0), img,
+                                         img_fmt=".png"))
     w.close()
 
 
@@ -44,17 +58,56 @@ def main():
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--classes", type=int, default=10)
+    p.add_argument("--streaming-input", action="store_true",
+                   help="feed through the streaming data plane "
+                        "(chunk-leased decode fleet + work stealing) "
+                        "instead of the per-process ImageRecordIter")
+    p.add_argument("--data-workers", type=int, default=None,
+                   help="decode workers per host (MXT_DATA_WORKERS)")
+    p.add_argument("--telemetry", action="store_true",
+                   help="write telemetry JSONL "
+                        "(imagenet_telemetry.jsonl) for tools/mxt_top.py "
+                        "— the data section shows per-host rec/s, queue "
+                        "depth, steals, and data_wait share")
     args = p.parse_args()
+
+    if args.telemetry:
+        os.environ.setdefault("MXT_TELEMETRY_JSONL",
+                              "imagenet_telemetry.jsonl")
 
     if args.synthetic and not os.path.exists(args.rec):
         os.makedirs(os.path.dirname(args.rec) or ".", exist_ok=True)
         make_synthetic_shard(args.rec)
 
     shape = tuple(int(s) for s in args.image_shape.split(","))
-    it = mx.io.ImageRecordIter(
-        path_imgrec=args.rec, data_shape=shape,
-        batch_size=args.batch_size, shuffle=True, rand_mirror=True,
-        preprocess_threads=4, layout="NHWC")  # feed MXU-native batches
+
+    def batches():
+        """Yield (x, y) NDArray pairs, epoch after epoch."""
+        if args.streaming_input:
+            # topology from the launch line (MXT_WORKER_ID /
+            # MXT_NUM_WORKERS — exported by tools/launch.py); one host
+            # here unless launched distributed
+            manifest = data_plane.ShardManifest([args.rec])
+            decoder = data_plane.ImageDecoder(
+                shape, rand_crop=True, rand_mirror=True, layout="NHWC")
+            loader = data_plane.StreamingDataLoader(
+                manifest, args.batch_size, decoder,
+                num_workers=args.data_workers, prefetch_to_device=True)
+            while True:
+                for b in loader:
+                    # short tail batches would retrace the fused step
+                    if b.data.shape[0] == args.batch_size:
+                        yield b.data, b.label
+        else:
+            it = mx.io.ImageRecordIter(
+                path_imgrec=args.rec, data_shape=shape,
+                batch_size=args.batch_size, shuffle=True,
+                rand_mirror=True, preprocess_threads=4,
+                layout="NHWC")  # feed MXU-native batches
+            while True:
+                it.reset()
+                for batch in it:
+                    yield batch.data[0], batch.label[0]
 
     mx.random.seed(0)
     # channels-last is the MXU-native layout
@@ -72,20 +125,21 @@ def main():
 
     speedo = mx.callback.Speedometer(args.batch_size, frequent=5)
     n = 0
-    for epoch in range(100):
-        it.reset()
-        for batch in it:
-            # iterator already emits NHWC — no layout flip anywhere
-            x = batch.data[0].astype(args.dtype)
-            loss = step(x, batch.label[0])
-            n += 1
-            speedo(mx.model.BatchEndParam(epoch=epoch, nbatch=n,
-                                          eval_metric=None, locals=None))
-            if n >= args.iters:
-                loss.wait_to_read()
-                print("done: loss %.4f after %d iters"
-                      % (float(loss.asnumpy()), n))
-                return
+    for x, y in batches():
+        # iterator already emits NHWC — no layout flip anywhere
+        loss = step(x.astype(args.dtype), y)
+        n += 1
+        speedo(mx.model.BatchEndParam(epoch=0, nbatch=n,
+                                      eval_metric=None, locals=None))
+        if n >= args.iters:
+            loss.wait_to_read()
+            print("done: loss %.4f after %d iters"
+                  % (float(loss.asnumpy()), n))
+            break
+    if args.telemetry:
+        mx.telemetry.flush(write_metrics=True)
+        print("telemetry: python tools/mxt_top.py --jsonl "
+              "imagenet_telemetry.jsonl --once")
 
 
 if __name__ == "__main__":

@@ -67,6 +67,7 @@ from . import tuning
 from . import resilience
 from . import membership
 from . import embedding
+from . import data_plane
 from . import visualization
 from . import visualization as viz
 from . import amp
@@ -82,7 +83,7 @@ __all__ = [
     "sym", "Symbol", "module", "mod", "Module", "BucketingModule", "model",
     "save_checkpoint", "load_checkpoint", "profiler", "monitor",
     "operator", "image", "config", "amp", "contrib", "resilience",
-    "membership", "telemetry", "tuning", "diagnostics",
+    "membership", "telemetry", "tuning", "diagnostics", "data_plane",
     "SequentialModule", "visualization", "viz", "runtime", "util", "rnn",
     "attribute", "AttrScope", "name", "engine",
 ]

@@ -210,6 +210,7 @@ class AsyncParamServer:
         self._updater = None
         self.embedding = None  # EmbeddingStore (attach_embedding)
         self.serving = None    # ServingHost (attach_serving)
+        self.data_plane = None  # ChunkLedger (attach_data_plane)
         self._mutate = threading.Lock()  # ps-lite customer-thread analog
         self._conns = set()  # live client sockets, torn down by close()
         self._conns_lock = threading.Lock()
@@ -359,6 +360,20 @@ class AsyncParamServer:
         self.serving = host
         return host
 
+    def attach_data_plane(self, ledger):
+        """Host the streaming data plane's chunk lease ledger on this
+        server: every ``data_*`` frame (lease/steal/cursor —
+        data_plane/ledger.py) dispatches to it. The membership reaper
+        feeds it: a reaped worker's host id is fenced in the ledger, so
+        its unconsumed chunks become stealable by survivors and its
+        zombie commits are refused typed (the lease-generation fence —
+        PR 10's ring-epoch discipline applied to input)."""
+        self.data_plane = ledger
+        self.membership.add_death_listener(
+            lambda ids: [ledger.fence_host(i) for i in ids
+                         if isinstance(i, int) and i >= 0])
+        return ledger
+
     def _fencing_active(self):
         from . import config
 
@@ -454,6 +469,15 @@ class AsyncParamServer:
             # credential fencing already ran above; the store adds the
             # row-granular ring-epoch fence for mutations
             return self.embedding.handle(op, key, payload)
+        # -- streaming data plane lease ledger (data_plane/ledger.py) -----
+        elif op.startswith("data_"):
+            if self.data_plane is None:
+                return ("err", "this server hosts no data-plane ledger "
+                               "(attach_data_plane)")
+            # a stale lease generation raises StaleLeaseError (a
+            # StaleWorkerError) — _serve answers it as a typed 'stale'
+            # reply, exactly like a fenced worker's dense push
+            return self.data_plane.handle(op, key, payload)
         # -- standalone serving replica (serving/fleet.py) ----------------
         elif op.startswith("srv_"):
             if self.serving is None:

@@ -160,7 +160,12 @@ _declare("MXT_FAULT", str, None,
          "replica_kill:replica=I[,after=K] kills serving replica I at "
          "its Kth router tick (in-flight requests fail over), "
          "replica_slow:replica=I,ms=N[,after=K] stalls replica I's "
-         "decode for N ms (hedge bait).")
+         "decode for N ms (hedge bait); "
+         "data_host_kill:host=I[,after=K] kills host I's data-plane "
+         "decode fleet at its Kth chunk-commit boundary (survivors "
+         "steal its reclaimed chunks), "
+         "data_worker_slow:host=I,ms=N slows host I's decode by N ms "
+         "per chunk (steal bait).")
 
 _declare("MXT_MEMBERSHIP", bool, True,
          "Elastic membership for the dist kvstore (membership.py): "
@@ -312,6 +317,29 @@ _declare("MXT_AG_LEAN_TAPE", bool, False,
          "inputs) on the autograd tape. Saves peak memory on very long "
          "eager recordings whose ops' vjp residuals don't already retain "
          "their inputs, at the cost of grad(create_graph=True) raising.")
+
+_declare("MXT_DATA_WORKERS", int, 2,
+         "Decode workers per host in the streaming data plane "
+         "(data_plane/workers.py) — the ImageRecordIter "
+         "preprocess_threads analog, pulling leased shard chunks "
+         "instead of a shared cursor.")
+_declare("MXT_DATA_BUFFER_BATCHES", int, 8,
+         "Bounded decoded-batch buffer per host (the data plane's "
+         "backpressure boundary): decode workers block when the "
+         "consumer falls this many batches behind instead of growing "
+         "host memory; resident bytes are accounted in the HBM "
+         "ledger's 'prefetch' pool.")
+_declare("MXT_DATA_CHUNK_RECORDS", int, 256,
+         "Records per data-plane chunk — the unit of lease, steal, and "
+         "batch formation (batches never cross a chunk, so keep this a "
+         "multiple of the batch size). Smaller chunks steal/resume at "
+         "finer grain; larger chunks read more sequentially.")
+_declare("MXT_DATA_STEAL", bool, True,
+         "Cross-host work stealing in the data plane: a host whose "
+         "lease queue runs dry steals unleased chunks from the slowest "
+         "peer (reclaimed dead-host chunks first). 0 pins every chunk "
+         "to its original owner (a dead host's tail is then lost until "
+         "it rejoins).")
 
 _declare("MXT_EMBEDDING_SERVERS", str, None,
          "Comma-separated host:port list of a running sharded-embedding "

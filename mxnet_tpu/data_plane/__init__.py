@@ -1,0 +1,39 @@
+"""Pod-scale streaming data plane (ROADMAP 3 — the MXNet 1.x data stack
+``ImageRecordIter`` / ``io.DataIter`` over recordio shards, rebuilt
+TPU-native and multi-host).
+
+The per-process iterator tops out around ~850 img/s per host core while
+one chip needs multiples of that — at mesh scale input is the ceiling,
+and PR 9's goodput accounting bills the loss as ``data_wait``. This
+package replaces the per-process cursor with a leased, stealable chunk
+keyspace:
+
+- :class:`~.manifest.ShardManifest` — recordio shards sliced into
+  deterministic chunks, partitioned across the mesh's hosts from the
+  launch-line topology (``MXT_NUM_WORKERS``/``MXT_MESH_SHAPE``) with an
+  epoch-seeded shuffle; chunk contents are a pure function of
+  (manifest, seed, epoch), never of the decoding host.
+- :class:`~.ledger.ChunkLedger` — exactly-once chunk consumption via
+  lease generations (PR 10 ring-epoch style fencing: a zombie host's
+  stale commit is refused typed), host fencing that reclaims a dead
+  host's chunks for survivors, and cross-host work stealing; shared
+  in-process or over the authenticated async transport
+  (``data_lease``/``data_steal``/``data_cursor`` ops,
+  :class:`~.ledger.RemoteLedger`).
+- :class:`~.workers.DecodeWorkerFleet` — ``MXT_DATA_WORKERS`` decode
+  threads per host feeding a bounded buffer (backpressure, bytes in
+  the HBM ledger's ``prefetch`` pool).
+- :class:`~.loader.StreamingDataLoader` — the ``for batch in loader``
+  face, stamping per-host ``data_wait`` and carrying a mid-epoch
+  checkpoint cursor (``CheckpointManager.save(extra=loader.cursor())``).
+"""
+from .ledger import ChunkLedger, RemoteLedger, StaleLeaseError
+from .loader import StreamBatch, StreamingDataLoader
+from .manifest import Chunk, ShardManifest
+from .workers import ArrayDecoder, DecodeWorkerFleet, ImageDecoder
+
+__all__ = [
+    "ShardManifest", "Chunk", "ChunkLedger", "RemoteLedger",
+    "StaleLeaseError", "DecodeWorkerFleet", "ImageDecoder",
+    "ArrayDecoder", "StreamingDataLoader", "StreamBatch",
+]

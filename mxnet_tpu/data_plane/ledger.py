@@ -1,0 +1,396 @@
+"""Chunk lease ledger — exactly-once chunk consumption for the
+streaming data plane (the sharding half of ps-lite's scheduler role,
+applied to input instead of parameters).
+
+One :class:`ChunkLedger` is the authoritative lease table for an epoch:
+every chunk of the :class:`~.manifest.ShardManifest` is consumed by
+EXACTLY ONE host, even across host deaths, work stealing, and zombie
+retries. The fencing design mirrors PR 10's embedding ring epoch:
+
+- **Lease generations.** Every lease/steal hands out a fresh monotone
+  token. A commit must present the token of the chunk's CURRENT lease;
+  a commit carrying a superseded token — the chunk was reclaimed from a
+  fenced host and re-leased to a thief — is refused with a typed
+  :class:`StaleLeaseError` (a :class:`StaleWorkerError` subclass, so it
+  rides the async transport's existing ``stale`` reply and surfaces
+  typed on the zombie's side).
+
+- **Host fencing.** ``fence_host`` (driven by the membership reaper's
+  death listener when the ledger is attached to an
+  :class:`~mxnet_tpu.async_server.AsyncParamServer`, or directly in
+  tests) moves the dead host's pending AND leased-uncommitted chunks
+  into a reclaim pool that any dry peer may steal from; everything the
+  dead host already committed stays committed — zero loss, zero
+  duplication.
+
+- **Work stealing.** A host whose own partition ran dry steals from the
+  reclaim pool first, then from the *slowest* live peer (the one with
+  the most pending chunks), popping from the TAIL of the victim's queue
+  (the work it would reach last).
+
+- **At-least-once transport safety.** The async client retries frames;
+  a commit replayed with the SAME token is acknowledged idempotently.
+
+The ledger is shared either in-process (single host / tests) or over
+the authenticated async-server transport via ``attach_data_plane`` —
+the ``data_lease`` / ``data_steal`` / ``data_cursor`` ops all dispatch
+to :meth:`ChunkLedger.handle`, retry-wrapped under ``kv_retry`` on the
+client side like every other kvstore op.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from ..base import MXNetError
+from ..membership import StaleWorkerError
+
+__all__ = ["ChunkLedger", "RemoteLedger", "StaleLeaseError"]
+
+
+class StaleLeaseError(StaleWorkerError):
+    """A chunk commit arrived under a superseded lease generation or
+    from a fenced host: the chunk was (or will be) consumed by its
+    current leaseholder, so applying this commit would double-count or
+    lose samples. The zombie must drop the chunk's batches."""
+
+
+class ChunkLedger:
+    """Thread-safe chunk lease/commit table for one epoch at a time."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._key = None          # (manifest_id, epoch)
+        self._pending = {}        # host -> deque(chunk_id)
+        self._reclaim = deque()   # chunks reclaimed from fenced hosts
+        self._lease = {}          # chunk_id -> (host, token)
+        self._done = {}           # chunk_id -> token it committed under
+        self._owner0 = {}         # chunk_id -> original owner (stats)
+        self._fenced = set()      # fenced host ids
+        self._token = 0           # monotone lease-generation counter
+        self._total = 0
+        self._steals = 0
+        self._stales = 0
+
+    # -- epoch lifecycle ---------------------------------------------------
+    def begin_epoch(self, manifest_id, epoch, owners, committed=()):
+        """Install the epoch's chunk partition. Idempotent and
+        first-caller-wins: every host derives the same ``owners`` table
+        from the shared (manifest, seed, epoch), so later callers just
+        join the epoch in progress. A DIFFERENT manifest for the same
+        epoch is a typed error (the hosts disagree about the dataset).
+        ``committed`` pre-marks chunks a resumed host's checkpoint
+        cursor already consumed — they are never re-leased."""
+        key = (str(manifest_id), int(epoch))
+        with self._lock:
+            if self._key == key:
+                return False  # epoch already installed — join it
+            if self._key is not None and self._key[0] != key[0] \
+                    and self._key[1] == key[1]:
+                raise MXNetError(
+                    "data-plane manifest mismatch for epoch %d: ledger "
+                    "holds %r, begin_epoch got %r — hosts disagree about "
+                    "the dataset" % (key[1], self._key[0], key[0]))
+            self._key = key
+            self._pending = {}
+            self._reclaim = deque()
+            self._lease = {}
+            self._done = {}
+            self._owner0 = {}
+            self._fenced = set()
+            self._steals = 0
+            self._stales = 0
+            done = set(int(c) for c in committed)
+            total = 0
+            for host, cids in owners.items():
+                q = deque()
+                for cid in cids:
+                    cid = int(cid)
+                    total += 1
+                    self._owner0[cid] = int(host)
+                    if cid in done:
+                        self._done[cid] = -1  # committed before resume
+                    else:
+                        q.append(cid)
+                self._pending[int(host)] = q
+            self._total = total
+            return True
+
+    def _require_epoch_locked(self):
+        if self._key is None:
+            raise MXNetError(
+                "data-plane ledger has no epoch — call begin_epoch first")
+
+    # -- lease / steal -----------------------------------------------------
+    def lease(self, host, n=1):
+        """Up to ``n`` chunks from ``host``'s own partition queue.
+        Returns ``[(chunk_id, token)]`` (empty when the queue is dry)."""
+        host = int(host)
+        out = []
+        with self._lock:
+            self._require_epoch_locked()
+            if host in self._fenced:
+                raise StaleLeaseError(
+                    "host %d is fenced — it must rejoin before leasing "
+                    "data chunks" % host)
+            q = self._pending.get(host)
+            while q and len(out) < int(n):
+                cid = q.popleft()
+                self._token += 1
+                self._lease[cid] = (host, self._token)
+                out.append((cid, self._token))
+        return out
+
+    def steal(self, thief, n=1):
+        """Up to ``n`` chunks for a dry host: the reclaim pool (fenced
+        hosts' work) first, then the tail of the slowest live peer's
+        queue. Returns ``[(chunk_id, token, victim_host)]`` — victim is
+        ``-1`` for reclaimed chunks."""
+        thief = int(thief)
+        out = []
+        with self._lock:
+            self._require_epoch_locked()
+            if thief in self._fenced:
+                raise StaleLeaseError(
+                    "host %d is fenced — it must rejoin before stealing "
+                    "data chunks" % thief)
+            while len(out) < int(n):
+                if self._reclaim:
+                    cid = self._reclaim.popleft()
+                    victim = -1
+                else:
+                    victim, q = None, None
+                    for h, hq in self._pending.items():
+                        if h == thief or h in self._fenced or not hq:
+                            continue
+                        if q is None or len(hq) > len(q):
+                            victim, q = h, hq
+                    if q is None:
+                        break
+                    cid = q.pop()  # tail: the work the victim reaches last
+                self._token += 1
+                self._lease[cid] = (thief, self._token)
+                out.append((cid, self._token, victim))
+            if out:
+                self._steals += len(out)
+        return out
+
+    # -- commit (the cursor advance) ---------------------------------------
+    def commit(self, host, chunk_id, token):
+        """Mark ``chunk_id`` consumed under lease ``token``. Exactly-once:
+        a replay with the same token is acknowledged idempotently; a
+        superseded token or a fenced host is refused typed."""
+        host, cid, token = int(host), int(chunk_id), int(token)
+        with self._lock:
+            self._require_epoch_locked()
+            prev = self._done.get(cid)
+            if prev is not None:
+                if prev == token:
+                    return False  # at-least-once replay of our own commit
+                self._stales += 1
+                raise StaleLeaseError(
+                    "chunk %d was already committed under lease "
+                    "generation %d — commit with generation %d is a "
+                    "zombie replay" % (cid, prev, token))
+            if host in self._fenced:
+                self._stales += 1
+                raise StaleLeaseError(
+                    "host %d was fenced (declared dead); its commit of "
+                    "chunk %d under lease generation %d is refused — the "
+                    "chunk was reclaimed for the survivors"
+                    % (host, cid, token))
+            lease = self._lease.get(cid)
+            if lease is None or lease != (host, token):
+                self._stales += 1
+                raise StaleLeaseError(
+                    "chunk %d lease generation %d (host %d) is stale — "
+                    "current lease is %r; the chunk belongs to its new "
+                    "leaseholder" % (cid, token, host, lease))
+            del self._lease[cid]
+            self._done[cid] = token
+            return True
+
+    # -- fencing -----------------------------------------------------------
+    def fence_host(self, host):
+        """Declare ``host`` dead: its pending and leased-uncommitted
+        chunks become stealable by survivors; its committed chunks stay
+        committed. Any later lease/steal/commit from the fenced host is
+        refused typed. Returns the number of chunks reclaimed."""
+        host = int(host)
+        with self._lock:
+            if self._key is None or host in self._fenced:
+                return 0
+            self._fenced.add(host)
+            n = 0
+            q = self._pending.get(host)
+            if q:
+                while q:
+                    self._reclaim.append(q.popleft())
+                    n += 1
+            for cid, (h, _tok) in list(self._lease.items()):
+                if h == host:
+                    # the lease entry stays until re-leased, but the
+                    # chunk is back in the pool; the zombie's commit is
+                    # refused by the fenced-host check either way
+                    del self._lease[cid]
+                    self._reclaim.append(cid)
+                    n += 1
+            return n
+
+    # -- views -------------------------------------------------------------
+    def cursor(self):
+        """Serializable epoch cursor: which chunks are consumed. Rides
+        CheckpointManager's ``extra`` payload (like PR 8's step cursor)
+        so a restarted host resumes mid-epoch without loss or
+        duplication."""
+        with self._lock:
+            self._require_epoch_locked()
+            return {"manifest_id": self._key[0], "epoch": self._key[1],
+                    "committed": sorted(self._done)}
+
+    def restore(self, cursor):
+        """Merge a checkpoint cursor's committed set into the current
+        epoch (same manifest + epoch required, typed otherwise)."""
+        with self._lock:
+            self._require_epoch_locked()
+            if (str(cursor.get("manifest_id")),
+                    int(cursor.get("epoch", -1))) != self._key:
+                raise MXNetError(
+                    "data-plane cursor %r does not match the ledger "
+                    "epoch %r" % (cursor, self._key))
+            for cid in cursor.get("committed", ()):
+                cid = int(cid)
+                if cid in self._done:
+                    continue
+                self._done[cid] = -1
+                self._lease.pop(cid, None)
+                for q in self._pending.values():
+                    try:
+                        q.remove(cid)
+                    except ValueError:
+                        pass
+        return self
+
+    def stats(self):
+        with self._lock:
+            if self._key is None:
+                return {"epoch": None}
+            return {
+                "manifest_id": self._key[0], "epoch": self._key[1],
+                "total": self._total,
+                "committed": len(self._done),
+                "leased": len(self._lease),
+                "reclaimable": len(self._reclaim),
+                "pending": {h: len(q) for h, q in self._pending.items()},
+                "fenced": sorted(self._fenced),
+                "steals": self._steals,
+                "stale_refused": self._stales,
+            }
+
+    def finished(self):
+        """True when every chunk of the epoch is committed."""
+        with self._lock:
+            return self._key is not None and len(self._done) >= self._total
+
+    def idle(self):
+        """True when nothing is pending or reclaimable anywhere — the
+        remaining work (if any) is leased to live hosts. A dry host
+        polls instead of exiting: a late death can still hand it
+        reclaimed chunks."""
+        with self._lock:
+            if self._key is None:
+                return True
+            return not self._reclaim and not any(
+                q for h, q in self._pending.items()
+                if h not in self._fenced)
+
+    # -- wire dispatch (async_server attach_data_plane) --------------------
+    def handle(self, op, key, payload):
+        """One ``data_*`` frame → one reply tuple. StaleLeaseError
+        propagates — the server answers it as a typed ``stale`` reply
+        and the zombie's client raises StaleWorkerError."""
+        del key
+        if op == "data_epoch":
+            manifest_id, epoch, owners, committed = payload
+            fresh = self.begin_epoch(manifest_id, epoch, owners,
+                                     committed=committed or ())
+            return ("ok", fresh)
+        elif op == "data_lease":
+            host, n = payload
+            return ("ok", self.lease(host, n))
+        elif op == "data_steal":
+            host, n = payload
+            return ("ok", self.steal(host, n))
+        elif op == "data_cursor":
+            verb = payload[0]
+            if verb == "commit":
+                _, host, cid, token = payload
+                return ("ok", self.commit(host, cid, token))
+            elif verb == "get":
+                return ("ok", self.cursor())
+            elif verb == "restore":
+                self.restore(payload[1])
+                return ("ok", None)
+            return ("err", "unknown data_cursor verb %r" % (verb,))
+        elif op == "data_stats":
+            return ("ok", self.stats())
+        elif op == "data_fence":
+            return ("ok", self.fence_host(payload))
+        return ("err", "unknown data-plane op %r" % (op,))
+
+
+class RemoteLedger:
+    """Client adapter: the same lease/steal/commit surface as
+    :class:`ChunkLedger`, spoken over an
+    :class:`~mxnet_tpu.async_server.AsyncClient` to the coordinator's
+    attached ledger. Every call rides ``AsyncClient.request`` — i.e.
+    ``kv_retry`` with reconnect, bounded deadline, and the typed
+    ``stale`` reply surfacing as :class:`StaleWorkerError`."""
+
+    def __init__(self, client):
+        self._c = client
+
+    def begin_epoch(self, manifest_id, epoch, owners, committed=()):
+        return self._c.request(
+            "data_epoch", None,
+            (manifest_id, int(epoch), owners, list(committed)))
+
+    def lease(self, host, n=1):
+        return self._c.request("data_lease", None, (int(host), int(n)))
+
+    def steal(self, thief, n=1):
+        return self._c.request("data_steal", None, (int(thief), int(n)))
+
+    def commit(self, host, chunk_id, token):
+        return self._c.request(
+            "data_cursor", None,
+            ("commit", int(host), int(chunk_id), int(token)))
+
+    def cursor(self):
+        return self._c.request("data_cursor", None, ("get",))
+
+    def restore(self, cursor):
+        self._c.request("data_cursor", None, ("restore", cursor))
+        return self
+
+    def stats(self):
+        return self._c.request("data_stats")
+
+    def fence_host(self, host):
+        return self._c.request("data_fence", None, int(host))
+
+    def finished(self):
+        s = self.stats()
+        return s.get("epoch") is not None \
+            and s.get("committed", 0) >= s.get("total", 0)
+
+    def idle(self):
+        s = self.stats()
+        if s.get("epoch") is None:
+            return True
+        fenced = set(s.get("fenced", ()))
+        return not s.get("reclaimable", 0) and not any(
+            n for h, n in s.get("pending", {}).items() if h not in fenced)
+
+    def close(self):
+        self._c.close()

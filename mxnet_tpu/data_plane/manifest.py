@@ -1,0 +1,200 @@
+"""Shard manifest — the recordio keyspace of a streaming epoch
+(ref: src/io/iter_image_recordio_2.cc — ImageRecordIOParser2's
+InputSplit over .rec shards; dmlc InputSplit::Create partitions byte
+ranges, here the partition unit is a *chunk* of indexed records).
+
+A :class:`ShardManifest` describes a dataset as a list of indexed
+recordio shard files and slices their record keys into fixed-size
+**chunks** — the unit of lease, steal, and batch formation for the
+multi-host data plane:
+
+- **Chunks are static.** Chunk ``i`` always covers the same consecutive
+  run of keys inside one shard (sequential read locality), regardless
+  of epoch. Only the *visit order* of chunks and the *record order
+  inside* each chunk are epoch-shuffled.
+
+- **Chunk contents are a pure function of (manifest, seed, epoch).**
+  ``epoch_chunk(cid, epoch, seed)`` derives its intra-chunk permutation
+  from a blake2b hash of (manifest_id, seed, epoch, cid) — NOT from the
+  identity of the host or worker that decodes it. Work stealing can
+  therefore move a chunk between hosts without changing a single byte
+  of the batches it produces: bit-identical batch contents whether the
+  owner or a thief decodes it (the acceptance property the end-to-end
+  test pins).
+
+- **Partitioning needs zero user configuration.** ``owners()`` deals
+  the epoch-shuffled chunk order round-robin across the mesh's hosts;
+  the host count defaults from the launch line (``MXT_NUM_WORKERS`` /
+  ``MXT_MESH_SHAPE`` are both exported by tools/launch.py), so the same
+  script streams on 1 host or a pod.
+
+Batches never cross a chunk boundary, so ``chunk_records`` should be a
+multiple of the batch size (a tail chunk may still be short — it yields
+one short final batch, the reference's ``round_batch=False`` shape).
+"""
+from __future__ import annotations
+
+import glob as _glob
+import hashlib
+import os
+from collections import namedtuple
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["ShardManifest", "Chunk"]
+
+#: One leasable unit of work: a run of record keys inside one shard.
+#: ``keys`` is already in the epoch's intra-chunk visit order when the
+#: chunk came from :meth:`ShardManifest.epoch_chunk`.
+Chunk = namedtuple("Chunk", ["chunk_id", "shard_id", "keys"])
+
+
+def _chunk_seed(manifest_id, seed, epoch, chunk_id=None, tag="order"):
+    """Deterministic 31-bit seed from the (manifest, seed, epoch[, chunk])
+    coordinates — host/worker identity never enters, so a stolen chunk
+    decodes bit-identically on the thief. ``tag`` separates the streams
+    (chunk-order shuffle vs intra-chunk order vs augmentation draws)."""
+    h = hashlib.blake2b(digest_size=4)
+    h.update(manifest_id.encode("utf-8"))
+    h.update(b"|%s|%d|%d" % (tag.encode("utf-8"), int(seed), int(epoch)))
+    if chunk_id is not None:
+        h.update(b"|%d" % int(chunk_id))
+    return int.from_bytes(h.digest(), "little") & 0x7FFFFFFF
+
+
+class ShardManifest:
+    """The record keyspace of a recordio-backed dataset, chunked.
+
+    ``shards`` is a list of ``.rec`` paths (the ``.idx`` sidecar path is
+    derived by extension swap) or ``(rec_path, idx_path)`` pairs. Every
+    shard must be indexed — random seek is what lets a chunk start
+    mid-shard and a rejoined host resume mid-epoch.
+    """
+
+    def __init__(self, shards, chunk_records=None):
+        from ..recordio import MXIndexedRecordIO
+
+        if not shards:
+            raise MXNetError("ShardManifest needs at least one shard")
+        if chunk_records is None:
+            from .. import config
+
+            chunk_records = int(config.get("MXT_DATA_CHUNK_RECORDS"))
+        if chunk_records < 1:
+            raise MXNetError("chunk_records must be >= 1, got %d"
+                             % chunk_records)
+        self.chunk_records = int(chunk_records)
+        self.shards = []
+        for s in shards:
+            if isinstance(s, (tuple, list)):
+                rec, idx = s
+            else:
+                rec = s
+                idx = os.path.splitext(s)[0] + ".idx"
+            if not os.path.isfile(idx):
+                raise MXNetError(
+                    "shard %r has no index sidecar %r — the data plane "
+                    "needs indexed shards (tools/im2rec.py writes them)"
+                    % (rec, idx))
+            r = MXIndexedRecordIO(idx, rec, "r")
+            keys = tuple(r.keys)
+            r.close()
+            if not keys:
+                raise MXNetError("shard %r is empty" % (rec,))
+            self.shards.append({"rec": rec, "idx": idx, "keys": keys})
+        # static chunk table: consecutive key runs per shard
+        self._chunks = []
+        for sid, sh in enumerate(self.shards):
+            keys = sh["keys"]
+            for lo in range(0, len(keys), self.chunk_records):
+                self._chunks.append(
+                    (sid, keys[lo:lo + self.chunk_records]))
+        self.manifest_id = self._fingerprint()
+
+    @classmethod
+    def from_glob(cls, pattern, chunk_records=None):
+        """Manifest over every ``.rec`` matching ``pattern`` (sorted, so
+        all hosts derive the identical shard order from a shared path)."""
+        recs = sorted(_glob.glob(pattern))
+        if not recs:
+            raise MXNetError("no recordio shards match %r" % (pattern,))
+        return cls(recs, chunk_records=chunk_records)
+
+    def _fingerprint(self):
+        """Stable id over shard basenames + record counts + chunking —
+        hosts sharing a lease ledger must agree on the chunk table, and
+        a mismatched manifest is refused typed at ``begin_epoch``."""
+        h = hashlib.blake2b(digest_size=8)
+        for sh in self.shards:
+            h.update(os.path.basename(sh["rec"]).encode("utf-8"))
+            h.update(b"|%d;" % len(sh["keys"]))
+        h.update(b"c%d" % self.chunk_records)
+        return h.hexdigest()
+
+    # -- sizes -------------------------------------------------------------
+    @property
+    def num_records(self):
+        return sum(len(sh["keys"]) for sh in self.shards)
+
+    @property
+    def num_chunks(self):
+        return len(self._chunks)
+
+    def record_ids(self):
+        """Every (shard_id, key) in the manifest — the exactly-once
+        assertion's ground truth."""
+        return [(sid, k) for sid, sh in enumerate(self.shards)
+                for k in sh["keys"]]
+
+    # -- epoch plan --------------------------------------------------------
+    def epoch_order(self, epoch, seed=0):
+        """The epoch's global chunk visit order (seeded shuffle) —
+        identical on every host."""
+        order = np.arange(self.num_chunks)
+        rng = np.random.RandomState(
+            _chunk_seed(self.manifest_id, seed, epoch))
+        rng.shuffle(order)
+        return [int(c) for c in order]
+
+    def epoch_chunk(self, chunk_id, epoch, seed=0):
+        """The chunk with its intra-chunk record order shuffled for this
+        epoch — a pure function of the coordinates, never of the decoding
+        host."""
+        sid, keys = self._chunks[int(chunk_id)]
+        idx = np.arange(len(keys))
+        rng = np.random.RandomState(
+            _chunk_seed(self.manifest_id, seed, epoch, chunk_id))
+        rng.shuffle(idx)
+        return Chunk(int(chunk_id), sid, tuple(keys[i] for i in idx))
+
+    def owners(self, epoch, num_hosts, seed=0):
+        """Deterministic host partition: the epoch-shuffled chunk order
+        dealt round-robin over ``num_hosts``. Every host computes the
+        same table from the shared (manifest, seed, epoch), so the lease
+        ledger's ``begin_epoch`` is idempotent across hosts."""
+        if num_hosts < 1:
+            raise MXNetError("num_hosts must be >= 1, got %d" % num_hosts)
+        order = self.epoch_order(epoch, seed)
+        table = {h: [] for h in range(num_hosts)}
+        for i, cid in enumerate(order):
+            table[i % num_hosts].append(cid)
+        return table
+
+    def chunk_records_of(self, chunk_id):
+        """Record count of one chunk (only the tail chunk of a shard may
+        be short)."""
+        return len(self._chunks[int(chunk_id)][1])
+
+    # -- shard IO ----------------------------------------------------------
+    def open_reader(self, shard_id):
+        """Fresh indexed reader for one shard. One handle per (worker,
+        shard) — neither the Python reader nor the native FILE* is safe
+        to share across seeking threads. The handles pickle cleanly
+        (recordio ``__getstate__``), which is how process-based decode
+        workers would receive them."""
+        from ..recordio import MXIndexedRecordIO
+
+        sh = self.shards[int(shard_id)]
+        return MXIndexedRecordIO(sh["idx"], sh["rec"], "r")

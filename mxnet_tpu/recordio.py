@@ -57,12 +57,17 @@ class MXRecordIO:
         self.close()
 
     def __getstate__(self):
-        """Support pickling across DataLoader worker fork
-        (ref: recordio.py — __getstate__ closes the handle)."""
-        is_open = self.is_open
-        self.close()
+        """Support pickling across process workers — how the data
+        plane's decode fleet would receive shard handles. The reference
+        (recordio.py — __getstate__) CLOSED the live handle because it
+        held a C pointer; a Python file handle just needs excluding, so
+        pickling an OPEN reader no longer kills the parent's handle (a
+        parent that ships a reader to N workers keeps reading). An open
+        writer is flushed first so the clone observes its bytes; note a
+        writer clone reopens with truncating "w", reference semantics."""
+        if self.is_open and self.writable:
+            self.handle.flush()
         d = dict(self.__dict__)
-        d["is_open"] = is_open
         del d["handle"]
         return d
 
@@ -143,6 +148,8 @@ class MXIndexedRecordIO(MXRecordIO):
         super().close()
 
     def __getstate__(self):
+        if self.fidx is not None:
+            self.fidx.flush()
         d = super().__getstate__()
         d.pop("fidx", None)
         return d

@@ -64,6 +64,23 @@ def test_example_runs(script, tmp_path, monkeypatch):
     runpy.run_path(os.path.join(REPO_ROOT, script), run_name="__main__")
 
 
+@pytest.mark.slow
+def test_example_imagenet_streaming_input(tmp_path, monkeypatch):
+    """The --streaming-input path: the same example feeds the sharded
+    step through the data plane (chunk-leased decode fleet) instead of
+    the per-process ImageRecordIter — tier-1 covers the default path;
+    this rides the slow tier to avoid a second ResNet compile."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(sys, "argv", [
+        "examples/train_imagenet_resnet.py", "--synthetic", "--iters",
+        "2", "--batch-size", "8", "--image-shape", "3,32,32",
+        "--dtype", "float32", "--streaming-input", "--telemetry"])
+    runpy.run_path(
+        os.path.join(REPO_ROOT, "examples/train_imagenet_resnet.py"),
+        run_name="__main__")
+    assert os.path.exists(str(tmp_path / "imagenet_telemetry.jsonl"))
+
+
 def test_example_mnist_gluon_converges(tmp_path, monkeypatch, capsys):
     """Train-tier bar on the canonical Gluon example (the synthetic
     fallback is a LEARNABLE prototype task, so accuracy is a real

@@ -148,5 +148,29 @@ for seed in "${SEEDS[@]}"; do
     fi
 done
 
+# -- streaming data-plane sweep -----------------------------------------------
+# data_host_kill / data_worker_slow: the chaos-marked cells in
+# tests/test_data_plane.py kill one in-process host's decode fleet at a
+# chunk boundary mid-epoch (survivors steal its reclaimed chunks and
+# the epoch completes with 0 lost / 0 duplicated records; the zombie's
+# stale-lease commit is refused typed) and slow one host's decode until
+# its peer's steal fires — bounded, never a hang; the outer `timeout`
+# is only the backstop.
+for seed in "${SEEDS[@]}"; do
+    echo "== data-plane sweep: MXT_CHAOS_SEED=$seed (cell timeout ${CELL_TIMEOUT}s)"
+    timeout -k 10 "$CELL_TIMEOUT" env JAX_PLATFORMS=cpu \
+        MXT_CHAOS_SEED="$seed" \
+        python -m pytest tests/test_data_plane.py -q -m "chaos and not slow" \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    rc=$?
+    if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+        echo "!! HANG: data-plane sweep seed=$seed exceeded ${CELL_TIMEOUT}s" >&2
+        fail=1
+    elif [ "$rc" -ne 0 ]; then
+        echo "!! FAIL: data-plane sweep seed=$seed rc=$rc" >&2
+        fail=1
+    fi
+done
+
 [ "$fail" -eq 0 ] && echo "chaos matrix: all seeds clean"
 exit "$fail"

@@ -108,6 +108,19 @@ SCAN = {
     "mxnet_tpu/embedding/cache.py": _ALL,
     "mxnet_tpu/embedding/client.py": _ALL,
     "mxnet_tpu/embedding/store.py": _ALL,
+    # the streaming data plane: decode WORKERS do host-side numpy by
+    # design (that layer is the one place host memory is supposed to be
+    # touched — JPEG decode + augment + batchify), so their intentional
+    # host reads are sync-ok annotated at the worker boundary. The FEED
+    # path (loader.py into _DevicePrefetcher) and the lease ledger
+    # (host-integer bookkeeping + wire frames) must stay lint-clean: a
+    # stray device read there re-serializes the consumer against every
+    # batch.
+    "mxnet_tpu/data_plane/__init__.py": _ALL,
+    "mxnet_tpu/data_plane/manifest.py": _ALL,
+    "mxnet_tpu/data_plane/ledger.py": _ALL,
+    "mxnet_tpu/data_plane/workers.py": _ALL,
+    "mxnet_tpu/data_plane/loader.py": _ALL,
     "mxnet_tpu/serving/__init__.py": _ALL,
     "mxnet_tpu/serving/engine.py": _ALL,
     "mxnet_tpu/serving/scheduler.py": _ALL,

@@ -405,6 +405,24 @@ def render(samples, prev, dt):
             if age is not None:
                 fleet_ages[m] = age
 
+    # data-plane section (mxnet_tpu/data_plane/): only rendered when a
+    # streaming loader's decode fleet has published its host-labeled
+    # gauges — a per-process-iterator trainer shows no data noise.
+    # Per-host rec/s + data_wait share is the input-boundness
+    # attribution: the host whose wait share grows is the one starving.
+    data_hosts = sorted({dict(lab).get("host")
+                         for (n, lab), v in samples.items()
+                         if n == "mxt_data_records_per_second"} - {None})
+    data_steals = metric_sum(samples, "mxt_data_steals_total")
+    data_stale = metric_sum(samples, "mxt_data_stale_leases_total")
+    data_bytes_rate, _ = rate("mxt_data_bytes_total")
+    data_rps = {h: metric_sum(samples, "mxt_data_records_per_second",
+                              host=h) for h in data_hosts}
+    data_q = {h: metric_sum(samples, "mxt_data_queue_depth", host=h)
+              for h in data_hosts}
+    data_wait = {h: rate("mxt_data_wait_seconds_total", host=h)[0]
+                 for h in data_hosts}
+
     # serving section (mxnet_tpu/serving/): only rendered when the
     # process has served — a pure trainer shows no serving noise
     tok_rate, tok_total = rate("mxt_serving_tokens_total")
@@ -513,6 +531,21 @@ def render(samples, prev, dt):
                _fmt(flt_fail, "%.0f"), _fmt(flt_stale, "%.0f")),
             "  routed p50/p99   %s / %s"
             % (_fmt_s(flt_p50), _fmt_s(flt_p99)),
+        ]
+    if data_hosts:
+        lines += [
+            "-" * 46,
+            "  data rec/s       %s   bytes/s %s"
+            % ("  ".join("h%s %s" % (h, _fmt(data_rps[h], "%.0f"))
+                         for h in data_hosts),
+               _fmt_b(data_bytes_rate)),
+            "  data queue       %s   steals %s   stale refused %s"
+            % ("  ".join("h%s %s" % (h, _fmt(data_q[h], "%.0f"))
+                         for h in data_hosts),
+               _fmt(data_steals, "%.0f"), _fmt(data_stale, "%.0f")),
+            "  data_wait share  %s"
+            % "  ".join("h%s %s" % (h, _fmt(data_wait[h], "%.3f"))
+                        for h in data_hosts),
         ]
     if tok_total is not None:
         lines += [
