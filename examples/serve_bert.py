@@ -37,6 +37,18 @@ per byte of pool). Both compose with every other flag::
 
     python examples/serve_bert.py --draft-k 4 --quantize-kv --ab
     python examples/serve_bert.py --draft-k 4 --replicas 2 --kill-one
+
+`--prefix-cache` turns on shared-prefix KV reuse (prompts get a common
+system preamble; repeat admissions enter the cached pages by reference
+and prefill only their suffix — watch the hit ratio and copy-on-write
+count it prints), and `--prefill-replicas N` splits an N+M fleet into
+prefill/decode tiers: long prompts prefill on the prefill tier and
+their KV pages ship over the transport to a decode replica
+(`srv_ship_pages`/`srv_adopt_pages`)::
+
+    python examples/serve_bert.py --prefix-cache --ab
+    python examples/serve_bert.py --prefix-cache --replicas 3 \\
+        --prefill-replicas 1
 """
 from __future__ import annotations
 
@@ -48,20 +60,31 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def make_traffic(n, seed, vocab, deadline, max_new=48):
+def make_traffic(n, seed, vocab, deadline, max_new=48, system=None):
     import numpy as np
 
     from mxnet_tpu import serving
 
     rng = np.random.RandomState(seed)
     reqs = []
-    for _ in range(n):
+    for i in range(n):
         plen = int(rng.randint(4, 97))       # mixed-length prompts
         mnew = int(rng.randint(8, max(9, max_new + 1)))  # mixed budgets
-        reqs.append(serving.Request(
-            rng.randint(1, vocab, plen).tolist(), max_new_tokens=mnew,
-            deadline=deadline))
+        prompt = rng.randint(1, vocab, plen).tolist()
+        if system is not None and i % 2:     # half share the preamble
+            prompt = system + prompt
+        reqs.append(serving.Request(prompt, max_new_tokens=mnew,
+                                    deadline=deadline))
     return reqs
+
+
+def _counter(name):
+    from mxnet_tpu import telemetry
+
+    fam = telemetry.registry().get(name)
+    if fam is None:
+        return 0.0
+    return float(sum(ch.value for ch in fam.children().values()))
 
 
 def run(batcher_cls, engine, requests, label):
@@ -127,6 +150,19 @@ def main():
     p.add_argument("--quantize-kv", action="store_true",
                    help="serve from int8-quantized KV pages (per-row "
                         "scales; ~4x resident sequences per pool byte)")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="shared-prefix KV reuse: half the traffic gets "
+                        "a common system preamble; repeat admissions "
+                        "enter its cached pages by reference "
+                        "(refcounted, copy-on-write at divergence) and "
+                        "prefill only their suffix")
+    p.add_argument("--prefill-replicas", type=int, default=0,
+                   metavar="N",
+                   help="with --replicas: run N replicas in the "
+                        "PREFILL role (the rest decode) — long prompts "
+                        "prefill there and their finished KV pages "
+                        "ship over the transport to a decode replica "
+                        "(srv_ship_pages/srv_adopt_pages)")
     p.add_argument("--watchdog", type=float, nargs="?", const=30.0,
                    default=None, metavar="SECONDS",
                    help="arm the diagnostics layer (flight recorder + "
@@ -137,6 +173,10 @@ def main():
                         "MXT_WATCHDOG_ACTION=abort makes the replica "
                         "die typed so a supervisor respawns it")
     args = p.parse_args()
+
+    if args.prefix_cache and args.draft_k:
+        p.error("--prefix-cache rides the plain engine's fused "
+                "suffix admission; drop --draft-k")
 
     if args.telemetry:
         os.environ["MXT_TELEMETRY_JSONL"] = args.telemetry
@@ -176,10 +216,13 @@ def main():
                     quantized=args.quantize_kv),
                 prefill_buckets=(64, 128), max_context=256)
         else:
+            buckets = (64, 128, 192) if args.prefix_cache \
+                else (64, 128)
             eng = serving.DecodeEngine(model, params=params,
                                        slots=args.slots, cache=cache,
-                                       prefill_buckets=(64, 128),
-                                       max_context=256)
+                                       prefill_buckets=buckets,
+                                       max_context=256,
+                                       prefix_cache=args.prefix_cache)
         t0 = time.perf_counter()
         n = eng.aot_warmup()
         print("aot_warmup: %d request-path programs in %.1fs "
@@ -188,9 +231,22 @@ def main():
               % (n, time.perf_counter() - t0))
         return eng
 
-    if args.replicas > 1 or args.kill_one or args.fleet_top:
-        n = max(2 if args.kill_one else 1, args.replicas)
-        pool, coord = serving.local_serving_fleet(n, engine)
+    import numpy as np
+
+    system = (np.random.RandomState(3).randint(1, 512, 64).tolist()
+              if args.prefix_cache else None)
+
+    if args.replicas > 1 or args.kill_one or args.fleet_top \
+            or args.prefill_replicas:
+        n = max(2 if args.kill_one else 1, args.replicas,
+                args.prefill_replicas + 1)
+        roles = None
+        if args.prefill_replicas:
+            roles = (["prefill"] * args.prefill_replicas
+                     + ["decode"] * (n - args.prefill_replicas))
+            print("fleet roles: %s" % " ".join(roles))
+        pool, coord = serving.local_serving_fleet(n, engine,
+                                                  roles=roles)
         router = serving.FleetRouter(pool, slo=args.deadline)
         collector = None
         if args.fleet_top:
@@ -200,15 +256,17 @@ def main():
             telemetry_fleet.set_default_collector(collector)
             collector.refresh()
             collector.start(interval=0.2)
-        rng = __import__("numpy").random.RandomState(7)
+        rng = np.random.RandomState(7)
         t0 = time.perf_counter()
         reqs = []
         for i in range(args.requests):
             plen = int(rng.randint(4, 97))
             mnew = int(rng.randint(8, max(9, args.max_new + 1)))
+            prompt = rng.randint(1, 512, plen).tolist()
+            if system is not None and i % 2:
+                prompt = system + prompt
             reqs.append(router.submit(
-                rng.randint(1, 512, plen).tolist(),
-                max_new_tokens=mnew, deadline=args.deadline,
+                prompt, max_new_tokens=mnew, deadline=args.deadline,
                 token="req-%d" % i))
         if args.kill_one:
             while router.step() and router.steps < 8:
@@ -235,6 +293,18 @@ def main():
                  {h.index: sum(1 for r in done
                                if r.committed_by == h.index)
                   for h in pool.replicas()}))
+        if args.prefix_cache:
+            hits = _counter("mxt_serving_prefix_hits_total")
+            miss = _counter("mxt_serving_prefix_misses_total")
+            print("   prefix: hit %.3f (%d/%d)   cow %d"
+                  % (hits / max(1.0, hits + miss), hits, hits + miss,
+                     _counter("mxt_serving_cow_copies_total")))
+        if args.prefill_replicas:
+            print("   handoff: %d pages shipped, %d adopted, %.1f KiB "
+                  "over the wire"
+                  % (_counter("mxt_serving_pages_shipped_total"),
+                     _counter("mxt_serving_pages_adopted_total"),
+                     _counter("mxt_serving_ship_bytes_total") / 1024))
         if collector is not None:
             from mxnet_tpu import telemetry_fleet
 
@@ -279,12 +349,18 @@ def main():
 
     cont = run(serving.ContinuousBatcher, engine(),
                make_traffic(args.requests, 7, 512, args.deadline,
-                            args.max_new),
+                            args.max_new, system=system),
                "continuous")
+    if args.prefix_cache:
+        hits = _counter("mxt_serving_prefix_hits_total")
+        miss = _counter("mxt_serving_prefix_misses_total")
+        print("prefix: hit %.3f (%d/%d)   cow %d"
+              % (hits / max(1.0, hits + miss), hits, hits + miss,
+                 _counter("mxt_serving_cow_copies_total")))
     if args.ab:
         stat = run(serving.StaticBatcher, engine(),
                    make_traffic(args.requests, 7, 512, args.deadline,
-                                args.max_new),
+                                args.max_new, system=system),
                    "static    ")
         if stat:
             print("continuous batching speedup: %.2fx" % (cont / stat))

@@ -352,11 +352,13 @@ class AsyncParamServer:
 
     def attach_serving(self, host):
         """Host a standalone serving replica's front door on this
-        server: every ``srv_*`` frame (submit/cancel/poll/load/drain —
-        serving/fleet.py ServingHost) dispatches to it. Serving ops
-        carry no membership credential — the fencing that matters for
-        the fleet is router-side (a fenced replica's late reply is
-        refused typed at the accept gate)."""
+        server: every ``srv_*`` frame (submit/cancel/poll/load/drain,
+        plus the disaggregation pair ship_pages/adopt_pages that moves
+        finished prefill KV pages between replicas — serving/fleet.py
+        ServingHost) dispatches to it. Serving ops carry no membership
+        credential — the fencing that matters for the fleet is
+        router-side (a fenced replica's late reply is refused typed at
+        the accept gate)."""
         self.serving = host
         return host
 

@@ -267,6 +267,14 @@ _declare("MXT_FLEET_HEDGE_BUDGET", int, None,
          "double the fleet's work. 0 disables hedging; unset derives "
          "max(1, fleet slot capacity // 4).")
 
+_declare("MXT_FLEET_PREFILL_THRESHOLD", int, 64,
+         "Prompt length (tokens) at which the fleet router dispatches "
+         "a request through the disaggregated prefill/decode handoff "
+         "(serving/router.py): prefill on a prefill-role replica, KV "
+         "pages shipped over the transport, adopted into a decode-role "
+         "replica. Shorter prompts route straight to the decode tier; "
+         "pools without both roles always dispatch directly.")
+
 _declare("MXT_FLEET_SCRAPE_TIMEOUT", float, 5.0,
          "Per-member transport deadline in seconds for the fleet "
          "telemetry collector's tel_snapshot/tel_spans scrapes "

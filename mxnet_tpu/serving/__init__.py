@@ -27,6 +27,13 @@ production-traffic half:
   quantized=True)`` for int8 KV pages (~4x resident sequences per
   byte) and ``TinyDecoder.quantize_params`` for weight-only int8
   decode matmuls routed per shape by ``tuning.resolve_quant``.
+- :mod:`~mxnet_tpu.serving.prefix` — :class:`PrefixIndex`:
+  shared-prefix KV reuse. Prompts are hashed at admission in
+  page-aligned chunks (a blake2b chain); a hit points the new
+  sequence's page table at the already-resident pages (per-page
+  refcounts in :class:`PagedKVCache`, copy-on-write on divergence)
+  and prefill starts at the first non-shared token. Enable with
+  ``DecodeEngine(..., prefix_cache=True)``.
 - :mod:`~mxnet_tpu.serving.metrics` — SLO metrics
   (``mxt_serving_*``) through the PR-5 telemetry registry;
   ``tools/mxt_top.py`` renders them live.
@@ -38,6 +45,11 @@ production-traffic half:
   transparent failover on replica death (idempotency tokens — a
   replayed completed request never re-decodes), graceful drain +
   AOT-warm rejoin, and typed refusal of fenced zombies' late replies.
+  Replicas may run role-split (``role="prefill"`` / ``"decode"``):
+  long prompts prefill on the prefill tier, the finished KV pages
+  ship over the transport (``srv_ship_pages`` / ``srv_adopt_pages``)
+  and the request enters decode with zero prefill work on the decode
+  tier.
 
 Minimal use::
 
@@ -68,13 +80,14 @@ from .fleet import (LocalReplica, RemoteReplica, ReplicaPool,
                     serve_replica)
 from .kv_cache import PagedKVCache
 from .model import TinyDecoder
+from .prefix import PrefixIndex
 from .router import FleetRouter, RoutedRequest
 from .scheduler import ContinuousBatcher, Request, StaticBatcher
 from .speculative import SpeculativeEngine
 from . import metrics
 
 __all__ = ["DecodeEngine", "SpeculativeEngine", "PagedKVCache",
-           "TinyDecoder",
+           "PrefixIndex", "TinyDecoder",
            "ContinuousBatcher", "Request", "StaticBatcher", "metrics",
            "FleetRouter", "RoutedRequest", "ReplicaPool", "LocalReplica",
            "RemoteReplica", "ServingHost", "StaleReplicaError",

@@ -116,7 +116,8 @@ class DecodeEngine:
     tokens_per_step = 1
 
     def __init__(self, model, params=None, slots=None, cache=None,
-                 prefill_buckets=(64, 256), max_context=None, seed=0):
+                 prefill_buckets=(64, 256), max_context=None, seed=0,
+                 prefix_cache=False):
         import jax
         import jax.numpy as jnp
 
@@ -167,6 +168,16 @@ class DecodeEngine:
         self._buckets = sorted({self._round_bucket(b)
                                 for b in prefill_buckets})
         self._admit_fns = {}
+        # shared-prefix reuse (serving/prefix.py): opt-in because the
+        # speculative subclass and draft caches don't compose with
+        # page sharing (the verify overshoot writes into prompt pages)
+        self.prefix = None
+        if prefix_cache:
+            from .prefix import PrefixIndex
+
+            self.prefix = PrefixIndex(self.cache)
+        self._prefix_admit_fns = {}
+        self._adopt_fns = {}
         tuning.register_step(self)
         # diagnostics HBM ledger: the replica's weights (the KV pool
         # registers itself in PagedKVCache). Host arithmetic on shape
@@ -266,12 +277,37 @@ class DecodeEngine:
         itself."""
         return [int(row[slot])]
 
-    def can_admit(self, total_tokens):
+    def can_admit(self, total_tokens, prompt=None):
         """Whether admission-side page reservations for a request of
         ``total_tokens`` (prompt + max_new) would succeed right now —
         the scheduler's gate. Covers the engine's reservation slack and
-        (in the speculative subclass) the draft cache too."""
-        return self.cache.can_reserve(total_tokens + self._reserve_slack)
+        (in the speculative subclass) the draft cache too. With a
+        prefix index and the prompt in hand, a cached prefix discounts
+        the page bill, and under pool pressure cold index entries are
+        shed (LRU) before giving up — index-pinned pages are capacity,
+        not a leak."""
+        total = total_tokens + self._reserve_slack
+        if self.prefix is None or prompt is None:
+            return self.cache.can_reserve(total)
+        pages, covered, chain = self.prefix.lookup(prompt)
+        shared, cow, _ = self._share_plan(len(prompt), pages, covered)
+        need = self.cache.pages_needed(total) - len(shared) + cow
+        if self.cache.available() >= need:
+            return True
+        keep = chain[:len(shared)] if shared else ()
+        return self.prefix.trim(need, keep=keep)
+
+    def _share_plan(self, T, pages, covered):
+        """(shared_pages, cow_debt, start) for a prefix-index hit on a
+        ``T``-token prompt: a partial hit prefills from the first
+        uncovered token; a FULL match (page-aligned prompt entirely
+        cached) still recomputes the last token — its K/V write lands
+        in the final shared page, which is the one copy-on-write."""
+        if not covered:
+            return [], 0, 0
+        if covered >= T:
+            return list(pages), 1, T - 1
+        return list(pages), 0, covered
 
     def flush(self):
         """Drain the in-flight window (every dispatched step's tokens
@@ -359,7 +395,10 @@ class DecodeEngine:
         """Prefill a request into a free slot: reserve its worst-case
         pages, then ONE fused dispatch runs the bucketed prompt pass,
         scatters the prompt K/V into the pool, and seeds the slot with
-        the first sampled token.
+        the first sampled token. With a prefix index, a cached prefix
+        routes through the fused SUFFIX program instead — shared pages
+        enter the page table by reference and only the uncovered tail
+        is computed.
 
         Returns a PendingValue of that first token — deferred like
         everything else; the scheduler materializes it at a retirement
@@ -368,6 +407,15 @@ class DecodeEngine:
 
         from ..ndarray.pending import PendingValue
 
+        if self.prefix is not None:
+            prompt = np.array(list(prompt_tokens), np.int32)
+            pages, covered, chain = self.prefix.lookup(prompt)
+            if covered:
+                self.prefix.hit()
+                return self._admit_with_prefix(
+                    slot, seq_id, prompt, max_new_tokens,
+                    pages, covered, chain)
+            self.prefix.miss()
         p = self._admit_prep(slot, seq_id, prompt_tokens, max_new_tokens)
         try:
             kv, self._pt, self._tokens, self._ctx, tok0 = \
@@ -388,6 +436,160 @@ class DecodeEngine:
         self._host_active[slot] = True
         self._host_len[slot] = p["T"]
         _m.tokens_total().inc()  # the prefill-sampled first token
+        if self.prefix is not None:
+            self.prefix.register(p["prompt"],
+                                 self.cache.pages_of(seq_id))
+        return PendingValue(tok0)
+
+    # -- shared-prefix admission ------------------------------------------
+    @staticmethod
+    def _pre_bucket(npre):
+        """Prefix-gather page-count bucket (next power of two): bounds
+        the number of fused suffix programs compiled per suffix
+        bucket."""
+        b = 1
+        while b < npre:
+            b *= 2
+        return b
+
+    def _prefix_admit_impl(self, params, kv, pt, tokens, ctx, padded,
+                           valid, start, pre_ids, page_arr, slot_arr,
+                           cow_src, cow_dst, row, slot, t, *, bucket,
+                           pre_pages):
+        """The whole device side of a prefix-HIT admission as ONE
+        program: the copy-on-write page copy (a scratch self-copy when
+        unused), the prefix page gather (+dequantization on quantized
+        pools), the suffix prompt pass attending the reused prefix, a
+        token-wise scatter of the suffix K/V into the sequence's own
+        pages, and the slot-state commit."""
+        import jax.numpy as jnp
+
+        model = self.model
+        S = self.cache.page_size
+        # 1) COW: the diverging sequence's private copy of its last
+        # shared page — BEFORE the gather, so a full-match admission
+        # gathers its own copy
+        kv = tuple(a.at[:, cow_dst].set(a[:, cow_src]) for a in kv)
+        # 2) gather the reused prefix, dequantizing int8 pools back to
+        # compute dtype (masked columns never contribute)
+        kpre = kv[0][:, pre_ids]      # (L, preb, S, H, D)
+        vpre = kv[1][:, pre_ids]
+        if self.cache.quantized:
+            kpre = kpre.astype(jnp.float32) \
+                * (kv[2][:, pre_ids] * (1.0 / 127.0))[..., None]
+            vpre = vpre.astype(jnp.float32) \
+                * (kv[3][:, pre_ids] * (1.0 / 127.0))[..., None]
+        else:
+            kpre = kpre.astype(jnp.float32)
+            vpre = vpre.astype(jnp.float32)
+        L, H, D = model.num_layers, model.num_heads, model.head_dim
+        kpre = kpre.reshape(L, pre_pages * S, H, D)
+        vpre = vpre.reshape(L, pre_pages * S, H, D)
+        # 3) suffix pass against the resident prefix
+        ks, vs, logits = model.prefill_with_prefix(
+            params, padded, valid, start, kpre, vpre)
+        kr = jnp.transpose(ks[:, 0], (0, 2, 1, 3))  # (L, bucket, H, D)
+        vr = jnp.transpose(vs[:, 0], (0, 2, 1, 3))
+        # 4) ONE token-wise scatter of the suffix rows (padded tail
+        # tokens route to the scratch page)
+        if self.cache.quantized:
+            kq, ka = self.cache._quantize(kr)
+            vq, va = self.cache._quantize(vr)
+            kv = (kv[0].at[:, page_arr, slot_arr].set(kq),
+                  kv[1].at[:, page_arr, slot_arr].set(vq),
+                  kv[2].at[:, page_arr, slot_arr].set(ka),
+                  kv[3].at[:, page_arr, slot_arr].set(va))
+        else:
+            kv = (kv[0].at[:, page_arr, slot_arr].set(
+                      kr.astype(kv[0].dtype)),
+                  kv[1].at[:, page_arr, slot_arr].set(
+                      vr.astype(kv[1].dtype)))
+        tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (1,)
+        return (kv, pt.at[slot].set(row), tokens.at[slot].set(tok0[0]),
+                ctx.at[slot].set(t), tok0)
+
+    def _prefix_admit_fn(self, bucket, pre_pages):
+        import jax
+
+        key = (bucket, pre_pages)
+        fn = self._prefix_admit_fns.get(key)
+        if fn is None:
+            fn = self._prefix_admit_fns[key] = jax.jit(
+                functools.partial(self._prefix_admit_impl,
+                                  bucket=bucket, pre_pages=pre_pages),
+                donate_argnums=(1, 2, 4))
+        return fn
+
+    def _admit_with_prefix(self, slot, seq_id, prompt, max_new_tokens,
+                           pages, covered, chain):
+        """Host half + dispatch of a prefix-hit admission: shared
+        reservation (the cached pages join the page table by
+        reference), the COW bookkeeping, suffix scatter coordinates,
+        and the fused suffix program."""
+        import jax.numpy as jnp
+
+        from ..ndarray.pending import PendingValue
+
+        if self._host_active[slot] or slot in self._seq_of_slot:
+            raise MXNetError("slot %d is occupied" % slot)
+        T = int(prompt.shape[0])
+        total = T + int(max_new_tokens)
+        if total > self.max_context:
+            raise MXNetError(
+                "prompt+max_new = %d exceeds the engine's max context %d"
+                % (total, self.max_context))
+        shared, cow, start = self._share_plan(T, pages, covered)
+        if not self.cache.reserve(seq_id, total + self._reserve_slack,
+                                  shared=shared, cow=cow):
+            raise MXNetError("KV pool too busy for sequence %r (check "
+                             "engine.can_admit before admitting)"
+                             % (seq_id,))
+        S = self.cache.page_size
+        scratch = self.cache.scratch_page
+        cow_src = cow_dst = scratch  # self-copy when no COW needed
+        if cow:
+            cow_src, cow_dst = self.cache.cow_page(seq_id,
+                                                   len(shared) - 1)
+        self.cache.alloc_for(seq_id, T)
+        seq_pages = self.cache.pages_of(seq_id)
+        Tsuf = T - start
+        bucket = self._bucket_for(Tsuf)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :Tsuf] = prompt[start:]
+        npre = -(-start // S)  # pages holding positions [0, start)
+        preb = self._pre_bucket(npre)
+        pre_ids = np.full((preb,), scratch, np.int32)
+        pre_ids[:npre] = seq_pages[:npre]
+        page_arr = np.full((bucket,), scratch, np.int32)
+        slot_arr = np.zeros((bucket,), np.int32)
+        for i in range(Tsuf):
+            pos = start + i
+            page_arr[i] = seq_pages[pos // S]
+            slot_arr[i] = pos % S
+        slot_arr[Tsuf:] = np.arange(bucket - Tsuf) % S  # scratch spread
+        row = self.cache.page_table_row(seq_id, self.table_width)
+        try:
+            kv, self._pt, self._tokens, self._ctx, tok0 = \
+                self._prefix_admit_fn(bucket, preb)(
+                    self.params, self.cache.state(), self._pt,
+                    self._tokens, self._ctx, jnp.asarray(padded),
+                    jnp.asarray(np.array([Tsuf], np.int32)),
+                    np.int32(start), jnp.asarray(pre_ids),
+                    jnp.asarray(page_arr), jnp.asarray(slot_arr),
+                    np.int32(cow_src), np.int32(cow_dst),
+                    jnp.asarray(row), np.int32(slot), np.int32(T))
+        except Exception as e:  # noqa: BLE001 — OOM gets the HBM ledger
+            from .. import diagnostics
+
+            self.cache.free(seq_id)  # drops the shared refs too
+            diagnostics.reraise_if_oom(e, "serving_prefill")
+            raise
+        self.cache.swap(kv)
+        self._seq_of_slot[slot] = seq_id
+        self._host_active[slot] = True
+        self._host_len[slot] = T
+        _m.tokens_total().inc()  # the prefill-sampled first token
+        self.prefix.register(prompt, seq_pages, chain)
         return PendingValue(tok0)
 
     def _post_reserve(self, seq_id, total):
@@ -425,6 +627,127 @@ class DecodeEngine:
         if seq is not None:
             self.cache.free(seq)
         self._host_len[slot] = 0
+
+    # -- disaggregated prefill -> decode handoff --------------------------
+    def export_pages(self, seq_id):
+        """Materialize a resident sequence's KV pages as host arrays —
+        the payload a PREFILL-role replica ships to a decode replica
+        (serving/fleet.py srv_ship_pages). This is a deliberate
+        device->host transfer: the handoff crosses the network, so the
+        pages must become wire bytes here, exactly like the embedding
+        store's row push — a serialization boundary, not a decode-loop
+        sync."""
+        pages = self.cache.pages_of(seq_id)
+        ids = np.array(pages, np.int32)
+        out = {
+            "npages": len(pages),
+            "quantized": self.cache.quantized,
+            "k": np.asarray(self.cache.k_pages[:, ids]),  # sync-ok: handoff serialization boundary (wire payload)
+            "v": np.asarray(self.cache.v_pages[:, ids]),  # sync-ok: handoff serialization boundary (wire payload)
+        }
+        if self.cache.quantized:
+            out["ks"] = np.asarray(self.cache.k_scales[:, ids])  # sync-ok: handoff wire payload
+            out["vs"] = np.asarray(self.cache.v_scales[:, ids])  # sync-ok: handoff wire payload
+        return out
+
+    def _adopt_impl(self, kv, pt, tokens, ctx, k_rows, v_rows, ks_rows,
+                    vs_rows, ids, row, slot, t, tok0):
+        """Install SHIPPED pages raw (already in pool storage dtype —
+        no re-quantization, so adopted state is bit-identical to the
+        prefill replica's) plus the slot-state commit, as one
+        program."""
+        kv0 = kv[0].at[:, ids].set(k_rows)
+        kv1 = kv[1].at[:, ids].set(v_rows)
+        if self.cache.quantized:
+            kv = (kv0, kv1, kv[2].at[:, ids].set(ks_rows),
+                  kv[3].at[:, ids].set(vs_rows))
+        else:
+            kv = (kv0, kv1)
+        return (kv, pt.at[slot].set(row), tokens.at[slot].set(tok0),
+                ctx.at[slot].set(t))
+
+    def _adopt_fn(self, nbp):
+        import jax
+
+        fn = self._adopt_fns.get(nbp)
+        if fn is None:
+            fn = self._adopt_fns[nbp] = jax.jit(
+                self._adopt_impl, donate_argnums=(0, 1, 3))
+        return fn
+
+    def adopt(self, slot, seq_id, prompt_len, max_new_tokens, payload,
+              first_token):
+        """Adopt a prefill replica's shipped KV pages into a free slot:
+        reserve + allocate as a normal admission would, then ONE fused
+        dispatch installs the page payload and commits the slot state.
+        The request enters decode with ZERO prefill work here — its
+        first sampled token (``first_token``) already rode the wire as
+        a host int, so adoption returns nothing deferred."""
+        import jax.numpy as jnp
+
+        if self._host_active[slot] or slot in self._seq_of_slot:
+            raise MXNetError("slot %d is occupied" % slot)
+        T = int(prompt_len)
+        total = T + int(max_new_tokens)
+        if T < 1:
+            raise MXNetError("empty prompt")
+        if total > self.max_context:
+            raise MXNetError(
+                "prompt+max_new = %d exceeds the engine's max context %d"
+                % (total, self.max_context))
+        if bool(payload.get("quantized")) != self.cache.quantized:
+            raise MXNetError("shipped pages are %squantized but this "
+                             "pool is %squantized"
+                             % ("" if payload.get("quantized") else "un",
+                                "" if self.cache.quantized else "un"))
+        if not self.cache.reserve(seq_id, total + self._reserve_slack):
+            raise MXNetError("KV pool too busy for sequence %r (check "
+                             "engine.can_admit before admitting)"
+                             % (seq_id,))
+        try:
+            self.cache.alloc_for(seq_id, T)
+            pages = self.cache.pages_of(seq_id)
+            npages = int(payload["npages"])
+            if npages != len(pages):
+                raise MXNetError(
+                    "shipped payload covers %d pages but a %d-token "
+                    "prompt needs %d" % (npages, T, len(pages)))
+            nbp = self._bucket_for(T) // self.cache.page_size
+            ids = np.full((nbp,), self.cache.scratch_page, np.int32)
+            ids[:npages] = pages
+
+            def pad(a):
+                if a.shape[1] == nbp:
+                    return a
+                w = np.zeros((a.shape[0], nbp) + a.shape[2:], a.dtype)
+                w[:, :npages] = a
+                return w
+
+            args = [self.cache.state(), self._pt, self._tokens,
+                    self._ctx, jnp.asarray(pad(payload["k"])),
+                    jnp.asarray(pad(payload["v"]))]
+            if self.cache.quantized:
+                args += [jnp.asarray(pad(payload["ks"])),
+                         jnp.asarray(pad(payload["vs"]))]
+            else:
+                z = np.zeros((1,), np.float32)
+                args += [jnp.asarray(z), jnp.asarray(z)]  # unused
+            row = self.cache.page_table_row(seq_id, self.table_width)
+            args += [jnp.asarray(ids), jnp.asarray(row), np.int32(slot),
+                     np.int32(T), np.int32(int(first_token))]
+            kv, self._pt, self._tokens, self._ctx = \
+                self._adopt_fn(nbp)(*args)
+        except Exception as e:  # noqa: BLE001 — OOM gets the HBM ledger
+            from .. import diagnostics
+
+            self.cache.free(seq_id)
+            diagnostics.reraise_if_oom(e, "serving_adopt")
+            raise
+        self.cache.swap(kv)
+        self._seq_of_slot[slot] = seq_id
+        self._host_active[slot] = True
+        self._host_len[slot] = T
+        _m.pages_adopted_total().inc(npages)
 
     def defrag(self):
         """Compact the KV pool and re-emit live slots' page-table rows

@@ -92,6 +92,55 @@ def _is_replica_member(member_id):
         return False
 
 
+def _payload_bytes(payload):
+    """Wire size of a page payload — host shape metadata only."""
+    return sum(int(a.nbytes) for a in payload.values()
+               if hasattr(a, "nbytes"))
+
+
+def _ship_prefill(engine, copy_id, prompt, max_new_tokens,
+                  trace_id=None, track=None, now_fn=time.monotonic):
+    """The prefill half of a disaggregated handoff: run the bucketed
+    prefill HERE (a transient engine slot), export the finished KV
+    pages + the prefill-sampled first token as a wire payload, and
+    release the slot — the pages live on only in the payload (and, with
+    a prefix index on this engine, as shared pages for later hits).
+    Returns ``(tok0, payload)``; stamps a ``prefill`` span on
+    ``trace_id``."""
+    from .. import telemetry
+
+    t0 = now_fn()
+    slot = next((s for s in range(engine.slots)
+                 if s not in engine._seq_of_slot), None)
+    if slot is None:
+        raise MXNetError("no free prefill slot for handoff %r"
+                         % (copy_id,))
+    seq_id = "ship:%s" % (copy_id,)
+    pv = engine.admit(slot, seq_id, prompt, max_new_tokens)
+    # sync-ok: handoff serialization boundary — the first token must
+    # become a wire int here, one read per shipped request
+    tok0 = int(pv.get().reshape(-1)[0])
+    payload = engine.export_pages(seq_id)
+    engine.release(slot)
+    _m.pages_shipped_total().inc(payload["npages"])
+    _m.ship_bytes_total().labels("ship").inc(_payload_bytes(payload))
+    if trace_id is not None:
+        t1 = now_fn()
+        telemetry.record_trace_span(
+            "prefill", trace_id, t0, t1, clock_now=t1, track=track,
+            copy=copy_id, pages=payload["npages"])
+    return tok0, payload
+
+
+_SHIP_CACHE_CAP = 64  # idempotent re-ship window per replica
+
+
+def _remember_ship(cache, copy_id, result):
+    cache[copy_id] = result
+    while len(cache) > _SHIP_CACHE_CAP:
+        cache.pop(next(iter(cache)))
+
+
 class LocalReplica:
     """One in-process serving replica: engine + continuous batcher +
     membership registration, with the drain/rejoin/kill lifecycle the
@@ -100,13 +149,19 @@ class LocalReplica:
     :class:`RemoteReplica` so the router never cares which it holds."""
 
     def __init__(self, index, engine_factory, coordinator=None,
-                 now_fn=time.monotonic, heartbeats=True, reg_timeout=5.0):
+                 now_fn=time.monotonic, heartbeats=True, reg_timeout=5.0,
+                 role="decode"):
         self.index = int(index)
         self._factory = engine_factory
         self.coordinator = coordinator
         self._now = now_fn
         self._heartbeats = bool(heartbeats)
         self._reg_timeout = reg_timeout
+        # disaggregation: a "prefill" replica runs bucketed prefills
+        # and ships finished KV pages; a "decode" replica adopts them
+        # (every replica can still do both — the role is the router's
+        # placement hint, carried on the membership meta)
+        self.role = str(role)
         self.engine = None
         self.batcher = None
         self.member = None
@@ -116,7 +171,9 @@ class LocalReplica:
         self.killed = False
         self.slow_until = 0.0   # replica_slow brownout horizon
         self._ticks = 0
+        self._ships = 0         # ship_pages calls (chaos counter)
         self._copies = {}       # copy_id -> Request live on this replica
+        self._shipped = {}      # copy_id -> (tok0, payload): re-ship cache
         self._poll_cursor = 0   # read cursor into batcher.completed
 
     # -- lifecycle ---------------------------------------------------------
@@ -141,6 +198,8 @@ class LocalReplica:
         self.killed = False
         self.slow_until = 0.0
         self._copies.clear()
+        self._shipped.clear()
+        self._ships = 0
         self._poll_cursor = 0
         self.engine = self._factory()
         self.capacity = int(self.engine.slots)
@@ -168,7 +227,8 @@ class LocalReplica:
             _replica_member_id(self.index), timeout=self._reg_timeout)
         self.member.register(meta={
             "serving_replica": True, "index": self.index,
-            "slots": int(self.engine.slots), "endpoint": None})
+            "slots": int(self.engine.slots), "endpoint": None,
+            "role": self.role})
         if self._heartbeats:
             self.member.start_heartbeats()
         self.generation = self.member.generation
@@ -274,6 +334,65 @@ class LocalReplica:
         if req is not None:
             self.batcher.cancel(req)
 
+    def ship_pages(self, copy_id, prompt, max_new_tokens, trace_id=None):
+        """PREFILL-role half of a disaggregated handoff: prefill the
+        prompt here and return ``(first_token, page_payload)`` for
+        adoption on a decode replica. Idempotent by copy id — a
+        transport retry re-ships the cached payload instead of
+        re-prefilling. Consults the seeded ``replica_kill`` rule first
+        so chaos cells can kill a prefill replica deterministically
+        MID-SHIP (the router's kv_retry re-routes to a survivor or
+        falls back to local prefill)."""
+        from .. import resilience
+
+        if not self.alive:
+            raise ConnectionError(
+                "serving replica %d is %s" % (self.index, self.state))
+        cached = self._shipped.get(copy_id)
+        if cached is not None:
+            return cached
+        inj = resilience.fault_point()
+        rule = inj.rule("replica_kill")
+        if rule is not None \
+                and int(rule.get("replica", -1)) == self.index \
+                and self._ships >= int(rule.get("after", 0)) \
+                and inj.should("replica_kill"):
+            self.kill()
+            raise ConnectionError(
+                "serving replica %d died mid-ship" % self.index)
+        self._ships += 1
+        out = _ship_prefill(self.engine, copy_id, prompt,
+                            max_new_tokens, trace_id=trace_id,
+                            track="replica-%d" % self.index,
+                            now_fn=self._now)
+        _remember_ship(self._shipped, copy_id, out)
+        return out
+
+    def adopt_copy(self, copy_id, prompt, max_new_tokens, deadline=None,
+                   eos_id=None, trace_id=None, handoff=None):
+        """DECODE-role half of a disaggregated handoff: submit a
+        request whose KV pages (and first token) were prefilled
+        elsewhere — the scheduler installs the payload at admission and
+        the request enters decode with zero prefill work here.
+        Idempotent by copy id."""
+        if not self.alive:
+            raise ConnectionError(
+                "serving replica %d is %s" % (self.index, self.state))
+        if copy_id in self._copies:  # idempotent re-adopt
+            return self._copies[copy_id].state
+        tok0, payload = handoff
+        req = Request(prompt, max_new_tokens=max_new_tokens,
+                      deadline=deadline, eos_id=eos_id,
+                      request_id=copy_id, trace_id=trace_id)
+        req._handoff = (payload, int(tok0))
+        self.batcher.submit(req)
+        if req.state == "rejected":
+            return "rejected"
+        _m.ship_bytes_total().labels("adopt").inc(
+            _payload_bytes(payload))
+        self._copies[copy_id] = req
+        return req.state
+
     def queued_copies(self):
         """Copy ids still admission-queued here (migratable on drain)."""
         return [cid for cid, r in self._copies.items()
@@ -350,7 +469,8 @@ class RemoteReplica:
     transport. The remote process drives its own decode loop, so
     :meth:`tick` is a no-op here."""
 
-    def __init__(self, index, host, port, slots=None, timeout=None):
+    def __init__(self, index, host, port, slots=None, timeout=None,
+                 role="decode"):
         from .. import config
         from ..async_server import AsyncClient
 
@@ -358,6 +478,7 @@ class RemoteReplica:
         self.host = host
         self.port = int(port)
         self.capacity = int(slots or 0)
+        self.role = str(role)
         self.state = ROUTABLE
         self.killed = False
         self.generation = None
@@ -393,6 +514,22 @@ class RemoteReplica:
             "srv_submit", None,
             (copy_id, [int(t) for t in prompt], int(max_new_tokens),
              deadline, eos_id, trace_id))
+
+    def ship_pages(self, copy_id, prompt, max_new_tokens, trace_id=None):
+        # page payloads (numpy arrays) ride the pickle frame whole —
+        # the serving twin of the embedding store's batched row push
+        tok0, payload = self._cl.request(
+            "srv_ship_pages", None,
+            (copy_id, [int(t) for t in prompt], int(max_new_tokens),
+             trace_id))
+        return int(tok0), payload
+
+    def adopt_copy(self, copy_id, prompt, max_new_tokens, deadline=None,
+                   eos_id=None, trace_id=None, handoff=None):
+        return self._cl.request(
+            "srv_adopt_pages", None,
+            (copy_id, [int(t) for t in prompt], int(max_new_tokens),
+             deadline, eos_id, trace_id, handoff))
 
     def cancel_copy(self, copy_id):
         self._cl.request("srv_cancel", None, copy_id)
@@ -471,21 +608,27 @@ class ReplicaPool:
     def replicas(self):
         return [self._handles[k] for k in sorted(self._handles)]
 
-    def routable(self):
-        return [h for h in self.replicas()
-                if h.state == ROUTABLE and not h.fenced]
+    def routable(self, role=None):
+        out = [h for h in self.replicas()
+               if h.state == ROUTABLE and not h.fenced]
+        if role is not None:
+            out = [h for h in out
+                   if getattr(h, "role", "decode") == role]
+        return out
 
     def total_capacity(self):
         return sum(int(h.capacity or 0) for h in self.replicas()
                    if h.state in (ROUTABLE, DRAINING))
 
-    def pick(self, exclude=()):
+    def pick(self, exclude=(), role=None):
         """Least-loaded routable replica — the SLO-aware placement
         rule: (queue depth + active slots) / capacity, ties broken by
         lowest index for determinism. A replica whose load probe fails
-        is marked dead on the spot (transport-observed death)."""
+        is marked dead on the spot (transport-observed death).
+        ``role`` restricts the candidates to one disaggregation tier
+        (prefill/decode)."""
         best, best_score = None, None
-        for h in self.routable():
+        for h in self.routable(role):
             if h.index in exclude:
                 continue
             try:
@@ -557,8 +700,9 @@ class ReplicaPool:
             rid = int(meta.get("index", _replica_index(w)))
             ep = meta.get("endpoint")
             if rid in live and rid not in self._handles and ep:
-                self.add(RemoteReplica(rid, ep[0], ep[1],
-                                       slots=meta.get("slots")))
+                self.add(RemoteReplica(
+                    rid, ep[0], ep[1], slots=meta.get("slots"),
+                    role=meta.get("role", "decode")))
         self.publish()
         return self
 
@@ -591,12 +735,14 @@ class ReplicaPool:
 
 
 def local_serving_fleet(n, engine_factory, now_fn=time.monotonic,
-                        warm=True, heartbeats=True):
+                        warm=True, heartbeats=True, roles=None):
     """An in-process fleet: one coordinator async server (the membership
     table), ``n`` :class:`LocalReplica`\\ s registered in it over real
     loopback sockets, and the pool wired to the reaper's death listener.
-    Returns ``(pool, coordinator_server)`` — close the pool's replicas,
-    then the server (the order is forgiving: graceful deregister is
+    ``roles`` (optional, one per replica) assigns disaggregation tiers
+    — e.g. ``("prefill", "decode", "decode")``. Returns
+    ``(pool, coordinator_server)`` — close the pool's replicas, then
+    the server (the order is forgiving: graceful deregister is
     bounded)."""
     from ..async_server import AsyncParamServer
 
@@ -606,9 +752,10 @@ def local_serving_fleet(n, engine_factory, now_fn=time.monotonic,
     coord = ("127.0.0.1", srv._sock.getsockname()[1])
     pool = ReplicaPool(coordinator=coord, server=srv)
     for i in range(n):
+        role = roles[i] if roles else "decode"
         pool.add(LocalReplica(i, engine_factory, coordinator=coord,
-                              now_fn=now_fn,
-                              heartbeats=heartbeats).start(warm=warm))
+                              now_fn=now_fn, heartbeats=heartbeats,
+                              role=role).start(warm=warm))
     pool.publish()
     return pool, srv
 
@@ -627,6 +774,7 @@ class ServingHost:
         self.batcher = batcher
         self.admitting = True
         self._copies = {}
+        self._shipped = {}  # copy_id -> (tok0, payload): re-ship cache
         self._cursor = 0
         self._lock = threading.Lock()
 
@@ -672,6 +820,39 @@ class ServingHost:
                     "queue": len(self.batcher._queue),
                     "active": len(self.batcher._slot_req),
                     "slots": int(self.batcher.engine.slots)})
+            elif op == "srv_ship_pages":
+                # the disaggregated handoff's prefill half, served over
+                # the wire: idempotent by copy id (a kv_retry re-ship
+                # returns the cached payload without re-prefilling)
+                if not self.admitting:
+                    return ("err", "replica is draining (not admitting)")
+                cid, prompt, max_new, trace_id = payload
+                cached = self._shipped.get(cid)
+                if cached is None:
+                    cached = _ship_prefill(
+                        self.batcher.engine, cid, prompt, max_new,
+                        trace_id=trace_id, track=self.batcher.track)
+                    _remember_ship(self._shipped, cid, cached)
+                return ("ok", cached)
+            elif op == "srv_adopt_pages":
+                if not self.admitting:
+                    return ("err", "replica is draining (not admitting)")
+                cid, prompt, max_new, deadline, eos, trace_id, handoff \
+                    = payload
+                if cid in self._copies:  # idempotent re-adopt
+                    return ("ok", self._copies[cid].state)
+                tok0, pl = handoff
+                req = Request(prompt, max_new_tokens=max_new,
+                              deadline=deadline, eos_id=eos,
+                              request_id=cid, trace_id=trace_id)
+                req._handoff = (pl, int(tok0))
+                self.batcher.submit(req)
+                if req.state == "rejected":
+                    return ("ok", "rejected")
+                _m.ship_bytes_total().labels("adopt").inc(
+                    _payload_bytes(pl))
+                self._copies[cid] = req
+                return ("ok", req.state)
             elif op == "srv_drain":
                 self.admitting = not bool(payload)
                 return ("ok", None)
@@ -694,7 +875,7 @@ class ServingHost:
 
 
 def serve_replica(engine, coordinator, index=0, host="127.0.0.1",
-                  port=0, now_fn=time.monotonic):
+                  port=0, now_fn=time.monotonic, role="decode"):
     """Host one replica as a standalone server: binds an async server
     answering ``srv_*`` ops, AOT-warms the engine, registers at the
     ``coordinator`` membership table with the endpoint + capacity meta
@@ -717,7 +898,8 @@ def serve_replica(engine, coordinator, index=0, host="127.0.0.1",
     member.register(meta={
         "serving_replica": True, "index": int(index),
         "slots": int(engine.slots),
-        "endpoint": (bound[0], int(bound[1]))})
+        "endpoint": (bound[0], int(bound[1])),
+        "role": str(role)})
     member.start_heartbeats()
     stop_event = threading.Event()
     loop = threading.Thread(target=hostobj.run_loop, args=(stop_event,),
@@ -757,7 +939,9 @@ def main():
     srv, _, _, stop = serve_replica(eng, (chost, int(cport)),
                                     index=index,
                                     port=int(os.environ.get(
-                                        "MXT_FLEET_PORT", "0")))
+                                        "MXT_FLEET_PORT", "0")),
+                                    role=os.environ.get(
+                                        "MXT_FLEET_ROLE", "decode"))
     print("SERVING_REPLICA_READY %s:%d"
           % srv._sock.getsockname()[:2], flush=True)
     try:

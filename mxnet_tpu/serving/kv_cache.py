@@ -29,6 +29,22 @@ writes of inactive batch slots and padded page-table entries route
 there, keeping the decode program's shapes fixed without conditional
 writes.
 
+Shared prefixes (serving/prefix.py): pages carry **refcounts** — a new
+sequence admitted against a cached prefix lists the *same* pool pages
+in its page table (:meth:`reserve` with ``shared=``), each reference
+bumping the page's count; :meth:`free` decrements and only a page's
+LAST reference returns it to the free list. The prefix index itself
+holds references too (:meth:`retain_pages`/:meth:`release_pages`), so
+an indexed prefix survives its originating sequence. A write landing
+in a shared page goes copy-on-write: :meth:`cow_page` swaps a fresh
+page into the sequence's list (host bookkeeping; the device-side page
+copy runs inside the caller's fused admission program), and ``cow``
+debt is part of the reservation promise so a fully-shared admission
+cannot overcommit the pool. :meth:`defrag` compacts by refcount — any
+referenced page survives, including index-pinned pages owned by no
+sequence — and notifies registered movers (:meth:`add_mover`) with the
+id remapping.
+
 Quantized pages (``quantized=True``): the K/V pools store symmetric
 signed int8 with a per-(position, head) float32 amax alongside —
 ``scale(q) = 127 / amax``, the ops/quantization.py triple convention
@@ -95,6 +111,9 @@ class PagedKVCache:
         self._free = list(range(self.num_pages - 1, -1, -1))  # pop() = 0
         self._pages = {}     # seq_id -> [page ids, in sequence order]
         self._quota = {}     # seq_id -> reserved page count (total)
+        self._refs = {}      # page id -> reference count (>= 1)
+        self._cow = {}       # seq_id -> outstanding copy-on-write debt
+        self._movers = []    # defrag listeners: cb({old page: new page})
         _m.kv_pages_total().set(self.num_pages)
         # diagnostics HBM ledger: the whole preallocated K+V pool
         # (scratch page + scale planes included) — .nbytes is shape
@@ -140,10 +159,13 @@ class PagedKVCache:
 
     def _publish(self):
         in_use = self.num_pages - len(self._free)
-        reserved = sum(self._quota.values()) - sum(
-            len(p) for p in self._pages.values())
+        reserved = (sum(self._quota.values())
+                    - sum(len(p) for p in self._pages.values())
+                    + sum(self._cow.values()))
         _m.kv_pages_in_use().set(in_use)
         _m.kv_pages_reserved().set(max(0, reserved))
+        _m.shared_pages().set(
+            sum(1 for c in self._refs.values() if c > 1))
         if self.quantized:
             # quantized-page occupancy: its own gauge so mxt_top can
             # show how much of the serving load runs on int8 pages
@@ -151,33 +173,56 @@ class PagedKVCache:
 
     # -- reservation + allocation ----------------------------------------
     def available(self):
-        """Pages free AND unpromised — what admission may still reserve."""
+        """Pages free AND unpromised — what admission may still reserve.
+        Outstanding copy-on-write debts count as promises: a fully
+        shared admission still owes the pool its divergence page."""
         with self._lock:
-            unallocated = sum(self._quota.values()) - sum(
-                len(p) for p in self._pages.values())
+            unallocated = (sum(self._quota.values())
+                           - sum(len(p) for p in self._pages.values())
+                           + sum(self._cow.values()))
             return len(self._free) - unallocated
 
-    def can_reserve(self, ntokens):
-        return self.pages_needed(ntokens) <= self.available()
+    def can_reserve(self, ntokens, shared=0, cow=0):
+        """Would :meth:`reserve` succeed right now? ``shared`` pages
+        come refcounted from the prefix index (no free-list draw);
+        ``cow`` is the extra copy-on-write page debt."""
+        need = self.pages_needed(ntokens) - int(shared) + int(cow)
+        return need <= self.available()
 
-    def reserve(self, seq_id, ntokens):
+    def reserve(self, seq_id, ntokens, shared=(), cow=0):
         """Promise ``ceil(ntokens / page_size)`` pages to ``seq_id``
         (its lifetime worst case). False = pool too busy — the request
-        stays queued. A sequence reserves once."""
+        stays queued. A sequence reserves once.
+
+        ``shared`` seeds the sequence's page list with already-resident
+        prefix pages (each gains a reference — they are NOT drawn from
+        the free list, which is the whole capacity win); ``cow`` pages
+        of copy-on-write debt join the promise so the later
+        :meth:`cow_page` draw cannot fail."""
         npages = self.pages_needed(ntokens)
         if npages > self.num_pages:
             raise MXNetError(
                 "request needs %d KV pages but the pool only has %d — "
                 "raise MXT_SERVING_PAGES or shorten prompt+max_new"
                 % (npages, self.num_pages))
-        if self.available() < npages:
+        shared = list(shared)
+        if self.available() < npages - len(shared) + int(cow):
             return False
         with self._lock:
             if seq_id in self._quota:
                 raise MXNetError("sequence %r already holds a "
                                  "reservation" % (seq_id,))
+            for p in shared:
+                if self._refs.get(p, 0) < 1:
+                    raise MXNetError(
+                        "shared page %d is not resident (stale prefix "
+                        "index entry?)" % (p,))
             self._quota[seq_id] = npages
-            self._pages[seq_id] = []
+            self._pages[seq_id] = shared
+            for p in shared:
+                self._refs[p] += 1
+            if cow:
+                self._cow[seq_id] = int(cow)
         self._publish()
         return True
 
@@ -195,6 +240,7 @@ class PagedKVCache:
                     "sequence %r exceeded its %d-page reservation"
                     % (seq_id, self._quota[seq_id]))
             page = self._free.pop()
+            self._refs[page] = 1
             pages.append(page)
         self._publish()
         return page
@@ -207,8 +253,66 @@ class PagedKVCache:
             new.append(self.alloc_page(seq_id))
         return new
 
+    def cow_page(self, seq_id, idx):
+        """Copy-on-write: the sequence is about to WRITE into its
+        ``idx``-th page while other references share it. Swap a fresh
+        page into the list (host bookkeeping only — the caller's fused
+        admission program performs the device-side page copy before its
+        scatter) and retire one page of COW debt. Returns
+        ``(src_page, dst_page)`` for that device copy."""
+        with self._lock:
+            pages = self._pages[seq_id]
+            src = pages[idx]
+            dst = self._free.pop()
+            self._refs[dst] = 1
+            pages[idx] = dst
+            self._refs[src] -= 1
+            if self._refs[src] == 0:  # last ref raced away: still correct
+                del self._refs[src]
+                self._free.append(src)
+            debt = self._cow.get(seq_id, 0) - 1
+            if debt > 0:
+                self._cow[seq_id] = debt
+            else:
+                self._cow.pop(seq_id, None)
+        _m.cow_copies_total().inc()
+        self._publish()
+        return src, dst
+
+    def refcount(self, page):
+        with self._lock:
+            return self._refs.get(page, 0)
+
+    def retain_pages(self, pages):
+        """Take an ownership reference on resident pages (the prefix
+        index pinning a cached prefix past its originating sequence)."""
+        with self._lock:
+            for p in pages:
+                if self._refs.get(p, 0) < 1:
+                    raise MXNetError("cannot retain non-resident page %d"
+                                     % (p,))
+            for p in pages:
+                self._refs[p] += 1
+        self._publish()
+
+    def release_pages(self, pages):
+        """Drop references taken with :meth:`retain_pages`; pages whose
+        last reference this was return to the free list."""
+        freed = []
+        with self._lock:
+            for p in pages:
+                self._refs[p] -= 1
+                if self._refs[p] == 0:
+                    del self._refs[p]
+                    freed.append(p)
+            self._free.extend(reversed(freed))
+        self._publish()
+        return len(freed)
+
     def free(self, seq_id):
-        """Release a sequence: its pages return to the free list, its
+        """Release a sequence: each of its pages drops one reference
+        and only last references return to the free list (shared prefix
+        pages survive for the index / sibling sequences); the
         reservation dissolves. In-flight decode steps that still read
         the pages are safe — they consumed earlier pool *values*, and a
         later prefill writing a recycled page produces a new value the
@@ -216,7 +320,14 @@ class PagedKVCache:
         with self._lock:
             pages = self._pages.pop(seq_id, [])
             self._quota.pop(seq_id, None)
-            self._free.extend(reversed(pages))
+            self._cow.pop(seq_id, None)
+            released = []
+            for p in pages:
+                self._refs[p] -= 1
+                if self._refs[p] == 0:
+                    del self._refs[p]
+                    released.append(p)
+            self._free.extend(reversed(released))
         self._publish()
         return len(pages)
 
@@ -321,19 +432,28 @@ class PagedKVCache:
         return row
 
     # -- defrag -----------------------------------------------------------
+    def add_mover(self, cb):
+        """Register a defrag listener: ``cb({old_page: new_page})``
+        fires after every compaction that moved pages (the prefix index
+        remaps its cached page lists through it)."""
+        self._movers.append(cb)
+
     def defrag(self):
         """Compact live pages to the low end of the pool: after churn
         the free list is scattered and long-lived sequences pin high
         page ids; compaction restores contiguity (DMA locality, and the
-        precondition for ever shrinking the pool). One gather/scatter
-        pair on device per pool; page tables on the NEXT decode step
-        pick up the moved ids (callers must re-emit device page-table
-        rows for live slots — serving.DecodeEngine.defrag does).
+        precondition for ever shrinking the pool). Liveness is the
+        REFCOUNT map, not the sequence lists — an index-pinned prefix
+        page owned by no sequence moves with everything else, never
+        into the free list. One gather/scatter pair on device per pool;
+        page tables on the NEXT decode step pick up the moved ids
+        (callers must re-emit device page-table rows for live slots —
+        serving.DecodeEngine.defrag does), and registered movers
+        (:meth:`add_mover`) get the id remapping.
 
         Returns the number of pages moved."""
         with self._lock:
-            used = sorted(p for pages in self._pages.values()
-                          for p in pages)
+            used = sorted(self._refs)
             mapping = {old: new for new, old in enumerate(used)
                        if old != new}
             if not mapping:
@@ -343,6 +463,8 @@ class PagedKVCache:
             self._pages = {
                 seq: [mapping.get(p, p) for p in pages]
                 for seq, pages in self._pages.items()}
+            self._refs = {mapping.get(p, p): c
+                          for p, c in self._refs.items()}
             self._free = list(range(self.num_pages - 1, len(used) - 1, -1))
         # functional scatter: RHS gathers from the OLD array, so
         # overlapping src/dst ranges cannot clobber each other
@@ -353,5 +475,7 @@ class PagedKVCache:
                 self.k_scales[:, src])
             self.v_scales = self.v_scales.at[:, dst].set(
                 self.v_scales[:, src])
+        for cb in self._movers:
+            cb(dict(mapping))
         self._publish()
         return len(src)

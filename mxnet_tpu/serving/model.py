@@ -225,6 +225,77 @@ class TinyDecoder:
         return (jnp.stack(ks), jnp.stack(vs),
                 self.logits(params, h_last))
 
+    def prefill_with_prefix(self, params, tokens, valid_length, start,
+                            k_prefix, v_prefix):
+        """Suffix prompt pass against a REUSED prefix: the serving
+        engine found tokens ``[0, start)`` already resident as shared
+        KV pages (serving/prefix.py), so only the suffix runs through
+        the layers — admission cost drops proportionally to prefix
+        coverage.
+
+        ``tokens``: (B, Tsuf) right-padded SUFFIX tokens,
+        ``valid_length``: (B,) valid suffix lengths, ``start``: scalar
+        int32 absolute position of the first suffix token,
+        ``k_prefix``/``v_prefix``: (L, Tpre_pad, H, D) float prefix K/V
+        gathered (and dequantized) from the pool — positions at or past
+        ``start`` in the padded gather are masked out, so a page-padded
+        gather and the full-match copy-on-write case (``start = T-1``
+        recomputing only the final token) are both correct.
+
+        Returns ``(k, v, last_logits)`` for the SUFFIX only — k/v
+        ``(L, B, H, Tsuf, D)``, exactly :meth:`prefill`'s layout, ready
+        for the page scatter."""
+        import jax.numpy as jnp
+
+        from ..ops import attention as A
+
+        B, Tsuf = tokens.shape
+        Tpre = k_prefix.shape[1]
+        neg = A._NEG_INF
+        start = jnp.asarray(start, jnp.int32)
+        h = self.embed(params, tokens,
+                       start + jnp.arange(Tsuf)[None, :])
+        # bias (B, 1, Tsuf, Tpre + Tsuf): prefix columns open below
+        # `start`, suffix columns causal within the suffix AND below
+        # the ragged valid length
+        pre_open = jnp.where(jnp.arange(Tpre)[None, :] < start,
+                             0.0, neg)                     # (1, Tpre)
+        pre_open = jnp.broadcast_to(pre_open, (Tsuf, Tpre))
+        rows = jnp.arange(Tsuf)
+        causal = jnp.where(rows[None, :] <= rows[:, None], 0.0, neg)
+        mask = jnp.concatenate([pre_open, causal], axis=1)  # (Tsuf, Ttot)
+        ragged = jnp.where(
+            rows[None, :] < valid_length.astype(jnp.int32)[:, None],
+            0.0, neg)                                      # (B, Tsuf)
+        bias = mask[None, None] + jnp.concatenate(
+            [jnp.zeros((B, Tpre), jnp.float32), ragged],
+            axis=1)[:, None, None, :]
+        kpre = jnp.transpose(k_prefix, (0, 2, 1, 3))  # (L, H, Tpre, D)
+        vpre = jnp.transpose(v_prefix, (0, 2, 1, 3))
+        ks, vs = [], []
+        for l in range(self.num_layers):
+            q, k, v = self.layer_qkv(params, l, h)      # (B, Tsuf, H, D)
+            qt = jnp.transpose(q, (0, 2, 1, 3))         # (B, H, Tsuf, D)
+            kt = jnp.transpose(k, (0, 2, 1, 3))
+            vt = jnp.transpose(v, (0, 2, 1, 3))
+            ks.append(kt)
+            vs.append(vt)
+            kcat = jnp.concatenate(
+                [jnp.broadcast_to(kpre[l][None],
+                                  (B,) + kpre[l].shape), kt], axis=2)
+            vcat = jnp.concatenate(
+                [jnp.broadcast_to(vpre[l][None],
+                                  (B,) + vpre[l].shape), vt], axis=2)
+            attn = A._attention_reference(qt, kcat, vcat, bias, False,
+                                          self.sm_scale)
+            h = self.layer_finish(params, l, h,
+                                  jnp.transpose(attn, (0, 2, 1, 3)))
+        last = jnp.clip(valid_length.astype(jnp.int32) - 1, 0, Tsuf - 1)
+        h_last = jnp.take_along_axis(
+            h, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        return (jnp.stack(ks), jnp.stack(vs),
+                self.logits(params, h_last))
+
     # -- the cache-free oracle -------------------------------------------
     def reference_decode(self, params, prompt, max_new_tokens,
                          eos_id=None):

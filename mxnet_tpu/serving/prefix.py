@@ -1,0 +1,148 @@
+"""Shared-prefix index — content-addressed reuse of resident KV pages.
+
+At millions-of-users scale most prompts share prefixes (system prompts,
+few-shot preambles), and the paged KV cache already stores those
+prefixes as fixed-size pages: the only missing piece is a map from
+*prompt content* to *resident pages*. This module is that map.
+
+Keying: a blake2b **chain** over page-size-aligned token blocks — the
+same chunk-fingerprint discipline as the data plane's manifest. Block
+``j``'s digest hashes ``digest(j-1) || tokens[j*S:(j+1)*S]``, so a
+digest names the ENTIRE prefix up to that block, not just the block:
+two prompts share an entry iff they are token-identical up to that
+page boundary. Every admitted prompt registers ALL its full-block
+chain digests, so a later prompt matching any page-aligned prefix hits
+at the longest shared boundary.
+
+Ownership: each entry holds an index-side REFERENCE on its pages
+(kv_cache.retain_pages), so a cached prefix survives the sequence that
+created it; eviction is LRU under pool pressure (:meth:`trim`), and
+defrag remaps entries through the cache's mover callback. Hash math
+runs host-side at admission — control plane, never inside the decode
+loop (this module is on the check_host_syncs.py scan list; its
+sanctioned numpy call hashes host token lists).
+"""
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+
+import numpy as np
+
+from . import metrics as _m
+
+__all__ = ["PrefixIndex"]
+
+_DIGEST_BYTES = 16
+
+
+class PrefixIndex:
+    """LRU map: chain digest of a page-aligned prompt prefix -> the
+    resident pool pages holding its KV state."""
+
+    def __init__(self, cache, capacity=1024):
+        self.cache = cache
+        self.page_size = cache.page_size
+        self.capacity = int(capacity)
+        # digest -> (pages tuple, ntokens covered); insertion order = LRU
+        self._entries = OrderedDict()
+        cache.add_mover(self._remap)
+
+    # -- keying -----------------------------------------------------------
+    def chain(self, prompt):
+        """The digest chain of ``prompt``'s full page-size blocks:
+        ``chain[j]`` names tokens ``[0, (j+1)*S)``. Host-side hashing —
+        admission control plane."""
+        S = self.page_size
+        out = []
+        h = b""
+        for j in range(len(prompt) // S):
+            block = np.asarray(  # sync-ok: host token list hashing
+                prompt[j * S:(j + 1) * S], np.int32)
+            h = hashlib.blake2b(h + block.tobytes(),
+                                digest_size=_DIGEST_BYTES).digest()
+            out.append(h)
+        return out
+
+    # -- lookup + registration -------------------------------------------
+    def lookup(self, prompt):
+        """Longest cached page-aligned prefix of ``prompt``:
+        ``(pages, covered_tokens, chain)`` — empty/0 on a miss. The hit
+        entry (and every shorter chain entry) moves to MRU. The caller
+        must take its own references (kv_cache.reserve ``shared=``)
+        before the pages are safe from :meth:`trim`."""
+        chain = self.chain(prompt)
+        for j in range(len(chain) - 1, -1, -1):
+            entry = self._entries.get(chain[j])
+            if entry is not None:
+                self._entries.move_to_end(chain[j])
+                pages, ntok = entry
+                return list(pages), ntok, chain
+        return [], 0, chain
+
+    def register(self, prompt, pages, chain=None):
+        """Index an admitted prompt: every full-block chain digest maps
+        to its page prefix, each entry retaining its pages so they
+        outlive the sequence. Known digests just refresh to MRU."""
+        chain = self.chain(prompt) if chain is None else chain
+        added = 0
+        for j, digest in enumerate(chain):
+            if digest in self._entries:
+                self._entries.move_to_end(digest)
+                continue
+            prefix = tuple(pages[:j + 1])
+            if len(prefix) < j + 1:
+                break  # caller shipped fewer pages than blocks
+            self.cache.retain_pages(prefix)
+            self._entries[digest] = (prefix, (j + 1) * self.page_size)
+            added += 1
+        while len(self._entries) > self.capacity:
+            self._evict_lru()
+        return added
+
+    # -- eviction ---------------------------------------------------------
+    def _evict_lru(self, keep=()):
+        for digest in self._entries:
+            if digest not in keep:
+                pages, _ = self._entries.pop(digest)
+                self.cache.release_pages(pages)
+                return True
+        return False
+
+    def trim(self, need_pages, keep=()):
+        """Evict LRU entries (skipping ``keep`` digests — the hit an
+        admission is about to consume) until the cache can hand out
+        ``need_pages`` more pages, or the index runs dry. Returns True
+        when the pool can now satisfy the request."""
+        keep = frozenset(keep)
+        while self.cache.available() < need_pages:
+            if not self._evict_lru(keep):
+                return self.cache.available() >= need_pages
+        return True
+
+    def clear(self):
+        """Drop every entry (and its page references)."""
+        while self._entries:
+            self._evict_lru()
+
+    # -- defrag -----------------------------------------------------------
+    def _remap(self, mapping):
+        """kv_cache defrag mover: rewrite cached page ids in place."""
+        self._entries = OrderedDict(
+            (d, (tuple(mapping.get(p, p) for p in pages), ntok))
+            for d, (pages, ntok) in self._entries.items())
+
+    # -- introspection ----------------------------------------------------
+    def __len__(self):
+        return len(self._entries)
+
+    def entries(self):
+        """[(covered_tokens, pages tuple)] in LRU->MRU order."""
+        return [(ntok, pages)
+                for pages, ntok in self._entries.values()]
+
+    def hit(self):
+        _m.prefix_hits_total().inc()
+
+    def miss(self):
+        _m.prefix_misses_total().inc()

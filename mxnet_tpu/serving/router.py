@@ -27,6 +27,20 @@ export), and wires the three robustness behaviors end to end:
   :class:`~mxnet_tpu.serving.fleet.StaleReplicaError` and is never
   committed — the request's failover copy is the only writer.
 
+- **Disaggregated prefill.** When the pool carries both ``prefill``-
+  and ``decode``-role replicas, a long prompt (>=
+  ``MXT_FLEET_PREFILL_THRESHOLD`` tokens) dispatches as a handoff:
+  prefill on the prefill tier, ship the finished KV pages over the
+  transport (``srv_ship_pages``), adopt them into a decode replica
+  (``srv_adopt_pages``) — the request enters decode with zero prefill
+  work on the decode tier. The chain rides ``kv_retry``: a prefill
+  replica that dies mid-ship is marked dead and the retry re-ships
+  from a survivor (idempotent by copy id); an exhausted prefill tier
+  falls back to ordinary local-prefill dispatch, so disaggregation
+  never loses a request. Short prompts route straight to the decode
+  tier. ``ship``/``adopt`` spans stamp the handoff on the router's
+  trace track.
+
 Host/device split: the router is PURE host bookkeeping over host
 scalars (queue lengths, wall-clock stamps, token lists already
 materialized by the replicas' deferred windows). It performs zero
@@ -98,7 +112,8 @@ class FleetRouter:
     """Front-door dispatch over a replica pool (see module docstring)."""
 
     def __init__(self, pool, now_fn=time.monotonic, slo=None,
-                 hedge_delay=None, hedge_budget=None):
+                 hedge_delay=None, hedge_budget=None,
+                 prefill_threshold=None):
         from .. import config
 
         self.pool = pool
@@ -110,6 +125,9 @@ class FleetRouter:
         if hedge_budget is None:
             hedge_budget = config.get("MXT_FLEET_HEDGE_BUDGET")
         self.hedge_budget = hedge_budget  # None -> capacity-derived
+        if prefill_threshold is None:
+            prefill_threshold = config.get("MXT_FLEET_PREFILL_THRESHOLD")
+        self.prefill_threshold = int(prefill_threshold)
         self._queue = collections.deque()
         self._inflight = {}   # token -> RoutedRequest
         self._by_copy = {}    # copy_id -> RoutedRequest
@@ -228,8 +246,26 @@ class FleetRouter:
                 break
 
     def _dispatch(self, rr, exclude=()):
+        """Place one copy of ``rr``. A long prompt on a role-split pool
+        goes through the disaggregated handoff (prefill tier ->
+        ship_pages -> decode-tier adopt); an exhausted prefill tier
+        falls back to ordinary dispatch (local prefill on the target),
+        so the handoff path never loses a request."""
+        if len(rr.prompt) >= self.prefill_threshold \
+                and self.pool.routable(role="prefill") \
+                and self.pool.routable(role="decode"):
+            try:
+                return self._dispatch_handoff(rr, exclude=exclude)
+            except KVStoreError:
+                # prefill tier gone mid-chain: the request still
+                # completes — local prefill on an ordinary dispatch
+                pass
+        return self._dispatch_direct(rr, exclude=exclude)
+
+    def _dispatch_direct(self, rr, exclude=()):
         """Place one copy of ``rr`` on the least-loaded routable replica
-        (never one that already holds a copy). Rides kv_retry: a replica
+        (never one that already holds a copy), preferring the decode
+        tier when the pool is role-split. Rides kv_retry: a replica
         that dies between pick and submit is marked dead and the retry
         picks a survivor; true exhaustion is a typed KVStoreError."""
         from .. import resilience
@@ -237,7 +273,12 @@ class FleetRouter:
         tried = set(exclude)
 
         def attempt():
-            h = self.pool.pick(exclude=tried | set(rr.copies))
+            h = self.pool.pick(exclude=tried | set(rr.copies),
+                               role="decode")
+            if h is None:
+                # no decode-role replica can take it: any routable
+                # replica (a prefill-only pool still serves)
+                h = self.pool.pick(exclude=tried | set(rr.copies))
             if h is None:
                 raise KVStoreError(
                     "no routable serving replica for request %r"
@@ -272,6 +313,80 @@ class FleetRouter:
         _m.fleet_dispatch_total().labels(str(h.index)).inc()
         self._span(rr, "dispatch", now, now, replica=h.index, copy=cid)
         return h
+
+    def _dispatch_handoff(self, rr, exclude=()):
+        """Disaggregated dispatch: prefill ``rr`` on a prefill-tier
+        replica, ship the finished KV pages over the transport, adopt
+        them into a decode-tier replica — the request enters decode
+        with zero prefill work on the decode tier. The whole chain is
+        one kv_retry unit keyed by a STABLE copy id, so a prefill
+        replica that dies mid-ship is marked dead and the retry
+        re-ships from a survivor (an already-shipped copy id returns
+        the cached payload — idempotent re-ship, never a re-prefill on
+        the same replica)."""
+        from .. import resilience
+
+        tried = set(exclude)
+        cid = "%s#%d" % (rr.token, rr._ncopy)
+
+        def attempt():
+            pf = self.pool.pick(exclude=tried, role="prefill")
+            if pf is None:
+                raise KVStoreError(
+                    "no routable prefill replica for request %r"
+                    % (rr.token,))
+            t0 = self._now()
+            try:
+                tok0, payload = pf.ship_pages(cid, rr.prompt,
+                                              rr.max_new_tokens,
+                                              trace_id=rr.trace_id)
+            except (ConnectionError, OSError):
+                tried.add(pf.index)
+                self.pool.mark_dead(pf.index)
+                raise
+            t1 = self._now()
+            self._span(rr, "ship", t0, t1, replica=pf.index, copy=cid,
+                       pages=int(payload.get("npages", 0)))
+            dec = self.pool.pick(exclude=tried | set(rr.copies),
+                                 role="decode")
+            if dec is None:
+                raise KVStoreError(
+                    "no routable decode replica for request %r"
+                    % (rr.token,))
+            t2 = self._now()
+            try:
+                state = dec.adopt_copy(cid, rr.prompt,
+                                       rr.max_new_tokens,
+                                       deadline=rr.deadline,
+                                       eos_id=rr.eos_id,
+                                       trace_id=rr.trace_id,
+                                       handoff=(tok0, payload))
+            except (ConnectionError, OSError):
+                tried.add(dec.index)
+                self.pool.mark_dead(dec.index)
+                raise
+            t3 = self._now()
+            self._span(rr, "adopt", t2, t3, replica=dec.index,
+                       copy=cid, pages=int(payload.get("npages", 0)))
+            return dec, state
+
+        dec, state = resilience.kv_retry("fleet_handoff", rr.token,
+                                         attempt)
+        rr._ncopy += 1
+        if state == "rejected":
+            self._finish(rr, "rejected")
+            return None
+        rr.copies[dec.index] = cid
+        self._by_copy[cid] = rr
+        rr.dispatches += 1
+        rr.state = "dispatched"
+        now = self._now()
+        if rr.t_dispatch is None:
+            rr.t_dispatch = now
+        _m.fleet_dispatch_total().labels(str(dec.index)).inc()
+        self._span(rr, "dispatch", now, now, replica=dec.index,
+                   copy=cid, handoff=True)
+        return dec
 
     # -- failover ----------------------------------------------------------
     def _failover_scan(self):

@@ -84,6 +84,10 @@ class Request:
             else "req-%d" % next(_req_ids)
         self.trace_id = None if trace_id is None else str(trace_id)
         self._track = None  # timeline row, stamped by the batcher
+        # disaggregated handoff: a prefill replica already computed this
+        # request's KV pages — (page payload, first token) to ADOPT at
+        # admission instead of prefilling (serving/fleet.py ship/adopt)
+        self._handoff = None
         self.output_tokens = []
         self.state = "created"  # queued|running|completed|evicted|rejected
         self.t_submit = self.t_admit = self.t_first = self.t_finish = None
@@ -369,7 +373,10 @@ class ContinuousBatcher:
         while self._queue and self._free_slots():
             req = self._queue[0]
             total = len(req.prompt) + req.max_new_tokens
-            if not self.engine.can_admit(total):
+            # a handoff request adopts shipped pages — no prefix
+            # discount applies, so gate on the plain reservation
+            prompt = None if req._handoff is not None else req.prompt
+            if not self.engine.can_admit(total, prompt=prompt):
                 break  # pages busy; retiring traffic will free them
             self._queue.popleft()
             slot = self._free_slots()[0]
@@ -377,9 +384,21 @@ class ContinuousBatcher:
             _m.request_latency().labels("queue").observe(
                 max(0.0, now - req.t_submit))
             _trace_span(req, "queue", req.t_submit, now, now)
-            req._first_pv = self.engine.admit(
-                slot, req.id, req.prompt, req.max_new_tokens)
-            req.state = "running"
+            if req._handoff is not None:
+                # disaggregated path: install the prefill replica's
+                # shipped pages; the first token rode the wire as a
+                # host int — zero prefill work, nothing deferred
+                payload, tok0 = req._handoff
+                self.engine.adopt(slot, req.id, len(req.prompt),
+                                  req.max_new_tokens, payload, tok0)
+                req._handoff = None
+                req._first_pv = None
+                req.state = "running"
+                req._record(int(tok0), now)  # may complete a 1-budget
+            else:
+                req._first_pv = self.engine.admit(
+                    slot, req.id, req.prompt, req.max_new_tokens)
+                req.state = "running"
             req._dispatched = 1  # the prefill-sampled token
             req._inflight = 0
             req._ub = 1

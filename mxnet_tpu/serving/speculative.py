@@ -325,7 +325,11 @@ class SpeculativeEngine(DecodeEngine):
         return toks
 
     # -- admission ---------------------------------------------------------
-    def can_admit(self, total_tokens):
+    def can_admit(self, total_tokens, prompt=None):
+        # prefix reuse doesn't compose with speculative decode (the
+        # verify overshoot writes past the committed budget), so the
+        # prompt is ignored here — both pools gate on the plain bill
+        del prompt
         padded = total_tokens + self._reserve_slack
         return (self.cache.can_reserve(padded)
                 and self.dcache.can_reserve(padded))

@@ -1,0 +1,519 @@
+"""Shared-prefix KV reuse + prefill/decode disaggregation (PR 16:
+mxnet_tpu/serving/prefix.py, kv_cache refcounts/copy-on-write, the
+fleet's srv_ship_pages/srv_adopt_pages handoff, and the router's
+role-aware dispatch).
+
+Covers: the blake2b chain hash, per-page refcount invariants (a shared
+page is never freed while referenced; the last reference returns it to
+the free list), copy-on-write on the quantized pool carrying scale
+planes, token-exact reuse vs the cache-free oracle (full-match COW
+path included), prefix-discounted admission with LRU index shedding
+under pressure, the unchanged <=1-sync-per-K decode protocol,
+disaggregated handoff token-exactness A->B with the
+prefill->ship->adopt->decode trace chain, idempotent re-ship, the
+seeded prefill-kill chaos cell swept by tools/chaos_matrix.sh, the
+mxt_top prefix line, and the host-sync lint inclusion.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import engine as eng_mod
+from mxnet_tpu import profiler, serving, telemetry, tuning
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving import (ContinuousBatcher, DecodeEngine,
+                               FleetRouter, PagedKVCache, PrefixIndex,
+                               Request, TinyDecoder)
+from mxnet_tpu.telemetry_fleet import chrome_trace, trace_tree
+
+
+def _seed():
+    return int(os.environ.get("MXT_CHAOS_SEED", "0"))
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries(monkeypatch, tmp_path):
+    """Dead replicas surface in milliseconds; every test gets its own
+    tuning table and a clean trace-span log."""
+    monkeypatch.setenv("MXT_KV_RETRIES", "1")
+    monkeypatch.setenv("MXT_KV_RETRY_BASE", "0.02")
+    monkeypatch.setenv("MXT_KV_RETRY_MAX", "0.05")
+    monkeypatch.setenv("MXT_TUNE_TABLE", str(tmp_path / "tune.json"))
+    tuning.reset()
+    telemetry.clear_trace_spans()
+    yield
+    telemetry.clear_trace_spans()
+    tuning.reset()
+
+
+MODEL = TinyDecoder(vocab=64, num_layers=1, num_heads=2, head_dim=8,
+                    max_len=256)
+PARAMS = MODEL.init_params(3)
+BASE = list(range(1, 17))   # page-aligned 2-page prompt (page_size 8)
+
+
+def _engine(pages=64, slots=2, quantized=False, prefix=True,
+            max_context=64):
+    return DecodeEngine(
+        MODEL, params=PARAMS, slots=slots,
+        cache=PagedKVCache(1, 2, 8, num_pages=pages, page_size=8,
+                           quantized=quantized),
+        prefill_buckets=(16,), max_context=max_context,
+        prefix_cache=prefix)
+
+
+def _engine_factory():
+    return _engine(pages=64, slots=2, prefix=False)
+
+
+def _role_fleet(roles):
+    return serving.local_serving_fleet(len(roles), _engine_factory,
+                                       warm=False, roles=roles)
+
+
+def _close(pool, srv):
+    for h in pool.replicas():
+        try:
+            h.close()
+        except Exception:  # noqa: BLE001 — killed handles
+            pass
+    srv.close()
+
+
+def _ref(prompt, n):
+    return MODEL.reference_decode(PARAMS, list(prompt), n)
+
+
+def _counter(name):
+    fam = telemetry.registry().get(name)
+    if fam is None:
+        return 0.0
+    return float(sum(ch.value for ch in fam.children().values()))
+
+
+# ---------------------------------------------------------------------------
+# chain hashing
+# ---------------------------------------------------------------------------
+def test_chain_hash_page_aligned_prefix_property():
+    """One digest per FULL page-size block; the chain of an extended
+    prompt starts with the chain of its prefix (the lookup walks this);
+    any token change flips every digest from that block on; position
+    folds in through the chain (a repeated block hashes differently at
+    each offset)."""
+    cache = PagedKVCache(1, 2, 8, num_pages=16, page_size=8)
+    idx = PrefixIndex(cache)
+    assert len(idx.chain(BASE)) == 2
+    assert len(idx.chain(BASE + [9, 9, 9])) == 2   # partial block: none
+    assert idx.chain(BASE + list(range(20, 28)))[:2] == idx.chain(BASE)
+    mutated = [99] + BASE[1:]
+    assert idx.chain(mutated)[0] != idx.chain(BASE)[0]
+    rep = [5] * 16
+    assert idx.chain(rep)[0] != idx.chain(rep)[1]
+
+
+# ---------------------------------------------------------------------------
+# refcount invariants (the pool-side half of sharing)
+# ---------------------------------------------------------------------------
+def test_shared_page_survives_owner_free():
+    cache = PagedKVCache(1, 2, 8, num_pages=8, page_size=8)
+    assert cache.reserve("a", 16)
+    pa = [cache.alloc_page("a"), cache.alloc_page("a")]
+    # b admits sharing a's first page
+    assert cache.reserve("b", 16, shared=pa[:1])
+    cache.alloc_for("b", 16)
+    assert cache.refcount(pa[0]) == 2
+    in_use = cache.pages_in_use()
+    cache.free("a")
+    # the shared page survived; only a's private page returned
+    assert cache.refcount(pa[0]) == 1
+    assert cache.pages_in_use() == in_use - 1
+    assert pa[0] in cache.pages_of("b")
+    cache.free("b")  # last reference: everything returns
+    assert cache.pages_in_use() == 0
+    assert cache.refcount(pa[0]) == 0
+
+
+def test_retain_release_and_stale_shared_reserve():
+    cache = PagedKVCache(1, 2, 8, num_pages=8, page_size=8)
+    assert cache.reserve("a", 16)
+    pa = cache.alloc_for("a", 16)
+    cache.retain_pages(pa)              # index pin
+    cache.free("a")
+    assert cache.pages_in_use() == 2    # pinned pages stay resident
+    assert cache.release_pages(pa) == 2
+    assert cache.pages_in_use() == 0
+    with pytest.raises(MXNetError):
+        cache.retain_pages([pa[0]])     # non-resident: typed refusal
+    with pytest.raises(MXNetError):
+        cache.reserve("c", 16, shared=[pa[0]])  # stale index entry
+
+
+def test_cow_page_bookkeeping_and_debt():
+    cache = PagedKVCache(1, 2, 8, num_pages=8, page_size=8)
+    assert cache.reserve("a", 16)
+    pa = cache.alloc_for("a", 16)
+    cache.retain_pages(pa)
+    c0 = _counter("mxt_serving_cow_copies_total")
+    # b fully shares a's pages and owes one divergence page
+    assert cache.reserve("b", 16, shared=pa, cow=1)
+    src, dst = cache.cow_page("b", 1)
+    assert src == pa[1] and dst not in pa
+    assert cache.pages_of("b") == [pa[0], dst]
+    assert cache.refcount(src) == 2     # a + index pin keep it
+    assert cache.refcount(dst) == 1
+    assert _counter("mxt_serving_cow_copies_total") == c0 + 1
+    # the COW debt is retired: no outstanding promise inflates the bill
+    avail = cache.available()
+    cache.free("b")
+    assert cache.available() == avail + 1
+
+
+def test_defrag_mover_remap_unit():
+    """Defrag liveness is the refcount map: a pinned page owned by NO
+    sequence compacts down (never into the free list) and registered
+    movers see the remapping."""
+    cache = PagedKVCache(1, 2, 8, num_pages=16, page_size=8)
+    assert cache.reserve("a", 24)
+    pa = cache.alloc_for("a", 24)
+    cache.retain_pages(pa[2:])          # pin only the HIGH page
+    cache.free("a")
+    assert cache.pages_in_use() == 1
+    seen = []
+    cache.add_mover(seen.append)
+    moved = cache.defrag()
+    assert moved == 1 and seen and pa[2] in seen[0]
+    new = seen[0][pa[2]]
+    assert cache.refcount(new) == 1
+    assert cache.release_pages([new]) == 1
+    assert cache.pages_in_use() == 0
+
+
+def test_defrag_remaps_prefix_index():
+    """An index entry's pages survive an engine defrag (the index rides
+    the mover callback) — a hit afterwards still decodes token-exactly."""
+    eng = _engine(pages=16)
+    pv = eng.admit(0, "a", BASE, 4)
+    int(pv.get().reshape(-1)[0])
+    eng.release(0)                       # pages survive as index pins
+    assert eng.cache.pages_in_use() == 2
+    eng.defrag()
+    prompt = BASE + [20, 21]
+    pv = eng.admit(0, "b", prompt, 4)
+    t0 = int(pv.get().reshape(-1)[0])
+    assert _counter("mxt_serving_prefix_hits_total") >= 1
+    assert t0 == _ref(prompt, 1)[0]
+    eng.release(0)
+
+
+# ---------------------------------------------------------------------------
+# token-exact reuse vs the cache-free oracle
+# ---------------------------------------------------------------------------
+def test_prefix_reuse_token_exact_vs_oracle():
+    """A cold miss, a full-match replay (COW), a partial hit, and an
+    unrelated prompt all decode token-exactly vs the dense cache-free
+    oracle — reuse changes the page bill, never the tokens."""
+    eng = _engine(pages=64, slots=2)
+    sched = ContinuousBatcher(eng)
+    prompts = [BASE + [20, 21, 22],      # cold miss (registers BASE)
+               list(BASE),               # full match -> COW last page
+               BASE + [30, 31],          # partial hit: 2 shared pages
+               [40, 41, 42]]             # unrelated short miss
+    h0 = _counter("mxt_serving_prefix_hits_total")
+    c0 = _counter("mxt_serving_cow_copies_total")
+    reqs = [sched.submit(Request(p, max_new_tokens=5)) for p in prompts]
+    sched.run()
+    for r, p in zip(reqs, prompts):
+        assert r.state == "completed"
+        assert r.output_tokens == _ref(p, 5), p
+    assert _counter("mxt_serving_prefix_hits_total") >= h0 + 2
+    assert _counter("mxt_serving_cow_copies_total") >= c0 + 1
+    # every sequence released; only index pins keep pages resident
+    eng.prefix.clear()
+    assert eng.cache.pages_in_use() == 0
+
+
+def test_full_match_cow_pages_diverge():
+    eng = _engine(pages=32)
+    pv = eng.admit(0, "a", BASE, 4)
+    ta = int(pv.get().reshape(-1)[0])
+    pa = eng.cache.pages_of("a")
+    pv = eng.admit(1, "b", BASE, 4)
+    tb = int(pv.get().reshape(-1)[0])
+    pb = eng.cache.pages_of("b")
+    assert ta == tb == _ref(BASE, 1)[0]
+    assert pb[0] == pa[0]                # head page shared
+    assert pb[-1] != pa[-1]              # tail page copy-on-written
+    assert eng.cache.refcount(pa[0]) >= 3  # a + b + index pins
+    eng.release(0)
+    eng.release(1)
+
+
+def test_quantized_cow_carries_pages_and_scales():
+    """COW on the int8 pool copies BOTH the quantized rows and the f32
+    amax planes: the diverged page must be bit-identical to its source
+    (the re-prefilled tail token re-quantizes to the same values — one
+    layer, same inputs)."""
+    eng = _engine(pages=32, quantized=True)
+    pv = eng.admit(0, "a", BASE, 4)
+    pv.get()
+    pv = eng.admit(1, "b", BASE, 4)
+    pv.get()
+    src = eng.cache.pages_of("a")[-1]
+    dst = eng.cache.pages_of("b")[-1]
+    assert src != dst
+    np.testing.assert_array_equal(
+        np.asarray(eng.cache.k_pages[:, dst]),
+        np.asarray(eng.cache.k_pages[:, src]))
+    np.testing.assert_array_equal(
+        np.asarray(eng.cache.k_scales[:, dst]),
+        np.asarray(eng.cache.k_scales[:, src]))
+    np.testing.assert_array_equal(
+        np.asarray(eng.cache.v_scales[:, dst]),
+        np.asarray(eng.cache.v_scales[:, src]))
+    eng.release(0)
+    eng.release(1)
+
+
+def test_can_admit_prefix_discount_and_lru_shedding():
+    """A cached prefix discounts the admission page bill below what a
+    raw reservation could afford; under pool pressure cold index
+    entries shed LRU to free pages — index pins are capacity, not a
+    leak."""
+    eng = _engine(pages=6, max_context=48)
+    pv = eng.admit(0, "a", BASE, 8)      # 3 of 6 pages
+    int(pv.get().reshape(-1)[0])
+    eng.release(0)                       # 2 full pages stay index-pinned
+    assert eng.cache.pages_in_use() == 2
+    # squeeze the pool: 2 more pages held by a foreign reservation
+    assert eng.cache.reserve("pin", 16)
+    eng.cache.alloc_for("pin", 16)       # free pages: 2
+    total = len(BASE) + 8                # 3-page bill undiscounted
+    assert not eng.cache.can_reserve(total)
+    # full match: 2 shared + 1 COW = 2 fresh-page bill -> fits
+    assert eng.can_admit(total, prompt=BASE)
+    assert len(eng.prefix) == 2          # the hit kept its entries
+    # an UNRELATED same-size prompt only fits once the index sheds
+    assert eng.can_admit(total, prompt=list(range(30, 46)))
+    assert len(eng.prefix) == 0          # entries shed LRU
+    assert eng.cache.pages_in_use() == 2  # only the pin remains
+    eng.cache.free("pin")
+
+
+# ---------------------------------------------------------------------------
+# the async contract is untouched
+# ---------------------------------------------------------------------------
+def test_zero_host_sync_decode_with_prefix_hits():
+    """Prefix reuse is an ADMISSION feature: with a shared-prefix hit
+    resident, the decode loop still performs <= 1 host sync per K
+    steps — sync parity with the plain engine."""
+    eng = _engine(pages=64, slots=2)
+    sched = ContinuousBatcher(eng)
+    h0 = _counter("mxt_serving_prefix_hits_total")
+    sched.submit(Request(list(BASE), max_new_tokens=40))
+    sched.submit(Request(list(BASE), max_new_tokens=40))  # COW hit
+    for _ in range(4):                    # admit + absorb prefill reads
+        sched.step()
+    assert _counter("mxt_serving_prefix_hits_total") >= h0 + 1
+    with eng_mod.bulk(4):
+        s0 = profiler.host_sync_count()
+        for _ in range(12):
+            sched.step()
+        syncs = profiler.host_sync_count() - s0
+    assert syncs <= 12 // 4 + 1, \
+        "prefix-hit decode loop performed %d host syncs over 12 steps" \
+        % syncs
+    sched.run()
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode over the fleet transport
+# ---------------------------------------------------------------------------
+def test_disagg_handoff_token_exact_and_trace_chain():
+    """Long prompt on a role-split pool: prefilled on the prefill tier,
+    pages shipped, adopted and decoded on a decode replica — output
+    token-exact vs the oracle; the prefill->ship->adopt->decode chain
+    reconstructs from the trace_id alone and exports to Chrome
+    trace-event JSON. A short prompt routes straight to the decode
+    tier with no ship."""
+    pool, srv = _role_fleet(["prefill", "decode", "decode"])
+    router = FleetRouter(pool, prefill_threshold=8)
+    s0 = _counter("mxt_serving_pages_shipped_total")
+    a0 = _counter("mxt_serving_pages_adopted_total")
+    long = router.submit(list(range(1, 13)), max_new_tokens=5,
+                         token="dg-long")
+    short = router.submit([5, 9, 2], max_new_tokens=4, token="dg-short")
+    router.run(max_steps=2000)
+    assert long.state == "completed" and short.state == "completed"
+    assert long.result == _ref(long.prompt, 5)
+    assert short.result == _ref(short.prompt, 4)
+    assert long.committed_by in (1, 2)    # decode tier decoded it
+    assert short.committed_by in (1, 2)
+    assert _counter("mxt_serving_pages_shipped_total") == s0 + 2
+    assert _counter("mxt_serving_pages_adopted_total") == a0 + 2
+    assert _counter("mxt_serving_ship_bytes_total") > 0
+    # the handoff chain, reassembled from the trace id alone
+    tree = trace_tree(telemetry.trace_spans(), long.trace_id)
+    names = set(tree["names"])
+    assert {"prefill", "ship", "adopt", "dispatch", "decode",
+            "commit"} <= names
+    assert "replica-0" in tree["tracks"]  # prefill ran on the P tier
+    ships = [s for s in tree["tracks"]["router"] if s["name"] == "ship"]
+    assert ships and ships[0]["attrs"]["replica"] == 0
+    assert ships[0]["attrs"]["pages"] == 2
+    # the short request never shipped
+    assert "ship" not in trace_tree(telemetry.trace_spans(),
+                                    short.trace_id)["names"]
+    # Perfetto-loadable chrome trace: events carry the required keys
+    doc = chrome_trace(telemetry.trace_spans(long.trace_id))
+    evs = doc["traceEvents"]
+    assert any(e.get("name") == "ship" and e.get("ph") == "X"
+               for e in evs)
+    assert all(set(e) >= {"name", "ph", "pid", "tid", "ts"}
+               for e in evs)
+    # adopted state fully released once decoding finished
+    for h in pool.replicas():
+        assert h.engine.cache.pages_in_use() == 0
+    _close(pool, srv)
+
+
+def test_ship_idempotent_and_adopt_idempotent():
+    """A re-shipped copy id returns the CACHED payload (no second
+    prefill); a re-adopted copy id resolves to the already-submitted
+    request — so the router's kv_retry can replay either half of the
+    handoff safely."""
+    pool, srv = _role_fleet(["prefill", "decode"])
+    pf, dec = pool.get(0), pool.get(1)
+    prompt = list(range(1, 13))
+    tok0, payload = pf.ship_pages("cid-1", prompt, 4)
+    tok0b, payload_b = pf.ship_pages("cid-1", prompt, 4)
+    assert tok0b == tok0 and payload_b is payload
+    assert pf.engine.cache.pages_in_use() == 0  # shipped state released
+    state = dec.adopt_copy("cid-1", prompt, 4, handoff=(tok0, payload))
+    state2 = dec.adopt_copy("cid-1", prompt, 4, handoff=(tok0, payload))
+    assert state == state2
+    assert len(dec._copies) == 1
+    done = []
+    for _ in range(400):
+        dec.tick(time.monotonic())
+        done = dec.poll()
+        if done:
+            break
+    (cid, st, toks), = done
+    assert cid == "cid-1" and st == "completed"
+    assert toks == _ref(prompt, 4)
+    _close(pool, srv)
+
+
+def test_adopt_refuses_pool_dtype_mismatch():
+    eng_q = _engine(pages=32, quantized=True, prefix=False)
+    eng_f = _engine(pages=32, prefix=False)
+    pv = eng_f.admit(0, "s", list(range(1, 13)), 4)
+    int(pv.get().reshape(-1)[0])
+    payload = eng_f.export_pages("s")
+    eng_f.release(0)
+    with pytest.raises(MXNetError):
+        eng_q.adopt(0, "t", 12, 4, payload, 7)
+    assert eng_q.cache.pages_in_use() == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos cell (swept per seed by tools/chaos_matrix.sh)
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_chaos_prefill_replica_killed_mid_ship(monkeypatch):
+    """Seeded replica_kill of a prefill replica: the router marks it
+    dead and re-ships from the surviving prefill replica — and when the
+    prefill tier is GONE, falls back to local prefill on the decode
+    tier. Either way zero requests are lost, outputs are token-exact,
+    and no surviving replica leaks pages."""
+    from mxnet_tpu import resilience
+
+    # phase 1: a prefill survivor takes over
+    monkeypatch.setenv(
+        "MXT_FAULT",
+        "replica_kill:replica=0,after=0,n=1,seed=%d" % _seed())
+    resilience.reset_faults()
+    try:
+        pool, srv = _role_fleet(["prefill", "prefill", "decode"])
+        router = FleetRouter(pool, prefill_threshold=8)
+        rng = np.random.RandomState(_seed())
+        reqs = [router.submit(rng.randint(1, 64, 12).tolist(),
+                              max_new_tokens=6, token="cp%d" % i)
+                for i in range(4)]
+        router.run(max_steps=2000)
+        assert pool.get(0).state == "dead"
+        assert all(rr.state == "completed" for rr in reqs)
+        assert all(rr.result == _ref(rr.prompt, rr.max_new_tokens)
+                   for rr in reqs)
+        assert all(rr.committed_by == 2 for rr in reqs)  # decode tier
+        for h in pool.replicas():
+            if h.state != "dead":
+                assert h.engine.cache.pages_in_use() == 0
+        _close(pool, srv)
+    finally:
+        resilience.reset_faults()
+
+    # phase 2: the ONLY prefill replica dies -> local-prefill fallback
+    # on the decode tier; the request still completes
+    monkeypatch.setenv(
+        "MXT_FAULT",
+        "replica_kill:replica=0,after=0,n=1,seed=%d" % _seed())
+    resilience.reset_faults()
+    try:
+        pool, srv = _role_fleet(["prefill", "decode"])
+        router = FleetRouter(pool, prefill_threshold=8)
+        rr = router.submit(list(range(1, 13)), max_new_tokens=6,
+                           token="cpf")
+        router.run(max_steps=2000)
+        assert pool.get(0).state == "dead"
+        assert rr.state == "completed"
+        assert rr.result == _ref(rr.prompt, 6)
+        assert rr.committed_by == 1       # local prefill + decode
+        assert pool.get(1).engine.cache.pages_in_use() == 0
+        _close(pool, srv)
+    finally:
+        resilience.reset_faults()
+
+
+# ---------------------------------------------------------------------------
+# observability + lint
+# ---------------------------------------------------------------------------
+def test_mxt_top_prefix_line():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "mxt_top", os.path.join(os.path.dirname(__file__), "..",
+                                "tools", "mxt_top.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    samples = {
+        ("mxt_serving_tokens_total", frozenset()): 120,
+        ("mxt_serving_prefix_hits_total", frozenset()): 30,
+        ("mxt_serving_prefix_misses_total", frozenset()): 10,
+        ("mxt_serving_shared_pages", frozenset()): 6,
+        ("mxt_serving_cow_copies_total", frozenset()): 2,
+    }
+    frame = mod.render(samples, None, 0)
+    assert "prefix" in frame and "0.750" in frame
+    # a replica without the prefix cache renders no prefix noise
+    plain = mod.render({("mxt_serving_tokens_total", frozenset()): 5},
+                       None, 0)
+    assert "prefix" not in plain
+
+
+def test_host_sync_lint_covers_prefix_and_handoff():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_host_syncs", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "check_host_syncs.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    assert "mxnet_tpu/serving/prefix.py" in m.SCAN
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bad = [b for b in m.check(root)
+           if b[0].startswith("mxnet_tpu/serving/")]
+    assert not bad, bad

@@ -3,6 +3,8 @@ weight-update-sharding ladder (stages 1/2/3, arXiv 2004.13336), sharded
 checkpoint/resume across mesh shapes, and elastic in-place mesh
 resharding fused with the membership layer (parallel/reshard.py)."""
 import os
+import subprocess
+import sys
 
 import numpy as np
 import pytest
@@ -278,7 +280,29 @@ def test_elastic_reshard_acceptance(tmp_path):
     fences one data-parallel rank mid-run; survivors reshard IN PLACE
     to (3×2) and continue. The resulting weights match a from-checkpoint
     restart on the smaller mesh BIT-exactly, with zero full-job restarts
-    and the resharding event visible in telemetry."""
+    and the resharding event visible in telemetry.
+
+    Runs ISOLATED in a fresh interpreter: in a full-suite session the
+    in-place mesh rebuild lands on an XLA CPU client already carrying
+    hundreds of compiled programs, which intermittently segfaults at
+    interpreter teardown (ROADMAP standing item). A clean process keeps
+    the acceptance deterministic without masking real failures — the
+    inner run's verdict is asserted, not swallowed."""
+    if os.environ.get("MXT_RESHARD_ACCEPTANCE_INNER") != "1":
+        env = dict(os.environ)
+        env["MXT_RESHARD_ACCEPTANCE_INNER"] = "1"
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q", "-x",
+             "%s::test_elastic_reshard_acceptance"
+             % os.path.abspath(__file__),
+             "-p", "no:cacheprovider", "-p", "no:xdist",
+             "-p", "no:randomly"],
+            env=env, timeout=600, capture_output=True, text=True)
+        assert r.returncode == 0, \
+            "isolated reshard acceptance failed (rc=%d)\n%s\n%s" \
+            % (r.returncode, r.stdout[-4000:], r.stderr[-2000:])
+        return
     spill = str(tmp_path / "reshard_spill")
     rng = np.random.RandomState(1)
     # batch 12: divisible by dp=4 before and dp=3 after the reshard

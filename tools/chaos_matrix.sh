@@ -172,5 +172,30 @@ for seed in "${SEEDS[@]}"; do
     fi
 done
 
+# -- disaggregated-handoff sweep ----------------------------------------------
+# replica_kill of a PREFILL-role replica mid-ship: the chaos-marked
+# cell in tests/test_prefix.py asserts the router re-ships the same
+# copy id from a surviving prefill replica (idempotent — never a
+# re-prefill on the dead one), and with the prefill tier gone falls
+# back to local prefill on the decode tier; zero requests lost,
+# outputs token-exact vs the oracle, no surviving replica leaks KV
+# pages — bounded, never a hang; the outer `timeout` is only the
+# backstop.
+for seed in "${SEEDS[@]}"; do
+    echo "== disagg-handoff sweep: MXT_CHAOS_SEED=$seed (cell timeout ${CELL_TIMEOUT}s)"
+    timeout -k 10 "$CELL_TIMEOUT" env JAX_PLATFORMS=cpu \
+        MXT_CHAOS_SEED="$seed" \
+        python -m pytest tests/test_prefix.py -q -m "chaos and not slow" \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    rc=$?
+    if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+        echo "!! HANG: disagg-handoff sweep seed=$seed exceeded ${CELL_TIMEOUT}s" >&2
+        fail=1
+    elif [ "$rc" -ne 0 ]; then
+        echo "!! FAIL: disagg-handoff sweep seed=$seed rc=$rc" >&2
+        fail=1
+    fi
+done
+
 [ "$fail" -eq 0 ] && echo "chaos matrix: all seeds clean"
 exit "$fail"

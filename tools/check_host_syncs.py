@@ -127,6 +127,12 @@ SCAN = {
     "mxnet_tpu/serving/kv_cache.py": _ALL,
     "mxnet_tpu/serving/model.py": _ALL,
     "mxnet_tpu/serving/metrics.py": _ALL,
+    # shared-prefix reuse is an ADMISSION-time feature: the blake2b
+    # chain hashes host token lists (annotated at the one asarray),
+    # and index bookkeeping is pure host dict/tuple work — the decode
+    # loop never consults it, so any unmarked device read here would
+    # mean prefix lookups started syncing the hot path
+    "mxnet_tpu/serving/prefix.py": _ALL,
     # the speculative round is TWO traced programs per k committed
     # tokens; the accepted-prefix commit is device-side by design, so
     # any unmarked read here would mean the host started peeking at

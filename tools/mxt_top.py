@@ -443,6 +443,12 @@ def render(samples, prev, dt):
                           "mxt_serving_spec_accepted_tokens_total")
     quant_pages = metric_sum(samples,
                              "mxt_serving_kv_quant_pages_in_use")
+    # shared-prefix reuse gauges (PR 16): rendered only when the engine
+    # runs with prefix_cache=True (the counters exist only then)
+    pfx_hits = metric_sum(samples, "mxt_serving_prefix_hits_total")
+    pfx_miss = metric_sum(samples, "mxt_serving_prefix_misses_total")
+    pfx_shared = metric_sum(samples, "mxt_serving_shared_pages")
+    pfx_cow = metric_sum(samples, "mxt_serving_cow_copies_total")
 
     lines = [
         "mxt_top  %s" % time.strftime("%H:%M:%S"),
@@ -568,6 +574,15 @@ def render(samples, prev, dt):
         if quant_pages is not None:
             lines.append("  int8 kv pages    %s in use"
                          % _fmt(quant_pages, "%.0f"))
+        if pfx_hits is not None or pfx_miss is not None:
+            total = (pfx_hits or 0) + (pfx_miss or 0)
+            ratio = (pfx_hits or 0) / total if total else 0.0
+            lines.append(
+                "  prefix           hit %s (%s/%s)   shared pages %s"
+                "   cow %s"
+                % (_fmt(ratio, "%.3f"), _fmt(pfx_hits, "%.0f"),
+                   _fmt(total, "%.0f"), _fmt(pfx_shared, "%.0f"),
+                   _fmt(pfx_cow, "%.0f")))
     return "\n".join(lines)
 
 
