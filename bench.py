@@ -47,7 +47,8 @@ _CONFIG_COST = {"resnet50": 420, "bert": 300, "lstm_ptb": 200,
                 "cold_warm": 120, "serving": 150, "zero_stage": 90,
                 "embedding_ab": 90, "serving_fleet": 120,
                 "speculative": 120, "kv_quant": 90, "fleet_obs": 90,
-                "streaming_input": 90, "prefix_reuse": 120}
+                "streaming_input": 90, "prefix_reuse": 120,
+                "autoscale": 150}
 
 
 def _remaining():
@@ -1871,6 +1872,171 @@ def bench_prefix_reuse(platform, dtype):
     return speedup, row
 
 
+def bench_autoscale(platform, dtype):
+    """autoscale_ab (serving/autoscaler.py + qos.py): a seeded flash
+    crowd (the traffic_storm fault rule) hits a fleet held at its
+    1-replica floor while the autoscaler watches the merged fleet page.
+    Asserts-by-record: the fleet scales UP (up decisions > 0, visible
+    as scale_up spans on the autoscaler's trace track in the Perfetto
+    fleet timeline), EVERY offered request is accounted — submitted ==
+    completed + typed-rejected, zero lost — and the p99 of the LAST
+    half of completions (after the spare went routable) recovers to
+    within the SLO. A second cell is the QoS isolation assert: a bulk
+    tenant saturates admission, its over-quota submits are refused
+    typed (OverQuotaError), and the interactive tenant's p99 stays
+    within a bounded multiple of the unloaded p99."""
+    import numpy as np
+
+    from mxnet_tpu import resilience, serving
+
+    del dtype  # f32: the A/B isolates the control loop, not math
+    slots = int(os.environ.get("BENCH_FLEET_SLOTS", "4"))
+    n_req = int(os.environ.get("BENCH_AUTOSCALE_REQUESTS", "24"))
+    window = float(os.environ.get("BENCH_AUTOSCALE_WINDOW", "120"))
+    layers, heads, hdim = 2, 2, 16
+    model = serving.TinyDecoder(vocab=512, num_layers=layers,
+                                num_heads=heads, head_dim=hdim,
+                                max_len=512)
+    params = model.init_params(0)
+
+    def factory():
+        return serving.DecodeEngine(
+            model, params=params, slots=slots,
+            cache=serving.PagedKVCache(layers, heads, hdim,
+                                       num_pages=256, page_size=16),
+            prefill_buckets=(64,), max_context=128)
+
+    def close_fleet(pool, srv):
+        for h in pool.replicas():
+            try:
+                h.close()
+            except Exception:  # noqa: BLE001 — drained/killed handles
+                pass
+        srv.close()
+
+    def pick(lats, q):
+        return lats[min(len(lats) - 1, int(q * len(lats)))] \
+            if lats else 0.0
+
+    # -- phase A: unloaded p99 at the floor — the yardstick both the
+    # SLO and the QoS isolation multiple are calibrated against
+    pool, srv = serving.local_serving_fleet(1, factory)
+    router = serving.FleetRouter(pool)
+    try:
+        rng = np.random.RandomState(7)
+        base = []
+        for i in range(6):
+            base.append(router.submit(
+                rng.randint(1, 512, 8).tolist(), max_new_tokens=6,
+                token="base-%d" % i))
+            router.run(max_steps=20000)
+        blats = sorted(r.t_finish - r.t_submit for r in base
+                       if r.state == "completed")
+        p99_base = pick(blats, 0.99)
+    finally:
+        close_fleet(pool, srv)
+    slo = max(8 * p99_base, 0.25)
+
+    # -- phase B: flash crowd, autoscaler closing the loop
+    old_fault = os.environ.get("MXT_FAULT")
+    os.environ["MXT_FAULT"] = "traffic_storm:rps=200,after=2"
+    resilience.reset_faults()
+    pool, srv = serving.local_serving_fleet(1, factory)
+    router = serving.FleetRouter(pool, slo=slo)
+    scaler = serving.FleetAutoscaler(
+        router, factory, slo=slo, min_replicas=1, max_replicas=3,
+        cooldown=0.25, queue_high=1.0, calm_ticks=10 ** 6)
+    gen = serving.TrafficGenerator(
+        router, rate=5.0, seed=3, vocab=512, prompt_len=(4, 16),
+        max_new_tokens=6, max_requests=n_req)
+    try:
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < window:
+            gen.tick(router._now())
+            router.step()
+            scaler.step()
+            if gen.total_offered() >= n_req \
+                    and all(r.done for r in gen.submitted):
+                break
+        storm_dt = time.perf_counter() - t0
+        done = [r for r in gen.submitted if r.state == "completed"]
+        lost = len(gen.submitted) - len(done)
+        tokens = sum(len(r.result) for r in done)
+        by_finish = sorted(done, key=lambda r: r.t_finish)
+        tail = sorted(r.t_finish - r.t_submit
+                      for r in by_finish[len(by_finish) // 2:])
+        p99_tail = pick(tail, 0.99)
+        up_events = sum(1 for d in scaler.decisions
+                        if d["direction"] == "up")
+        replicas_end = len(pool.routable())
+        scaler._collector.scrape()
+        span_names = {s.get("name")
+                      for s in scaler._collector.spans(scaler.trace_id)}
+        on_timeline = "scale_up" in span_names
+    finally:
+        scaler.close()
+        close_fleet(pool, srv)
+        if old_fault is None:
+            os.environ.pop("MXT_FAULT", None)
+        else:
+            os.environ["MXT_FAULT"] = old_fault
+        resilience.reset_faults()
+
+    # -- phase C: QoS isolation — bulk saturates admission, interactive
+    # rides the priority queue, over-quota bulk is refused typed
+    qos = serving.QosPolicy.parse("interactive:bulk")
+    qos.add_tenant("bulk", max_requests=3)
+    pool, srv = serving.local_serving_fleet(1, factory)
+    router = serving.FleetRouter(pool, qos=qos)
+    try:
+        rng = np.random.RandomState(5)
+        bulk_ok = bulk_refused = 0
+        for i in range(12):
+            try:
+                router.submit(rng.randint(1, 512, 12).tolist(),
+                              max_new_tokens=8, token="blk-%d" % i,
+                              tenant="bulk")
+                bulk_ok += 1
+            except serving.OverQuotaError:
+                bulk_refused += 1
+        inter = [router.submit(rng.randint(1, 512, 8).tolist(),
+                               max_new_tokens=6, token="int-%d" % i,
+                               tenant="interactive")
+                 for i in range(6)]
+        router.run(max_steps=40000)
+        ilats = sorted(r.t_finish - r.t_submit for r in inter
+                       if r.state == "completed")
+        p99_inter = pick(ilats, 0.99)
+    finally:
+        close_fleet(pool, srv)
+
+    recovery = slo / p99_tail if p99_tail else 0.0
+    row = {
+        "config": "autoscale_ab", "chips": 1, "batch_size": slots,
+        "dtype": "float32", "platform": platform, "requests": n_req,
+        "images_or_tokens_per_sec_per_chip": round(
+            tokens / storm_dt if storm_dt else 0.0, 2),
+        "slo_s": round(slo, 4),
+        "p99_base_ms": round(p99_base * 1e3, 2),
+        "p99_storm_tail_ms": round(p99_tail * 1e3, 2),
+        "slo_recovery_x": round(recovery, 3),
+        "replicas_start": 1, "replicas_end": replicas_end,
+        "scale_up_events": up_events,
+        "scale_up_span_on_timeline": on_timeline,
+        "submitted": len(gen.submitted),
+        "typed_rejected": gen.rejected,
+        "completed": len(done), "lost_requests": lost,
+        "qos_bulk_admitted": bulk_ok,
+        "qos_bulk_refused_typed": bulk_refused,
+        "p99_interactive_ms": round(p99_inter * 1e3, 2),
+        "qos_isolation_x": round(p99_inter / p99_base, 3)
+        if p99_base else None,
+        "mfu": None, "flops_per_sample": None,
+    }
+    _emit_jsonl(row)
+    return recovery, row
+
+
 def bench_cold_warm(platform, dtype):
     """Cold-vs-warm start A/B (tuning/): the SAME canonical fused-step
     loop run in two fresh processes sharing one persistent compile cache
@@ -2157,7 +2323,7 @@ def main():
         "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline,async_ab,"
         "telemetry_ab,diag_ab,cold_warm,serving,zero_stage,embedding_ab,"
         "serving_fleet,speculative,kv_quant,fleet_obs,streaming_input,"
-        "prefix_reuse"
+        "prefix_reuse,autoscale"
     ).split(",")
 
     # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
@@ -2208,6 +2374,9 @@ def main():
         "prefix_reuse": ("prefix_reuse_speedup",
                          "x (reuse-on/off tokens/s, token-exact)",
                          bench_prefix_reuse),
+        "autoscale": ("autoscale_slo_recovery",
+                      "x (SLO / post-scale p99 — >=1 means recovered)",
+                      bench_autoscale),
     }
     headline = None
     errors = []
@@ -2217,7 +2386,8 @@ def main():
                  "pipeline", "async_ab", "telemetry_ab", "diag_ab",
                  "cold_warm", "serving", "zero_stage", "embedding_ab",
                  "serving_fleet", "speculative", "kv_quant",
-                 "fleet_obs", "streaming_input", "prefix_reuse"):
+                 "fleet_obs", "streaming_input", "prefix_reuse",
+                 "autoscale"):
         if name not in configs:
             continue
         cost = float(os.environ.get("BENCH_COST_%s" % name.upper(),

@@ -49,6 +49,17 @@ their KV pages ship over the transport to a decode replica
     python examples/serve_bert.py --prefix-cache --ab
     python examples/serve_bert.py --prefix-cache --replicas 3 \\
         --prefill-replicas 1
+
+`--autoscale [MAX]` closes the control loop: an SLO-driven autoscaler
+watches the merged fleet page (p99 vs --deadline, queue backlog,
+occupancy) and grows the fleet with AOT-warm spares up to MAX
+(default 4) / drains it back to the --replicas floor, printing every
+scale decision. `--tenants SPEC` (e.g. ``interactive:bulk``) turns on
+multi-tenant QoS: requests round-robin the named tenants, dispatch is
+priority-aware (interactive preempts bulk under slot pressure), and
+per-tenant quotas refuse over-quota submits typed::
+
+    python examples/serve_bert.py --autoscale --tenants interactive:bulk
 """
 from __future__ import annotations
 
@@ -163,6 +174,23 @@ def main():
                         "prefill there and their finished KV pages "
                         "ship over the transport to a decode replica "
                         "(srv_ship_pages/srv_adopt_pages)")
+    p.add_argument("--autoscale", type=int, nargs="?", const=4,
+                   default=None, metavar="MAX",
+                   help="run the SLO-driven autoscaler over the fleet "
+                        "(floor = --replicas, ceiling = MAX, default "
+                        "4): the control loop watches the merged fleet "
+                        "page and spawns AOT-warm spares through the "
+                        "warming->routable lifecycle / drains idle "
+                        "replicas; every scale decision prints at the "
+                        "end")
+    p.add_argument("--tenants", default=None, metavar="SPEC",
+                   help="multi-tenant QoS, e.g. 'interactive:bulk' or "
+                        "'interactive=0,bulk=2': requests round-robin "
+                        "the named tenants, dispatch is priority-aware "
+                        "(interactive preempts bulk under slot "
+                        "pressure), per-tenant quotas "
+                        "(MXT_TENANT_QUOTA_REQUESTS/_TOKENS) refuse "
+                        "over-quota submits typed")
     p.add_argument("--watchdog", type=float, nargs="?", const=30.0,
                    default=None, metavar="SECONDS",
                    help="arm the diagnostics layer (flight recorder + "
@@ -237,7 +265,8 @@ def main():
               if args.prefix_cache else None)
 
     if args.replicas > 1 or args.kill_one or args.fleet_top \
-            or args.prefill_replicas:
+            or args.prefill_replicas or args.autoscale is not None \
+            or args.tenants:
         n = max(2 if args.kill_one else 1, args.replicas,
                 args.prefill_replicas + 1)
         roles = None
@@ -247,7 +276,16 @@ def main():
             print("fleet roles: %s" % " ".join(roles))
         pool, coord = serving.local_serving_fleet(n, engine,
                                                   roles=roles)
-        router = serving.FleetRouter(pool, slo=args.deadline)
+        qos = serving.QosPolicy.parse(args.tenants) if args.tenants \
+            else None
+        router = serving.FleetRouter(pool, slo=args.deadline, qos=qos)
+        scaler = None
+        if args.autoscale is not None:
+            scaler = serving.FleetAutoscaler(
+                router, engine, slo=args.deadline, min_replicas=n,
+                max_replicas=max(n, args.autoscale))
+            print("autoscale: floor %d, ceiling %d"
+                  % (n, max(n, args.autoscale)))
         collector = None
         if args.fleet_top:
             from mxnet_tpu import telemetry_fleet
@@ -257,17 +295,27 @@ def main():
             collector.refresh()
             collector.start(interval=0.2)
         rng = np.random.RandomState(7)
+        tenant_names = sorted(qos.tenants()) if qos is not None else []
         t0 = time.perf_counter()
         reqs = []
+        over_quota = 0
         for i in range(args.requests):
             plen = int(rng.randint(4, 97))
             mnew = int(rng.randint(8, max(9, args.max_new + 1)))
             prompt = rng.randint(1, 512, plen).tolist()
             if system is not None and i % 2:
                 prompt = system + prompt
-            reqs.append(router.submit(
-                prompt, max_new_tokens=mnew, deadline=args.deadline,
-                token="req-%d" % i))
+            tenant = tenant_names[i % len(tenant_names)] \
+                if tenant_names else None
+            try:
+                reqs.append(router.submit(
+                    prompt, max_new_tokens=mnew,
+                    deadline=args.deadline, token="req-%d" % i,
+                    tenant=tenant))
+            except serving.OverQuotaError as e:
+                over_quota += 1
+                print("over quota (tenant %s): req-%d refused typed"
+                      % (e.tenant, i))
         if args.kill_one:
             while router.step() and router.steps < 8:
                 pass
@@ -276,7 +324,11 @@ def main():
             print("killed replica %d mid-run (no deregister — the "
                   "fleet fails its in-flight requests over)"
                   % victim.index)
-        router.run()
+        if scaler is not None:
+            while router.step():
+                scaler.step()
+        else:
+            router.run()
         dt = time.perf_counter() - t0
         done = [r for r in reqs if r.state == "completed"]
         tokens = sum(len(r.result) for r in done)
@@ -293,6 +345,28 @@ def main():
                  {h.index: sum(1 for r in done
                                if r.committed_by == h.index)
                   for h in pool.replicas()}))
+        if scaler is not None:
+            ups = sum(1 for d in scaler.decisions
+                      if d["direction"] == "up")
+            downs = sum(1 for d in scaler.decisions
+                        if d["direction"] == "down")
+            print("   autoscale: %d -> %d replicas (%d up, %d down)"
+                  % (n, len(pool.routable()), ups, downs))
+            for d in scaler.decisions:
+                print("     #%d %-8s %s" % (d["seq"], d["direction"],
+                                            d.get("reason")))
+            scaler.close()
+        if qos is not None:
+            by_tenant = {}
+            for r in done:
+                key = r.tenant or "default"
+                by_tenant[key] = by_tenant.get(key, 0) + 1
+            pre = sum(r.preemptions for r in reqs)
+            print("   tenants: %s   preemptions %d   over-quota "
+                  "refused %d"
+                  % (" ".join("%s=%d" % kv
+                              for kv in sorted(by_tenant.items())),
+                     pre, over_quota))
         if args.prefix_cache:
             hits = _counter("mxt_serving_prefix_hits_total")
             miss = _counter("mxt_serving_prefix_misses_total")

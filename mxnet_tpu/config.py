@@ -165,7 +165,14 @@ _declare("MXT_FAULT", str, None,
          "decode fleet at its Kth chunk-commit boundary (survivors "
          "steal its reclaimed chunks), "
          "data_worker_slow:host=I,ms=N slows host I's decode by N ms "
-         "per chunk (steal bait).")
+         "per chunk (steal bait); "
+         "traffic_storm:rps=N,after=K[,tenant=T] flips the synthetic "
+         "serving TrafficGenerator to N req/s after its Kth tick "
+         "(optionally all attributed to tenant T) — the seeded flash "
+         "crowd the autoscaler must absorb; "
+         "replica_spawn_slow:ms=N makes every autoscaler-spawned spare "
+         "take N ms extra to warm before it may go routable (the "
+         "router must keep serving off the existing tier meanwhile).")
 
 _declare("MXT_MEMBERSHIP", bool, True,
          "Elastic membership for the dist kvstore (membership.py): "
@@ -287,6 +294,53 @@ _declare("MXT_FLEET_SCRAPE_INTERVAL", float, 2.0,
          "telemetry_fleet.FleetCollector.start() — how often the "
          "collector refreshes membership and re-scrapes every member's "
          "registry and trace spans.")
+
+_declare("MXT_AUTOSCALE_INTERVAL", float, 1.0,
+         "Control-loop period in seconds for the serving fleet "
+         "autoscaler's background thread (serving/autoscaler.py "
+         "FleetAutoscaler.start()) — how often the merged fleet page "
+         "is re-read and a scale decision considered.")
+_declare("MXT_AUTOSCALE_COOLDOWN", float, 5.0,
+         "Minimum seconds between autoscaler actuations in the SAME "
+         "replica pool (and per attached worker fleet): after an "
+         "up/down decision the loop observes only, so a scale-up's "
+         "effect lands in the signals before the next decision — the "
+         "anti-flap half of the hysteresis pair.")
+_declare("MXT_AUTOSCALE_MIN_REPLICAS", int, 1,
+         "Serving-replica floor: the autoscaler refuses typed "
+         "(AutoscalerError) any decision or scale_to() that would drop "
+         "the routable+warming population below this.")
+_declare("MXT_AUTOSCALE_MAX_REPLICAS", int, 8,
+         "Serving-replica ceiling: scale-up stops here; scale_to() "
+         "above it refuses typed.")
+_declare("MXT_AUTOSCALE_QUEUE_HIGH", float, 2.0,
+         "Scale-up pressure threshold: queued requests (router backlog "
+         "+ merged replica admission queues) >= this many per slot of "
+         "fleet capacity reads as hot, as does p99 latency above the "
+         "SLO.")
+_declare("MXT_AUTOSCALE_OCC_LOW", float, 0.3,
+         "Scale-down calm threshold: mean routable-replica occupancy "
+         "at or below this fraction, with an empty queue and p99 "
+         "within SLO, counts one calm tick.")
+_declare("MXT_AUTOSCALE_CALM_TICKS", int, 3,
+         "Consecutive calm observations required before the "
+         "autoscaler shrinks by one replica — the hysteresis half that "
+         "keeps a brief lull from draining capacity a flash crowd "
+         "would immediately need back.")
+_declare("MXT_AUTOSCALE_SLO", float, None,
+         "Target p99 routed-request latency in seconds for the "
+         "autoscaler's error signal when the FleetRouter has no slo= "
+         "of its own. Unset means latency never reads as hot (queue "
+         "pressure still scales).")
+
+_declare("MXT_TENANT_QUOTA_REQUESTS", int, None,
+         "Default per-tenant cap on OUTSTANDING requests (admitted, "
+         "not yet finished) for serving QoS (serving/qos.py) when a "
+         "tenant has no explicit TenantSpec. Unset means unlimited.")
+_declare("MXT_TENANT_QUOTA_TOKENS", int, None,
+         "Default per-tenant cap on outstanding token budget "
+         "(prompt + max_new_tokens summed over in-flight requests). "
+         "Unset means unlimited.")
 
 _declare("MXT_WATCHDOG_TIMEOUT", float, None,
          "Hang-watchdog stall threshold in seconds (diagnostics.py): a "

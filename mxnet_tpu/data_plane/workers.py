@@ -237,6 +237,7 @@ class DecodeWorkerFleet:
         self._lock = threading.Lock()
         self._threads = []
         self._live = 0
+        self._wids = set()      # worker ids currently running (resize())
         self._commits = 0       # chunks this fleet committed
         self._records = 0
         self._buffered_bytes = 0
@@ -253,7 +254,46 @@ class DecodeWorkerFleet:
             return self
         self._t0 = time.perf_counter()
         self._live = self.num_workers
+        self._wids = set(range(self.num_workers))
         for wid in range(self.num_workers):
+            t = threading.Thread(
+                target=self._run, args=(wid,), daemon=True,
+                name="data-decode-h%d-w%d" % (self.host, wid))
+            self._threads.append(t)
+            t.start()
+        return self
+
+    def live_workers(self):
+        """Worker threads currently decoding (retired, dead, and
+        not-yet-started workers excluded) — the autoscaler's 'did the
+        last resize land' signal."""
+        with self._lock:
+            return len(self._wids)
+
+    def resize(self, n):
+        """Grow or shrink the decode-worker fleet in place.
+
+        Growing spawns the missing worker ids immediately; shrinking is
+        cooperative — surplus workers (``wid >= n``) retire at their
+        next chunk boundary, so a shrink never abandons a leased chunk
+        mid-decode (the commit still lands, the batches still feed).
+        ``n < 1`` refuses typed: a host keeps at least one decode
+        worker while it lives (``close()`` is how a fleet stops)."""
+        n = int(n)
+        if n < 1:
+            raise MXNetError(
+                "DecodeWorkerFleet.resize(%d): a live host keeps at "
+                "least one decode worker — use close() to stop the "
+                "fleet" % (n,))
+        with self._lock:
+            self.num_workers = n
+            if not self._threads or self._stop.is_set():
+                return self  # not started yet: start() spawns n
+            spawn = [wid for wid in range(n) if wid not in self._wids]
+            for wid in spawn:
+                self._wids.add(wid)
+                self._live += 1
+        for wid in spawn:
             t = threading.Thread(
                 target=self._run, args=(wid,), daemon=True,
                 name="data-decode-h%d-w%d" % (self.host, wid))
@@ -308,6 +348,9 @@ class DecodeWorkerFleet:
         readers = {}
         try:
             while not self._stop.is_set():
+                if wid >= self.num_workers:
+                    return  # retired by resize(): shrink lands at a
+                    # chunk boundary, never mid-decode
                 if self._chaos():
                     return
                 try:
@@ -343,6 +386,7 @@ class DecodeWorkerFleet:
                 r.close()
             with self._lock:
                 self._live -= 1
+                self._wids.discard(wid)
                 last = self._live <= 0
             if last:
                 # wake the consumer immediately instead of letting it

@@ -55,6 +55,15 @@ checkpoint writes at named points.
                                  # requests fail over to survivors)
     replica_slow:replica=0,ms=500   # stall serving replica 0's decode
                                  # for 500 ms (the router's hedge bait)
+    traffic_storm:rps=200,after=5   # flash crowd: the synthetic serving
+                                 # TrafficGenerator jumps to 200 req/s
+                                 # at its 5th tick (optional tenant=T
+                                 # attributes the whole storm to one
+                                 # tenant — the QoS isolation stressor)
+    replica_spawn_slow:ms=250    # every autoscaler-spawned spare takes
+                                 # 250 ms extra to warm before it may
+                                 # go routable (the router must keep
+                                 # serving off the existing tier)
 
 ``p`` defaults to 1.0, ``n`` (max firings) to unlimited, ``seed`` to 0.
 One injector instance lives per distinct spec string so the drawn

@@ -50,6 +50,20 @@ production-traffic half:
   ship over the transport (``srv_ship_pages`` / ``srv_adopt_pages``)
   and the request enters decode with zero prefill work on the decode
   tier.
+- :mod:`~mxnet_tpu.serving.autoscaler` /
+  :mod:`~mxnet_tpu.serving.qos` — the closed control loop over all of
+  it: :class:`FleetAutoscaler` consumes the FleetCollector's merged
+  fleet page (p99 vs SLO, queue depth, occupancy, goodput) and
+  actuates — spawns AOT-warm spares through the warming->routable
+  lifecycle, shrinks via ``router.drain``, independently scales
+  decode-worker fleets and the prefill/decode tiers — with hysteresis
+  + cooldown (never flaps) and typed floor refusal
+  (:class:`AutoscalerError`); :class:`QosPolicy` adds multi-tenant
+  isolation — per-tenant outstanding quotas with typed
+  :class:`OverQuotaError` refusal, priority-class dispatch, and
+  preemption of bulk for interactive (preempted requests re-enqueue
+  idempotently, never lost). :class:`TrafficGenerator` is the seeded
+  flash-crowd arrival process the chaos cells drive.
 
 Minimal use::
 
@@ -74,6 +88,7 @@ Fleet use::
 """
 from __future__ import annotations
 
+from .autoscaler import AutoscalerError, FleetAutoscaler, TrafficGenerator
 from .engine import DecodeEngine
 from .fleet import (LocalReplica, RemoteReplica, ReplicaPool,
                     ServingHost, StaleReplicaError, local_serving_fleet,
@@ -81,6 +96,7 @@ from .fleet import (LocalReplica, RemoteReplica, ReplicaPool,
 from .kv_cache import PagedKVCache
 from .model import TinyDecoder
 from .prefix import PrefixIndex
+from .qos import OverQuotaError, QosPolicy, TenantSpec
 from .router import FleetRouter, RoutedRequest
 from .scheduler import ContinuousBatcher, Request, StaticBatcher
 from .speculative import SpeculativeEngine
@@ -91,4 +107,6 @@ __all__ = ["DecodeEngine", "SpeculativeEngine", "PagedKVCache",
            "ContinuousBatcher", "Request", "StaticBatcher", "metrics",
            "FleetRouter", "RoutedRequest", "ReplicaPool", "LocalReplica",
            "RemoteReplica", "ServingHost", "StaleReplicaError",
-           "local_serving_fleet", "serve_replica"]
+           "local_serving_fleet", "serve_replica",
+           "FleetAutoscaler", "AutoscalerError", "TrafficGenerator",
+           "QosPolicy", "TenantSpec", "OverQuotaError"]

@@ -1,0 +1,544 @@
+"""SLO-driven fleet autoscaler — the actuator that closes the PR 13
+observability loop.
+
+Everything the fleet already EXPORTS (p99 latency, queue depth,
+replica occupancy, goodput — the FleetCollector's merged page) becomes
+an input here, and every lever the fleet already HAS becomes an
+actuator:
+
+* **grow** — spawn a hot spare through the PR 11 warming→routable
+  lifecycle: build the engine, AOT-warm it off the shared compile cache
+  (zero request-path compiles, the PR 6 contract), and only then
+  register + route. The spawn is split :meth:`LocalReplica.prepare` /
+  ``go_routable`` so a SLOW-warming spare (``replica_spawn_slow``)
+  holds in ``warming`` without ever stalling the router's step — the
+  autoscaler promotes it from its own loop when warm-up completes.
+* **shrink** — ``router.drain``: stop admission, migrate queued copies
+  to peers, finish running ones, deregister gracefully. Only a
+  ROUTABLE replica is ever drained (the PR 18 lifecycle-race bugfix).
+* **decode-worker fleets** — :meth:`DecodeWorkerFleet.resize`, driven
+  independently by the fleet's own buffer watermarks (a starved
+  consumer grows the fleet, a producer running far ahead shrinks it).
+* **prefill/decode tiers** — on a role-split pool (PR 16), growth goes
+  to the hotter tier (mean per-replica occupancy from the merged page).
+
+Control discipline — the loop must never flap and never lie:
+
+* hysteresis: scale UP on a hot signal (p99 over the SLO, or queue
+  backlog past ``MXT_AUTOSCALE_QUEUE_HIGH`` × capacity); scale DOWN
+  only after ``MXT_AUTOSCALE_CALM_TICKS`` consecutive calm evaluations
+  (empty queue, occupancy under ``MXT_AUTOSCALE_OCC_LOW``, p99 within
+  SLO). One hot sample resets the calm streak.
+* cooldown: ``MXT_AUTOSCALE_COOLDOWN`` seconds between actions, and at
+  most one spare warming at a time.
+* typed floor/ceiling: the loop clamps; an EXPLICIT ``scale_to`` below
+  ``min_replicas`` (or above ``max_replicas``) raises
+  :class:`AutoscalerError` and counts a ``refused`` event.
+* every decision is a replica-lifecycle event on the PR 13 trace
+  timeline (``scale_up``/``scale_down`` spans on the autoscaler's own
+  track + ``mxt_autoscale_events_total{direction}``), so a Perfetto
+  load of the fleet trace shows WHEN the fleet grew and WHY.
+
+Decisions are host arithmetic over metrics snapshots and wall clocks —
+tools/check_host_syncs.py scans this module; reading device state to
+decide a scale action would re-serialize the very fleet it grows.
+
+:class:`TrafficGenerator` lives here too: the seeded open-loop arrival
+process the flash-crowd chaos cells and the ``autoscale_ab`` bench
+drive, consulting the ``traffic_storm:rps=N,after=K[,tenant=T]`` fault
+rule so every storm is deterministic per ``MXT_CHAOS_SEED``.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..base import MXNetError
+from . import metrics as _m
+from .fleet import WARMING, LocalReplica
+
+__all__ = ["AutoscalerError", "FleetAutoscaler", "TrafficGenerator"]
+
+_TRACK = "autoscaler"
+
+# decode-worker fleet watermarks: fraction of the host-side batch
+# buffer. Empty buffer = the consumer is starving (grow the fleet);
+# near-full = the producers run far ahead (shrink it).
+_WORKER_LOW = 0.25
+_WORKER_HIGH = 0.75
+
+# p99 is read from the fleet-wide request latency histogram
+_LATENCY_METRIC = "mxt_fleet_request_latency_seconds"
+_OCC_METRIC = "mxt_fleet_replica_occupancy"
+_REQS_METRIC = "mxt_fleet_requests_total"
+
+
+class AutoscalerError(MXNetError):
+    """Typed refusal of a scale action (below the configured floor,
+    above the ceiling, or an actuator in an unusable state)."""
+
+
+class FleetAutoscaler:
+    """The control loop. ``step()`` runs one evaluation synchronously
+    (what the tests and the bench drive, deterministic under a fake
+    ``now_fn``); ``start(interval)`` runs it on a daemon thread like
+    the FleetCollector's background scrape.
+
+    ``engine_factory`` is the same callable the fleet was built from —
+    a spawned spare AOT-warms off the shared compile cache, so growth
+    is cheap by construction (the arXiv 2604.15464 economics)."""
+
+    def __init__(self, router, engine_factory, collector=None,
+                 now_fn=None, slo=None, min_replicas=None,
+                 max_replicas=None, cooldown=None, queue_high=None,
+                 occ_low=None, calm_ticks=None, warm=True,
+                 heartbeats=True, worker_fleets=()):
+        from .. import config, telemetry
+
+        self.router = router
+        self.pool = router.pool
+        self._factory = engine_factory
+        self._now = now_fn if now_fn is not None else router._now
+        self._warm = bool(warm)
+        self._heartbeats = bool(heartbeats)
+        if slo is None:
+            slo = getattr(router, "slo", None)
+        if slo is None:
+            slo = config.get("MXT_AUTOSCALE_SLO")
+        self.slo = slo
+        self.min_replicas = int(config.get("MXT_AUTOSCALE_MIN_REPLICAS")
+                                if min_replicas is None else min_replicas)
+        self.max_replicas = int(config.get("MXT_AUTOSCALE_MAX_REPLICAS")
+                                if max_replicas is None else max_replicas)
+        if self.min_replicas < 1:
+            raise AutoscalerError(
+                "autoscaler floor must be >= 1 replica (got %d) — a "
+                "fleet scaled to zero cannot serve the request that "
+                "would scale it back up" % self.min_replicas)
+        if self.max_replicas < self.min_replicas:
+            raise AutoscalerError(
+                "autoscaler ceiling %d is below its floor %d"
+                % (self.max_replicas, self.min_replicas))
+        self.cooldown = config.get("MXT_AUTOSCALE_COOLDOWN") \
+            if cooldown is None else cooldown
+        self.queue_high = config.get("MXT_AUTOSCALE_QUEUE_HIGH") \
+            if queue_high is None else queue_high
+        self.occ_low = config.get("MXT_AUTOSCALE_OCC_LOW") \
+            if occ_low is None else occ_low
+        self.calm_ticks = int(config.get("MXT_AUTOSCALE_CALM_TICKS")
+                              if calm_ticks is None else calm_ticks)
+        self._collector = collector
+        self._own_collector = False
+        if self._collector is None:
+            from .. import telemetry_fleet
+
+            self._collector = telemetry_fleet.FleetCollector(
+                server=self.pool.server,
+                coordinator=None if self.pool.server is not None
+                else self.pool.coordinator,
+                include_local=True, now_fn=self._now)
+            self._own_collector = True
+        # the autoscaler's OWN trace: scale decisions + spare promotions
+        # land here so the Perfetto fleet timeline shows the control
+        # loop next to the request tracks
+        self.trace_id = telemetry.new_trace_id()
+        self.decisions = []      # decision records, oldest first
+        self._ndecisions = 0
+        self._last_action = None  # time of the last actuation (cooldown)
+        self._calm = 0            # consecutive calm evaluations
+        self._pending = []        # (handle, ready_at): spares warming
+        self._worker_fleets = list(worker_fleets)
+        self._worker_last = {}    # id(fleet) -> last actuation time
+        self._thread = None
+        self._stop = threading.Event()
+        _m.autoscale_target_replicas().set(
+            len(self.pool.routable()) + len(self._pending))
+
+    # -- signals -------------------------------------------------------------
+    def signals(self):
+        """One merged-fleet-page snapshot reduced to the loop's inputs:
+        p99 vs SLO, queue backlog (router + replica queues), occupancy
+        per slot, and goodput. Pure host arithmetic — missing metrics
+        (no traffic yet) read as ``None``/zero, never an error."""
+        reg = self._collector.scrape().fleet_registry()
+        p99 = reg.quantile(_LATENCY_METRIC, 0.99, missing_ok=True)
+        queue = len(self.router._queue)
+        rq = reg.merged_value("mxt_serving_queue_depth")
+        if rq:
+            queue += int(rq)
+        occ = reg.merged_value(_OCC_METRIC) or 0
+        cap = max(1, self.pool.total_capacity())
+        done = reg.merged_value(_REQS_METRIC,
+                                labels={"outcome": "completed"}) or 0
+        bad = 0
+        for outcome in ("evicted", "rejected"):
+            bad += reg.merged_value(_REQS_METRIC,
+                                    labels={"outcome": outcome}) or 0
+        goodput = done / (done + bad) if (done + bad) else None
+        return {"p99": p99, "queue": queue, "occupancy": occ / cap,
+                "capacity": cap, "goodput": goodput}
+
+    # -- the loop ------------------------------------------------------------
+    def step(self):
+        """One control evaluation: promote any warmed spare, read the
+        merged page, decide, actuate. Returns the decision direction
+        (``"up"``/``"down"``) or ``None`` (hold)."""
+        now = self._now()
+        self.promote_spares(now)
+        sig = self.signals()
+        decision = self._decide(sig, now)
+        if decision == "up":
+            self._scale_up(sig, now)
+        elif decision == "down":
+            self._scale_down(sig, now)
+        self._step_workers(now)
+        _m.autoscale_target_replicas().set(self.replica_target())
+        return decision
+
+    def replica_target(self):
+        """Replicas the loop currently stands behind: routable +
+        draining-out excluded, warming spares included."""
+        return len(self.pool.routable()) + len(self._pending)
+
+    def _decide(self, sig, now):
+        hot = sig["queue"] >= self.queue_high * sig["capacity"]
+        if not hot and self.slo is not None and sig["p99"] is not None:
+            hot = sig["p99"] > self.slo
+        calm = (sig["queue"] == 0 and sig["occupancy"] <= self.occ_low
+                and (self.slo is None or sig["p99"] is None
+                     or sig["p99"] <= self.slo))
+        if hot:
+            self._calm = 0   # hysteresis: one hot sample resets calm
+        elif calm:
+            self._calm += 1
+        if self._last_action is not None \
+                and now - self._last_action < self.cooldown:
+            return None
+        target = self.replica_target()
+        if hot and not self._pending and target < self.max_replicas:
+            return "up"
+        if not hot and calm and self._calm >= self.calm_ticks \
+                and target > self.min_replicas:
+            return "down"
+        return None
+
+    # -- actuators -----------------------------------------------------------
+    def _next_index(self):
+        return 1 + max((h.index for h in self.pool.replicas()),
+                       default=-1)
+
+    def _growth_role(self):
+        """On a role-split pool, grow the hotter tier (mean per-replica
+        occupancy from the merged page); plain pools grow decode."""
+        pf = self.pool.routable(role="prefill")
+        if not pf:
+            return "decode"
+        reg = self._collector.fleet_registry()
+
+        def mean_occ(handles):
+            occ = cap = 0
+            for h in handles:
+                occ += reg.merged_value(
+                    _OCC_METRIC, labels={"replica": str(h.index)}) or 0
+                cap += max(1, int(h.capacity or 1))
+            return occ / max(1, cap)
+
+        dec = [h for h in self.pool.routable()
+               if getattr(h, "role", "decode") != "prefill"]
+        return "prefill" if mean_occ(pf) > mean_occ(dec) else "decode"
+
+    def _scale_up(self, sig, now, role=None):
+        """Spawn one spare: prepare (build + AOT-warm) now, join the
+        pool WARMING, go routable when warm-up completes — immediately,
+        unless the seeded ``replica_spawn_slow:ms=N`` rule holds it
+        (the router keeps serving off the existing replicas either
+        way)."""
+        from .. import resilience
+
+        if role is None:
+            role = self._growth_role()
+        idx = self._next_index()
+        h = LocalReplica(idx, self._factory,
+                         coordinator=self.pool.coordinator,
+                         now_fn=self._now, heartbeats=self._heartbeats,
+                         role=role)
+        h.prepare(warm=self._warm)
+        delay = 0.0
+        inj = resilience.fault_point()
+        rule = inj.rule("replica_spawn_slow")
+        if rule is not None and inj.should("replica_spawn_slow"):
+            delay = int(rule.get("ms", 100)) / 1e3
+        self.pool.add(h)
+        self._pending.append((h, now + delay))
+        self._record("up", now, replica=idx, role=role,
+                     reason=self._reason(sig), delay=delay)
+        self.promote_spares(now)
+
+    def _scale_down(self, sig, now):
+        """Drain one routable replica: the least-loaded (the cheapest
+        to migrate), highest index on ties (spares retire before the
+        seed fleet). Refuses typed at the floor."""
+        candidates = self.pool.routable()
+        if self.replica_target() <= self.min_replicas:
+            self._record("refused", now, reason="at floor (%d)"
+                         % self.min_replicas)
+            raise AutoscalerError(
+                "cannot scale below the configured floor of %d "
+                "replica(s) — raise min_replicas/MXT_AUTOSCALE_MIN_"
+                "REPLICAS if a smaller fleet is really intended"
+                % self.min_replicas)
+
+        def load_of(h):
+            try:
+                ld = h.load()
+                return int(ld.get("queue", 0)) + int(ld.get("active", 0))
+            except (ConnectionError, OSError):
+                return 0
+
+        victim = min(candidates, key=lambda h: (load_of(h), -h.index))
+        self.router.drain(victim.index)
+        self._record("down", now, replica=victim.index,
+                     reason=self._reason(sig))
+
+    def promote_spares(self, now=None):
+        """Flip warmed spares to routable (their warm-up horizon
+        passed); the slow-spawn rule only ever delays THIS promotion,
+        never the router. Returns the indices promoted."""
+        now = self._now() if now is None else now
+        out = []
+        still = []
+        for h, ready_at in self._pending:
+            if h.state != WARMING:   # killed while warming
+                continue
+            if now >= ready_at:
+                h.go_routable()
+                out.append(h.index)
+                self._span("replica_routable", now, replica=h.index)
+            else:
+                still.append((h, ready_at))
+        self._pending = still
+        if out:
+            self.pool.publish()
+        return out
+
+    def scale_to(self, n, reason="manual"):
+        """Explicit fleet size: clamps NOTHING — below the floor or
+        above the ceiling is a typed refusal (and a ``refused`` event),
+        exactly so an operator typo cannot black-hole the fleet."""
+        n = int(n)
+        now = self._now()
+        if n < self.min_replicas or n > self.max_replicas:
+            self._record("refused", now, reason="%s: %d outside [%d, %d]"
+                         % (reason, n, self.min_replicas,
+                            self.max_replicas))
+            raise AutoscalerError(
+                "scale_to(%d) refused: outside the configured bounds "
+                "[%d, %d]" % (n, self.min_replicas, self.max_replicas))
+        guard = 0
+        while self.replica_target() < n and guard < 64:
+            self._scale_up(None, self._now())
+            guard += 1
+        while self.replica_target() > n and guard < 64:
+            self._scale_down(None, self._now())
+            guard += 1
+        return self.replica_target()
+
+    def attach_worker_fleet(self, fleet):
+        """Register a :class:`~mxnet_tpu.data_plane.workers.
+        DecodeWorkerFleet` for independent scaling off its own buffer
+        watermarks."""
+        self._worker_fleets.append(fleet)
+        return fleet
+
+    def _step_workers(self, now):
+        """Independent decode-worker scaling: one worker at a time per
+        fleet, its own cooldown, floor of 1 enforced typed by
+        ``resize`` itself."""
+        for wf in self._worker_fleets:
+            q = getattr(wf, "_q", None)
+            if q is None or not getattr(q, "maxsize", 0):
+                continue
+            last = self._worker_last.get(id(wf))
+            if last is not None and now - last < self.cooldown:
+                continue
+            fill = q.qsize() / q.maxsize
+            if fill <= _WORKER_LOW and wf.live_workers() >= \
+                    wf.num_workers:
+                wf.resize(wf.num_workers + 1)
+                self._worker_last[id(wf)] = now
+                self._record("workers_up", now, workers=wf.num_workers,
+                             reason="buffer %.0f%% full" % (100 * fill))
+            elif fill >= _WORKER_HIGH and wf.num_workers > 1:
+                wf.resize(wf.num_workers - 1)
+                self._worker_last[id(wf)] = now
+                self._record("workers_down", now,
+                             workers=wf.num_workers,
+                             reason="buffer %.0f%% full" % (100 * fill))
+
+    # -- bookkeeping ---------------------------------------------------------
+    @staticmethod
+    def _reason(sig):
+        if sig is None:
+            return "explicit"
+        return ("queue=%d occ=%.2f p99=%s"
+                % (sig["queue"], sig["occupancy"],
+                   "-" if sig["p99"] is None else
+                   "%.3fs" % sig["p99"]))
+
+    def _span(self, name, now, **attrs):
+        from .. import telemetry
+
+        telemetry.record_trace_span(name, self.trace_id, now, now,
+                                    clock_now=now, track=_TRACK, **attrs)
+
+    def _record(self, direction, now, reason=None, **attrs):
+        from .. import diagnostics
+
+        self._ndecisions += 1
+        rec = dict(attrs)
+        rec.update({"direction": direction, "at": now, "reason": reason,
+                    "seq": self._ndecisions})
+        self.decisions.append(rec)
+        if direction in ("up", "down"):
+            self._last_action = now
+            self._calm = 0
+        _m.autoscale_events_total().labels(direction).inc()
+        _m.autoscale_last_decision().labels(direction).set(
+            self._ndecisions)
+        self._span("scale_" + direction, now, reason=reason, **attrs)
+        diagnostics.record_event("autoscale_" + direction,
+                                 reason=reason, **attrs)
+
+    # -- background loop -----------------------------------------------------
+    def start(self, interval=None):
+        """Run the loop on a daemon thread every ``interval`` seconds
+        (default ``MXT_AUTOSCALE_INTERVAL``) — the deployment shape;
+        tests and the bench call :meth:`step` synchronously instead."""
+        from .. import config
+
+        if interval is None:
+            interval = config.get("MXT_AUTOSCALE_INTERVAL")
+        interval = float(interval)  # sync-ok: host config scalar
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.step()
+                except Exception:  # noqa: BLE001 — the control loop
+                    pass           # must never take the fleet down
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="mxt-autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def close(self):
+        self.stop()
+        if self._own_collector:
+            self._collector.close()
+
+
+class TrafficGenerator:
+    """Seeded open-loop arrival process over a :class:`FleetRouter` —
+    the load half of the flash-crowd story. A credit accumulator turns
+    (rate × elapsed) into whole submissions per :meth:`tick`, prompts
+    come from a seeded RNG, and the ``traffic_storm:rps=N,after=K
+    [,tenant=T]`` fault rule flips the rate to ``N`` after the Kth tick
+    (tagging storm traffic with tenant ``T``) — deterministically per
+    ``MXT_CHAOS_SEED``, like every other chaos rule. Typed over-quota
+    refusals are COUNTED, never dropped silently."""
+
+    def __init__(self, router, rate=10.0, seed=0, vocab=64,
+                 prompt_len=(4, 12), max_new_tokens=6, deadline=None,
+                 tenants=None, max_requests=None, prefix="tg"):
+        import numpy as np
+
+        self.router = router
+        self.rate = rate
+        self.vocab = int(vocab)
+        self.prompt_len = (int(prompt_len[0]), int(prompt_len[1]))
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline = deadline
+        self.tenants = list(tenants) if tenants else []
+        self.max_requests = None if max_requests is None \
+            else int(max_requests)
+        self.prefix = str(prefix)
+        self._rng = np.random.RandomState(int(seed))
+        self._credit = 0.0
+        self._last = None
+        self._ticks = 0
+        self.storm = None          # (rps, tenant) once the rule fired
+        self.submitted = []        # RoutedRequests accepted
+        self.rejected = 0          # typed OverQuotaError refusals
+        self.rejected_by_tenant = {}
+
+    def _storm_check(self):
+        from .. import resilience
+
+        if self.storm is not None:
+            return
+        inj = resilience.fault_point()
+        rule = inj.rule("traffic_storm")
+        if rule is not None \
+                and self._ticks >= int(rule.get("after", 0)) \
+                and inj.should("traffic_storm"):
+            self.storm = (int(rule.get("rps", 100)),
+                          rule.get("tenant"))
+
+    def tick(self, now):
+        """Advance the arrival process to ``now``; returns the number
+        of requests submitted this tick (accepted + refused)."""
+        from .qos import OverQuotaError
+
+        self._ticks += 1
+        self._storm_check()
+        if self._last is None:
+            self._last = now
+            return 0
+        dt = max(0.0, now - self._last)
+        self._last = now
+        rate = self.rate
+        storm_tenant = None
+        if self.storm is not None:
+            rate, storm_tenant = self.storm
+        self._credit += rate * dt
+        n = int(self._credit)
+        self._credit -= n
+        emitted = 0
+        for _ in range(n):
+            if self.max_requests is not None \
+                    and self.total_offered() >= self.max_requests:
+                break
+            lo, hi = self.prompt_len
+            plen = int(self._rng.randint(lo, hi + 1))
+            prompt = [int(t) for t in
+                      self._rng.randint(1, self.vocab, size=plen)]
+            tenant = storm_tenant
+            if tenant is None and self.tenants:
+                tenant = self.tenants[self.total_offered()
+                                      % len(self.tenants)]
+            token = "%s-%d" % (self.prefix, self.total_offered())
+            try:
+                rr = self.router.submit(
+                    prompt, max_new_tokens=self.max_new_tokens,
+                    deadline=self.deadline, token=token, tenant=tenant)
+            except OverQuotaError as e:
+                self.rejected += 1
+                key = e.tenant or "default"
+                self.rejected_by_tenant[key] = \
+                    self.rejected_by_tenant.get(key, 0) + 1
+            else:
+                self.submitted.append(rr)
+            emitted += 1
+        return emitted
+
+    def total_offered(self):
+        return len(self.submitted) + self.rejected

@@ -37,7 +37,11 @@ behind a front door. This module is the pool half of that front door
 Failure injection (``MXT_FAULT``): ``replica_kill:replica=I[,after=K]``
 kills replica I at its Kth router tick (ungraceful — in-flight requests
 fail over); ``replica_slow:replica=I,ms=N[,after=K]`` stalls replica
-I's decode for N ms (hedge bait). Both are seeded and deterministic.
+I's decode for N ms (hedge bait);
+``replica_spawn_slow:ms=N`` (consulted by the autoscaler's spawn path)
+holds a freshly spawned spare in ``warming`` for N ms — the router
+must keep serving off the existing replicas meanwhile. All seeded and
+deterministic.
 """
 from __future__ import annotations
 
@@ -193,7 +197,17 @@ class LocalReplica:
         """Build the engine, AOT-warm it through ``tuning.warmup()``
         (zero request-path compiles with a warm persistent cache),
         register in the coordinator's membership table, and only THEN
-        become routable — a cold replica is never offered traffic."""
+        become routable — a cold replica is never offered traffic.
+        Split as :meth:`prepare` + :meth:`go_routable` so the
+        autoscaler can hold a slow-warming spare in ``warming``
+        (``replica_spawn_slow``) without stalling the router."""
+        self.prepare(warm=warm)
+        return self.go_routable()
+
+    def prepare(self, warm=True):
+        """The hot-spare half of :meth:`start`: build + AOT-warm the
+        engine WITHOUT registering. The replica stays ``warming`` — it
+        joins membership (and traffic) only at :meth:`go_routable`."""
         self.state = WARMING
         self.killed = False
         self.slow_until = 0.0
@@ -210,6 +224,16 @@ class LocalReplica:
 
             tuning.warmup(steps=(self.engine,), kernels=False,
                           include_live=False, reason="fleet_replica")
+        return self
+
+    def go_routable(self):
+        """Register and become routable (idempotent once routable)."""
+        if self.state == ROUTABLE:
+            return self
+        if self.engine is None:
+            raise MXNetError(
+                "replica %d has no engine: call prepare() (or start()) "
+                "before go_routable()" % self.index)
         self._register()
         self.state = ROUTABLE
         from .. import diagnostics
@@ -309,18 +333,22 @@ class LocalReplica:
                 "slots": self.capacity}
 
     def submit_copy(self, copy_id, prompt, max_new_tokens, deadline=None,
-                    eos_id=None, trace_id=None):
+                    eos_id=None, trace_id=None, tenant=None,
+                    priority=None):
         """Dispatch one request copy into this replica's batcher.
         Returns the copy's admission state (``queued`` or — for a
         request that can never fit this engine — ``rejected``).
         ``trace_id`` threads the router's distributed trace through
-        this replica's queue/prefill/decode spans."""
+        this replica's queue/prefill/decode spans; ``tenant`` /
+        ``priority`` carry the QoS class into the batcher's
+        priority-aware admission."""
         if not self.alive:
             raise ConnectionError(
                 "serving replica %d is %s" % (self.index, self.state))
         req = Request(prompt, max_new_tokens=max_new_tokens,
                       deadline=deadline, eos_id=eos_id,
-                      request_id=copy_id, trace_id=trace_id)
+                      request_id=copy_id, trace_id=trace_id,
+                      tenant=tenant, priority=priority)
         self.batcher.submit(req)
         if req.state == "rejected":
             return "rejected"
@@ -369,7 +397,8 @@ class LocalReplica:
         return out
 
     def adopt_copy(self, copy_id, prompt, max_new_tokens, deadline=None,
-                   eos_id=None, trace_id=None, handoff=None):
+                   eos_id=None, trace_id=None, handoff=None,
+                   tenant=None, priority=None):
         """DECODE-role half of a disaggregated handoff: submit a
         request whose KV pages (and first token) were prefilled
         elsewhere — the scheduler installs the payload at admission and
@@ -383,7 +412,8 @@ class LocalReplica:
         tok0, payload = handoff
         req = Request(prompt, max_new_tokens=max_new_tokens,
                       deadline=deadline, eos_id=eos_id,
-                      request_id=copy_id, trace_id=trace_id)
+                      request_id=copy_id, trace_id=trace_id,
+                      tenant=tenant, priority=priority)
         req._handoff = (payload, int(tok0))
         self.batcher.submit(req)
         if req.state == "rejected":
@@ -505,15 +535,17 @@ class RemoteReplica:
         return ld
 
     def submit_copy(self, copy_id, prompt, max_new_tokens, deadline=None,
-                    eos_id=None, trace_id=None):
+                    eos_id=None, trace_id=None, tenant=None,
+                    priority=None):
         # the trace_id rides the srv_submit frame, so the remote
         # replica's queue/prefill/decode spans land in ITS span log
         # under the router's trace — the collector's tel_spans scrape
-        # reunites them
+        # reunites them; tenant/priority extend the frame (old hosts
+        # read the 6-tuple prefix, new hosts default missing QoS fields)
         return self._cl.request(
             "srv_submit", None,
             (copy_id, [int(t) for t in prompt], int(max_new_tokens),
-             deadline, eos_id, trace_id))
+             deadline, eos_id, trace_id, tenant, priority))
 
     def ship_pages(self, copy_id, prompt, max_new_tokens, trace_id=None):
         # page payloads (numpy arrays) ride the pickle frame whole —
@@ -525,11 +557,12 @@ class RemoteReplica:
         return int(tok0), payload
 
     def adopt_copy(self, copy_id, prompt, max_new_tokens, deadline=None,
-                   eos_id=None, trace_id=None, handoff=None):
+                   eos_id=None, trace_id=None, handoff=None,
+                   tenant=None, priority=None):
         return self._cl.request(
             "srv_adopt_pages", None,
             (copy_id, [int(t) for t in prompt], int(max_new_tokens),
-             deadline, eos_id, trace_id, handoff))
+             deadline, eos_id, trace_id, handoff, tenant, priority))
 
     def cancel_copy(self, copy_id):
         self._cl.request("srv_cancel", None, copy_id)
@@ -785,12 +818,16 @@ class ServingHost:
                 if not self.admitting:
                     return ("err", "replica is draining (not admitting)")
                 # pre-tracing routers send 5-tuples; the trace_id is
-                # the optional 6th element
+                # the optional 6th element, QoS tenant/priority the
+                # optional 7th/8th (pre-QoS routers omit them)
                 cid, prompt, max_new, deadline, eos = payload[:5]
                 trace_id = payload[5] if len(payload) > 5 else None
+                tenant = payload[6] if len(payload) > 6 else None
+                priority = payload[7] if len(payload) > 7 else None
                 req = Request(prompt, max_new_tokens=max_new,
                               deadline=deadline, eos_id=eos,
-                              request_id=cid, trace_id=trace_id)
+                              request_id=cid, trace_id=trace_id,
+                              tenant=tenant, priority=priority)
                 self.batcher.submit(req)
                 if req.state == "rejected":
                     return ("ok", "rejected")
@@ -838,13 +875,16 @@ class ServingHost:
                 if not self.admitting:
                     return ("err", "replica is draining (not admitting)")
                 cid, prompt, max_new, deadline, eos, trace_id, handoff \
-                    = payload
+                    = payload[:7]
+                tenant = payload[7] if len(payload) > 7 else None
+                priority = payload[8] if len(payload) > 8 else None
                 if cid in self._copies:  # idempotent re-adopt
                     return ("ok", self._copies[cid].state)
                 tok0, pl = handoff
                 req = Request(prompt, max_new_tokens=max_new,
                               deadline=deadline, eos_id=eos,
-                              request_id=cid, trace_id=trace_id)
+                              request_id=cid, trace_id=trace_id,
+                              tenant=tenant, priority=priority)
                 req._handoff = (pl, int(tok0))
                 self.batcher.submit(req)
                 if req.state == "rejected":

@@ -1,0 +1,231 @@
+"""Multi-tenant QoS for the serving fleet: admission quotas + priority
+classes (the isolation half of the PR 18 control loop).
+
+Requests carry a ``tenant`` id and a ``priority`` class. This module
+owns the two policy questions the router and scheduler then enforce:
+
+* **admission** — per-tenant quotas over OUTSTANDING work (in-flight
+  requests and in-flight token budget, prompt + max_new). Over-quota
+  submission raises the typed :class:`OverQuotaError` — never a silent
+  drop — and counts in ``mxt_tenant_rejected_total{tenant}``. Quotas
+  over outstanding work (not wall-clock rate windows) keep the policy
+  deterministic under fake clocks and self-correcting: finishing a
+  request refunds its budget at the router's single finish gate.
+* **priority** — a small integer class, LOWER IS MORE IMPORTANT
+  (interactive=0 < standard=1 < bulk=2). The router's dispatch and the
+  scheduler's admission pick the best class first (FIFO within a
+  class), and under slot/page pressure the scheduler PREEMPTS the most
+  bulk running request to seat an interactive arrival; the preempted
+  request re-enqueues through the PR 11 idempotent-failover path, so
+  bulk under pressure is late, never lost.
+
+Everything here is host bookkeeping over python ints — the lint in
+tools/check_host_syncs.py scans this module: a QoS decision must never
+read device state.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..base import MXNetError
+from . import metrics as _m
+
+__all__ = [
+    "PRIORITY_CLASSES", "OverQuotaError", "TenantSpec", "QosPolicy",
+]
+
+# canonical priority classes; lower number = more important. Unknown
+# tenant names default to "standard" unless the spec pins a class.
+PRIORITY_CLASSES = {"interactive": 0, "standard": 1, "bulk": 2}
+_DEFAULT_TENANT = "default"
+
+
+class OverQuotaError(MXNetError):
+    """Typed per-tenant admission refusal (quota exhausted).
+
+    Carries ``tenant`` so callers (traffic generators, benches, demos)
+    can count refusals per tenant without parsing the message."""
+
+    def __init__(self, msg, tenant=None):
+        super(OverQuotaError, self).__init__(msg)
+        self.tenant = tenant
+
+
+class TenantSpec(object):
+    """One tenant's policy row: priority class + outstanding quotas.
+
+    ``priority`` defaults from the tenant's NAME when it matches a
+    canonical class (an ``interactive`` tenant is class 0 without any
+    extra configuration); ``max_requests`` / ``max_tokens`` of ``None``
+    mean unlimited on that axis."""
+
+    __slots__ = ("name", "priority", "max_requests", "max_tokens")
+
+    def __init__(self, name, priority=None, max_requests=None,
+                 max_tokens=None):
+        self.name = str(name)
+        if priority is None:
+            priority = PRIORITY_CLASSES.get(
+                self.name, PRIORITY_CLASSES["standard"])
+        self.priority = int(priority)
+        self.max_requests = None if max_requests is None \
+            else int(max_requests)
+        self.max_tokens = None if max_tokens is None else int(max_tokens)
+        if self.max_requests is not None and self.max_requests < 1:
+            raise MXNetError(
+                "tenant %r: max_requests must be >= 1 (got %d) — a "
+                "tenant that can never admit is a config error, not a "
+                "quota" % (self.name, self.max_requests))
+        if self.max_tokens is not None and self.max_tokens < 1:
+            raise MXNetError(
+                "tenant %r: max_tokens must be >= 1 (got %d)"
+                % (self.name, self.max_tokens))
+
+    def __repr__(self):
+        return ("TenantSpec(%r, priority=%d, max_requests=%r, "
+                "max_tokens=%r)" % (self.name, self.priority,
+                                    self.max_requests, self.max_tokens))
+
+
+class QosPolicy(object):
+    """Tenant registry + admission ledger.
+
+    The router calls :meth:`admit` before accepting a submission and
+    :meth:`release` exactly once per admitted request at its single
+    finish gate, so the outstanding ledger can never leak. Tenants not
+    declared up front are auto-registered on first sight with the
+    default quotas (``MXT_TENANT_QUOTA_REQUESTS`` /
+    ``MXT_TENANT_QUOTA_TOKENS``; unset = unlimited) and a priority
+    class inferred from the name."""
+
+    def __init__(self, tenants=None, default_max_requests=None,
+                 default_max_tokens=None):
+        from .. import config
+
+        if default_max_requests is None:
+            default_max_requests = config.get("MXT_TENANT_QUOTA_REQUESTS")
+        if default_max_tokens is None:
+            default_max_tokens = config.get("MXT_TENANT_QUOTA_TOKENS")
+        self.default_max_requests = default_max_requests
+        self.default_max_tokens = default_max_tokens
+        self._tenants = {}        # name -> TenantSpec
+        self._requests = {}       # name -> outstanding request count
+        self._tokens = {}         # name -> outstanding token budget
+        self._lock = threading.Lock()
+        for t in (tenants or ()):
+            if not isinstance(t, TenantSpec):
+                t = TenantSpec(t)
+            self._tenants[t.name] = t
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def parse(cls, spec, **kwargs):
+        """Build a policy from a compact CLI spec: tenant names
+        separated by ``:`` or ``,``, each optionally ``name=class``
+        (class = canonical name or integer). ``interactive:bulk`` gives
+        two tenants in classes 0 and 2."""
+        policy = cls(**kwargs)
+        for part in str(spec).replace(",", ":").split(":"):
+            part = part.strip()
+            if not part:
+                continue
+            prio = None
+            if "=" in part:
+                part, _, cls_name = part.partition("=")
+                part = part.strip()
+                cls_name = cls_name.strip()
+                if cls_name in PRIORITY_CLASSES:
+                    prio = PRIORITY_CLASSES[cls_name]
+                else:
+                    try:
+                        prio = int(cls_name)
+                    except ValueError:
+                        raise MXNetError(
+                            "tenant spec %r: class %r is neither a "
+                            "canonical class (%s) nor an integer"
+                            % (spec, cls_name,
+                               "/".join(sorted(PRIORITY_CLASSES))))
+            policy.add_tenant(part, priority=prio)
+        if not policy.tenants():
+            raise MXNetError("tenant spec %r declares no tenants" % spec)
+        return policy
+
+    def add_tenant(self, name, priority=None, max_requests=None,
+                   max_tokens=None):
+        spec = TenantSpec(
+            name, priority=priority,
+            max_requests=self.default_max_requests
+            if max_requests is None else max_requests,
+            max_tokens=self.default_max_tokens
+            if max_tokens is None else max_tokens)
+        with self._lock:
+            self._tenants[spec.name] = spec
+        return spec
+
+    def tenants(self):
+        with self._lock:
+            return sorted(self._tenants)
+
+    def _spec(self, tenant):
+        """Resolve (auto-registering unknowns). Caller holds no lock."""
+        name = _DEFAULT_TENANT if tenant is None else str(tenant)
+        with self._lock:
+            spec = self._tenants.get(name)
+        if spec is None:
+            spec = self.add_tenant(name)
+        return spec
+
+    def priority_of(self, tenant):
+        """The tenant's priority class (auto-registers unknowns)."""
+        return self._spec(tenant).priority
+
+    # -- admission ledger ----------------------------------------------------
+    def admit(self, tenant, tokens):
+        """Charge one request + ``tokens`` budget against the tenant's
+        outstanding quota; raises :class:`OverQuotaError` (and counts
+        the rejection) when either axis is exhausted."""
+        spec = self._spec(tenant)
+        tokens = int(tokens)
+        with self._lock:
+            nreq = self._requests.get(spec.name, 0)
+            ntok = self._tokens.get(spec.name, 0)
+            if spec.max_requests is not None \
+                    and nreq + 1 > spec.max_requests:
+                _m.tenant_rejected_total().labels(spec.name).inc()
+                raise OverQuotaError(
+                    "tenant %r over request quota: %d outstanding of "
+                    "max %d — finish or cancel in-flight work before "
+                    "submitting more (typed refusal, the request was "
+                    "NOT enqueued)" % (spec.name, nreq,
+                                       spec.max_requests),
+                    tenant=spec.name)
+            if spec.max_tokens is not None \
+                    and ntok + tokens > spec.max_tokens:
+                _m.tenant_rejected_total().labels(spec.name).inc()
+                raise OverQuotaError(
+                    "tenant %r over token quota: %d outstanding + %d "
+                    "requested > max %d (typed refusal, the request "
+                    "was NOT enqueued)" % (spec.name, ntok, tokens,
+                                           spec.max_tokens),
+                    tenant=spec.name)
+            self._requests[spec.name] = nreq + 1
+            self._tokens[spec.name] = ntok + tokens
+        _m.tenant_admitted_total().labels(spec.name).inc()
+        _m.tenant_inflight().labels(spec.name).set(nreq + 1)
+        return spec
+
+    def release(self, tenant, tokens):
+        """Refund one finished request's charge (router finish gate)."""
+        spec = self._spec(tenant)
+        with self._lock:
+            nreq = max(0, self._requests.get(spec.name, 0) - 1)
+            ntok = max(0, self._tokens.get(spec.name, 0) - int(tokens))
+            self._requests[spec.name] = nreq
+            self._tokens[spec.name] = ntok
+        _m.tenant_inflight().labels(spec.name).set(nreq)
+
+    def outstanding(self, tenant):
+        """(requests, tokens) currently charged to the tenant."""
+        name = _DEFAULT_TENANT if tenant is None else str(tenant)
+        with self._lock:
+            return (self._requests.get(name, 0), self._tokens.get(name, 0))

@@ -41,6 +41,18 @@ export), and wires the three robustness behaviors end to end:
   tier. ``ship``/``adopt`` spans stamp the handoff on the router's
   trace track.
 
+- **Multi-tenant QoS.** With a :class:`~mxnet_tpu.serving.qos.
+  QosPolicy` attached, submissions carry a tenant id + priority class:
+  admission charges the tenant's outstanding quota (typed
+  :class:`~mxnet_tpu.serving.qos.OverQuotaError` refusal when
+  exhausted — never a silent drop; refunded at the finish gate),
+  dispatch picks the best (lowest) priority class first (FIFO within a
+  class), and a replica scheduler that PREEMPTS a bulk request to seat
+  an interactive one reports the copy back as ``preempted`` — a
+  non-terminal outcome the router re-enqueues at the BACK of the queue
+  (it yields) through the same idempotent machinery as failover, so
+  preempted bulk is late, never lost.
+
 Host/device split: the router is PURE host bookkeeping over host
 scalars (queue lengths, wall-clock stamps, token lists already
 materialized by the replicas' deferred windows). It performs zero
@@ -59,9 +71,10 @@ import itertools
 import time
 
 from .. import telemetry
+from ..base import MXNetError
 from ..resilience import KVStoreError
 from . import metrics as _m
-from .fleet import DEAD, DRAINING, StaleReplicaError
+from .fleet import DEAD, DRAINING, ROUTABLE, StaleReplicaError
 
 __all__ = ["RoutedRequest", "FleetRouter"]
 
@@ -80,10 +93,11 @@ class RoutedRequest:
                  "eos_id", "state", "result", "committed_by", "commits",
                  "copies", "dispatches", "hedges", "failovers",
                  "hedge_delay", "t_submit", "t_dispatch", "t_finish",
-                 "trace_id", "_ncopy")
+                 "trace_id", "_ncopy", "tenant", "priority",
+                 "preemptions")
 
     def __init__(self, prompt, max_new_tokens=16, deadline=None,
-                 eos_id=None, token=None):
+                 eos_id=None, token=None, tenant=None, priority=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.deadline = None if deadline is None else float(deadline)  # sync-ok: host scalar
@@ -91,6 +105,9 @@ class RoutedRequest:
         self.token = token if token is not None \
             else "fr-%d" % next(_tok_ids)
         self.state = "queued"  # queued|dispatched|completed|evicted|rejected
+        self.tenant = None if tenant is None else str(tenant)
+        self.priority = 0 if priority is None else int(priority)
+        self.preemptions = 0
         self.result = None
         self.committed_by = None
         self.commits = 0
@@ -113,11 +130,12 @@ class FleetRouter:
 
     def __init__(self, pool, now_fn=time.monotonic, slo=None,
                  hedge_delay=None, hedge_budget=None,
-                 prefill_threshold=None):
+                 prefill_threshold=None, qos=None):
         from .. import config
 
         self.pool = pool
         self._now = now_fn
+        self.qos = qos  # serving/qos.py QosPolicy (None = no QoS layer)
         self.slo = None if slo is None else float(slo)  # sync-ok: host scalar
         if hedge_delay is None:
             hedge_delay = config.get("MXT_FLEET_HEDGE_DELAY")
@@ -139,11 +157,15 @@ class FleetRouter:
 
     # -- intake ------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, deadline=None,
-               eos_id=None, token=None):
+               eos_id=None, token=None, tenant=None, priority=None):
         """Queue one request. ``token`` is the idempotency key: a token
         whose request already COMPLETED returns the recorded
         :class:`RoutedRequest` immediately (never re-decodes); one still
-        in flight returns that in-flight request (no duplicate)."""
+        in flight returns that in-flight request (no duplicate).
+        ``tenant``/``priority`` are the QoS coordinates; with a policy
+        attached, admission charges the tenant's outstanding quota and
+        may raise the typed OverQuotaError (an idempotent REPLAY is
+        answered from the record first — it never re-charges)."""
         if token is not None:
             done = self._results.get(token)
             if done is not None:
@@ -153,8 +175,15 @@ class FleetRouter:
             live = self._inflight.get(token)
             if live is not None:
                 return live
+        if self.qos is not None:
+            if priority is None:
+                priority = self.qos.priority_of(tenant)
+            # typed OverQuotaError propagates: the request is refused
+            # BEFORE it exists anywhere — nothing to clean up
+            self.qos.admit(tenant, len(prompt) + int(max_new_tokens))
         rr = RoutedRequest(prompt, max_new_tokens=max_new_tokens,
-                           deadline=deadline, eos_id=eos_id, token=token)
+                           deadline=deadline, eos_id=eos_id, token=token,
+                           tenant=tenant, priority=priority)
         rr.t_submit = self._now()
         # the distributed trace starts HERE: one trace_id per routed
         # request, propagated through every dispatch, hedge duplicate,
@@ -233,16 +262,32 @@ class FleetRouter:
         self.pool.publish()
 
     # -- dispatch ----------------------------------------------------------
+    def _next_queued(self):
+        """Index of the next request to dispatch: the best (lowest)
+        priority class, FIFO within a class — an interactive arrival
+        overtakes queued bulk but never an older interactive request.
+        Uniform priorities (no QoS) degrade to index 0: the historical
+        pure-FIFO order, failover's front-of-queue re-enqueue intact."""
+        best_i = 0
+        best_p = self._queue[0].priority
+        for i, rr in enumerate(self._queue):
+            if rr.priority < best_p:
+                best_i, best_p = i, rr.priority
+        return best_i
+
     def _dispatch_queue(self):
         while self._queue:
             if not self.pool.routable():
                 break
-            rr = self._queue.popleft()
+            i = self._next_queued()
+            rr = self._queue[i]
+            del self._queue[i]
             try:
                 self._dispatch(rr)
             except KVStoreError:
                 # no replica could take it right now: keep it queued
-                self._queue.appendleft(rr)
+                # at its old position (class-FIFO order preserved)
+                self._queue.insert(i, rr)
                 break
 
     def _dispatch(self, rr, exclude=()):
@@ -288,7 +333,9 @@ class FleetRouter:
                 state = h.submit_copy(cid, rr.prompt, rr.max_new_tokens,
                                       deadline=rr.deadline,
                                       eos_id=rr.eos_id,
-                                      trace_id=rr.trace_id)
+                                      trace_id=rr.trace_id,
+                                      tenant=rr.tenant,
+                                      priority=rr.priority)
             except (ConnectionError, OSError):
                 tried.add(h.index)
                 self.pool.mark_dead(h.index)
@@ -360,7 +407,9 @@ class FleetRouter:
                                        deadline=rr.deadline,
                                        eos_id=rr.eos_id,
                                        trace_id=rr.trace_id,
-                                       handoff=(tok0, payload))
+                                       handoff=(tok0, payload),
+                                       tenant=rr.tenant,
+                                       priority=rr.priority)
             except (ConnectionError, OSError):
                 tried.add(dec.index)
                 self.pool.mark_dead(dec.index)
@@ -508,6 +557,18 @@ class FleetRouter:
             return False  # already committed (duplicate completion)
         if state == "completed":
             self._commit(rr, handle, tokens)
+        elif state == "preempted" and not rr.copies:
+            # QoS preemption is NOT a terminal outcome: the scheduler
+            # freed the slot for a higher class; the request re-enqueues
+            # at the BACK of the queue (it yields — failover keeps the
+            # front) and replays through the same idempotent machinery,
+            # so preempted bulk is late, never lost
+            rr.preemptions += 1
+            rr.state = "queued"
+            self._queue.append(rr)
+            now = self._now()
+            self._span(rr, "preempt_reenqueue", now, now,
+                       preemptions=rr.preemptions)
         elif state in ("evicted", "rejected") and not rr.copies:
             # every copy is gone and none completed: the SLO miss (or
             # admission reject) is the request's real outcome
@@ -541,6 +602,11 @@ class FleetRouter:
         rr.state = outcome
         rr.t_finish = self._now()
         self._inflight.pop(rr.token, None)
+        if self.qos is not None:
+            # refund the admission charge exactly once (every terminal
+            # outcome funnels through here; replays never re-charged)
+            self.qos.release(rr.tenant,
+                             len(rr.prompt) + rr.max_new_tokens)
         self.finished.append(rr)
         if rr.t_submit is not None:
             self._span(rr, "request", rr.t_submit, rr.t_finish,
@@ -558,8 +624,21 @@ class FleetRouter:
         re-dispatch onto peers), let running copies finish, and — once
         it is empty — deregister it cleanly (``_finish_drains``).
         Rejoin via ``pool.get(rid).rejoin()``: the replica AOT-warms
-        through the shared compile cache before it is routable again."""
+        through the shared compile cache before it is routable again.
+
+        Only a ROUTABLE replica drains: draining one still ``warming``
+        would race its go-routable transition (it would register AFTER
+        the drain and serve anyway), and a second drain of an already
+        draining/drained replica would re-migrate copies the first
+        drain already moved — both are typed errors, not silent
+        no-ops."""
         h = self.pool.get(rid)
+        if h.state != ROUTABLE:
+            raise MXNetError(
+                "cannot drain serving replica %d in state %r: only a "
+                "routable replica drains (a warming spare must finish "
+                "warm-up first; a draining/drained/dead one has no "
+                "admission left to stop)" % (rid, h.state))
         h.drain_start()
         try:
             queued = h.queued_copies()

@@ -70,7 +70,8 @@ class Request:
     scheduler stamps queue/prefill/decode spans against it."""
 
     def __init__(self, prompt, max_new_tokens=16, deadline=None,
-                 eos_id=None, request_id=None, trace_id=None):
+                 eos_id=None, request_id=None, trace_id=None,
+                 tenant=None, priority=None):
         self.prompt = [int(t) for t in prompt]
         if not self.prompt:
             raise MXNetError("Request needs a non-empty prompt")
@@ -83,13 +84,19 @@ class Request:
         self.id = request_id if request_id is not None \
             else "req-%d" % next(_req_ids)
         self.trace_id = None if trace_id is None else str(trace_id)
+        # multi-tenant QoS (serving/qos.py): the tenant id rides for
+        # accounting; the priority CLASS (lower = more important)
+        # orders admission and selects preemption victims
+        self.tenant = None if tenant is None else str(tenant)
+        self.priority = 0 if priority is None else int(priority)
         self._track = None  # timeline row, stamped by the batcher
         # disaggregated handoff: a prefill replica already computed this
         # request's KV pages — (page payload, first token) to ADOPT at
         # admission instead of prefilling (serving/fleet.py ship/adopt)
         self._handoff = None
         self.output_tokens = []
-        self.state = "created"  # queued|running|completed|evicted|rejected
+        # queued|running|completed|evicted|rejected|preempted
+        self.state = "created"
         self.t_submit = self.t_admit = self.t_first = self.t_finish = None
         self._dispatched = 0   # tokens generated-or-in-flight (incl. #1)
         self._first_pv = None  # deferred first token from prefill
@@ -104,7 +111,8 @@ class Request:
 
     @property
     def done(self):
-        return self.state in ("completed", "evicted", "rejected")
+        return self.state in ("completed", "evicted", "rejected",
+                              "preempted")
 
     def _take_first(self, now):
         """Materialize the prefill's deferred first token (idempotent;
@@ -369,16 +377,66 @@ class ContinuousBatcher:
                 # completion lands via step metadata at retirement
         _m.active_requests().set(len(self._slot_req))
 
+    def _pick_admit_index(self):
+        """Index of the next queued request to admit: the best (lowest)
+        priority class, FIFO within a class — so an interactive arrival
+        overtakes queued bulk, but never an older interactive one. With
+        uniform priorities (the no-QoS deployment) this is index 0,
+        identical to the historical pure-FIFO admit."""
+        best_i = 0
+        best_p = self._queue[0].priority
+        for i, req in enumerate(self._queue):
+            if req.priority < best_p:
+                best_i, best_p = i, req.priority
+        return best_i
+
+    def _preempt_for(self, req, now):
+        """Free capacity for ``req`` by force-evicting one RUNNING
+        victim of a strictly worse (higher-numbered) priority class —
+        most-bulk first, latest-submitted within a class (least sunk
+        work). The victim leaves through the deadline-eviction
+        machinery but in its own ``preempted`` state, which the fleet
+        router treats as non-terminal: the copy re-enqueues through the
+        PR 11 idempotent-failover path and replays token-exact later —
+        late, never lost. Returns True when a victim was evicted."""
+        victims = [(s, r) for s, r in self._slot_req.items()
+                   if not r.done and r.priority > req.priority]
+        if not victims:
+            return False
+        victims.sort(key=lambda sr: (sr[1].priority,
+                                     sr[1].t_submit or 0.0))
+        slot, victim = victims[-1]
+        self.engine.release(slot)
+        del self._slot_req[slot]
+        victim.state = "preempted"
+        victim.t_finish = now
+        self._finalize(victim, "preempted")
+        _m.tenant_preempted_total().labels(
+            victim.tenant or "default").inc()
+        _m.active_requests().set(len(self._slot_req))
+        return True
+
     def _admit(self, now):
-        while self._queue and self._free_slots():
-            req = self._queue[0]
+        while self._queue:
+            i = self._pick_admit_index()
+            req = self._queue[i]
+            if not self._free_slots():
+                # slot pressure: a top-class arrival may preempt a
+                # strictly lower class out of its slot; equal-priority
+                # traffic waits exactly as before
+                if not self._preempt_for(req, now):
+                    break
+                continue  # re-evaluate with the freed slot/pages
             total = len(req.prompt) + req.max_new_tokens
             # a handoff request adopts shipped pages — no prefix
             # discount applies, so gate on the plain reservation
             prompt = None if req._handoff is not None else req.prompt
             if not self.engine.can_admit(total, prompt=prompt):
-                break  # pages busy; retiring traffic will free them
-            self._queue.popleft()
+                # page pressure: same preemption rule as slot pressure
+                if not self._preempt_for(req, now):
+                    break  # pages busy; retiring traffic will free them
+                continue
+            del self._queue[i]
             slot = self._free_slots()[0]
             req.t_admit = now
             _m.request_latency().labels("queue").observe(
@@ -431,7 +489,7 @@ class ContinuousBatcher:
             return
         req._finalized = True
         _m.requests_total().labels(outcome).inc()
-        if outcome in ("evicted", "rejected"):
+        if outcome in ("evicted", "rejected", "preempted"):
             now = self._now()
             _trace_span(req, outcome, req.t_submit,
                         req.t_finish if req.t_finish is not None

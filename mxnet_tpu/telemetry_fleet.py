@@ -150,15 +150,21 @@ class FleetRegistry:
         return self._fams.get(sanitize_metric_name(name))
 
     # -- cross-member aggregation ------------------------------------------
-    def merged_histogram(self, name, labels=None, include_stale=False):
+    def merged_histogram(self, name, labels=None, include_stale=False,
+                         missing_ok=False):
         """One bucket-wise merged snapshot of histogram ``name`` across
         every (live, unless ``include_stale``) member — and across its
         labelsets unless ``labels`` pins one. Returns ``{"buckets",
         "counts", "sum", "count"}``; merged quantiles over it equal the
         quantiles of the union of every member's observations (same
-        bounds, summed counts — the PR 5 merge contract)."""
+        bounds, summed counts — the PR 5 merge contract).
+        ``missing_ok=True`` returns ``None`` for an absent family
+        instead of raising — the autoscaler's "no traffic yet" read,
+        where a missing latency histogram is a signal, not an error."""
         fam = self.get(name)
         if fam is None:
+            if missing_ok:
+                return None
             raise MXNetError("fleet registry has no metric %r" % name)
         if fam["kind"] != "histogram":
             raise MXNetError("fleet metric %r is a %s, not a histogram"
@@ -182,9 +188,13 @@ class FleetRegistry:
         return {"buckets": tuple(bounds), "counts": counts,
                 "sum": csum, "count": total}
 
-    def quantile(self, name, q, labels=None, include_stale=False):
+    def quantile(self, name, q, labels=None, include_stale=False,
+                 missing_ok=False):
         snap = self.merged_histogram(name, labels=labels,
-                                     include_stale=include_stale)
+                                     include_stale=include_stale,
+                                     missing_ok=missing_ok)
+        if snap is None:
+            return None
         return histogram_quantile(q, list(snap["buckets"]),
                                   list(snap["counts"]))
 

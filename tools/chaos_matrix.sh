@@ -197,5 +197,30 @@ for seed in "${SEEDS[@]}"; do
     fi
 done
 
+# -- autoscale sweep ----------------------------------------------------------
+# traffic_storm / replica_spawn_slow: the chaos-marked cells in
+# tests/test_autoscaler.py flip the seeded TrafficGenerator to a flash
+# crowd mid-run (the autoscaler must scale up, absorb it, and account
+# every request: submitted == committed + typed-rejected, zero lost)
+# and slow the spawned spare's warm-up (the router must keep serving
+# off the existing routable tier — a warming spare is never dispatched
+# to and never stalls the control loop) — bounded, never a hang; the
+# outer `timeout` is only the backstop.
+for seed in "${SEEDS[@]}"; do
+    echo "== autoscale sweep: MXT_CHAOS_SEED=$seed (cell timeout ${CELL_TIMEOUT}s)"
+    timeout -k 10 "$CELL_TIMEOUT" env JAX_PLATFORMS=cpu \
+        MXT_CHAOS_SEED="$seed" \
+        python -m pytest tests/test_autoscaler.py -q -m "chaos and not slow" \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    rc=$?
+    if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+        echo "!! HANG: autoscale sweep seed=$seed exceeded ${CELL_TIMEOUT}s" >&2
+        fail=1
+    elif [ "$rc" -ne 0 ]; then
+        echo "!! FAIL: autoscale sweep seed=$seed rc=$rc" >&2
+        fail=1
+    fi
+done
+
 [ "$fail" -eq 0 ] && echo "chaos matrix: all seeds clean"
 exit "$fail"

@@ -147,6 +147,14 @@ SCAN = {
     # only sanctioned float()s, each sync-ok annotated.
     "mxnet_tpu/serving/fleet.py": _ALL,
     "mxnet_tpu/serving/router.py": _ALL,
+    # the autoscaler's control loop and the QoS admission gate run
+    # between decode ticks of the whole fleet: both must stay pure
+    # host arithmetic over already-merged gauges/histograms — a device
+    # read (or a blocking scrape) inside either would stall every
+    # replica once per control period, turning the thing that absorbs
+    # flash crowds into the thing that causes them
+    "mxnet_tpu/serving/autoscaler.py": _ALL,
+    "mxnet_tpu/serving/qos.py": _ALL,
 }
 
 _MARKER = "sync-ok"

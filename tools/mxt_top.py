@@ -38,6 +38,14 @@ and, when a fleet router is live (serving/fleet.py + router.py):
                        fenced-zombie replies refused typed)
     routed p50/p99     fleet-level request latency (submit -> commit)
 
+and, when the autoscaler / QoS layer is live (serving/autoscaler.py +
+serving/qos.py):
+
+    autoscale          target replicas + up/down/refused decision
+                       counts + the most recent decision direction
+    tenant <name>      per-tenant admitted / rejected (over-quota) /
+                       preempted / inflight
+
 and, with ``--fleet`` (the telemetry_fleet.py collector's merged page —
 member-labeled samples from every scraped fleet member):
 
@@ -450,6 +458,33 @@ def render(samples, prev, dt):
     pfx_shared = metric_sum(samples, "mxt_serving_shared_pages")
     pfx_cow = metric_sum(samples, "mxt_serving_cow_copies_total")
 
+    # autoscaler / QoS section (serving/autoscaler.py + qos.py): only
+    # rendered when an autoscaler has stood up its target gauge or a
+    # QoS policy has admitted per-tenant traffic — an unscaled,
+    # single-tenant fleet shows no control-loop noise
+    asc_target = metric_sum(samples, "mxt_autoscale_target_replicas")
+    asc_events = {}
+    asc_last = {}
+    for (n, lab), v in samples.items():
+        d = dict(lab)
+        if "direction" not in d:
+            continue
+        if n == "mxt_autoscale_events_total":
+            asc_events[d["direction"]] = \
+                asc_events.get(d["direction"], 0.0) + v
+        elif n == "mxt_autoscale_last_decision":
+            # monotonic decision seq per direction: the max IS the
+            # most recent decision
+            asc_last[d["direction"]] = \
+                max(asc_last.get(d["direction"], 0.0), v)
+    asc_latest = max(asc_last, key=asc_last.get) if asc_last else None
+    qos_tenants = sorted(
+        {dict(lab).get("tenant") for (n, lab), v in samples.items()
+         if n in ("mxt_tenant_admitted_total", "mxt_tenant_rejected_total",
+                  "mxt_tenant_preempted_total",
+                  "mxt_tenant_inflight_requests")
+         and "tenant" in dict(lab)} - {None})
+
     lines = [
         "mxt_top  %s" % time.strftime("%H:%M:%S"),
         "-" * 46,
@@ -583,6 +618,33 @@ def render(samples, prev, dt):
                 % (_fmt(ratio, "%.3f"), _fmt(pfx_hits, "%.0f"),
                    _fmt(total, "%.0f"), _fmt(pfx_shared, "%.0f"),
                    _fmt(pfx_cow, "%.0f")))
+    if asc_target is not None or qos_tenants:
+        lines.append("-" * 46)
+        if asc_target is not None:
+            lines.append(
+                "  autoscale        target %s   up %s  down %s"
+                "  refused %s"
+                % (_fmt(asc_target, "%.0f"),
+                   _fmt(asc_events.get("up", 0), "%.0f"),
+                   _fmt(asc_events.get("down", 0), "%.0f"),
+                   _fmt(asc_events.get("refused", 0), "%.0f")))
+            if asc_latest is not None:
+                lines.append("  last decision    %s (#%s)"
+                             % (asc_latest,
+                                _fmt(asc_last[asc_latest], "%.0f")))
+        for t in qos_tenants:
+            adm = metric_sum(samples, "mxt_tenant_admitted_total",
+                             tenant=t)
+            rej = metric_sum(samples, "mxt_tenant_rejected_total",
+                             tenant=t)
+            pre = metric_sum(samples, "mxt_tenant_preempted_total",
+                             tenant=t)
+            inflt = metric_sum(samples, "mxt_tenant_inflight_requests",
+                               tenant=t)
+            lines.append(
+                "  tenant %-9s adm %s  rej %s  pre %s  inflight %s"
+                % (t, _fmt(adm, "%.0f"), _fmt(rej, "%.0f"),
+                   _fmt(pre, "%.0f"), _fmt(inflt, "%.0f")))
     return "\n".join(lines)
 
 
