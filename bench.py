@@ -48,7 +48,7 @@ _CONFIG_COST = {"resnet50": 420, "bert": 300, "lstm_ptb": 200,
                 "embedding_ab": 90, "serving_fleet": 120,
                 "speculative": 120, "kv_quant": 90, "fleet_obs": 90,
                 "streaming_input": 90, "prefix_reuse": 120,
-                "autoscale": 150}
+                "autoscale": 150, "parallel_4d": 90}
 
 
 def _remaining():
@@ -2208,6 +2208,193 @@ def bench_zero_stages(platform, dtype, _data=None):
     return shrink_opt, row
 
 
+def _parallel_4d_measure():
+    """The parallel_4d_ab measurement body: the SAME pp=2/ep=2 toy LM
+    stepped two ways on ONE (2,1,2,2) dp×tp×pp×ep mesh — the island
+    composition (one value_and_grad launch plus one eager fused-optimizer
+    launch per parameter: the pre-unification dispatch shape) vs the
+    unified ShardedTrainStep (the whole schedule + MoE + loss + update
+    as its single donated jit). Both legs run exactly
+    ``pipeline_moe_forward`` and the same loss/update op math from the
+    same placed initial params, so the loss series must match
+    bit-for-bit: the A/B isolates launch structure, never math. (The
+    genuinely different island programs — shard_map pipeline_apply +
+    moe_apply on their own sub-meshes — can't be bit-compared, which is
+    why the baseline here is the same math split into launches.)"""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel, profiler
+    from mxnet_tpu.ops.registry import get_op
+    from mxnet_tpu.parallel import unified as _u
+
+    batch = int(os.environ.get("BENCH_4D_BATCH", "16"))
+    hidden = int(os.environ.get("BENCH_4D_HIDDEN", "16"))
+    iters = int(os.environ.get("BENCH_4D_ITERS", "20"))
+    stages, experts, micro, cf, lr = 2, 2, 4, 1.25, 0.05
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (batch, hidden)).astype(np.float32)
+    y = rng.randint(0, 8, (batch,)).astype(np.float32)
+
+    mx.random.seed(7)
+    mesh = parallel.make_mesh((2, 1, 2, 2), ("dp", "tp", "pp", "ep"))
+    net = parallel.PipelineMoEBlock(
+        num_stages=stages, num_experts=experts, in_units=hidden,
+        hidden=hidden, expert_hidden=2 * hidden, num_classes=8,
+        num_microbatches=micro, capacity_factor=cf)
+    net.initialize()
+    step = parallel.ShardedTrainStep(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": lr}, mesh=mesh, rules=net.sharding_rules(mesh),
+        zero_stage=1)
+    vals0 = net.param_values()  # placed initial params, pre-first-step
+
+    # --- island leg: same math, pre-unification launch structure ------
+    def island_loss(vals, xb, yb):
+        logits, _, _ = _u.pipeline_moe_forward(
+            vals, xb, micro, cf, mesh=mesh, dp="dp", pp="pp", ep="ep")
+        # gluon/loss.py SoftmaxCrossEntropyLoss math, op for op
+        pred = jax.nn.log_softmax(logits, axis=-1)
+        idx = jnp.clip(yb.astype(jnp.int32), 0, logits.shape[-1] - 1)
+        lp = jnp.take_along_axis(pred, idx[:, None], axis=-1)
+        return jnp.mean(jnp.mean(-lp, axis=1))
+
+    grad_fn = jax.jit(jax.value_and_grad(island_loss))
+    sgd = get_op("sgd_update").fn
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    ys = jax.device_put(y, NamedSharding(mesh, P("dp")))
+
+    def island_step(vals):
+        loss, grads = grad_fn(vals, xs, ys)  # launch 1: fwd+bwd
+        # one eager fused-optimizer launch PER parameter — the island tax
+        return loss, {k: sgd(vals[k], grads[k], lr=lr) for k in vals}
+
+    vals = dict(vals0)
+    island_losses = []
+    l, vals = island_step(vals)  # compile lap (lands in the series too)
+    island_losses.append(l)
+    island_ms, island_syncs = float("inf"), 0
+    for _ in range(3):  # best-of-3 windows: the 8-thread CPU rendezvous
+        h0 = profiler.host_sync_count()  # is jittery per window
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            l, vals = island_step(vals)
+            island_losses.append(l)
+        island_syncs = max(island_syncs, profiler.host_sync_count() - h0)
+        l.block_until_ready()
+        island_ms = min(island_ms, (time.perf_counter() - t0) / iters * 1e3)
+    island_launches = 1 + len(vals0)
+
+    # --- unified leg: ONE donated jit (island leg never mutated net).
+    # Inputs convert ONCE, like the island leg's device_put above — the
+    # A/B measures launch structure, not host->device feeding.
+    xa, ya = nd.array(x), nd.array(y)
+    unified_losses = [step(xa, ya)]
+    unified_ms, unified_syncs = float("inf"), 0
+    n0 = profiler.launch_count()
+    for _ in range(3):
+        h0 = profiler.host_sync_count()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            unified_losses.append(step(xa, ya))
+        unified_syncs = max(unified_syncs,
+                            profiler.host_sync_count() - h0)
+        unified_losses[-1].wait_to_read()
+        unified_ms = min(unified_ms,
+                         (time.perf_counter() - t0) / iters * 1e3)
+    unified_launches = (profiler.launch_count() - n0) // (3 * iters)
+
+    il = [float(v) for v in island_losses]  # sync-ok: post-loop reads
+    ul = [float(v.asscalar()) for v in unified_losses]  # sync-ok: post-loop
+    moe = parallel.publish_moe_telemetry(net)
+    pdb = step.per_device_bytes()
+    return {
+        "batch": batch, "hidden": hidden, "iters": iters,
+        "mesh": {"dp": 2, "tp": 1, "pp": 2, "ep": 2},
+        "island_step_time_ms": round(island_ms, 3),
+        "unified_step_time_ms": round(unified_ms, 3),
+        "island_launches_per_step": island_launches,
+        "unified_launches_per_step": int(unified_launches),
+        "island_hot_loop_syncs": int(island_syncs),
+        "unified_hot_loop_syncs": int(unified_syncs),
+        "losses_island": [round(v, 7) for v in il],
+        "losses_unified": [round(v, 7) for v in ul],
+        "losses_equal": il == ul,  # bit-exact, not tolerance
+        "param_bytes_per_device": pdb["param_bytes"],
+        "opt_bytes_per_device": pdb["opt_state_bytes"],
+        "moe_expert_load": moe["expert_load"],
+        "moe_router_drops": moe["drops"],
+    }
+
+
+_PARALLEL_4D_CODE = r'''
+import json, os, sys
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8").strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["MXT_BENCH_DIR"])
+import bench
+print("P4DROW " + json.dumps(bench._parallel_4d_measure()))
+'''
+
+
+def bench_parallel_4d(platform, dtype, _data=None):
+    """Unified 4D parallelism A/B (parallel/unified.py): pipeline + MoE
+    as shardings inside the one-launch sharded step vs the same math
+    stepped as launch islands, on the 8-device CPU mesh. The contract:
+    bit-identical loss series (layout and launch structure, never math),
+    ``launches_per_step == 1`` for the unified leg, sync parity on the
+    hot loop (zero host syncs both legs), and the unified leg at least
+    matching the island composition's step time. Runs in a subprocess
+    so the forced 8-device CPU mesh never disturbs the parent backend."""
+    del dtype  # f32 — the A/B isolates launch structure, not math
+    data = _data  # tests (already on the 8-dev mesh) measure in-process
+    if data is None:
+        env = dict(os.environ)
+        env["MXT_BENCH_DIR"] = os.path.dirname(os.path.abspath(__file__))
+        r = subprocess.run([sys.executable, "-c", _PARALLEL_4D_CODE],
+                           env=env, capture_output=True, text=True,
+                           timeout=600)
+        for line in r.stdout.splitlines():
+            if line.startswith("P4DROW "):
+                data = json.loads(line[len("P4DROW "):])
+        if data is None:
+            raise RuntimeError("parallel_4d subprocess produced no row: %s"
+                               % (r.stderr or r.stdout)[-400:])
+    speedup = (data["island_step_time_ms"] / data["unified_step_time_ms"]
+               if data["unified_step_time_ms"] else 0.0)
+    row = {
+        "config": "parallel_4d_ab", "chips": 8,
+        "batch_size": data["batch"], "dtype": "float32",
+        "platform": "cpu",  # always the virtual CPU mesh (subprocess)
+        "mesh": data["mesh"],
+        "island_step_time_ms": data["island_step_time_ms"],
+        "unified_step_time_ms": data["unified_step_time_ms"],
+        "step_time_ms": data["unified_step_time_ms"],
+        "launches_per_step": data["unified_launches_per_step"],
+        "island_launches_per_step": data["island_launches_per_step"],
+        "losses_equal": data["losses_equal"],
+        "sync_parity": (data["island_hot_loop_syncs"]
+                        == data["unified_hot_loop_syncs"]),
+        "param_bytes_per_device": data["param_bytes_per_device"],
+        "opt_bytes_per_device": data["opt_bytes_per_device"],
+        "moe_expert_load": data["moe_expert_load"],
+        "moe_router_drops": data["moe_router_drops"],
+        "unified_speedup": round(speedup, 2),
+        "images_or_tokens_per_sec_per_chip": round(
+            data["batch"] * 1e3 / data["unified_step_time_ms"] / 8, 2)
+        if data["unified_step_time_ms"] else 0.0,
+        "mfu": None, "flops_per_sample": None,
+    }
+    _emit_jsonl(row)
+    return speedup, row
+
+
 def bench_serving(platform, dtype):
     """Serving stack (mxnet_tpu/serving/): mixed-length synthetic
     traffic through the paged-KV decode engine, once under the
@@ -2321,9 +2508,9 @@ def main():
     configs = os.environ.get(
         "BENCH_CONFIGS",
         "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline,async_ab,"
-        "telemetry_ab,diag_ab,cold_warm,serving,zero_stage,embedding_ab,"
-        "serving_fleet,speculative,kv_quant,fleet_obs,streaming_input,"
-        "prefix_reuse,autoscale"
+        "telemetry_ab,diag_ab,cold_warm,serving,zero_stage,parallel_4d,"
+        "embedding_ab,serving_fleet,speculative,kv_quant,fleet_obs,"
+        "streaming_input,prefix_reuse,autoscale"
     ).split(",")
 
     # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
@@ -2353,6 +2540,9 @@ def main():
         "zero_stage": ("zero_opt_bytes_shrink",
                        "x (replicated/ZeRO-2 opt bytes per device)",
                        bench_zero_stages),
+        "parallel_4d": ("parallel_4d_unified_speedup",
+                        "x (island/unified 4D step time, bit-exact)",
+                        bench_parallel_4d),
         "embedding_ab": ("embedding_server_scaling",
                          "x (2srv/1srv embedding bytes/sec)",
                          bench_embedding_ab),
@@ -2384,10 +2574,10 @@ def main():
     best_resnet = None
     for name in ("resnet50", "bert", "lstm_ptb", "wide_deep", "lenet",
                  "pipeline", "async_ab", "telemetry_ab", "diag_ab",
-                 "cold_warm", "serving", "zero_stage", "embedding_ab",
-                 "serving_fleet", "speculative", "kv_quant",
-                 "fleet_obs", "streaming_input", "prefix_reuse",
-                 "autoscale"):
+                 "cold_warm", "serving", "zero_stage", "parallel_4d",
+                 "embedding_ab", "serving_fleet", "speculative",
+                 "kv_quant", "fleet_obs", "streaming_input",
+                 "prefix_reuse", "autoscale"):
         if name not in configs:
             continue
         cost = float(os.environ.get("BENCH_COST_%s" % name.upper(),

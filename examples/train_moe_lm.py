@@ -1,0 +1,126 @@
+"""A pp=2/ep=2 mixture-of-experts toy LM on the unified 4D mesh
+(parallel/unified.py): pipeline stages AND experts are just SHARDINGS
+inside ShardedTrainStep's single donated launch — no eager
+pipeline/MoE dispatch, and every platform feature (ZeRO, AOT warmup,
+elastic reshard, checkpoint shards) applies unchanged.
+
+Run (single host — 8 virtual CPU devices):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/train_moe_lm.py --steps 20
+
+Scale to N hosts by changing ONLY the launch line (the script reads the
+exported mesh env, zero code changes):
+
+    python tools/launch.py -n 16 --launcher ssh -H hosts \
+        --mesh 16,1,2,2 --mesh-axes dp,tp,pp,ep --zero-stage 2 \
+        python examples/train_moe_lm.py --steps 1000
+
+The model is PipelineMoEBlock: in_units -> D, two pipeline stages
+(dense + Switch-MoE FFN each, stage params stacked (S, ...) sharded
+P(pp), expert params (S, E, ...) sharded P(pp, ep)), D -> classes head.
+The microbatched schedule runs as masked ticks INSIDE the step program,
+so launches/step stays 1.0 — watch it (and the per-expert router load)
+with --telemetry + tools/mxt_top.py.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, profiler
+
+
+def pick_mesh(spec=None):
+    """The launch-line mesh when tools/launch.py exported one, else a
+    local dp×tp×pp×ep mesh sized to the visible devices (pp/ep collapse
+    to 1 when there are too few devices — same program, fewer axes)."""
+    if spec:
+        shape = tuple(int(s) for s in spec.split(","))
+        return parallel.make_mesh(shape, ("dp", "tp", "pp", "ep"))
+    if os.environ.get("MXT_MESH_SHAPE"):
+        return parallel.make_mesh()  # no-arg: the launch-line mesh
+    import jax
+
+    n = jax.device_count()
+    shape = (-1, 1, 2, 2) if n % 4 == 0 else (-1, 1, 1, 1)
+    return parallel.make_mesh(shape, ("dp", "tp", "pp", "ep"))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--hidden", type=int, default=32)
+    ap.add_argument("--stages", type=int, default=2)
+    ap.add_argument("--experts", type=int, default=2)
+    ap.add_argument("--microbatches", type=int, default=4)
+    ap.add_argument("--classes", type=int, default=16)
+    ap.add_argument("--mesh", default=None,
+                    help="local mesh shape override, e.g. 2,1,2,2 "
+                         "(axes dp,tp,pp,ep); default: launch-line "
+                         "mesh, else auto-sized to visible devices")
+    ap.add_argument("--zero-stage", type=int, default=None,
+                    choices=(0, 1, 2, 3))
+    ap.add_argument("--telemetry", action="store_true")
+    args = ap.parse_args()
+
+    if args.telemetry:
+        os.environ.setdefault("MXT_TELEMETRY_JSONL",
+                              "moe_lm_telemetry.jsonl")
+        from mxnet_tpu import telemetry
+
+        srv = telemetry.start_http_server(
+            int(os.environ.get("MXT_TELEMETRY_PORT", "9109")))
+        print("telemetry: JSONL -> %s ; live console:\n"
+              "  python tools/mxt_top.py --url http://127.0.0.1:%d"
+              % (os.environ["MXT_TELEMETRY_JSONL"],
+                 srv.server_address[1]))
+    mesh = pick_mesh(args.mesh)
+    print("mesh:", dict(mesh.shape))
+
+    mx.random.seed(7)
+    net = parallel.PipelineMoEBlock(
+        num_stages=args.stages, num_experts=args.experts,
+        in_units=args.hidden, hidden=args.hidden,
+        expert_hidden=2 * args.hidden, num_classes=args.classes,
+        num_microbatches=args.microbatches)
+    net.initialize()
+    step = parallel.ShardedTrainStep(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-2}, mesh=mesh,
+        rules=net.sharding_rules(mesh), zero_stage=args.zero_stage)
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.uniform(-1, 1, (args.batch_size, args.hidden))
+                 .astype(np.float32))
+    y = nd.array(rng.randint(0, args.classes, (args.batch_size,))
+                 .astype(np.float32))
+    loss = None
+    for i in range(args.steps):
+        loss = step(x, y)
+        if (i + 1) % 10 == 0 or i + 1 == args.steps:
+            print("step %d  loss %.4f"
+                  % (i + 1, float(loss.asscalar())))
+    # one quiet step with no host reads in between: the whole pipeline
+    # schedule + MoE dispatch + loss + backward + update is ONE launch
+    n0 = profiler.launch_count()
+    loss = step(x, y)
+    launches = profiler.launch_count() - n0
+    loss.wait_to_read()
+    moe = parallel.publish_moe_telemetry(net)
+    print("launches/step: %d" % launches)
+    print("expert load: %s  router drops: %.0f"
+          % (moe["expert_load"], moe["drops"]))
+    b = step.per_device_bytes()
+    print("per-device bytes: params %d  opt %d"
+          % (b["param_bytes"], b["opt_state_bytes"]))
+    assert launches == 1, "pipeline+MoE step must stay one launch"
+
+
+if __name__ == "__main__":
+    main()

@@ -189,14 +189,16 @@ _declare("MXT_ELASTIC", bool, False,
          "faster but cannot drop a dead peer.")
 _declare("MXT_MESH_SHAPE", str, None,
          "Comma-separated global mesh shape for no-arg "
-         "parallel.make_mesh() calls (e.g. '16,2'; one -1 wildcard "
+         "parallel.make_mesh() calls (e.g. '16,2' for dp×tp, "
+         "'2,1,2,2' for the full dp×tp×pp×ep; one -1 wildcard "
          "allowed). Exported per worker by tools/launch.py --mesh so "
          "the same training script scales from 1 host to N without "
          "code changes.")
 _declare("MXT_MESH_AXES", str, None,
          "Comma-separated mesh axis names paired with MXT_MESH_SHAPE "
-         "(default: 'data,model' truncated to the shape's rank). Set "
-         "by tools/launch.py --mesh-axes.")
+         "(default: 'data,model,pipe,expert' truncated to the shape's "
+         "rank; dp/tp/pp/ep spellings are accepted wherever an axis "
+         "role is resolved). Set by tools/launch.py --mesh-axes.")
 _declare("MXT_ZERO_STAGE", int, None,
          "Default ZeRO weight-update sharding stage (0-3) for "
          "parallel.ShardedTrainStep when the constructor doesn't pass "

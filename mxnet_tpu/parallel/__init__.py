@@ -12,6 +12,9 @@ translating worker/server push/pull. The KVStore API survives as a facade
   weight-update sharding over the data axis)
 - reshard.py: elastic in-place mesh resharding when membership fences
   a dead host (CheckpointManager shards as the transfer format)
+- unified.py: 4D composition — pipeline stages + MoE experts as
+  rule-sharded stacked params on a dp×tp×pp×ep mesh, trained by the
+  SAME one-launch ShardedTrainStep (no eager island dispatch)
 """
 from .mesh import (
     make_mesh, data_parallel_mesh, init_distributed, local_device_count,
@@ -27,6 +30,10 @@ from .sequence import (current_sequence_scope, ring_attention,
                        sequence_scope, ulysses_attention)
 from .pipeline import pipeline_apply, stack_stage_params
 from .moe import moe_apply, stack_expert_params, switch_load_balance_loss
+from .unified import (
+    PipelineMoEBlock, pipeline_moe_forward, publish_moe_telemetry,
+    moe_capacity, resolve_mesh_axis,
+)
 
 __all__ = ["make_mesh", "data_parallel_mesh", "init_distributed",
            "local_device_count", "ShardedTrainStep", "shard_params",
@@ -36,4 +43,6 @@ __all__ = ["make_mesh", "data_parallel_mesh", "init_distributed",
            "ulysses_attention", "pipeline_apply", "stack_stage_params",
            "moe_apply", "stack_expert_params",
            "switch_load_balance_loss", "sequence_scope",
-           "current_sequence_scope"]
+           "current_sequence_scope", "PipelineMoEBlock",
+           "pipeline_moe_forward", "publish_moe_telemetry",
+           "moe_capacity", "resolve_mesh_axis"]

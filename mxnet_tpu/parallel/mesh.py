@@ -57,7 +57,8 @@ def init_distributed(coordinator_address=None, num_processes=None,
             num_processes=num_processes, process_id=process_id)
 
 
-def make_mesh(shape=None, axis_names=("data", "model"), devices=None):
+def make_mesh(shape=None, axis_names=("data", "model", "pipe", "expert"),
+              devices=None):
     """Build a Mesh over the (global) device list.
 
     ``shape`` of -1 entries auto-fills like reshape; default puts every
@@ -65,6 +66,14 @@ def make_mesh(shape=None, axis_names=("data", "model"), devices=None):
     optionally ``MXT_MESH_AXES``) is consulted first — tools/launch.py
     exports it per worker from its ``--mesh`` flag, so the same training
     script scales from 1 host to N by changing only the launch line.
+
+    The default axis vocabulary is the full 4D story —
+    ``(data, model, pipe, expert)`` — and ``axis_names`` is truncated to
+    the rank of ``shape``, so ``--mesh 8`` is pure dp, ``--mesh 4,2`` is
+    dp×tp, and ``--mesh 2,1,2,2`` is dp×tp×pp×ep with no ``--mesh-axes``
+    needed. Pass MXT_MESH_AXES / ``axis_names`` to rename (the short
+    forms ``dp,tp,pp,ep`` are understood everywhere an axis role is
+    resolved — see parallel/unified.py).
 
     On a pod slice the device order from jax.devices() is ICI-contiguous,
     so adjacent mesh coordinates ride ICI rather than DCN — keep the
@@ -83,7 +92,10 @@ def make_mesh(shape=None, axis_names=("data", "model"), devices=None):
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if shape is None:
-        shape = (n,) + (1,) * (len(axis_names) - 1)
+        # No shape anywhere: everything data-parallel. Cap the implied
+        # rank at 2 so the no-arg mesh stays the classic (n, 1)
+        # data×model — extra axes appear only when a shape asks for them.
+        shape = (n,) + (1,) * (min(len(axis_names), 2) - 1)
     shape = list(shape)
     if len(shape) != len(axis_names):
         if len(shape) < len(axis_names):

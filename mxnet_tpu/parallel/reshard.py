@@ -108,10 +108,22 @@ class HostDeviceMap:
 
 def plan_survivor_mesh(mesh, lost_workers, host_map, data_axis="data"):
     """The survivor mesh after ``lost_workers`` die: every non-data axis
-    keeps its extent (tensor-parallel groups stay intact — their
-    collectives were laid out for ICI adjacency), the data axis absorbs
-    the loss. Raises typed when the surviving device count can't keep
-    the non-data axes whole. Returns None when nothing changes."""
+    keeps its extent (tp groups stay intact for ICI adjacency, the pp
+    stage count is preserved, the ep extent — and with it the expert
+    partitioning — survives; experts are REMAPPED onto the survivor
+    devices by the spill/restore), the data axis absorbs the loss.
+    ``data_axis`` accepts the synonym vocabulary (``data``/``dp``) and
+    falls back to whichever spelling the mesh actually uses. Raises
+    typed when the surviving device count can't keep the non-data axes
+    whole. Returns None when nothing changes."""
+    if data_axis not in mesh.shape:
+        from .unified import resolve_mesh_axis
+
+        resolved = resolve_mesh_axis(mesh, "dp")
+        if resolved is None:
+            raise MXNetError("mesh %s has no %r axis to shrink"
+                             % (dict(mesh.shape), data_axis))
+        data_axis = resolved
     devices = host_map.devices_for_survivors(lost_workers)
     if len(devices) == mesh.devices.size:
         return None
@@ -119,9 +131,6 @@ def plan_survivor_mesh(mesh, lost_workers, host_map, data_axis="data"):
     for ax in mesh.axis_names:
         if ax != data_axis:
             other *= mesh.shape[ax]
-    if data_axis not in mesh.shape:
-        raise MXNetError("mesh %s has no %r axis to shrink"
-                         % (dict(mesh.shape), data_axis))
     if len(devices) % other:
         raise MXNetError(
             "%d surviving devices cannot keep the non-%s axes (extent %d) "
@@ -225,11 +234,15 @@ class ElasticReshardController:
             loss = step(x, y)
     """
 
-    def __init__(self, step, host_map, data_axis="data", spill_dir=None,
+    def __init__(self, step, host_map, data_axis=None, spill_dir=None,
                  warm=True):
         self.step = step
         self.host_map = host_map
-        self.data_axis = data_axis
+        # default to the step's own resolved data axis so a 4D
+        # dp×tp×pp×ep mesh (whatever the dp axis is actually named)
+        # shrinks the right dimension without the caller spelling it
+        self.data_axis = (data_axis if data_axis is not None
+                          else getattr(step, "data_axis", "data"))
         self.spill_dir = spill_dir
         self.warm = warm
         self.events = []

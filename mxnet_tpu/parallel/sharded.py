@@ -10,9 +10,21 @@ collectives are inserted by GSPMD and ride ICI.
 
 Params live as jax arrays placed with NamedSharding; PartitionSpec rules
 (regex on parameter name) give tensor parallelism, default is replicated
-(pure data parallel). Aux states (BatchNorm running stats) are carried as
-non-differentiated inputs and returned updated — the same rebind-capture
-protocol as CachedOp (gluon/block.py — _build_cached).
+(pure data parallel). Aux states (BatchNorm running stats, MoE router
+accounting) are carried as non-differentiated inputs and returned
+updated — the same rebind-capture protocol as CachedOp (gluon/block.py —
+_build_cached).
+
+The mesh is not limited to dp×tp: the same step runs a full
+dp×tp×pp×ep mesh (``make_mesh((2, 1, 2, 2), ("dp", "tp", "pp",
+"ep"))`` or the launch line's ``--mesh 2,1,2,2 --mesh-axes
+dp,tp,pp,ep``) where pipeline stages and MoE experts are RULE-SHARDED
+stacked parameters and the schedule/routing are ordinary ops inside
+this one donated program — parallel/unified.py builds such a block;
+the step only sees more named axes. ZeRO eligibility stays a per-axis
+decision: dim 0 must divide dp AND no rule may already shard the param
+on any axis (tp/pp/ep exclusion); optimizer state for rule-sharded
+params follows the weight's own layout instead.
 
 The sharding annotations are END-TO-END (the SNIPPETS "8 chips to
 6000-chip superclusters without changing application code" pattern): the
@@ -232,8 +244,24 @@ class ShardedTrainStep:
                 "zero_stage must be 0..3 (got %r)" % (zero_stage,))
         self.zero_stage = zero_stage
         self.mesh = mesh or make_mesh(axis_names=(data_axis,))
+        if data_axis not in self.mesh.axis_names:
+            if data_axis == "data":
+                # the default name against a mesh that spells its axes
+                # differently (the 4D launch convention dp,tp,pp,ep):
+                # the FIRST mesh axis is the data axis by construction
+                # (slowest-varying — make_mesh keeps dp outermost)
+                data_axis = self.mesh.axis_names[0]
+            else:
+                raise MXNetError(
+                    "mesh has no %r axis (axes: %s)"
+                    % (data_axis, self.mesh.axis_names))
         self.data_axis = data_axis
         self._rules = rules
+        # blocks that pin internal layouts (parallel/unified.py) resolve
+        # their sharding axes against the step's LIVE mesh
+        rebind = getattr(block, "rebind_mesh", None)
+        if callable(rebind):
+            rebind(self.mesh)
         self._all_params = OrderedDict(
             sorted(block.collect_params().items()))
         for name, p in self._all_params.items():
@@ -257,22 +285,29 @@ class ShardedTrainStep:
         self._states = {}
         for n in self._train_names:
             d = self._all_params[n].data().data
-            zshard = self._zero_shardings[n]
-            if zshard is not None:
-                n_state = len(jax.eval_shape(self._init_s, d))
-                self._states[n] = jax.jit(
-                    self._init_s, out_shardings=(zshard,) * n_state)(d) \
-                    if n_state else ()
-            else:
-                self._states[n] = self._init_s(d)
+            # states materialize directly AT their storage sharding:
+            # ZeRO-eligible params at 1/dp, rule-sharded (tp/pp/ep)
+            # params matching the weight's own placement — never a
+            # replicated-then-reshard peak
+            sshard = self._state_shardings[n]
+            n_state = len(jax.eval_shape(self._init_s, d))
+            self._states[n] = jax.jit(
+                self._init_s, out_shardings=(sshard,) * n_state)(d) \
+                if n_state else ()
         # base RNG key is drawn lazily on the first step so a
         # mx.random.seed() between construction and training still takes
         # effect; per-step keys are then fold_in(base, t) ON DEVICE (a
         # host-side split per step is a separate executable launch — ~3.4ms
         # each on the axon tunnel)
         self._base_key = None
-        # device-resident step counter, carried/donated through the jit
-        self._t_dev = jnp.zeros((), jnp.int32)
+        # device-resident step counter, carried/donated through the jit.
+        # Placed mesh-replicated from birth: the jit RETURNS it that way,
+        # so an uncommitted initial value would change the argument
+        # sharding between call 0 and call 1 and force a full recompile
+        # of the step program on the second step.
+        self._t_dev = jax.device_put(
+            jnp.zeros((), jnp.int32),
+            NamedSharding(self.mesh, P()))
         self._batch_cache = {}
         self._aot_compiled = {}  # (x sig, y sig) -> compiled (see _compile)
         self._last_sig = None
@@ -294,9 +329,25 @@ class ShardedTrainStep:
         train = set(self._train_names)
         self._param_shardings = {}
         self._zero_shardings = {n: None for n in self._train_names}
+        self._state_shardings = {}
         for n, p in self._all_params.items():
             d = p.data().data
             spec = _spec_for(n, self._rules)
+            # rule validation (typed, at derivation time — not a cryptic
+            # XLA error at trace time): every named axis must exist on
+            # THIS mesh and the spec must fit the tensor's rank, else a
+            # 4D rule on a 2D mesh would silently replicate (or crash)
+            for ax in tuple(spec):
+                for a in (ax if isinstance(ax, tuple) else (ax,)):
+                    if a is not None and a not in self.mesh.axis_names:
+                        raise MXNetError(
+                            "sharding rule for %s names mesh axis %r, "
+                            "but the mesh has axes %s"
+                            % (n, a, self.mesh.axis_names))
+            if len(tuple(spec)) > d.ndim:
+                raise MXNetError(
+                    "sharding rule for %s has %d dims but the parameter "
+                    "is rank %d" % (n, len(tuple(spec)), d.ndim))
             padded = tuple(spec) + (None,) * (d.ndim - len(tuple(spec)))
             zspec = None
             if (self.zero_stage >= 1 and n in train and d.ndim >= 1
@@ -310,6 +361,14 @@ class ShardedTrainStep:
             pspec = zspec if (self.zero_stage >= 3 and zspec is not None) \
                 else spec
             self._param_shardings[n] = NamedSharding(self.mesh, pspec)
+            if n in train:
+                # optimizer state follows the UPDATE sharding when ZeRO
+                # owns the param, else the weight's own storage layout —
+                # a momentum/adam slot for a pp/ep-rule-sharded expert
+                # weight must live sharded like the weight, never
+                # silently replicated (the non-dp-axis regression)
+                self._state_shardings[n] = self._zero_shardings[n] \
+                    or self._param_shardings[n]
 
     def _batch_sharding(self, ndim):
         return NamedSharding(
@@ -348,6 +407,7 @@ class ShardedTrainStep:
     def _build(self):
         loss_fn = self._loss_for_grad()
         zero = [self._zero_shardings[n] for n in self._train_names]
+        sshard = [self._state_shardings[n] for n in self._train_names]
         wshard = [self._param_shardings[n] for n in self._train_names]
         ashard = [self._param_shardings[n] for n in self._aux_names]
         stage = self.zero_stage
@@ -378,22 +438,23 @@ class ShardedTrainStep:
                 for a, sh in zip(new_aux, ashard))
             new_train = []
             new_states = []
-            for w, g, s, z, ws in zip(train_vals, grads, states, zero,
-                                      wshard):
+            for w, g, s, z, ss, ws in zip(train_vals, grads, states,
+                                          zero, sshard, wshard):
                 if z is not None and stage >= 2:
                     # ZeRO-2/3: pin the grad to the update sharding —
                     # GSPMD fuses the dp all-reduce into reduce-scatter
                     # and each replica updates only its slice
                     g = jax.lax.with_sharding_constraint(g, z)
                 w2, s2 = self._update(w, g, s, t)
+                # optimizer state stays pinned to its STORAGE sharding
+                # across the update (ZeRO slice, or the weight's own
+                # tp/pp/ep layout); the weight returns to ITS storage
+                # (all-gather under ZeRO-1/2, stays dim-0-sharded under
+                # ZeRO-3 where ws == z)
+                s2 = tuple(
+                    jax.lax.with_sharding_constraint(si, ss)
+                    for si in s2)
                 if z is not None:
-                    # ZeRO-1+: optimizer state stays sharded across the
-                    # update; the weight returns to its STORAGE sharding
-                    # (all-gather under stages 1/2, stays dim-0-sharded
-                    # under ZeRO-3 where ws == z)
-                    s2 = tuple(
-                        jax.lax.with_sharding_constraint(si, z)
-                        for si in s2)
                     w2 = jax.lax.with_sharding_constraint(w2, ws)
                 new_train.append(w2)
                 new_states.append(s2)
@@ -684,7 +745,11 @@ class ShardedTrainStep:
             if not vals:
                 self._states[n] = ()
                 continue
-            z = self._zero_shardings[n] or replicated
+            # state storage sharding, NOT `zero or replicated`: a state
+            # for a pp/ep/tp-rule-sharded weight re-places onto the
+            # weight's layout (the old fallback silently replicated it,
+            # dp×-ing its per-device bytes on every restore)
+            z = self._state_shardings[n]
             self._states[n] = tuple(jax.device_put(vals, [z] * len(vals)))
         if key_data is not None:
             self._base_key = jax.random.wrap_key_data(
@@ -710,6 +775,11 @@ class ShardedTrainStep:
                 "rebind_mesh must keep the axis names (%s -> %s)"
                 % (self.mesh.axis_names, new_mesh.axis_names))
         self.mesh = new_mesh
+        rebind = getattr(self.block, "rebind_mesh", None)
+        if callable(rebind):
+            # mesh-aware blocks (parallel/unified.py) re-resolve their
+            # internal sharding constraints against the survivor mesh
+            rebind(new_mesh)
         self._compute_shardings()
         replicated = NamedSharding(self.mesh, P())
         if transfer:
@@ -718,7 +788,7 @@ class ShardedTrainStep:
             for n in self._train_names:
                 ss = list(self._states[n])
                 if ss:
-                    z = self._zero_shardings[n] or replicated
+                    z = self._state_shardings[n]
                     self._states[n] = tuple(
                         jax.device_put(ss, [z] * len(ss)))
             self._t_dev = jax.device_put(self._t_dev, replicated)

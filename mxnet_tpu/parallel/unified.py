@@ -1,0 +1,340 @@
+"""Unified 4D parallelism — pipeline stages + mixture-of-experts as
+SHARDINGS inside the one-launch sharded step.
+
+parallel/pipeline.py and parallel/moe.py are tested islands: each is a
+correct shard_map program on its own mesh, but they compose with
+nothing — a model that wants both pays one launch per pipeline_apply /
+moe_apply call, can't ride ZeRO, elastic reshard, the checkpoint
+protocol, or AOT warmup. This module folds both into plain GSPMD ops on
+ONE dp×tp×pp×ep mesh so :class:`~.sharded.ShardedTrainStep` runs the
+whole thing — forward through the microbatched pipeline schedule, MoE
+dispatch, loss, backward, optimizer — as its single donated jit
+(``launches_per_step == 1``).
+
+How each subsystem becomes a sharding:
+
+- **Pipeline**: stage parameters are STACKED with leading axis S and
+  rule-sharded ``P(pp)``; the GPipe schedule is python-unrolled masked
+  ticks (the PR 8 idiom — no ``lax.scan`` carries, no
+  dynamic_update_slice, no gather-of-traced-index: all three miscompile
+  under spmd-partitioning on some backends). The per-tick stage hop is
+  ``jnp.roll`` over the pp-sharded stage axis, which GSPMD lowers to a
+  collective-permute (the manual ``ppermute`` of pipeline_apply).
+  Bubble ticks compute garbage that the one-hot masked output writes
+  never read, so their gradient contribution is exactly zero.
+- **MoE**: expert parameters stack as (S, E, ...) sharded ``P(pp, ep)``;
+  capacity-factor top-1 routing (Switch-style cumsum positions, the
+  moe_apply math) runs per stage, and the dispatch/combine einsums over
+  the ep-sharded expert dim are GSPMD's all_to_all analog. Router
+  accounting (per-expert token load + over-capacity drops) accumulates
+  ON DEVICE into aux parameters carried through the donated step — the
+  BatchNorm running-stats protocol — and leaves the device only through
+  :func:`publish_moe_telemetry`, one deferred read per window.
+
+Because the schedule computes exactly the serial composition
+``stage_{S-1}(...stage_0(x_m))`` per microbatch, the unified step is
+bit-exact vs stepping the same math as separate launches — bench's
+``parallel_4d_ab`` row asserts it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..gluon.block import Block, _trace_depth
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["PipelineMoEBlock", "pipeline_moe_forward", "moe_capacity",
+           "publish_moe_telemetry", "resolve_mesh_axis"]
+
+# axis-name synonyms: the 4D launch convention is dp,tp,pp,ep
+# (tools/launch.py --mesh-axes dp,tp,pp,ep); the long-standing island
+# spellings data/model/pipe/expert keep working.
+_AXIS_SYNONYMS = {
+    "dp": ("dp", "data"),
+    "tp": ("tp", "model"),
+    "pp": ("pp", "pipe"),
+    "ep": ("ep", "expert"),
+}
+
+
+def resolve_mesh_axis(mesh, role):
+    """The mesh axis name filling ``role`` ('dp'/'tp'/'pp'/'ep'), or
+    None when the mesh has no such axis (that parallelism is off)."""
+    for cand in _AXIS_SYNONYMS[role]:
+        if cand in mesh.axis_names:
+            return cand
+    return None
+
+
+def moe_capacity(tokens, num_experts, capacity_factor):
+    """Per-expert capacity for ``tokens`` routed across ``num_experts``
+    (Switch/GShard ceil rounding — the factor always buys headroom)."""
+    return max(1, -(-int(tokens * capacity_factor) // num_experts))
+
+
+def pipeline_moe_forward(vals, x, num_microbatches, capacity_factor,
+                         mesh=None, dp=None, pp=None, ep=None):
+    """The pp×ep toy-LM forward: microbatched pipeline schedule with a
+    Switch-style MoE FFN inside every stage, as PURE jnp ops.
+
+    ``vals``: dict of parameter arrays (see :class:`PipelineMoEBlock`
+    for shapes — stage params stacked (S, ...), experts (S, E, ...)).
+    ``x``: (B, in_units) batch. Returns ``(logits, expert_load,
+    drops)`` where expert_load is the (E,) count of real tokens each
+    expert kept this pass and drops the scalar count routed over
+    capacity (bubble garbage excluded from both).
+
+    With ``mesh`` given, activations are pinned to the named axes via
+    with_sharding_constraint (the end-to-end GSPMD contract); without
+    it the same math runs on one device. BOTH bench legs call exactly
+    this function, which is what makes the island-vs-unified A/B
+    bit-exact: same ops, only launch structure differs.
+    """
+    s_stages, d, e_experts = vals["router_w"].shape
+    b = x.shape[0]
+    m = int(num_microbatches or s_stages)
+    if b % m:
+        raise MXNetError("batch %d not divisible into %d microbatches"
+                         % (b, m))
+    mb = b // m
+    capacity = moe_capacity(mb, e_experts, capacity_factor)
+
+    def cst(v, *axes):
+        if mesh is None:
+            return v
+        spec = tuple(axes) + (None,) * (v.ndim - len(axes))
+        return jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh, P(*spec)))
+
+    h = x @ vals["w_in"] + vals["b_in"]                  # (B, D)
+    x_mb = cst(h.reshape(m, mb, d), None, dp)            # (M, mb, D)
+    state = cst(jnp.zeros((s_stages, mb, d), h.dtype), pp, dp)
+    outs = cst(jnp.zeros((m, mb, d), h.dtype), None, dp)
+    load = jnp.zeros((e_experts,), h.dtype)
+    drops = jnp.zeros((), h.dtype)
+    stage0 = (np.arange(s_stages) == 0).reshape(s_stages, 1, 1)
+    last = (np.arange(s_stages) == s_stages - 1).reshape(s_stages, 1, 1)
+
+    for t in range(m + s_stages - 1):
+        # stage hop: each stage receives its predecessor's activation.
+        # roll over the pp-sharded stage axis == GSPMD collective-permute
+        # (ppermute's VJP is the reverse roll — the backward wave).
+        recv = cst(jnp.roll(state, 1, axis=0), pp, dp)
+        # feed: tick t hands microbatch t to stage 0 (static slice — the
+        # tick loop is python-unrolled, so there is no traced index to
+        # gather on); drain ticks feed zeros that nothing reads.
+        feed = x_mb[t] if t < m else jnp.zeros((mb, d), h.dtype)
+        inp = jnp.where(stage0, feed[None], recv)
+        hd = jnp.tanh(jnp.einsum("smd,sde->sme", inp, vals["stage_w"])
+                      + vals["stage_b"][:, None, :])
+        hd = cst(hd, pp, dp)
+        # --- Switch MoE inside the stage (the moe_apply math, batched
+        # over the pp-sharded stage axis) --------------------------------
+        gates = jax.nn.softmax(
+            jnp.einsum("smd,sde->sme", hd, vals["router_w"]), axis=-1)
+        onehot = jax.nn.one_hot(jnp.argmax(gates, axis=-1), e_experts,
+                                dtype=hd.dtype)            # (S, mb, E)
+        # token's position in its expert's capacity; one_hot is all-zero
+        # for positions >= capacity, which IS the over-capacity drop
+        pos = (jnp.cumsum(onehot, axis=1) - 1.0) * onehot
+        pos_oh = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), capacity,
+                                dtype=hd.dtype)            # (S, mb, C)
+        dispatch = onehot[..., :, None] * pos_oh[..., None, :]
+        dispatch = cst(dispatch, pp, dp)                   # (S, mb, E, C)
+        gate_val = (gates * onehot).sum(-1)                # (S, mb)
+        # dispatch/combine einsums over the ep-sharded expert slabs: the
+        # token movement GSPMD lowers to the all_to_all of moe_apply
+        slabs = cst(jnp.einsum("smec,smd->secd", dispatch, hd), pp, ep)
+        eh = jax.nn.relu(
+            jnp.einsum("secd,sedh->sech", slabs, vals["expert_w1"])
+            + vals["expert_b1"][:, :, None, :])
+        eo = jnp.einsum("sech,sehd->secd", eh, vals["expert_w2"]) \
+            + vals["expert_b2"][:, :, None, :]
+        eo = cst(eo, pp, ep)
+        moe = jnp.einsum("smec,secd->smd", dispatch, eo) \
+            * gate_val[..., None]
+        h2 = cst(hd + moe, pp, dp)
+        # on-device router accounting, REAL microbatches only: stage s
+        # holds microbatch t-s, which is real iff 0 <= t-s < M (bubble
+        # garbage must not pollute the load/overflow telemetry)
+        real = np.array([1.0 if 0 <= t - s < m else 0.0
+                         for s in range(s_stages)], np.float32)
+        kept = dispatch.sum(axis=(2, 3))                   # (S, mb) 0/1
+        load = load + (dispatch
+                       * real.reshape(-1, 1, 1, 1)).sum(axis=(0, 1, 3))
+        drops = drops + ((1.0 - kept) * real.reshape(-1, 1)).sum()
+        # the last stage finishes microbatch t-(S-1) at tick t: one-hot
+        # masked write (where, not .at[]/DUS — the spmd-safe store), and
+        # masked-sum extraction of the last stage's row (not h2[-1] — the
+        # slice of the pp-partitioned dim is the gather-transpose hazard)
+        out_t = jnp.sum(jnp.where(last, h2, 0.0), axis=0)  # (mb, D)
+        slot = t - (s_stages - 1)
+        if slot >= 0:
+            wmask = (np.arange(m) == slot).reshape(m, 1, 1)
+            outs = jnp.where(wmask, out_t[None], outs)
+        state = h2
+    logits = outs.reshape(b, d) @ vals["w_out"] + vals["b_out"]
+    return logits, load, drops
+
+
+class PipelineMoEBlock(Block):
+    """A pp×ep toy LM as ONE Gluon block the sharded step can own.
+
+    ``in_units -> D`` projection, then ``num_stages`` pipeline stages
+    (dense + Switch-MoE FFN with ``num_experts`` experts each), then a
+    ``D -> num_classes`` head. Stage parameters stack along a leading S
+    axis, expert parameters along (S, E) — :meth:`sharding_rules` pins
+    them to the mesh's pp/ep axes, and
+    :class:`~.sharded.ShardedTrainStep` then runs the whole schedule
+    inside its single donated jit.
+
+    Router accounting rides two ``grad_req='null'`` aux parameters
+    (``expert_load`` (E,), ``router_drops`` (1,)) that accumulate on
+    device through the donated step — zero per-step host syncs; read
+    them per window with :func:`publish_moe_telemetry`.
+
+    The block resolves its mesh axes lazily: ShardedTrainStep calls
+    :meth:`rebind_mesh` at construction AND at every elastic reshard,
+    so the sharding constraints always name the live mesh.
+    """
+
+    def __init__(self, num_stages=2, num_experts=2, in_units=8,
+                 hidden=8, expert_hidden=16, num_classes=8,
+                 num_microbatches=None, capacity_factor=1.25,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        s, e, d, hh = (int(num_stages), int(num_experts), int(hidden),
+                       int(expert_hidden))
+        self.num_stages, self.num_experts = s, e
+        self.num_microbatches = int(num_microbatches or s)
+        self.capacity_factor = float(capacity_factor)  # sync-ok: host config scalar
+        self._mesh = None
+        self._axes = {}
+        with self.name_scope():
+            g = self.params.get
+            self._p = {
+                "w_in": g("w_in", shape=(int(in_units), d)),
+                "b_in": g("b_in", shape=(d,), init="zeros"),
+                "stage_w": g("stage_w", shape=(s, d, d)),
+                "stage_b": g("stage_b", shape=(s, d), init="zeros"),
+                "router_w": g("router_w", shape=(s, d, e)),
+                "expert_w1": g("expert_w1", shape=(s, e, d, hh)),
+                "expert_b1": g("expert_b1", shape=(s, e, hh),
+                               init="zeros"),
+                "expert_w2": g("expert_w2", shape=(s, e, hh, d)),
+                "expert_b2": g("expert_b2", shape=(s, e, d),
+                               init="zeros"),
+                "w_out": g("w_out", shape=(d, int(num_classes))),
+                "b_out": g("b_out", shape=(int(num_classes),),
+                           init="zeros"),
+            }
+            self.expert_load = g("expert_load", shape=(e,),
+                                 init="zeros", grad_req="null")
+            self.router_drops = g("router_drops", shape=(1,),
+                                  init="zeros", grad_req="null")
+        # register every weight as a block ATTRIBUTE too: Block's
+        # structural walk (_collect_params_with_prefix) only sees
+        # _reg_params, and save_parameters/checkpoint spills ride that
+        # walk — a dict-only param would silently drop out of every
+        # checkpoint (and the elastic-reshard spill would restore
+        # initial weights)
+        for k, p in self._p.items():
+            setattr(self, k, p)
+
+    def param_values(self):
+        """{short_name: placed jax array} snapshot — bench/tests feed
+        these straight to :func:`pipeline_moe_forward` (the island leg
+        of the A/B starts from the very same placed initial params)."""
+        return {k: p.data().data for k, p in self._p.items()}
+
+    # -- mesh binding ---------------------------------------------------
+    def rebind_mesh(self, mesh):
+        """Resolve this block's sharding axes against ``mesh`` (called
+        by ShardedTrainStep at build and after every reshard — the
+        constraints must always name the LIVE mesh's axes)."""
+        self._mesh = mesh
+        self._axes = {r: resolve_mesh_axis(mesh, r)
+                      for r in ("dp", "pp", "ep")}
+        pp, ep = self._axes["pp"], self._axes["ep"]
+        if pp is not None and mesh.shape[pp] not in (1, self.num_stages):
+            raise MXNetError(
+                "mesh %r axis extent %d does not match %d pipeline "
+                "stages" % (pp, mesh.shape[pp], self.num_stages))
+        if ep is not None and self.num_experts % mesh.shape[ep]:
+            raise MXNetError(
+                "%d experts do not shard over %r axis extent %d"
+                % (self.num_experts, ep, mesh.shape[ep]))
+        return self
+
+    def sharding_rules(self, mesh=None):
+        """First-match rule list pinning stage params to pp and expert
+        params to (pp, ep), for ShardedTrainStep's ``rules=``."""
+        from .sharded import sharding_rule
+
+        mesh = mesh if mesh is not None else self._mesh
+        if mesh is None:
+            raise MXNetError("sharding_rules needs a mesh — pass one or "
+                             "call rebind_mesh first")
+        pp = resolve_mesh_axis(mesh, "pp")
+        ep = resolve_mesh_axis(mesh, "ep")
+        rules = []
+        if pp is not None and ep is not None:
+            rules.append((r"expert_(w1|b1|w2|b2)$", P(pp, ep)))
+        if pp is not None:
+            rules.append((r"(stage_w|stage_b|router_w)$", P(pp)))
+        return sharding_rule(*rules)
+
+    # -- forward --------------------------------------------------------
+    def forward(self, x):
+        vals = {k: p.data().data for k, p in self._p.items()}
+        data = x.data if isinstance(x, NDArray) else jnp.asarray(x)
+        # constraints only under a trace: the eager (init/debug) path
+        # runs the same math without pinning layouts
+        mesh = self._mesh if _trace_depth.depth else None
+        axes = self._axes if mesh is not None else {}
+        logits, load, drops = pipeline_moe_forward(
+            vals, data, self.num_microbatches, self.capacity_factor,
+            mesh=mesh, dp=axes.get("dp"), pp=axes.get("pp"),
+            ep=axes.get("ep"))
+        # accumulate router accounting into the carried aux params (the
+        # BatchNorm running-stats protocol: _set_data on the traced
+        # wrapper rebinds the aux output of the donated step)
+        el = self.expert_load.data()
+        el._set_data(el.data + load.astype(el.data.dtype))
+        rd = self.router_drops.data()
+        rd._set_data(rd.data + drops.reshape(1).astype(rd.data.dtype))
+        return NDArray(logits)
+
+
+def publish_moe_telemetry(block):
+    """One deferred window read of the on-device router accounting ->
+    ``mxt_moe_expert_load{expert}`` gauges + the
+    ``mxt_moe_router_drops_total`` counter. Call per telemetry window
+    (epoch end, reshard, bench teardown) — NEVER per step: the aux
+    arrays live on device and this is the one sanctioned transfer.
+    Returns ``{'expert_load': [...], 'drops': float}`` cumulative."""
+    from .. import telemetry
+
+    load = np.asarray(block.expert_load.data().data)  # sync-ok: windowed moe accounting read
+    drops = float(np.asarray(  # sync-ok: windowed moe accounting read
+        block.router_drops.data().data)[0])
+    g = telemetry.gauge(
+        "mxt_moe_expert_load",
+        "Cumulative real tokens each MoE expert kept (on-device router "
+        "accounting, read once per window).", ("expert",))
+    for i, v in enumerate(load):
+        g.labels(str(i)).set(float(v))  # sync-ok: host numpy value
+    c = telemetry.counter(
+        "mxt_moe_router_drops_total",
+        "Cumulative real tokens dropped over expert capacity.")
+    prev = getattr(block, "_moe_drops_published", 0.0)
+    if drops > prev:
+        c.inc(drops - prev)
+    block._moe_drops_published = drops
+    return {"expert_load": [float(v) for v in load],  # sync-ok: host numpy
+            "drops": drops}

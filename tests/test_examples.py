@@ -41,6 +41,10 @@ EXAMPLES = {
     "examples/serve_bert.py": [
         "--requests", "3", "--slots", "2", "--pages", "128",
         "--layers", "1", "--head-dim", "16", "--max-new", "12"],
+    # the unified 4D (dp×tp×pp×ep) pipeline+MoE step — batch must split
+    # into 4 microbatches whose slices divide dp=2
+    "examples/train_moe_lm.py": [
+        "--steps", "3", "--batch-size", "16", "--hidden", "16"],
 }
 
 

@@ -1,0 +1,241 @@
+"""Unified 4D (dp×tp×pp×ep) parallelism acceptance: pipeline stages
+and experts are SHARDINGS inside ShardedTrainStep's single donated
+launch (parallel/unified.py) — the microbatched pipeline schedule runs
+as masked ticks inside the program and Switch-MoE routing dispatches
+with capacity-factor einsums, so ``launches_per_step`` stays 1 while
+the math matches the eager island composition BIT-exactly."""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel.sharded import sharding_rule
+from mxnet_tpu.test_utils import with_seed
+
+
+def _mesh4d():
+    return parallel.make_mesh((2, 1, 2, 2), ("dp", "tp", "pp", "ep"))
+
+
+def _block(**kw):
+    cfg = dict(num_stages=2, num_experts=2, in_units=8, hidden=8,
+               expert_hidden=16, num_classes=8, num_microbatches=4)
+    cfg.update(kw)
+    net = parallel.PipelineMoEBlock(**cfg)
+    net.initialize()
+    return net
+
+
+# ---------------------------------------------------------------------------
+# acceptance: one launch, bit-exact vs the eager island composition
+# ---------------------------------------------------------------------------
+def test_unified_vs_islands_bit_exact_one_launch(monkeypatch):
+    """The A/B harness itself (bench.py parallel_4d_ab row, in-process
+    `_data=` mode like the zero_stage smoke): the unified one-launch 4D
+    step trains BIT-exactly equal to the island composition (jitted
+    fwd+bwd launch + per-param eager optimizer launches), with
+    launches_per_step == 1 and zero new host syncs on the hot path."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..",
+                              "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    monkeypatch.setenv("BENCH_4D_BATCH", "16")
+    monkeypatch.setenv("BENCH_4D_HIDDEN", "16")
+    monkeypatch.setenv("BENCH_4D_ITERS", "2")
+    # keep the smoke run out of the checked-in results file
+    monkeypatch.setattr(bench, "JSONL_PATH", os.devnull)
+    val, row = bench.bench_parallel_4d(
+        "cpu", "float32", _data=bench._parallel_4d_measure())
+    assert row["config"] == "parallel_4d_ab"
+    assert row["losses_equal"] is True
+    assert row["launches_per_step"] == 1
+    assert row["island_launches_per_step"] > 1
+    # sync parity: the unified step adds no host syncs over the islands
+    assert row["sync_parity"] is True
+    assert val > 0
+    assert row["unified_speedup"] == pytest.approx(val, abs=0.01)
+
+
+# ---------------------------------------------------------------------------
+# satellite 3 regression: ep-sharded params must not silently replicate
+# ---------------------------------------------------------------------------
+@with_seed()
+def test_expert_state_shardings_survive_save_load(tmp_path):
+    """Optimizer state of a rule-sharded expert weight stays P(pp, ep)
+    — at build, through training, and across save_states/load_states
+    (regression: the state path consulted only `_zero_shardings`, so a
+    non-ZeRO-eligible-but-rule-sharded param's adam moments silently
+    replicated, 4× the per-device bytes they should be)."""
+    mesh = _mesh4d()
+    net = _block()
+    step = parallel.ShardedTrainStep(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 0.01}, mesh=mesh,
+        rules=net.sharding_rules(mesh), zero_stage=2)
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.uniform(-1, 1, (16, 8)).astype(np.float32))
+    y = nd.array(rng.randint(0, 8, (16,)).astype(np.float32))
+    step(x, y)
+
+    name = [n for n in step._train_names if n.endswith("expert_w1")][0]
+    want = P("pp", "ep")
+    # rule-sharded → excluded from ZeRO, pinned to the rule's spec
+    assert step._zero_shardings[name] is None
+    assert step._state_shardings[name].spec == want
+    for s in step._states[name]:
+        assert s.sharding.spec == want
+    # the param itself is placed per the rule too (not replicated)
+    w = net.collect_params()[name].data().data
+    assert w.sharding.spec == want
+    assert w.addressable_shards[0].data.shape[:2] == (1, 1)
+
+    ck = str(tmp_path / "states.bin")
+    step.save_states(ck)
+    step.load_states(ck)
+    for s in step._states[name]:
+        assert s.sharding.spec == want, \
+            "expert state replicated by load_states"
+    # and a dense (non-rule) param still rides ZeRO over dp
+    dense = [n for n in step._train_names if n.endswith("w_in")][0]
+    assert step._zero_shardings[dense] is not None
+    loss = step(x, y)
+    assert np.isfinite(float(loss.asscalar()))
+
+
+# ---------------------------------------------------------------------------
+# typed validation: bad rules and mismatched meshes fail loudly
+# ---------------------------------------------------------------------------
+def test_sharding_rule_validation_typed_errors():
+    mesh = _mesh4d()
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def dense():
+        net = nn.HybridSequential(prefix="p4err_")
+        with net.name_scope():
+            net.add(nn.Dense(8, in_units=8))
+        net.initialize()
+        return net
+
+    # a rule naming an axis the mesh doesn't have is a typed error,
+    # not a silent replication
+    with pytest.raises(mx.MXNetError, match="names mesh axis"):
+        parallel.ShardedTrainStep(
+            dense(), loss_fn, "sgd", {"learning_rate": 0.1}, mesh=mesh,
+            rules=sharding_rule((r".*weight$", P("nonexistent"))))
+    # so is a rule with more dims than the parameter
+    with pytest.raises(mx.MXNetError, match="rank"):
+        parallel.ShardedTrainStep(
+            dense(), loss_fn, "sgd", {"learning_rate": 0.1}, mesh=mesh,
+            rules=sharding_rule((r".*bias$", P("pp", "ep", "dp"))))
+    # pp extent must equal the stage count (or 1)
+    mesh_pp4 = parallel.make_mesh((1, 1, 4, 2), ("dp", "tp", "pp", "ep"))
+    with pytest.raises(mx.MXNetError, match="pipeline"):
+        _block().rebind_mesh(mesh_pp4)
+    # experts must divide the ep extent
+    with pytest.raises(mx.MXNetError, match="experts"):
+        _block(num_experts=3).rebind_mesh(mesh)
+
+
+# ---------------------------------------------------------------------------
+# on-device router accounting: conservation, no per-step host syncs
+# ---------------------------------------------------------------------------
+@with_seed()
+def test_moe_accounting_conserves_tokens():
+    """Every (stage, token) routing slot is accounted exactly once:
+    sum(expert_load) + drops == stages * batch * steps. The counters
+    ride the aux-carry (grad_req='null') protocol, so the read is one
+    deferred host transfer per telemetry window, not a per-step sync."""
+    mesh = _mesh4d()
+    net = _block()
+    step = parallel.ShardedTrainStep(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.05}, mesh=mesh,
+        rules=net.sharding_rules(mesh), zero_stage=1)
+    # mesh telemetry covers the new axes (gauge iterates mesh.shape)
+    from mxnet_tpu import telemetry
+
+    fam = telemetry.registry().get("mxt_mesh_axis_size")
+    assert fam.labels("pp").value == 2
+    assert fam.labels("ep").value == 2
+    rng = np.random.RandomState(2)
+    steps, batch = 3, 16
+    x = nd.array(rng.uniform(-1, 1, (batch, 8)).astype(np.float32))
+    y = nd.array(rng.randint(0, 8, (batch,)).astype(np.float32))
+    for _ in range(steps):
+        step(x, y)
+    moe = parallel.publish_moe_telemetry(net)
+    total = sum(moe["expert_load"]) + moe["drops"]
+    assert total == net.num_stages * batch * steps
+    assert all(v >= 0 for v in moe["expert_load"])
+    # second publish in the same window: the prometheus counter only
+    # ever advances by the DELTA (no double count on re-publish)
+    from mxnet_tpu import telemetry
+
+    c0 = telemetry.registry().get("mxt_moe_router_drops_total").value
+    again = parallel.publish_moe_telemetry(net)
+    assert again["drops"] == moe["drops"]  # cumulative, unchanged
+    assert again["expert_load"] == moe["expert_load"]
+    assert telemetry.registry().get(
+        "mxt_moe_router_drops_total").value == c0
+
+
+@with_seed()
+def test_pipeline_moe_forward_batch_divisibility():
+    net = _block()
+    vals = net.param_values()
+    import jax.numpy as jnp
+
+    x = jnp.zeros((10, 8), jnp.float32)  # 10 % 4 != 0
+    with pytest.raises(mx.MXNetError, match="microbatch"):
+        parallel.pipeline_moe_forward(vals, x, 4, 1.25)
+
+
+def test_block_params_ride_structural_checkpoint_walk():
+    """Regression: every PipelineMoEBlock weight is registered as a
+    block ATTRIBUTE, not just in the internal dict — save_parameters
+    (and the elastic-reshard spill) walk _reg_params, and a dict-only
+    param silently dropped out of every checkpoint, so a reshard
+    restored INITIAL weights."""
+    net = _block()
+    walked = net._collect_params_with_prefix()
+    assert len(walked) == len(net.collect_params()) == 13
+    for k in ("w_in", "stage_w", "router_w", "expert_w1", "w_out",
+              "expert_load"):
+        assert k in walked, k
+
+
+def test_moe_capacity():
+    assert parallel.moe_capacity(8, 2, 1.0) == 4
+    assert parallel.moe_capacity(8, 2, 1.25) == 5
+    assert parallel.moe_capacity(1, 8, 1.0) == 1  # floor of 1
+
+
+# ---------------------------------------------------------------------------
+# 4-axis mesh construction defaults + axis-role synonyms
+# ---------------------------------------------------------------------------
+def test_make_mesh_4d_default_names_and_synonyms():
+    m = parallel.make_mesh((2, 1, 2, 2))
+    assert m.axis_names == ("data", "model", "pipe", "expert")
+    assert dict(m.shape) == {"data": 2, "model": 1, "pipe": 2,
+                             "expert": 2}
+    # rank-2 shapes keep the classic names; no-arg keeps (n, 1)
+    assert parallel.make_mesh((4, 2)).axis_names == ("data", "model")
+    assert dict(parallel.make_mesh().shape) == {"data": 8, "model": 1}
+    # synonyms resolve per ROLE, whatever the mesh spelled them
+    assert parallel.resolve_mesh_axis(m, "dp") == "data"
+    assert parallel.resolve_mesh_axis(m, "pp") == "pipe"
+    assert parallel.resolve_mesh_axis(m, "ep") == "expert"
+    short = parallel.make_mesh((2, 1, 2, 2), ("dp", "tp", "pp", "ep"))
+    assert parallel.resolve_mesh_axis(short, "dp") == "dp"
+    assert parallel.resolve_mesh_axis(short, "ep") == "ep"
+    two = parallel.make_mesh((4, 2), ("data", "model"))
+    assert parallel.resolve_mesh_axis(two, "pp") is None

@@ -386,6 +386,121 @@ def test_elastic_reshard_acceptance(tmp_path):
             "in-place reshard diverged from restart at %s" % n
 
 
+@pytest.mark.chaos
+@with_seed()
+def test_elastic_reshard_4d_acceptance(tmp_path):
+    """Acceptance (4D): a (2,1,2,2) dp×tp×pp×ep mesh trains the unified
+    pipeline+MoE step; the reaper fences one dp rank (seeded victim —
+    swept by tools/chaos_matrix.sh via MXT_CHAOS_SEED); survivors
+    reshard IN PLACE to (1,1,2,2) — pp preserved, experts REMAPPED onto
+    the survivor devices with unchanged local shard shapes, ZeRO
+    re-decided — and the result matches a from-checkpoint restart on
+    the survivor mesh BIT-exactly. Same interpreter isolation as
+    test_elastic_reshard_acceptance (in-place mesh rebuild on a hot XLA
+    CPU client)."""
+    if os.environ.get("MXT_RESHARD_4D_INNER") != "1":
+        env = dict(os.environ)
+        env["MXT_RESHARD_4D_INNER"] = "1"
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q", "-x",
+             "%s::test_elastic_reshard_4d_acceptance"
+             % os.path.abspath(__file__),
+             "-p", "no:cacheprovider", "-p", "no:xdist",
+             "-p", "no:randomly"],
+            env=env, timeout=600, capture_output=True, text=True)
+        assert r.returncode == 0, \
+            "isolated 4D reshard acceptance failed (rc=%d)\n%s\n%s" \
+            % (r.returncode, r.stdout[-4000:], r.stderr[-2000:])
+        return
+    spill = str(tmp_path / "reshard4d_spill")
+    victim = int(os.environ.get("MXT_CHAOS_SEED", "1")) % 2
+    rng = np.random.RandomState(4)
+    # batch 16 / 4 microbatches = 4-token slices: divide dp=2 and dp=1
+    x = rng.uniform(-1, 1, (16, 8)).astype(np.float32)
+    y = rng.randint(0, 8, (16,)).astype(np.float32)
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def build():
+        mx.random.seed(5)
+        net = parallel.PipelineMoEBlock(
+            num_stages=2, num_experts=2, in_units=8, hidden=8,
+            expert_hidden=16, num_classes=8, num_microbatches=4,
+            prefix="ers4d_")
+        net.initialize()
+        return net
+
+    # ---- path A: live run with an in-place 4D reshard ----------------
+    net_a = build()
+    mesh = parallel.make_mesh((2, 1, 2, 2), ("dp", "tp", "pp", "ep"))
+    step_a = parallel.ShardedTrainStep(
+        net_a, loss_fn, "adam", {"learning_rate": 0.01}, mesh=mesh,
+        rules=net_a.sharding_rules(mesh), zero_stage=2)
+    # 2 hosts × 4 devices: each host holds one full dp rank (a whole
+    # tp×pp×ep block), so losing a host shrinks dp 2 -> 1
+    hm = parallel.HostDeviceMap.from_mesh(mesh, 2)
+    ctrl = parallel.ElasticReshardController(step_a, hm, spill_dir=spill)
+    table = MembershipTable()
+    ctrl.attach(table)
+    gens = {w: table.register(w, now=0.0)[0] for w in range(2)}
+
+    for _ in range(3):
+        assert ctrl.maybe_reshard() is None
+        step_a(nd.array(x), nd.array(y))
+    table.heartbeat(1 - victim, gens[1 - victim], now=100.0)
+    assert table.reap(10.0, now=100.0) == [victim]
+    assert ctrl.pending == {victim}
+    event = ctrl.maybe_reshard()
+    assert event is not None
+    assert event["old_shape"] == {"dp": 2, "tp": 1, "pp": 2, "ep": 2}
+    assert event["new_shape"] == {"dp": 1, "tp": 1, "pp": 2, "ep": 2}
+    assert event["lost_workers"] == [victim]
+    assert dict(step_a.mesh.shape) == {"dp": 1, "tp": 1, "pp": 2,
+                                       "ep": 2}
+    # experts remapped onto the 4 survivor devices: sharding spec and
+    # LOCAL shard shapes unchanged (ep extent survived the shrink)
+    ew = [n for n in step_a._train_names
+          if n.endswith("expert_w1")][0]
+    d = net_a.collect_params()[ew].data().data
+    assert d.sharding.spec == P("pp", "ep")
+    assert len(d.sharding.device_set) == 4
+    assert d.addressable_shards[0].data.shape[:2] == (1, 1)
+    survivors = set(step_a.mesh.devices.reshape(-1))
+    assert {s.device for s in d.addressable_shards} <= survivors
+    # ZeRO re-decided against the SURVIVOR mesh: rule-sharded expert
+    # params stay excluded, dense params' zero shardings now name the
+    # new mesh (dp extent 1 — effectively replicated, still dp-owned)
+    assert step_a._zero_shardings[ew] is None
+    for n in step_a._train_names:
+        z = step_a._zero_shardings[n]
+        if z is not None:
+            assert z.mesh.shape == step_a.mesh.shape, n
+    # the resharded 4D program lowers ahead of the next step
+    assert step_a.aot_warmup() is True
+    for _ in range(2):
+        loss_a = step_a(nd.array(x), nd.array(y))
+    weights_a = _params_np(net_a)
+
+    # ---- path B: from-checkpoint restart on the survivor mesh --------
+    net_b = build()
+    mesh_b = parallel.plan_survivor_mesh(mesh, {victim}, hm)
+    assert dict(mesh_b.shape) == {"dp": 1, "tp": 1, "pp": 2, "ep": 2}
+    step_b = parallel.ShardedTrainStep(
+        net_b, loss_fn, "adam", {"learning_rate": 0.01}, mesh=mesh_b,
+        rules=net_b.sharding_rules(mesh_b), zero_stage=2)
+    mgr = CheckpointManager(spill, net=net_b, trainer=step_b,
+                            prefix="reshard")
+    state = mgr.resume()
+    assert state is not None and state.step == 3
+    for _ in range(2):
+        loss_b = step_b(nd.array(x), nd.array(y))
+
+    assert float(loss_a.asscalar()) == float(loss_b.asscalar())
+    for n, v in _params_np(net_b).items():
+        assert np.array_equal(v, weights_a[n]), \
+            "4D in-place reshard diverged from restart at %s" % n
+
+
 @with_seed()
 def test_reshard_controller_poll_view_and_cumulative_losses():
     """Worker-side wiring (no table attach): poll a membership view;
@@ -564,6 +679,20 @@ def test_mxt_top_mesh_section_renders_only_with_gauges():
     assert "zero=2" in frame
     assert "2.0KB" in frame and "1.5KB" in frame
     assert "reshards" in frame and "1" in frame
+    assert "moe load" not in frame  # no moe gauges -> no moe line
+
+    # the 4D mesh renders all four axes + the moe accounting line
+    samples[("mxt_mesh_axis_size", frozenset({("axis", "pipe")}))] = 2.0
+    samples[("mxt_mesh_axis_size",
+             frozenset({("axis", "expert")}))] = 2.0
+    samples[("mxt_moe_expert_load", frozenset({("expert", "0")}))] = 90.0
+    samples[("mxt_moe_expert_load", frozenset({("expert", "1")}))] = 84.0
+    samples[("mxt_moe_router_drops_total", frozenset())] = 18.0
+    frame = top.render(samples, None, 0)
+    assert "pipe=2" in frame and "expert=2" in frame
+    assert "moe load" in frame
+    assert "e0=90" in frame and "e1=84" in frame
+    assert "drops=18" in frame
 
 
 def test_mxt_top_jsonl_metrics_snapshot(tmp_path):
@@ -607,7 +736,10 @@ def test_host_sync_lint_covers_parallel_modules():
     spec.loader.exec_module(m)
     for rel in ("mxnet_tpu/parallel/mesh.py",
                 "mxnet_tpu/parallel/sharded.py",
-                "mxnet_tpu/parallel/reshard.py"):
+                "mxnet_tpu/parallel/reshard.py",
+                "mxnet_tpu/parallel/pipeline.py",
+                "mxnet_tpu/parallel/moe.py",
+                "mxnet_tpu/parallel/unified.py"):
         assert rel in m.SCAN
     root = os.path.join(os.path.dirname(__file__), "..")
     assert m.check(root) == []

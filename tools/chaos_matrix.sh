@@ -222,5 +222,30 @@ for seed in "${SEEDS[@]}"; do
     fi
 done
 
+# -- 4D elastic-reshard sweep -------------------------------------------------
+# Seeded host kill on the (2,1,2,2) dp×tp×pp×ep mesh: the chaos-marked
+# cell in tests/test_reshard.py picks the victim dp rank from
+# MXT_CHAOS_SEED, fences it via the membership reaper, and asserts the
+# survivors reshard IN PLACE to (1,1,2,2) — pipeline stages preserved,
+# experts remapped, ZeRO re-decided — finishing BIT-exact vs a
+# from-checkpoint restart with zero steps lost; the inner run is
+# already subprocess-isolated, the outer `timeout` is only the backstop.
+for seed in "${SEEDS[@]}"; do
+    echo "== 4D-reshard sweep: MXT_CHAOS_SEED=$seed (cell timeout ${CELL_TIMEOUT}s)"
+    timeout -k 10 "$CELL_TIMEOUT" env JAX_PLATFORMS=cpu \
+        MXT_CHAOS_SEED="$seed" \
+        python -m pytest tests/test_reshard.py -k elastic_reshard_4d \
+        -q -m "chaos and not slow" \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    rc=$?
+    if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+        echo "!! HANG: 4D-reshard sweep seed=$seed exceeded ${CELL_TIMEOUT}s" >&2
+        fail=1
+    elif [ "$rc" -ne 0 ]; then
+        echo "!! FAIL: 4D-reshard sweep seed=$seed rc=$rc" >&2
+        fail=1
+    fi
+done
+
 [ "$fail" -eq 0 ] && echo "chaos matrix: all seeds clean"
 exit "$fail"

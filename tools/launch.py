@@ -178,12 +178,15 @@ def main():
                     help="per-worker restart budget under --respawn")
     ap.add_argument("--mesh", default=None,
                     help="global mesh shape exported as MXT_MESH_SHAPE "
-                         "(e.g. '16,2'; one -1 wildcard allowed) — "
+                         "(e.g. '16,2' for dp×tp, '2,1,2,2' for the "
+                         "full dp×tp×pp×ep; one -1 wildcard allowed) — "
                          "workers' no-arg parallel.make_mesh() builds "
                          "this mesh over the GLOBAL device list")
     ap.add_argument("--mesh-axes", default=None,
                     help="axis names paired with --mesh (exported as "
-                         "MXT_MESH_AXES; default data,model)")
+                         "MXT_MESH_AXES; default data,model,pipe,expert "
+                         "truncated to the shape's rank — dp,tp,pp,ep "
+                         "are accepted synonyms)")
     ap.add_argument("--zero-stage", type=int, default=None,
                     choices=(0, 1, 2, 3),
                     help="default ZeRO weight-update sharding stage for "

@@ -19,10 +19,13 @@ async-training health signals once per interval:
 
 and, when a GSPMD sharded step is live (mesh gauges present):
 
-    mesh               device count, per-axis extents, ZeRO stage
+    mesh               device count, per-axis extents (all four on a
+                       dp×tp×pp×ep mesh), ZeRO stage
     per-dev bytes      param/optimizer bytes held by ONE device (the
                        memory the ZeRO-1/2/3 ladder shrinks ~dp×)
     reshards           in-place elastic mesh reshards so far
+    moe load           per-expert kept-token counts + over-capacity
+                       drops (windowed publish_moe_telemetry reads)
 
 and, when the process serves (mxnet_tpu/serving/ metrics present):
 
@@ -302,6 +305,16 @@ def render(samples, prev, dt):
             d = dict(lab)
             if "axis" in d:
                 mesh_axes.append("%s=%d" % (d["axis"], int(v)))
+    # MoE router accounting (parallel/unified.py): only rendered when a
+    # PipelineMoEBlock's windowed publish has landed — dense trainers
+    # show no expert noise
+    moe_load = []
+    for (n, lab), v in sorted(samples.items()):
+        if n == "mxt_moe_expert_load":
+            d = dict(lab)
+            if "expert" in d:
+                moe_load.append("e%s=%d" % (d["expert"], int(v)))
+    moe_drops = metric_sum(samples, "mxt_moe_router_drops_total")
 
     # diagnostics section (mxnet_tpu/diagnostics.py): only rendered
     # when the HBM ledger / goodput ledger have published — a process
@@ -514,6 +527,10 @@ def render(samples, prev, dt):
             % (_fmt_b(mesh_pbytes), _fmt_b(mesh_obytes)),
             "  reshards         %s" % _fmt(reshards, "%.0f"),
         ]
+        if moe_load:
+            lines.append(
+                "  moe load         %s   drops=%s"
+                % (" ".join(moe_load), _fmt(moe_drops, "%.0f")))
     if hbm_pools or goodput is not None:
         lines.append("-" * 46)
         for pool in sorted(hbm_pools):
