@@ -48,7 +48,8 @@ _CONFIG_COST = {"resnet50": 420, "bert": 300, "lstm_ptb": 200,
                 "embedding_ab": 90, "serving_fleet": 120,
                 "speculative": 120, "kv_quant": 90, "fleet_obs": 90,
                 "streaming_input": 90, "prefix_reuse": 120,
-                "autoscale": 150, "parallel_4d": 90}
+                "autoscale": 150, "parallel_4d": 90,
+                "training_health": 60}
 
 
 def _remaining():
@@ -1510,6 +1511,123 @@ def bench_fleet_observability(platform, dtype):
     return ratio, row
 
 
+def bench_training_health_ab(platform, dtype):
+    """training_health_ab (health.py): the SAME fused Gluon step run
+    with the per-layer training-health plane OFF and then ON. The stat
+    row (grad/param norms, update ratios, loss stats) is computed
+    INSIDE the donated step and rides the InflightWindow's staged value
+    channel, so the contract is the strongest of the observability
+    A/Bs: (a) host_syncs_per_step BIT-EQUAL both ways (parity
+    asserted-by-record), (b) per-step losses BIT-IDENTICAL (the row is
+    an extra output, never a feedback path), (c) overhead ratio
+    recorded (target >= 0.97x at accelerator scale — on a ~1ms CPU toy
+    step the extra norm outputs are a visible fraction; on a real step
+    they are noise). A third seeded leg injects a
+    ``grad_spike`` chaos fault and records that the anomaly detectors
+    fired — the end-to-end proof that the plane actually watches."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine, health, nd, profiler, resilience
+    from mxnet_tpu.gluon import Trainer, nn
+
+    del dtype  # f32: the A/B isolates instrumentation, not math
+    batch = int(os.environ.get("BENCH_HAB_BATCH", "64"))
+    hidden = int(os.environ.get("BENCH_HAB_HIDDEN", "256"))
+    iters = int(os.environ.get("BENCH_HAB_ITERS", "40"))
+    warmup = int(os.environ.get("BENCH_HAB_WARMUP", "3"))
+    window = int(os.environ.get("BENCH_HAB_INFLIGHT", "4"))
+
+    prev = {k: os.environ.get(k)
+            for k in ("MXT_HEALTH", "MXT_FAULT", "MXT_CHAOS_SEED",
+                      "MXT_HEALTH_POSTMORTEM")}
+
+    def _restore():
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        resilience.reset_faults()
+        health.reset()
+
+    def run(tag, armed, fault=None):
+        os.environ["MXT_HEALTH"] = "1" if armed else "0"
+        if fault:
+            os.environ["MXT_FAULT"] = fault
+            os.environ["MXT_CHAOS_SEED"] = "0"
+            # counting anomalies, not collecting dumps — don't litter
+            # the bench cwd with post-mortem files
+            os.environ["MXT_HEALTH_POSTMORTEM"] = "0"
+        else:
+            os.environ.pop("MXT_FAULT", None)
+        resilience.reset_faults()
+        health.reset()
+        try:
+            mx.random.seed(0)
+            net = nn.Sequential(prefix="hab_%s_" % tag)
+            with net.name_scope():
+                net.add(nn.Dense(hidden, activation="relu"),
+                        nn.Dense(hidden, activation="relu"),
+                        nn.Dense(10))
+            net.initialize()
+            tr = Trainer(net.collect_params(), "adam",
+                         {"learning_rate": 1e-3})
+            step = tr.fuse_step(net,
+                                mx.gluon.loss.SoftmaxCrossEntropyLoss())
+            rng = np.random.RandomState(0)
+            x = nd.array(rng.uniform(-1, 1,
+                                     (batch, 32)).astype(np.float32))
+            y = nd.array(rng.randint(0, 10, (batch,)).astype(np.float32))
+            losses = []
+            with engine.bulk(window):
+                for _ in range(warmup):
+                    step(x, y).wait_to_read()
+                t0 = time.perf_counter()
+                h0 = profiler.host_sync_count()
+                for _ in range(iters):
+                    losses.append(step(x, y))
+                nd.waitall()
+                dt = time.perf_counter() - t0
+                syncs = profiler.host_sync_count() - h0
+            # loss reads happen OUTSIDE the timed/sync-counted region
+            blob = b"".join(np.asarray(v.asnumpy(), dtype=np.float32)
+                            .tobytes() for v in losses)
+            anomalies = (step._health_mon.anomaly_count
+                         if getattr(step, "_health_mon", None) else 0)
+            return dt / iters * 1e3, syncs / iters, blob, anomalies
+        finally:
+            _restore()
+
+    off_ms, off_sps, off_blob, _ = run("off", False)
+    on_ms, on_sps, on_blob, _ = run("on", True)
+    spike_after = max(2, warmup)
+    _, _, _, spike_anoms = run(
+        "spike", True,
+        fault="grad_spike:layer=0,after=%d,scale=1e6,n=1" % spike_after)
+
+    overhead = on_ms / off_ms if off_ms else 0.0
+    row = {
+        "config": "training_health_ab", "chips": 1,
+        "batch_size": batch, "dtype": "float32", "platform": platform,
+        "inflight_window": window,
+        "health_off_step_time_ms": round(off_ms, 3),
+        "health_on_step_time_ms": round(on_ms, 3),
+        "host_syncs_per_step_off": round(off_sps, 3),
+        "host_syncs_per_step_on": round(on_sps, 3),
+        "sync_parity": off_sps == on_sps,  # bit-equal, not tolerance
+        "losses_equal": off_blob == on_blob,  # bit-identical streams
+        "spike_anomalies": spike_anoms,
+        "spike_detected": spike_anoms > 0,
+        "images_or_tokens_per_sec_per_chip": round(
+            batch * 1e3 / on_ms, 2) if on_ms else 0.0,
+        "mfu": None, "flops_per_sample": None,
+        "training_health_overhead": round(overhead, 4),
+    }
+    _emit_jsonl(row)
+    return overhead, row
+
+
 def bench_speculative(platform, dtype):
     """speculative_ab (serving/speculative.py): the SAME mixed-length
     traffic decoded by the plain engine and by the speculative engine
@@ -2510,7 +2628,7 @@ def main():
         "resnet50,bert,lstm_ptb,wide_deep,lenet,pipeline,async_ab,"
         "telemetry_ab,diag_ab,cold_warm,serving,zero_stage,parallel_4d,"
         "embedding_ab,serving_fleet,speculative,kv_quant,fleet_obs,"
-        "streaming_input,prefix_reuse,autoscale"
+        "streaming_input,prefix_reuse,autoscale,training_health"
     ).split(",")
 
     # headline priority: resnet50 (the SURVEY §6 headline) > bert > rest
@@ -2567,6 +2685,9 @@ def main():
         "autoscale": ("autoscale_slo_recovery",
                       "x (SLO / post-scale p99 — >=1 means recovered)",
                       bench_autoscale),
+        "training_health": ("training_health_overhead",
+                            "x (on/off step time, syncs bit-equal)",
+                            bench_training_health_ab),
     }
     headline = None
     errors = []
@@ -2577,7 +2698,7 @@ def main():
                  "cold_warm", "serving", "zero_stage", "parallel_4d",
                  "embedding_ab", "serving_fleet", "speculative",
                  "kv_quant", "fleet_obs", "streaming_input",
-                 "prefix_reuse", "autoscale"):
+                 "prefix_reuse", "autoscale", "training_health"):
         if name not in configs:
             continue
         cost = float(os.environ.get("BENCH_COST_%s" % name.upper(),

@@ -139,6 +139,13 @@ def main():
                         "serve Prometheus metrics on 127.0.0.1:9109, and "
                         "print the tools/mxt_top.py invocation to watch "
                         "the run live")
+    p.add_argument("--health", action="store_true",
+                   help="arm the training-health plane (health.py): "
+                        "per-layer grad/param norms + update ratios + "
+                        "loss stats computed INSIDE the fused step, "
+                        "anomaly detectors at window retirement, and "
+                        "the default SLO rules — zero extra host "
+                        "syncs per step")
     p.add_argument("--warmup", action="store_true",
                    help="AOT-compile the fused step before the first "
                         "batch (tuning.warmup). With MXT_COMPILE_CACHE_DIR "
@@ -187,6 +194,17 @@ def main():
               % (os.environ["MXT_TELEMETRY_JSONL"],
                  srv.server_address[1]))
 
+    if args.health:
+        # must be set BEFORE fuse_step builds: the stat row compiles
+        # into the one donated step program (MXT_HEALTH=1 equivalent)
+        os.environ["MXT_HEALTH"] = "1"
+        from mxnet_tpu import health
+
+        health.default_engine()  # seeds the standing rule set
+        print("health: armed — per-layer stats ride the inflight "
+              "window; curl /health on the telemetry port for the "
+              "rules verdict")
+
     mx.random.seed(42)
     if args.sharded:
         import jax
@@ -231,6 +249,13 @@ def main():
             print("epoch %d: mean loss %.4f"
                   % (epoch, float(np.mean([float(l.asscalar())
                                            for l in losses]))))
+        if args.health:
+            from mxnet_tpu import health
+
+            hp = health.render_health()
+            print("health: %s — loss ema %s, %d anomaly kind(s)"
+                  % (hp["status"], hp.get("loss_ema"),
+                     len(hp.get("anomalies") or {})))
         return
 
     trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
@@ -287,6 +312,16 @@ def main():
                                               locals=None))
             nd.waitall()  # barrier: land deferred flags/counters
             print("epoch %d: train acc %.4f" % (epoch, metric.get()[1]))
+
+    if args.health:
+        from mxnet_tpu import health
+
+        hp = health.render_health()
+        print("health: %s — loss ema %s, %d anomaly kind(s), "
+              "%d rule(s) evaluated"
+              % (hp["status"], hp.get("loss_ema"),
+                 len(hp.get("anomalies") or ()),
+                 len(hp.get("rules") or ())))
 
 
 if __name__ == "__main__":

@@ -67,7 +67,23 @@ def main():
     ap.add_argument("--zero-stage", type=int, default=None,
                     choices=(0, 1, 2, 3))
     ap.add_argument("--telemetry", action="store_true")
+    ap.add_argument("--health", action="store_true",
+                    help="arm the training-health plane: per-layer "
+                         "stats ride the sharded step's inflight "
+                         "window, and the MoE router gauges join the "
+                         "default rules engine (moe_router_drop_burn "
+                         "breaches while the router drops tokens)")
     args = ap.parse_args()
+
+    if args.health:
+        # before ShardedTrainStep builds: the stat row compiles into
+        # the one sharded launch (MXT_HEALTH=1 equivalent)
+        os.environ["MXT_HEALTH"] = "1"
+        from mxnet_tpu import health
+
+        health.default_engine()  # seeds rules incl. MoE router burn
+        print("health: armed — stats ride the sharded step window; "
+              "router drops feed the moe_router_drop_burn rule")
 
     if args.telemetry:
         os.environ.setdefault("MXT_TELEMETRY_JSONL",
@@ -106,6 +122,13 @@ def main():
         if (i + 1) % 10 == 0 or i + 1 == args.steps:
             print("step %d  loss %.4f"
                   % (i + 1, float(loss.asscalar())))
+            if args.health:
+                # land the router counters and take a rules sample so
+                # the burn/trend rules have history by the final report
+                from mxnet_tpu import health
+
+                parallel.publish_moe_telemetry(net)
+                health.evaluate_rules()
     # one quiet step with no host reads in between: the whole pipeline
     # schedule + MoE dispatch + loss + backward + update is ONE launch
     n0 = profiler.launch_count()
@@ -120,6 +143,22 @@ def main():
     print("per-device bytes: params %d  opt %d"
           % (b["param_bytes"], b["opt_state_bytes"]))
     assert launches == 1, "pipeline+MoE step must stay one launch"
+
+    if args.health:
+        from mxnet_tpu import health
+
+        # the publish above landed the router gauges in the registry —
+        # the rules engine now sees them alongside the training stats
+        for v in health.evaluate_rules():
+            if v["ok"] is None:
+                continue  # no data yet for this rule's metric
+            print("health rule %-22s %s  (%s)"
+                  % (v["rule"], "ok" if v["ok"] else "BREACHED",
+                     v.get("detail") or v.get("description", "")))
+        hp = health.render_health()
+        print("health: %s — loss ema %s, %d anomaly kind(s)"
+              % (hp["status"], hp.get("loss_ema"),
+                 len(hp.get("anomalies") or ())))
 
 
 if __name__ == "__main__":

@@ -172,7 +172,61 @@ _declare("MXT_FAULT", str, None,
          "crowd the autoscaler must absorb; "
          "replica_spawn_slow:ms=N makes every autoscaler-spawned spare "
          "take N ms extra to warm before it may go routable (the "
-         "router must keep serving off the existing tier meanwhile).")
+         "router must keep serving off the existing tier meanwhile); "
+         "grad_spike:layer=N,after=K[,scale=S] multiplies layer N's "
+         "gradient by S (default 1e4) ON DEVICE once the fused step's "
+         "dispatch count passes K — the seeded anomaly the training-"
+         "health detectors (health.py) must catch within one "
+         "InflightWindow retirement.")
+
+_declare("MXT_HEALTH", bool, False,
+         "Training-health plane (health.py): the fused train step "
+         "computes per-layer grad-norm / param-norm / update-ratio "
+         "stats INSIDE its one donated launch and stages them into the "
+         "async dispatch window, so K steps of stats cost the SAME one "
+         "deferred read the engine already performs (syncs/step is "
+         "bit-equal on vs off — bench training_health_ab asserts it). "
+         "Host-side detectors run at window retirement: loss-spike "
+         "(z-score vs EMA), grad-explosion/vanish, dead-layer. Read "
+         "when the fused program builds, like MXT_SKIP_NONFINITE.")
+_declare("MXT_HEALTH_SPIKE_Z", float, 6.0,
+         "Loss-spike z-score threshold: |loss - EMA| > z * stddev "
+         "(after the EMA warmup) fires a 'loss_spike' anomaly.")
+_declare("MXT_HEALTH_EXPLODE", float, 1e3,
+         "Per-layer gradient-norm ceiling: a grad L2 norm above this "
+         "(or non-finite) fires a 'grad_explosion' anomaly.")
+_declare("MXT_HEALTH_VANISH", float, 1e-8,
+         "Per-layer gradient-norm floor: a grad L2 norm below this "
+         "counts one vanish tick; MXT_HEALTH_DEAD_STEPS consecutive "
+         "ticks fire a 'dead_layer' anomaly.")
+_declare("MXT_HEALTH_DEAD_STEPS", int, 3,
+         "Consecutive vanished-gradient steps before a layer is "
+         "declared dead (health.py dead-layer detector).")
+_declare("MXT_HEALTH_EMA_DECAY", float, 0.9,
+         "EMA decay for the host-side loss mean/variance tracker the "
+         "loss-spike detector compares against.")
+_declare("MXT_HEALTH_GUARD_HOOK", bool, False,
+         "Let health anomalies join the MXT_SKIP_NONFINITE guard "
+         "bookkeeping: a grad_explosion anomaly also lands in the "
+         "skipped_nonfinite_steps counter path (host bookkeeping only "
+         "— numerics are NEVER touched by the detector; the on-device "
+         "skip remains the guard's own lax.cond).")
+_declare("MXT_HEALTH_SKEW_RATIO", float, 1.5,
+         "Fleet skew-watch straggler threshold: slowest member step "
+         "time / fleet median above this ratio reads as a straggler "
+         "verdict (health.fleet_skew over the FleetCollector's merged "
+         "registry).")
+_declare("MXT_HEALTH_DIVERGENCE", float, 0.5,
+         "Fleet skew-watch divergence threshold: a member grad-norm "
+         "fingerprint differing from the fleet median by more than "
+         "this relative fraction reads as numeric divergence (data-"
+         "parallel replicas should see near-identical global grad "
+         "norms).")
+_declare("MXT_HEALTH_POSTMORTEM", bool, True,
+         "Dump a diagnostics post-mortem on the FIRST health anomaly "
+         "of each kind (per monitor) so the flight-recorder tail "
+         "around the anomaly is preserved; 0 records events/counters "
+         "only.")
 
 _declare("MXT_MEMBERSHIP", bool, True,
          "Elastic membership for the dist kvstore (membership.py): "

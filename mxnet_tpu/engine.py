@@ -170,16 +170,21 @@ class InflightWindow:
       step, in dispatch order, decoded from a device-carried guard
       bitmask — deferred bookkeeping (update counts, loss-scale,
       skipped-step counter) lives in that callback.
-    - ``on_values`` (serving) receives ``(step_no, host_row)`` per
-      retired step, in dispatch order. Each push stages its per-step
-      device value (e.g. the decode step's sampled token ids); at
+    - ``on_values`` (serving decode, training health) receives
+      ``(step_no, host_row)`` per retired step, in dispatch order. Each
+      push stages its per-step device value (the decode step's sampled
+      token ids, or the training step's packed health stat row); at
       snapshot time the window stacks the staged values into ONE device
       array, so a single deferred transfer still retires a whole
       window's worth of steps — host_syncs/step stays <= 1/K no matter
       how much per-step data rides the window.
 
     A single push may defer flags or a value, not both (the snapshot
-    carries exactly one deferred device source).
+    carries exactly one deferred device source). The training-health
+    plane exploits that: in guard mode the stat row's LAST column packs
+    this step's non-finite bit, so the guard flag and the stats retire
+    from the SAME stacked read (health.py / gluon/train_step.py) and
+    syncs/step stays bit-equal with health on or off.
     """
 
     def __init__(self, name="step", on_flags=None, on_values=None):

@@ -130,6 +130,9 @@ class CachedTrainStep:
         self._stream = None      # engine.StepStream (async dispatch window)
         self._t_dev = None       # device-carried step count (guard mode)
         self._mask_dev = None    # device-carried flag bitmask (guard mode)
+        self._health = False     # stat row compiled into the program
+        self._health_mon = None  # health.HealthMonitor (retirement consumer)
+        self._spike = False      # grad_spike chaos rule compiled in
         self._hyper_cache = None  # (lr, wd, float(lr), float(wd))
         self._sig_recorded = False  # (x, y) signature saved for warmup
         self._hbm_published = False  # params/opt bytes in the HBM ledger
@@ -238,6 +241,16 @@ class CachedTrainStep:
         # at build time (toggling the env later needs a fresh step fn)
         self._guard = bool(_config().get("MXT_SKIP_NONFINITE"))
         guard = self._guard
+        # the health stat row and the grad_spike chaos rule compile INTO
+        # the program too (same read-at-build contract as the guard)
+        from .. import health as _health
+        from .. import resilience as _resilience
+
+        self._health = _health.enabled()
+        health = self._health
+        self._spike = _resilience.fault_point().rule("grad_spike") \
+            is not None
+        spike = self._spike
         upds = [_FusedUpdate._param_update(o, i) for i in self._indices]
         all_params = self._all_params
         train_names, aux_names = self._train_names, self._aux_names
@@ -274,17 +287,29 @@ class CachedTrainStep:
 
         if not guard:
             def step(train_vals, states, aux_vals, xv, yv, base_key, t, lr,
-                     wd, rescale):
+                     wd, rescale, spike_scale=1.0):
                 # per-step key derived on device: no host-side split launch
                 key = jax.random.fold_in(base_key, t)
                 (_, (loss_vec, new_aux, outs)), grads = jax.value_and_grad(
                     pure_loss, has_aux=True)(train_vals, aux_vals, xv, yv,
                                              key)
+                if spike:
+                    # seeded chaos: ONE layer's gradient scaled on device
+                    # (scale is 1.0 on every non-firing step)
+                    grads = _health.apply_grad_spike(grads, train_names,
+                                                     spike_scale)
                 new_train, new_states = [], []
                 for f, w, g, s in zip(upds, train_vals, grads, states):
                     w2, s2 = f(w, g, s, t, lr, wd, rescale)
                     new_train.append(w2)
                     new_states.append(s2)
+                if health:
+                    # per-layer stats packed INSIDE the program — staged
+                    # into the window, never read per step
+                    row = _health.stat_row(loss_vec, grads, train_vals,
+                                           new_train)
+                    return (loss_vec, tuple(new_train),
+                            tuple(new_states), new_aux, outs, row)
                 return (loss_vec, tuple(new_train), tuple(new_states),
                         new_aux, outs)
         else:
@@ -298,7 +323,7 @@ class CachedTrainStep:
             # bookkeeping. aux (BatchNorm stats) also roll back so a NaN
             # forward never pollutes the running statistics.
             def step(train_vals, states, aux_vals, xv, yv, base_key, t,
-                     mask, lr, wd, rescale):
+                     mask, lr, wd, rescale, spike_scale=1.0):
                 import jax.numpy as jnp
 
                 t_upd = t + 1  # the count this update applies at
@@ -306,6 +331,11 @@ class CachedTrainStep:
                 (_, (loss_vec, new_aux, outs)), grads = jax.value_and_grad(
                     pure_loss, has_aux=True)(train_vals, aux_vals, xv, yv,
                                              key)
+                if spike:
+                    # seeded chaos: ONE layer's gradient scaled on device
+                    # (scale is 1.0 on every non-firing step)
+                    grads = _health.apply_grad_spike(grads, train_names,
+                                                     spike_scale)
 
                 def _apply(_):
                     new_train, new_states = [], []
@@ -326,6 +356,13 @@ class CachedTrainStep:
                     finite, _apply, _skip, None)
                 t_new = t + jnp.where(finite, 1, 0)
                 mask_new = (mask << 1) | jnp.where(finite, 0, 1)
+                if health:
+                    # the guard bit rides the row's last column, so one
+                    # stacked read retires flags AND stats together
+                    row = _health.stat_row(loss_vec, grads, train_vals,
+                                           new_train, mask=mask_new)
+                    return (loss_vec, new_train, new_states, kept_aux,
+                            outs, t_new, mask_new, row)
                 return (loss_vec, new_train, new_states, kept_aux, outs,
                         t_new, mask_new)
 
@@ -334,9 +371,21 @@ class CachedTrainStep:
         # wrappers rebind to the outputs
         self._jit = jax.jit(step, donate_argnums=(0, 1, 2))
         from .. import engine, tuning
+        if health:
+            # stats ride the window's value channel: in guard mode the
+            # row's last column carries the guard bit, so the SAME one
+            # deferred read per K steps retires flags and stats together
+            self._health_mon = _health.HealthMonitor(
+                self._train_names, stream="fused_step",
+                guard_hook=(lambda: self._consume_flag(False))
+                if guard else None)
+            on_values = self._consume_health_row
+            on_flags = None
+        else:
+            on_values = None
+            on_flags = self._consume_flag if guard else None
         self._stream = engine.StepStream(
-            name="fused_step",
-            on_flags=self._consume_flag if guard else None)
+            name="fused_step", on_flags=on_flags, on_values=on_values)
         tuning.register_step(self)  # bare tuning.warmup() AOT-compiles us
 
     # -- per-step host path ------------------------------------------------
@@ -356,6 +405,16 @@ class CachedTrainStep:
             # dynamic loss-scale backoff driven from the same flag,
             # consumed from the trailing window
             scaler.update_scale(not finite)
+
+    def _consume_health_row(self, step_no, row):
+        """Land ONE retired step's stat row (and, in guard mode, its
+        guard bit — packed as the row's last column so the stacked
+        window read covers both) into host bookkeeping."""
+        if self._guard:
+            # bit 0 of the step's mask rode the row as 0.0/1.0 exactly
+            self._consume_flag(float(row[-1]) == 0.0)  # sync-ok: retired host row
+        if self._health_mon is not None:
+            self._health_mon.consume(step_no, row)
 
     def _reset_async(self):
         """Land every deferred flag and drop the device-carried step
@@ -572,16 +631,34 @@ class CachedTrainStep:
             # drawn lazily so mx.random.seed() between construction and
             # the first step still takes effect
             self._base_key = _random.new_key()
+        # seeded chaos: scale is 1.0 except on the one firing dispatch
+        # (jit sees the same weak-float aval either way — no retrace)
+        spike_scale = 1.0
+        if self._spike:
+            from .. import health as _health
+            spike_scale = _health.grad_spike_scale(
+                self._stream._dispatched + 1)
+        row = None
         try:
             if self._guard:
-                (loss_vec, new_w, new_s, new_aux, outs, t_new,
-                 mask_new) = self._jit(
-                    ws, ss, aux, x.data, y.data, self._base_key, t_in,
-                    mask_in, lr, wd, rescale)
+                if self._health:
+                    (loss_vec, new_w, new_s, new_aux, outs, t_new,
+                     mask_new, row) = self._jit(
+                        ws, ss, aux, x.data, y.data, self._base_key, t_in,
+                        mask_in, lr, wd, rescale, spike_scale)
+                else:
+                    (loss_vec, new_w, new_s, new_aux, outs, t_new,
+                     mask_new) = self._jit(
+                        ws, ss, aux, x.data, y.data, self._base_key, t_in,
+                        mask_in, lr, wd, rescale, spike_scale)
+            elif self._health:
+                loss_vec, new_w, new_s, new_aux, outs, row = self._jit(
+                    ws, ss, aux, x.data, y.data, self._base_key, t_in, lr,
+                    wd, rescale, spike_scale)
             else:
                 loss_vec, new_w, new_s, new_aux, outs = self._jit(
                     ws, ss, aux, x.data, y.data, self._base_key, t_in, lr,
-                    wd, rescale)
+                    wd, rescale, spike_scale)
         except Exception as e:  # noqa: BLE001 — OOM gets the HBM ledger
             from .. import diagnostics
 
@@ -601,13 +678,26 @@ class CachedTrainStep:
             if sched is not None:
                 from ..ndarray.pending import PendingValue
 
-                ok = (int(PendingValue(mask_new).get()) & 1) == 0
-                self._consume_flag(ok)
+                if row is not None:
+                    # same single read as the mask path: the row carries
+                    # the guard bit in its last column plus the stats
+                    r = PendingValue(row).get()  # sync-ok: scheduler forces per-step observe
+                    self._consume_health_row(int(t_in) + 1, r)
+                else:
+                    ok = (int(PendingValue(mask_new).get()) & 1) == 0
+                    self._consume_flag(ok)
             else:
                 # deferred: the flag lands when the engine window retires
                 # this step's token (<= 1 host read per K steps)
                 self._t_dev, self._mask_dev = t_new, mask_new
-                self._stream.push(loss_vec, flags=mask_new)
+                if row is not None:
+                    self._stream.push(loss_vec, value=row)
+                else:
+                    self._stream.push(loss_vec, flags=mask_new)
+        elif row is not None:
+            # stats stage into the window; the retirement read the token
+            # already costs covers them (bit-equal syncs/step vs off)
+            self._stream.push(loss_vec, value=row)
         else:
             # no host-consumed outputs; the token still throttles dispatch
             self._stream.push(loss_vec)

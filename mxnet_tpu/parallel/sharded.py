@@ -311,6 +311,11 @@ class ShardedTrainStep:
         self._batch_cache = {}
         self._aot_compiled = {}  # (x sig, y sig) -> compiled (see _compile)
         self._last_sig = None
+        self._ncalls = 0         # host dispatch counter (chaos timing)
+        self._stream = None      # engine.StepStream (health staging only)
+        self._health = False     # stat row compiled into the program
+        self._health_mon = None  # health.HealthMonitor (retirement consumer)
+        self._spike = False      # grad_spike chaos rule compiled in
         self._jit = self._build()
         from .. import tuning
 
@@ -412,8 +417,20 @@ class ShardedTrainStep:
         ashard = [self._param_shardings[n] for n in self._aux_names]
         stage = self.zero_stage
         replicated = NamedSharding(self.mesh, P())
+        # training-health plane: the stat row and the grad_spike chaos
+        # rule compile INTO the program at build (like the guard in the
+        # single-host step); re-read on rebind_mesh's rebuild
+        from .. import health as _health
+        from .. import resilience as _resilience
+        self._health = _health.enabled()
+        health = self._health
+        self._spike = _resilience.fault_point().rule("grad_spike") \
+            is not None
+        spike = self._spike
+        train_names = self._train_names
 
-        def step(train_vals, states, aux_vals, x, y, base_key, t):
+        def step(train_vals, states, aux_vals, x, y, base_key, t,
+                 spike_scale=1.0):
             # explicit end-to-end annotations (the GSPMD scale-out
             # contract): batch pinned to the data axis, loss replicated,
             # INSIDE the program — the same step placed on a 1-host or
@@ -428,6 +445,11 @@ class ShardedTrainStep:
             key = jax.random.fold_in(base_key, t)
             (loss, new_aux), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(train_vals, aux_vals, x, y, key)
+            if spike:
+                # seeded chaos: ONE layer's gradient scaled on device
+                # (scale is 1.0 on every non-firing step)
+                grads = _health.apply_grad_spike(grads, train_names,
+                                                 spike_scale)
             loss = jax.lax.with_sharding_constraint(loss, replicated)
             # aux (BN running stats) pinned to their STORAGE sharding:
             # without this, ZeRO's sharded states pressure the GSPMD
@@ -458,8 +480,27 @@ class ShardedTrainStep:
                     w2 = jax.lax.with_sharding_constraint(w2, ws)
                 new_train.append(w2)
                 new_states.append(s2)
+            if health:
+                # per-layer stats packed ON DEVICE, replicated like the
+                # loss: every host stages the identical small row into
+                # its window, so per-host publication needs no gather
+                row = _health.stat_row(loss, grads, train_vals,
+                                       tuple(new_train))
+                row = jax.lax.with_sharding_constraint(row, replicated)
+                return (loss, tuple(new_train), tuple(new_states),
+                        new_aux, t, row)
             return loss, tuple(new_train), tuple(new_states), new_aux, t
 
+        if health and self._stream is None:
+            from .. import engine
+
+            # health stats ride a StepStream value channel: K steps of
+            # rows cost ONE deferred read at retirement (and zero when
+            # health is off — the stream itself only exists when armed)
+            self._health_mon = _health.HealthMonitor(
+                self._train_names, stream="sharded_step")
+            self._stream = engine.StepStream(
+                name="sharded_step", on_values=self._health_mon.consume)
         # params/states keep their placement; donate them so XLA reuses the
         # buffers (the static_alloc analog); t is donated too so the step
         # counter lives on device across steps
@@ -619,9 +660,27 @@ class ShardedTrainStep:
                 "x_shape": list(sig[0][0]), "x_dtype": sig[0][1],
                 "y_shape": list(sig[1][0]), "y_dtype": sig[1][1]})
         train_vals, states, aux_vals = self._gather()
-        loss, new_train, new_states, new_aux, self._t_dev = self._jit(
-            train_vals, states, aux_vals, self._shard_batch(x),
-            self._shard_batch(y), self._ensure_key(), self._t_dev)
+        # seeded chaos: scale is 1.0 except on the one firing dispatch
+        # (same weak-float aval either way — no retrace)
+        self._ncalls += 1
+        spike_scale = 1.0
+        if self._spike:
+            from .. import health as _health
+            spike_scale = _health.grad_spike_scale(self._ncalls)
+        if self._health:
+            (loss, new_train, new_states, new_aux, self._t_dev,
+             row) = self._jit(
+                train_vals, states, aux_vals, self._shard_batch(x),
+                self._shard_batch(y), self._ensure_key(), self._t_dev,
+                spike_scale)
+            # stats stage into the window: the ONE deferred read per K
+            # steps at retirement covers them, the hot path reads nothing
+            self._stream.push(loss, value=row)
+        else:
+            loss, new_train, new_states, new_aux, self._t_dev = self._jit(
+                train_vals, states, aux_vals, self._shard_batch(x),
+                self._shard_batch(y), self._ensure_key(), self._t_dev,
+                spike_scale)
         from .. import profiler
         profiler.record_launch()
         for n, v in zip(self._train_names, new_train):

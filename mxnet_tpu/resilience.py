@@ -64,6 +64,15 @@ checkpoint writes at named points.
                                  # 250 ms extra to warm before it may
                                  # go routable (the router must keep
                                  # serving off the existing tier)
+    grad_spike:layer=0,after=3,scale=1e6,n=1  # multiply layer 0's
+                                 # gradient by 1e6 ON DEVICE once the
+                                 # fused step's dispatch count passes 3
+                                 # (the scale rides the program as a
+                                 # traced scalar, 1.0 on non-firing
+                                 # steps) — the seeded anomaly the
+                                 # training-health detectors
+                                 # (health.py) must catch within one
+                                 # InflightWindow retirement
 
 ``p`` defaults to 1.0, ``n`` (max firings) to unlimited, ``seed`` to 0.
 One injector instance lives per distinct spec string so the drawn

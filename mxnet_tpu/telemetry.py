@@ -1041,6 +1041,18 @@ def start_http_server(port=None):
                 except Exception as e:  # noqa: BLE001 — see above
                     status, ctype = 500, "text/plain; charset=utf-8"
                     body = ("fleet route error: %s" % e).encode("utf-8")
+            elif path == "/health":
+                # the training-health plane's rule verdicts + anomaly
+                # summary (200 ok / 503 degraded — the load-balancer
+                # health-check contract)
+                try:
+                    from . import health
+
+                    status, ctype, body = health.handle_health()
+                    body = body.encode("utf-8")
+                except Exception as e:  # noqa: BLE001 — see above
+                    status, ctype = 500, "text/plain; charset=utf-8"
+                    body = ("health route error: %s" % e).encode("utf-8")
             else:
                 status = 200
                 ctype = "text/plain; version=0.0.4; charset=utf-8"

@@ -219,6 +219,34 @@ class FleetRegistry:
                 seen = True
         return total if seen else None
 
+    def member_values(self, name, labels=None, include_stale=False):
+        """``{member: value}`` of a counter/gauge per (live) member —
+        the per-host view the training-health skew watch compares
+        (health.fleet_skew): unlike :meth:`merged_value` the members
+        stay separate, because a straggler only shows up as a SPREAD
+        across hosts, never in the fleet sum. Members whose export
+        lacks the family (or only has histogram children) are simply
+        absent from the dict."""
+        fam = self.get(name)
+        if fam is None or fam["kind"] == "histogram":
+            return {}
+        want = None
+        if labels is not None:
+            want = tuple(str(labels[k]) for k in fam["labelnames"])
+        out = {}
+        for member, rec in fam["members"].items():
+            if rec["stale"] and not include_stale:
+                continue
+            total, seen = 0.0, False
+            for values, v in rec["children"].items():
+                if want is not None and values != want:
+                    continue
+                total += float(v)  # sync-ok: host wire scalar
+                seen = True
+            if seen:
+                out[member] = total
+        return out
+
     # -- exposition ---------------------------------------------------------
     def render_prometheus(self):
         """The fleet page: every member's samples re-labeled with

@@ -247,5 +247,30 @@ for seed in "${SEEDS[@]}"; do
     fi
 done
 
+# -- training-health sweep ------------------------------------------------------
+# grad_spike: the chaos-marked cells in tests/test_health.py arm the
+# seeded on-device gradient perturbation (one layer, scaled 1e6 after a
+# seeded dispatch) and assert the health detectors catch it WITHIN ONE
+# InflightWindow retirement — typed health_anomaly flight-recorder
+# event, mxt_health_anomalies_total bumped, a post-mortem dumped — and
+# that with the guard hook off the training numerics equal an unwatched
+# run bit-for-bit (detection is observability, never a silent rescue);
+# bounded, never a hang; the outer `timeout` is only the backstop.
+for seed in "${SEEDS[@]}"; do
+    echo "== training-health sweep: MXT_CHAOS_SEED=$seed (cell timeout ${CELL_TIMEOUT}s)"
+    timeout -k 10 "$CELL_TIMEOUT" env JAX_PLATFORMS=cpu \
+        MXT_CHAOS_SEED="$seed" \
+        python -m pytest tests/test_health.py -q -m "chaos and not slow" \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    rc=$?
+    if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+        echo "!! HANG: training-health sweep seed=$seed exceeded ${CELL_TIMEOUT}s" >&2
+        fail=1
+    elif [ "$rc" -ne 0 ]; then
+        echo "!! FAIL: training-health sweep seed=$seed rc=$rc" >&2
+        fail=1
+    fi
+done
+
 [ "$fail" -eq 0 ] && echo "chaos matrix: all seeds clean"
 exit "$fail"

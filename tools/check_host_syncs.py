@@ -64,6 +64,14 @@ SCAN = {
     # are config scalars and already-transferred wire values, each
     # sync-ok annotated.
     "mxnet_tpu/telemetry_fleet.py": _ALL,
+    # the training-health plane: stat rows are computed ON DEVICE inside
+    # the fused step and reach the host only through the InflightWindow's
+    # deferred value channel — HealthMonitor.consume / the detectors run
+    # at window retirement over rows that are already host data, and the
+    # rules engine reads registry scalars. The annotated reads are those
+    # retired rows and host rule params; an UNMARKED read here would
+    # mean the Monitor heritage crept back in (a per-step gradient peek).
+    "mxnet_tpu/health.py": _ALL,
     "mxnet_tpu/gluon/contrib/estimator.py": _ALL,
     "mxnet_tpu/monitor.py": _TRANSFER,
     "mxnet_tpu/metric.py": [r"\.asnumpy\(", r"\.asscalar\(",

@@ -498,6 +498,25 @@ def render(samples, prev, dt):
                   "mxt_tenant_inflight_requests")
          and "tenant" in dict(lab)} - {None})
 
+    # training-health section (mxnet_tpu/health.py): only rendered when
+    # a HealthMonitor / rules engine has published — a process without
+    # the health plane armed shows no training-health noise
+    hl_ema = metric_sum(samples, "mxt_health_loss_ema")
+    hl_skew = metric_sum(samples, "mxt_health_step_skew_ratio")
+    hl_step_ms = metric_sum(samples, "mxt_health_host_step_ms")
+    hl_anoms = []  # (count, kind, layer), top-3 by count
+    hl_rules_ok, hl_rules_bad = [], []
+    for (n, lab), v in sorted(samples.items()):
+        d = dict(lab)
+        if n == "mxt_health_anomalies_total" and "kind" in d:
+            hl_anoms.append((v, d["kind"], d.get("layer", "?")))
+        elif n == "mxt_health_rule_ok" and "rule" in d:
+            (hl_rules_ok if v else hl_rules_bad).append(d["rule"])
+    hl_anoms.sort(key=lambda r: (-r[0], r[1], r[2]))
+    hl_present = (hl_ema is not None or hl_skew is not None
+                  or hl_step_ms is not None or hl_anoms
+                  or hl_rules_ok or hl_rules_bad)
+
     lines = [
         "mxt_top  %s" % time.strftime("%H:%M:%S"),
         "-" * 46,
@@ -662,6 +681,25 @@ def render(samples, prev, dt):
                 "  tenant %-9s adm %s  rej %s  pre %s  inflight %s"
                 % (t, _fmt(adm, "%.0f"), _fmt(rej, "%.0f"),
                    _fmt(pre, "%.0f"), _fmt(inflt, "%.0f")))
+    if hl_present:
+        lines += [
+            "-" * 46,
+            "  health loss ema  %s   step %s ms"
+            % (_fmt(hl_ema, "%.5g"), _fmt(hl_step_ms, "%.1f")),
+        ]
+        if hl_skew is not None:
+            lines.append("  step skew        %s" % _fmt(hl_skew, "%.2f"))
+        if hl_anoms:
+            lines.append(
+                "  anomalies        %s"
+                % "  ".join("%s:%s=%d" % (k, l, int(c))
+                            for c, k, l in hl_anoms[:3]))
+        if hl_rules_ok or hl_rules_bad:
+            lines.append(
+                "  rules            %d ok / %d breached%s"
+                % (len(hl_rules_ok), len(hl_rules_bad),
+                   ("   (" + ", ".join(sorted(hl_rules_bad)) + ")")
+                   if hl_rules_bad else ""))
     return "\n".join(lines)
 
 
